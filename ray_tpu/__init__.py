@@ -1,0 +1,48 @@
+"""ray_tpu — a TPU-native distributed computing framework.
+
+Capability set modeled on Ray (tasks, actors, objects, placement groups,
+Data/Train/Tune/Serve/RLlib-equivalent libraries) but architected for
+JAX/XLA on TPU pods: SPMD compute compiled over ICI device meshes, a
+device-lane executor that owns the chips, in-graph collectives, and
+host-side control/object planes.
+"""
+
+__version__ = "0.1.0"
+
+from .api import (  # noqa: F401
+    ActorHandle,
+    ObjectRef,
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    get_runtime_context,
+    init,
+    is_initialized,
+    kill,
+    kv_del,
+    kv_exists,
+    kv_get,
+    kv_keys,
+    kv_put,
+    method,
+    placement_group,
+    put,
+    remote,
+    remove_placement_group,
+    shutdown,
+    wait,
+)
+from ._private.exceptions import (  # noqa: F401
+    ActorDiedError,
+    ActorUnavailableError,
+    GetTimeoutError,
+    ObjectLostError,
+    OutOfMemoryError,
+    RayTpuError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+)
+from ._private.task_spec import SchedulingStrategy  # noqa: F401

@@ -1,0 +1,225 @@
+"""Actors: stateful remote workers.
+
+Capability parity target: /root/reference/python/ray/actor.py
+(ActorClass:544 — options/remote; ActorHandle:1192 — method dispatch,
+serializable handles; named actors via get_actor). TPU-native addition:
+actors with ``num_tpus > 0`` (or ``scheduling_strategy="device"``) are
+**device actors** hosted in the node-owner process on dedicated threads, so
+their state can hold live jax arrays / compiled functions and method calls
+pay no serialization — the building block for Learner/Trainer gangs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+from . import context as context_mod
+from .ids import ActorID, TaskID
+from .object_ref import ObjectRef
+from .remote_function import encode_args
+from .task_spec import SchedulingStrategy, TaskSpec
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._method_name = name
+        self._num_returns = num_returns
+
+    def options(self, num_returns=None, **_):
+        return ActorMethod(self._handle, self._method_name,
+                           num_returns or self._num_returns)
+
+    def remote(self, *args, **kwargs):
+        h = self._handle
+        ctx = context_mod.require_context()
+        enc_args, enc_kwargs = encode_args(args, kwargs, h._is_device)
+        spec = TaskSpec(
+            task_id=TaskID.for_actor_task(h._actor_id),
+            name=f"{h._class_name}.{self._method_name}",
+            func_id="",
+            args=enc_args,
+            kwargs=enc_kwargs,
+            num_returns=self._num_returns,
+            resources={"CPU": 0.0},
+            strategy=SchedulingStrategy(kind="device" if h._is_device else "default"),
+            actor_id=h._actor_id,
+            method_name=self._method_name,
+        )
+        refs = ctx.submit_spec(spec)
+        return refs[0] if self._num_returns == 1 else refs
+
+    def __call__(self, *a, **k):
+        raise TypeError("Actor methods must be invoked with '.remote(...)'.")
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, method_names: list[str],
+                 class_name: str = "Actor", is_device: bool = False,
+                 creation_ref: ObjectRef | None = None):
+        self._actor_id = actor_id
+        self._method_names = list(method_names)
+        self._class_name = class_name
+        self._is_device = is_device
+        # Resolving this ref (or calling any method) observes creation errors.
+        self._creation_ref = creation_ref
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if self._method_names and name not in self._method_names:
+            raise AttributeError(
+                f"actor {self._class_name} has no remote method '{name}'"
+            )
+        nret = 1
+        meta = _method_meta.get((self._class_name, name))
+        if meta:
+            nret = meta.get("num_returns", 1)
+        return ActorMethod(self, name, nret)
+
+    def _ready(self):
+        """Block until the actor finished __init__ (raises on failure)."""
+        if self._creation_ref is not None:
+            context_mod.require_context().get(self._creation_ref)
+        return self
+
+    def __reduce__(self):
+        return (
+            _rebuild_handle,
+            (self._actor_id.binary(), self._method_names, self._class_name,
+             self._is_device),
+        )
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
+
+
+def _rebuild_handle(actor_bin, method_names, class_name, is_device):
+    return ActorHandle(ActorID(actor_bin), method_names, class_name, is_device)
+
+
+# (class_name, method) -> metadata from @method decorator.
+_method_meta: dict[tuple, dict] = {}
+
+
+def method(num_returns=1):
+    """Decorator configuring an actor method (parity: ray.method)."""
+
+    def deco(fn):
+        fn.__rt_method_meta__ = {"num_returns": num_returns}
+        return fn
+
+    return deco
+
+
+class ActorClass:
+    def __init__(self, cls, *, num_cpus=None, num_tpus=None, resources=None,
+                 max_restarts=0, max_concurrency=1, scheduling_strategy=None,
+                 name=None, lifetime=None):
+        self._cls = cls
+        self._class_name = cls.__name__
+        res = dict(resources or {})
+        if num_cpus is not None:
+            res["CPU"] = float(num_cpus)
+        if num_tpus is not None and num_tpus > 0:
+            res["TPU"] = float(num_tpus)
+        res.setdefault("CPU", 0.0 if res.get("TPU") else 1.0)
+        self._resources = res
+        self._max_restarts = max_restarts
+        self._max_concurrency = max_concurrency
+        if isinstance(scheduling_strategy, str):
+            scheduling_strategy = SchedulingStrategy(kind=scheduling_strategy)
+        self._strategy = scheduling_strategy or SchedulingStrategy()
+        self._name = name
+        self._export_cache: tuple | None = None
+        for mname in self._method_names():
+            m = getattr(cls, mname)
+            meta = getattr(m, "__rt_method_meta__", None)
+            if meta:
+                _method_meta[(self._class_name, mname)] = meta
+        functools.update_wrapper(self, cls, updated=[])
+
+    def _method_names(self) -> list[str]:
+        return [
+            n for n in dir(self._cls)
+            if not n.startswith("_") and callable(getattr(self._cls, n, None))
+        ]
+
+    def options(self, **overrides) -> "ActorClass":
+        merged = dict(
+            resources=dict(self._resources),
+            max_restarts=self._max_restarts,
+            max_concurrency=self._max_concurrency,
+            scheduling_strategy=self._strategy,
+            name=self._name,
+        )
+        if "num_cpus" in overrides:
+            merged["resources"]["CPU"] = float(overrides.pop("num_cpus"))
+        if "num_tpus" in overrides:
+            merged["resources"]["TPU"] = float(overrides.pop("num_tpus"))
+        if "scheduling_strategy" in overrides:
+            s = overrides.pop("scheduling_strategy")
+            merged["scheduling_strategy"] = (
+                SchedulingStrategy(kind=s) if isinstance(s, str) else s
+            )
+        overrides.pop("lifetime", None)
+        merged.update(overrides)
+        return ActorClass(self._cls, **merged)
+
+    def _device_lane(self) -> bool:
+        return (
+            self._strategy.kind == "device"
+            or self._resources.get("TPU", 0) > 0
+            or self._resources.get("device", 0) > 0
+        )
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        ctx = context_mod.get_context()
+        if ctx is None:
+            from ..api import init
+
+            init()
+            ctx = context_mod.require_context()
+        if self._export_cache and self._export_cache[0] is ctx:
+            fid = self._export_cache[1]
+        else:
+            fid = ctx.export_function(self._cls)
+            self._export_cache = (ctx, fid)
+        device = self._device_lane()
+        enc_args, enc_kwargs = encode_args(args, kwargs, device)
+        actor_id = ActorID.of(ctx.job_id)
+        method_names = self._method_names()
+        spec = TaskSpec(
+            task_id=TaskID.for_actor_task(actor_id),
+            name=f"{self._class_name}.__init__",
+            func_id=fid,
+            args=enc_args,
+            kwargs=enc_kwargs,
+            num_returns=1,
+            resources=dict(self._resources),
+            strategy=SchedulingStrategy(kind="device") if device else self._strategy,
+            actor_id=actor_id,
+            is_actor_creation=True,
+            max_concurrency=self._max_concurrency,
+            max_restarts=self._max_restarts,
+            actor_name=self._name,
+            runtime_env={"methods": method_names},
+        )
+        refs = ctx.submit_spec(spec)
+        return ActorHandle(actor_id, method_names, self._class_name, device,
+                           creation_ref=refs[0])
+
+    def __call__(self, *a, **k):
+        raise TypeError(
+            f"Actor class {self._class_name} cannot be instantiated directly; "
+            f"use '{self._class_name}.remote(...)'."
+        )
+
+
+def get_actor(name: str) -> ActorHandle:
+    ctx = context_mod.require_context()
+    info = ctx.get_actor_by_name(name)
+    if info is None:
+        raise ValueError(f"no actor named '{name}'")
+    return ActorHandle(ActorID(info["actor_id"]), info["methods"], name)

@@ -1,0 +1,98 @@
+"""Single-source config/flag system.
+
+Capability parity target: the reference's RAY_CONFIG single definition file
+(/root/reference/src/ray/common/ray_config_def.h, ~220 entries materialized
+into a singleton overridable via env vars and `init(_system_config=...)`).
+
+Every tunable of this framework is declared here once via `_cfg`. Values
+resolve in priority order:
+  1. `init(system_config={...})` overrides,
+  2. `RT_<NAME>` environment variables,
+  3. the declared default.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field, fields
+from typing import Any
+
+_ENV_PREFIX = "RT_"
+
+
+def _coerce(value: str, typ: type) -> Any:
+    if typ is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    return typ(value)
+
+
+def _cfg(default):
+    return field(default=default)
+
+
+@dataclass
+class Config:
+    # --- object store ---
+    object_store_memory_mb: int = _cfg(2048)
+    # Objects smaller than this are inlined into task replies / the in-process
+    # memory store instead of the shared-memory store (reference:
+    # max_direct_call_object_size in ray_config_def.h).
+    max_inline_object_size: int = _cfg(100 * 1024)
+    object_spill_dir: str = _cfg("/tmp/ray_tpu_spill")
+    object_store_eviction_fraction: float = _cfg(0.8)
+
+    # --- scheduling ---
+    # Pack below this node-utilization score, spread above (reference:
+    # scheduler_spread_threshold, hybrid_scheduling_policy.h).
+    scheduler_spread_threshold: float = _cfg(0.5)
+    worker_lease_timeout_s: float = _cfg(30.0)
+    max_pending_lease_requests_per_scheduling_class: int = _cfg(10)
+
+    # --- workers ---
+    num_cpu_workers_prestart: int = _cfg(0)
+    worker_register_timeout_s: float = _cfg(30.0)
+    worker_startup_timeout_s: float = _cfg(60.0)
+    idle_worker_kill_timeout_s: float = _cfg(300.0)
+    max_cpu_workers: int = _cfg(64)
+
+    # --- fault tolerance ---
+    task_max_retries: int = _cfg(3)
+    actor_max_restarts: int = _cfg(0)
+    health_check_period_s: float = _cfg(1.0)
+    health_check_failure_threshold: int = _cfg(5)
+    # Max bytes of lineage (task specs kept for object reconstruction) per
+    # owner (reference: max_lineage_bytes, task_manager.h).
+    max_lineage_bytes: int = _cfg(100 * 1024 * 1024)
+
+    # --- control plane ---
+    controller_port: int = _cfg(0)  # 0 = unix socket only
+    pubsub_poll_timeout_s: float = _cfg(60.0)
+    kv_max_value_bytes: int = _cfg(512 * 1024 * 1024)
+
+    # --- metrics / events ---
+    metrics_export_interval_s: float = _cfg(5.0)
+    task_events_buffer_size: int = _cfg(100_000)
+
+    # --- tpu ---
+    tpu_chips_per_host: int = _cfg(0)  # 0 = autodetect
+    # Mesh axis names used throughout the parallel layer.
+    mesh_axis_order: str = _cfg("dp,fsdp,sp,tp")
+
+    def apply_overrides(self, overrides: dict | None = None):
+        for f in fields(self):
+            env = os.environ.get(_ENV_PREFIX + f.name.upper())
+            if env is not None:
+                setattr(self, f.name, _coerce(env, type(getattr(self, f.name))))
+        if overrides:
+            for k, v in overrides.items():
+                if not hasattr(self, k):
+                    raise ValueError(f"Unknown system config key: {k}")
+                setattr(self, k, v)
+        return self
+
+
+GLOBAL_CONFIG = Config().apply_overrides()
+
+
+def get_config() -> Config:
+    return GLOBAL_CONFIG

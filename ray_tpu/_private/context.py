@@ -1,0 +1,74 @@
+"""Execution-context indirection.
+
+Every process hosting framework code has exactly one context:
+
+  * the driver process — a ``Runtime`` (owns the node service, scheduler,
+    device executor and object directory), or
+  * a worker subprocess — a ``WorkerContext`` (duplex RPC client back to the
+    node service + direct shared-memory reads).
+
+The public API (``ray_tpu.get/put/remote/...``) dispatches through
+``get_context()`` so the same user code runs unchanged on the driver and
+inside tasks/actors — mirroring how the reference embeds a core worker in
+every process (/root/reference/src/ray/core_worker/core_worker_process.h).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+_context = None
+
+
+def get_context():
+    return _context
+
+
+def set_context(ctx) -> None:
+    global _context
+    _context = ctx
+
+
+def require_context():
+    if _context is None:
+        raise RuntimeError(
+            "ray_tpu has not been initialized in this process — call ray_tpu.init() first."
+        )
+    return _context
+
+
+class RuntimeContext:
+    """User-visible runtime context (``ray_tpu.get_runtime_context()``),
+    parity with /root/reference/python/ray/runtime_context.py."""
+
+    def __init__(self, ctx):
+        self._ctx = ctx
+
+    @property
+    def job_id(self):
+        return self._ctx.job_id
+
+    @property
+    def node_id(self):
+        return self._ctx.node_id
+
+    @property
+    def worker_id(self):
+        return self._ctx.worker_id
+
+    @property
+    def task_id(self):
+        return getattr(self._ctx, "current_task_id", None)
+
+    @property
+    def actor_id(self):
+        return getattr(self._ctx, "current_actor_id", None)
+
+    def get(self) -> dict:
+        return {
+            "job_id": self.job_id,
+            "node_id": self.node_id,
+            "worker_id": self.worker_id,
+            "task_id": self.task_id,
+            "actor_id": self.actor_id,
+        }

@@ -1,0 +1,937 @@
+"""Node service: scheduler, worker pool, object directory, actor manager.
+
+This is the per-node brain, the moral equivalent of the reference's raylet
+(/root/reference/src/ray/raylet/node_manager.h:125 — dispatch loop,
+worker_pool.h:156 — worker leasing/forking) fused with the owner-side task
+manager (/root/reference/src/ray/core_worker/task_manager.h:195 — retries,
+lineage) and, in round 1, the head-node control plane
+(/root/reference/src/ray/gcs/gcs_server/gcs_server.h:78 — actor FSM, KV,
+named actors). All state is owned by a single asyncio event loop.
+
+TPU-native design choice: compute that touches the TPU runs on the
+**device executor** — thread pools *inside the process that owns the chips*
+(JAX requires a single process per host to own the local devices; forked
+subprocesses cannot share them). CPU-only tasks go to forked worker
+subprocesses, like the reference. So a node has two lanes:
+
+    device lane:  in-process ThreadPoolExecutor(s); zero-serialization
+                  results (python objects stay in the memory store)
+    cpu lane:     subprocess workers leased per task; results ride the
+                  shared-memory store (large) or inline bytes (small)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import os
+import subprocess
+import sys
+import time
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import cloudpickle
+
+from . import serialization
+from .config import get_config
+from .exceptions import (
+    ActorDiedError,
+    ObjectLostError,
+    TaskCancelledError,
+    TaskError,
+    WorkerCrashedError,
+)
+from .ids import ActorID, ObjectID, PlacementGroupID, TaskID, WorkerID
+from .object_store import SharedMemoryStore
+from .rpc import ConnectionLost, DuplexServer, ServerConn
+from .task_spec import REF, VAL, SchedulingStrategy, TaskSpec
+
+PENDING, READY, ERROR = "PENDING", "READY", "ERROR"
+
+
+@dataclass
+class ObjectState:
+    status: str = PENDING
+    # location: "memory" (python object or bytes in-process) | "shm"
+    location: str = "memory"
+    value: Any = None  # ("obj", x) | ("bytes", b) | None
+    error: Optional[TaskError] = None
+    size: int = 0
+    refcount: int = 0
+    waiters: list = field(default_factory=list)  # asyncio.Future
+    creating_spec: Optional[TaskSpec] = None  # lineage (reconstruction)
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: WorkerID
+    proc: subprocess.Popen
+    conn: Optional[ServerConn] = None
+    state: str = "STARTING"  # STARTING/IDLE/BUSY/DEAD
+    inflight: dict = field(default_factory=dict)  # TaskID -> TaskSpec
+    actor_id: Optional[ActorID] = None
+    last_idle: float = field(default_factory=time.monotonic)
+    registered: Optional[asyncio.Future] = None
+
+
+@dataclass
+class ActorState:
+    actor_id: ActorID
+    creation_spec: TaskSpec
+    state: str = "PENDING"  # PENDING/ALIVE/RESTARTING/DEAD
+    is_device: bool = False
+    worker: Optional[WorkerHandle] = None
+    device_pool: Optional[ThreadPoolExecutor] = None
+    instance: Any = None  # device actors: the live python object
+    queue: collections.deque = field(default_factory=collections.deque)
+    inflight: int = 0
+    num_restarts: int = 0
+    name: Optional[str] = None
+    death_cause: Optional[str] = None
+    ready_fut: Optional[asyncio.Future] = None
+
+
+@dataclass
+class PlacementGroup:
+    pg_id: PlacementGroupID
+    bundles: list  # list[dict resource->amount]
+    strategy: str = "PACK"
+    state: str = "CREATED"
+
+
+class NodeService:
+    """Single-node scheduler + object directory + actor manager + KV."""
+
+    def __init__(self, session_id: str, sock_path: str, resources: dict,
+                 shm_store: SharedMemoryStore, loop: asyncio.AbstractEventLoop):
+        self.cfg = get_config()
+        self.session_id = session_id
+        self.sock_path = sock_path
+        self.loop = loop
+        self.shm = shm_store
+        self.total_resources = dict(resources)
+        self.available = dict(resources)
+
+        self.objects: dict[ObjectID, ObjectState] = {}
+        self.kv: dict[str, bytes] = {}
+        self.functions: dict[str, bytes] = {}
+        self._fn_cache: dict[str, Any] = {}  # deserialized, device lane only
+
+        self.workers: dict[WorkerID, WorkerHandle] = {}
+        self.idle_workers: collections.deque[WorkerHandle] = collections.deque()
+        self.pending_cpu: collections.deque[TaskSpec] = collections.deque()
+        self.cancelled: set[TaskID] = set()
+
+        self.actors: dict[ActorID, ActorState] = {}
+        self.named_actors: dict[str, ActorID] = {}
+
+        self.placement_groups: dict[PlacementGroupID, PlacementGroup] = {}
+
+        # Device lane: tasks with TPU resources (or strategy "device").
+        self.device_pool = ThreadPoolExecutor(
+            max_workers=int(os.environ.get("RT_DEVICE_POOL_THREADS", "4")),
+            thread_name_prefix="device-exec",
+        )
+        self.server = DuplexServer(sock_path, self._handle_rpc, self._on_disconnect)
+        self._closing = False
+        # metrics / introspection counters
+        self.counters = collections.Counter()
+        self.task_events: collections.deque = collections.deque(
+            maxlen=self.cfg.task_events_buffer_size
+        )
+
+    async def start(self):
+        await self.server.start()
+
+    # ------------------------------------------------------------------
+    # Object directory
+    # ------------------------------------------------------------------
+    def _obj(self, oid: ObjectID) -> ObjectState:
+        st = self.objects.get(oid)
+        if st is None:
+            st = self.objects[oid] = ObjectState()
+        return st
+
+    def mark_ready_value(self, oid: ObjectID, value: Any):
+        """Device-lane result: keep the live python object (no serialization)."""
+        st = self._obj(oid)
+        st.status, st.location, st.value = READY, "memory", ("obj", value)
+        self._wake(oid, st)
+
+    def mark_ready_bytes(self, oid: ObjectID, blob: bytes):
+        st = self._obj(oid)
+        st.status, st.location, st.value = READY, "memory", ("bytes", blob)
+        st.size = len(blob)
+        self._wake(oid, st)
+
+    def mark_ready_shm(self, oid: ObjectID, size: int):
+        st = self._obj(oid)
+        st.status, st.location, st.value = READY, "shm", None
+        st.size = size
+        self._wake(oid, st)
+
+    def mark_error(self, oid: ObjectID, err: TaskError):
+        st = self._obj(oid)
+        st.status, st.error = ERROR, err
+        self._wake(oid, st)
+
+    def _wake(self, oid: ObjectID, st: ObjectState):
+        for fut in st.waiters:
+            if not fut.done():
+                fut.set_result(None)
+        st.waiters.clear()
+        self._kick()
+        # A ref dropped while the object was still pending: free on arrival.
+        self._maybe_free(oid, st)
+
+    async def wait_object(self, oid: ObjectID, timeout: float | None = None) -> ObjectState:
+        st = self._obj(oid)
+        if st.status == PENDING:
+            fut = self.loop.create_future()
+            st.waiters.append(fut)
+            if timeout is None:
+                await fut
+            else:
+                try:
+                    await asyncio.wait_for(fut, timeout)
+                except asyncio.TimeoutError:
+                    pass
+        return st
+
+    def incref(self, oid: ObjectID, n: int = 1):
+        self._obj(oid).refcount += n
+
+    def decref(self, oid: ObjectID, n: int = 1):
+        st = self.objects.get(oid)
+        if st is None:
+            return
+        st.refcount -= n
+        self._maybe_free(oid, st)
+
+    def _maybe_free(self, oid: ObjectID, st: ObjectState):
+        if st.refcount <= 0 and st.status != PENDING and not st.waiters:
+            self.objects.pop(oid, None)
+            if st.location == "shm":
+                self.shm.delete(oid)
+
+    def materialize_for_ipc(self, oid: ObjectID) -> tuple:
+        """Return ("bytes", blob) | ("shm",) | ("err", e) for a READY object,
+        serializing device-lane python objects on demand."""
+        st = self.objects[oid]
+        if st.status == ERROR:
+            return ("err", st.error)
+        if st.location == "shm":
+            return ("shm",)
+        kind, val = st.value
+        if kind == "bytes":
+            blob = val
+        else:
+            blob = serialization.serialize(val)
+        if len(blob) > self.cfg.max_inline_object_size:
+            self.shm.put(oid, blob)
+            st.location, st.value, st.size = "shm", None, len(blob)
+            return ("shm",)
+        return ("bytes", blob)
+
+    def value_in_process(self, oid: ObjectID):
+        """Deserialize (or fetch) a READY object into a python value; device
+        lane fast path."""
+        st = self.objects[oid]
+        if st.status == ERROR:
+            raise st.error
+        if st.location == "shm":
+            mv = self.shm.get(oid)
+            if mv is None:
+                raise ObjectLostError(f"object {oid.hex()[:16]} missing from store")
+            val = serialization.deserialize(mv)
+            return val
+        kind, val = st.value
+        if kind == "bytes":
+            obj = serialization.deserialize(val)
+            st.value = ("obj", obj)
+            return obj
+        return val
+
+    # ------------------------------------------------------------------
+    # Task submission & scheduling
+    # ------------------------------------------------------------------
+    def submit(self, spec: TaskSpec) -> list[ObjectID]:
+        """Register returns + enqueue. Loop thread only."""
+        rids = spec.return_ids()
+        for rid in rids:
+            st = self._obj(rid)
+            st.creating_spec = spec
+            st.refcount += 1  # submitter's implicit ref, released by ObjectRef
+        # Pin args until the task reaches a terminal state (reference:
+        # task-argument pinning in the raylet's DependencyManager).
+        for dep in spec.dependencies():
+            self.incref(dep)
+        self.counters["tasks_submitted"] += 1
+        self.task_events.append(
+            {"task_id": spec.task_id.hex(), "name": spec.name, "state": "SUBMITTED",
+             "ts": time.time()}
+        )
+        if spec.is_actor_creation:
+            self.loop.create_task(self._create_actor(spec))
+        elif spec.actor_id is not None:
+            self._submit_actor_task(spec)
+        else:
+            self.pending_cpu.append(spec)
+            self._kick()
+        return rids
+
+    def _kick(self):
+        if not self._closing:
+            self.loop.call_soon(self._dispatch)
+
+    def _deps_ready(self, spec: TaskSpec) -> bool:
+        """True if all deps are terminal. Raises the dep's error if any dep
+        failed — errors propagate through the task graph (reference:
+        dependency failures poison downstream tasks)."""
+        for dep in spec.dependencies():
+            st = self._obj(dep)
+            if st.status == ERROR:
+                raise st.error
+            if st.status == PENDING:
+                # _wake() on any object completion re-kicks the dispatcher,
+                # so parking needs no per-spec waiter future.
+                return False
+        return True
+
+    def _is_device_task(self, spec: TaskSpec) -> bool:
+        return (
+            spec.strategy.kind == "device"
+            or spec.resources.get("TPU", 0) > 0
+            or spec.resources.get("device", 0) > 0
+        )
+
+    def _dispatch(self):
+        if self._closing:
+            return
+        still_pending = collections.deque()
+        while self.pending_cpu:
+            spec = self.pending_cpu.popleft()
+            if spec.task_id in self.cancelled:
+                self.cancelled.discard(spec.task_id)
+                self._fail_task(spec, TaskCancelledError(task_name=spec.name))
+                continue
+            try:
+                if not self._deps_ready(spec):
+                    still_pending.append(spec)
+                    continue
+            except TaskError as e:
+                self._fail_task(spec, e)
+                continue
+            if self._is_device_task(spec):
+                self._run_on_device(spec)
+                continue
+            worker = self._acquire_worker(spec)
+            if worker is None:
+                still_pending.append(spec)
+                continue
+            self.loop.create_task(self._run_on_worker(worker, spec))
+        self.pending_cpu = still_pending
+        for actor in self.actors.values():
+            if actor.queue:
+                self._pump_actor(actor)
+
+    # -- CPU worker lane ------------------------------------------------
+    def _acquire_worker(self, spec: TaskSpec) -> Optional[WorkerHandle]:
+        need = spec.resources.get("CPU", 1.0)
+        if self.available.get("CPU", 0) < need:
+            return None
+        while self.idle_workers:
+            w = self.idle_workers.popleft()
+            if w.state == "IDLE" and w.conn is not None and w.conn.alive:
+                w.state = "BUSY"
+                self.available["CPU"] -= need
+                return w
+        # No idle worker: fork one, but never more STARTING workers than CPU
+        # slots could run concurrently (forks cost ~2.5s on small hosts).
+        live = [w for w in self.workers.values()
+                if w.state != "DEAD" and w.actor_id is None]
+        starting = sum(1 for w in live if w.state == "STARTING")
+        if (len(live) < self.cfg.max_cpu_workers
+                and starting < max(1, int(self.available.get("CPU", 1)))):
+            self._spawn_worker()
+        return None
+
+    def _spawn_worker(self, actor_id: ActorID | None = None) -> WorkerHandle:
+        wid = WorkerID.from_random()
+        env = dict(os.environ)
+        # CPU workers must not grab the TPU chips.
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["RT_SESSION_ID"] = self.session_id
+        env["RT_SOCK_PATH"] = self.sock_path
+        env["RT_WORKER_ID"] = wid.hex()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker"],
+            env=env,
+            stdout=None,
+            stderr=None,
+        )
+        w = WorkerHandle(worker_id=wid, proc=proc, actor_id=actor_id)
+        w.registered = self.loop.create_future()
+        self.workers[wid] = w
+        self.counters["workers_started"] += 1
+        return w
+
+    async def _run_on_worker(self, worker: WorkerHandle, spec: TaskSpec):
+        worker.inflight[spec.task_id] = spec
+        try:
+            payload = self._spec_for_ipc(spec)
+            reply = await worker.conn.call("execute_task", payload)
+            self._handle_task_reply(spec, reply)
+        except ConnectionLost:
+            self._retry_or_fail(spec, WorkerCrashedError(task_name=spec.name))
+        except TaskError as e:
+            self._fail_task(spec, e)
+        except BaseException as e:  # noqa: BLE001 - never leave returns pending
+            self._fail_task(spec, TaskError.from_exception(e, spec.name))
+        finally:
+            worker.inflight.pop(spec.task_id, None)
+            self.available["CPU"] = self.available.get("CPU", 0) + spec.resources.get("CPU", 1.0)
+            if worker.state == "BUSY":
+                worker.state = "IDLE"
+                worker.last_idle = time.monotonic()
+                self.idle_workers.append(worker)
+            self._kick()
+
+    def _spec_for_ipc(self, spec: TaskSpec) -> dict:
+        """Resolve READY deps: memory-store values are inlined (serialized),
+        shm objects stay refs (worker mmaps them)."""
+        def enc(a):
+            if a[0] == REF:
+                st = self.objects[a[1]]
+                if st.status == ERROR:
+                    raise st.error
+                mat = self.materialize_for_ipc(a[1])
+                if mat[0] == "bytes":
+                    return ("v", mat[1])
+                return ("shm", a[1].binary())
+            return a
+        return {
+            "task_id": spec.task_id.binary(),
+            "name": spec.name,
+            "func_id": spec.func_id,
+            "args": [enc(a) for a in spec.args],
+            "kwargs": {k: enc(v) for k, v in spec.kwargs.items()},
+            "num_returns": spec.num_returns,
+            "method_name": spec.method_name,
+            "actor_id": spec.actor_id.binary() if spec.actor_id else None,
+            "is_actor_creation": spec.is_actor_creation,
+        }
+
+    def _handle_task_reply(self, spec: TaskSpec, reply: dict):
+        rids = spec.return_ids()
+        if reply.get("error") is not None:
+            err = reply["error"]
+            if spec.retry_exceptions and spec.max_retries > 0 and spec.actor_id is None:
+                spec.max_retries -= 1
+                self.pending_cpu.append(spec)
+                self._kick()
+                return
+            for rid in rids:
+                self.mark_error(rid, err)
+            self.counters["tasks_failed"] += 1
+            return
+        results = reply["results"]  # list[("b", blob) | ("shm", size)]
+        if len(results) != len(rids):
+            self._fail_task(spec, TaskError(
+                f"task '{spec.name}' declared num_returns={len(rids)} but "
+                f"returned {len(results)} values"))
+            return
+        for rid, res in zip(rids, results):
+            if res[0] == "b":
+                self.mark_ready_bytes(rid, res[1])
+            else:
+                self.mark_ready_shm(rid, res[1])
+        self._release_deps(spec)
+        self.counters["tasks_finished"] += 1
+        self.task_events.append(
+            {"task_id": spec.task_id.hex(), "name": spec.name, "state": "FINISHED",
+             "ts": time.time()}
+        )
+
+    def _release_deps(self, spec: TaskSpec):
+        """Unpin task args exactly once, at the task's terminal state."""
+        if getattr(spec, "_deps_released", False):
+            return
+        spec._deps_released = True
+        for dep in spec.dependencies():
+            self.decref(dep)
+
+    def _retry_or_fail(self, spec: TaskSpec, err: TaskError):
+        if spec.max_retries > 0 and not spec.is_actor_creation and spec.actor_id is None:
+            spec.max_retries -= 1
+            self.counters["tasks_retried"] += 1
+            self.pending_cpu.append(spec)
+            self._kick()
+        else:
+            self._fail_task(spec, err)
+
+    def _fail_task(self, spec: TaskSpec, err: TaskError):
+        for rid in spec.return_ids():
+            self.mark_error(rid, err)
+        self._release_deps(spec)
+        self.counters["tasks_failed"] += 1
+
+    # -- device lane ----------------------------------------------------
+    def _resolve_args_in_process(self, spec: TaskSpec):
+        def dec(a):
+            if a[0] == REF:
+                return self.value_in_process(a[1])
+            if a[0] == "o":  # in-process passthrough (device lane fast path)
+                return a[1]
+            return serialization.deserialize(a[1])
+        args = [dec(a) for a in spec.args]
+        kwargs = {k: dec(v) for k, v in spec.kwargs.items()}
+        return args, kwargs
+
+    def _get_callable(self, func_id: str):
+        fn = self._fn_cache.get(func_id)
+        if fn is None:
+            fn = cloudpickle.loads(self.functions[func_id])
+            self._fn_cache[func_id] = fn
+        return fn
+
+    def _run_on_device(self, spec: TaskSpec, pool: ThreadPoolExecutor | None = None,
+                       instance: Any = None, actor: ActorState | None = None):
+        try:
+            args, kwargs = self._resolve_args_in_process(spec)
+            fn = None if instance is not None else self._get_callable(spec.func_id)
+        except TaskError as e:
+            self._fail_task(spec, e)
+            return
+        except BaseException as e:  # noqa: BLE001
+            self._fail_task(spec, TaskError.from_exception(e, spec.name))
+            return
+
+        def run():
+            from . import worker as worker_mod
+
+            tok = worker_mod._running_task.set(spec.task_id)
+            try:
+                if instance is not None:
+                    method = getattr(instance, spec.method_name)
+                    return (True, method(*args, **kwargs))
+                return (True, fn(*args, **kwargs))
+            except BaseException as e:  # noqa: BLE001
+                return (False, TaskError.from_exception(e, spec.name))
+            finally:
+                worker_mod._running_task.reset(tok)
+
+        fut = (pool or self.device_pool).submit(run)
+
+        def done(f):
+            ok, value = f.result()
+            def finish():
+                if actor is not None:
+                    actor.inflight -= 1
+                    self._pump_actor(actor)
+                rids = spec.return_ids()
+                if not ok:
+                    # Same retry semantics as the CPU lane.
+                    if (spec.retry_exceptions and spec.max_retries > 0
+                            and spec.actor_id is None):
+                        spec.max_retries -= 1
+                        self.counters["tasks_retried"] += 1
+                        self.pending_cpu.append(spec)
+                        self._kick()
+                        return
+                    self._fail_task(spec, value)
+                    return
+                try:
+                    if spec.num_returns == 1:
+                        self.mark_ready_value(rids[0], value)
+                    else:
+                        vals = list(value)
+                        if len(vals) != len(rids):
+                            raise TypeError(
+                                f"declared num_returns={len(rids)} but task "
+                                f"returned {len(vals)} values")
+                        for rid, v in zip(rids, vals):
+                            self.mark_ready_value(rid, v)
+                except BaseException as e:  # noqa: BLE001
+                    self._fail_task(spec, TaskError.from_exception(e, spec.name))
+                    return
+                self._release_deps(spec)
+                self.counters["tasks_finished"] += 1
+            self.loop.call_soon_threadsafe(finish)
+
+        fut.add_done_callback(done)
+
+    # ------------------------------------------------------------------
+    # Actors
+    # ------------------------------------------------------------------
+    async def _create_actor(self, spec: TaskSpec):
+        aid = spec.actor_id
+        actor = ActorState(
+            actor_id=aid,
+            creation_spec=spec,
+            is_device=self._is_device_task(spec),
+            name=spec.actor_name,
+        )
+        actor.ready_fut = self.loop.create_future()
+        self.actors[aid] = actor
+        if spec.actor_name:
+            if spec.actor_name in self.named_actors:
+                self._actor_creation_failed(
+                    actor,
+                    ActorDiedError(f"actor name '{spec.actor_name}' already taken"),
+                )
+                return
+            self.named_actors[spec.actor_name] = aid
+        await self._start_actor(actor)
+
+    async def _start_actor(self, actor: ActorState):
+        spec = actor.creation_spec
+        if actor.is_device:
+            try:
+                args, kwargs = self._resolve_args_in_process(spec)
+                cls = self._get_callable(spec.func_id)
+            except BaseException as e:  # noqa: BLE001
+                self._actor_creation_failed(actor, e)
+                return
+            actor.device_pool = ThreadPoolExecutor(
+                max_workers=max(1, spec.max_concurrency),
+                thread_name_prefix=f"actor-{actor.actor_id.hex()[:8]}",
+            )
+
+            def construct():
+                try:
+                    return (True, cls(*args, **kwargs))
+                except BaseException as e:  # noqa: BLE001
+                    return (False, TaskError.from_exception(e, spec.name))
+
+            ok, value = await self.loop.run_in_executor(actor.device_pool, construct)
+            if not ok:
+                self._actor_creation_failed(actor, value)
+                return
+            actor.instance = value
+            self._actor_alive(actor)
+        else:
+            worker = self._spawn_worker(actor_id=actor.actor_id)
+            actor.worker = worker
+            try:
+                await asyncio.wait_for(
+                    worker.registered, self.cfg.worker_startup_timeout_s
+                )
+            except asyncio.TimeoutError:
+                self._actor_creation_failed(
+                    actor, ActorDiedError("actor worker failed to start")
+                )
+                return
+            try:
+                reply = await worker.conn.call(
+                    "create_actor", self._spec_for_ipc(spec)
+                )
+            except ConnectionLost:
+                self._actor_creation_failed(
+                    actor, ActorDiedError("actor worker died during __init__")
+                )
+                return
+            if reply.get("error") is not None:
+                self._actor_creation_failed(actor, reply["error"])
+                return
+            self._actor_alive(actor)
+
+    def _actor_alive(self, actor: ActorState):
+        actor.state = "ALIVE"
+        spec = actor.creation_spec
+        # The creation "return" is the handle-ready signal.
+        self.mark_ready_value(spec.return_ids()[0], None)
+        if actor.ready_fut and not actor.ready_fut.done():
+            actor.ready_fut.set_result(None)
+        self._pump_actor(actor)
+
+    def _actor_creation_failed(self, actor: ActorState, err):
+        if not isinstance(err, TaskError):
+            err = ActorDiedError(f"actor creation failed: {err}")
+        actor.state = "DEAD"
+        actor.death_cause = str(err)
+        # Free the name unless another live actor holds it (duplicate-name
+        # failures must not unregister the original holder).
+        if actor.name and self.named_actors.get(actor.name) == actor.actor_id:
+            self.named_actors.pop(actor.name, None)
+        self._fail_task(actor.creation_spec, err)
+        for spec in actor.queue:
+            self._fail_task(spec, ActorDiedError(str(err), task_name=spec.name))
+        actor.queue.clear()
+
+    def _submit_actor_task(self, spec: TaskSpec):
+        actor = self.actors.get(spec.actor_id)
+        if actor is None or actor.state == "DEAD":
+            cause = actor.death_cause if actor else "unknown actor"
+            self._fail_task(spec, ActorDiedError(f"actor is dead: {cause}",
+                                                 task_name=spec.name))
+            return
+        actor.queue.append(spec)
+        self._pump_actor(actor)
+
+    def _pump_actor(self, actor: ActorState):
+        if actor.state != "ALIVE":
+            return
+        limit = max(1, actor.creation_spec.max_concurrency)
+        while actor.queue and actor.inflight < limit:
+            spec = actor.queue.popleft()
+            if spec.task_id in self.cancelled:
+                self.cancelled.discard(spec.task_id)
+                self._fail_task(spec, TaskCancelledError(task_name=spec.name))
+                continue
+            try:
+                if not self._deps_ready(spec):
+                    actor.queue.appendleft(spec)
+                    # Re-pump on dep readiness via generic kick.
+                    break
+            except TaskError as e:
+                self._fail_task(spec, e)
+                continue
+            actor.inflight += 1
+            if actor.is_device:
+                self._run_on_device(
+                    spec, pool=actor.device_pool, instance=actor.instance, actor=actor
+                )
+            else:
+                self.loop.create_task(self._run_actor_task(actor, spec))
+
+    async def _run_actor_task(self, actor: ActorState, spec: TaskSpec):
+        worker = actor.worker
+        worker.inflight[spec.task_id] = spec
+        try:
+            reply = await worker.conn.call("execute_task", self._spec_for_ipc(spec))
+            self._handle_task_reply(spec, reply)
+        except ConnectionLost:
+            self._fail_task(spec, ActorDiedError("actor worker died mid-call",
+                                                 task_name=spec.name))
+            return  # restart handled by _on_disconnect
+        except TaskError as e:
+            self._fail_task(spec, e)
+        except BaseException as e:  # noqa: BLE001 - never leave returns pending
+            self._fail_task(spec, TaskError.from_exception(e, spec.name))
+        finally:
+            worker.inflight.pop(spec.task_id, None)
+            actor.inflight -= 1
+        self._pump_actor(actor)
+
+    async def _restart_actor(self, actor: ActorState):
+        actor.state = "RESTARTING"
+        actor.num_restarts += 1
+        self.counters["actors_restarted"] += 1
+        await self._start_actor(actor)
+
+    def kill_actor(self, aid: ActorID, no_restart: bool = True):
+        actor = self.actors.get(aid)
+        if actor is None or actor.state == "DEAD":
+            return
+        actor.state = "DEAD"
+        actor.death_cause = "killed via kill()"
+        if actor.name:
+            self.named_actors.pop(actor.name, None)
+        for spec in actor.queue:
+            self._fail_task(spec, ActorDiedError("actor was killed", task_name=spec.name))
+        actor.queue.clear()
+        if actor.worker is not None:
+            self._kill_worker(actor.worker)
+        if actor.device_pool is not None:
+            actor.device_pool.shutdown(wait=False)
+            actor.instance = None
+
+    def _kill_worker(self, worker: WorkerHandle):
+        worker.state = "DEAD"
+        try:
+            worker.proc.terminate()
+        except ProcessLookupError:
+            pass
+
+    # ------------------------------------------------------------------
+    # Placement groups (single-node round 1: bundle accounting)
+    # ------------------------------------------------------------------
+    def create_placement_group(self, bundles: list[dict], strategy: str) -> PlacementGroupID:
+        pg_id = PlacementGroupID.from_random()
+        needed: dict[str, float] = {}
+        for b in bundles:
+            for k, v in b.items():
+                needed[k] = needed.get(k, 0) + v
+        for k, v in needed.items():
+            if self.total_resources.get(k, 0) < v:
+                raise ValueError(
+                    f"placement group infeasible: needs {v} {k}, node has "
+                    f"{self.total_resources.get(k, 0)}"
+                )
+        pg = PlacementGroup(pg_id=pg_id, bundles=bundles, strategy=strategy)
+        self.placement_groups[pg_id] = pg
+        return pg_id
+
+    def remove_placement_group(self, pg_id: PlacementGroupID):
+        self.placement_groups.pop(pg_id, None)
+
+    # ------------------------------------------------------------------
+    # RPC handling (worker -> node service)
+    # ------------------------------------------------------------------
+    async def _handle_rpc(self, conn: ServerConn, method: str, payload: Any):
+        if method == "register":
+            wid = WorkerID.from_hex(payload["worker_id"])
+            w = self.workers.get(wid)
+            if w is None:
+                raise RuntimeError(f"unknown worker {payload['worker_id']}")
+            w.conn = conn
+            conn.meta["worker"] = w
+            if w.actor_id is None:
+                w.state = "IDLE"
+                w.last_idle = time.monotonic()
+                self.idle_workers.append(w)
+            else:
+                w.state = "BUSY"  # dedicated actor worker
+            if w.registered and not w.registered.done():
+                w.registered.set_result(None)
+            self._kick()
+            return {"session_id": self.session_id}
+
+        if method == "fetch_function":
+            return self.functions.get(payload)
+
+        if method == "export_function":
+            fid, blob = payload
+            if blob is not None and fid not in self.functions:
+                self.functions[fid] = blob
+            return fid in self.functions
+
+        if method == "submit_task":
+            spec: TaskSpec = payload
+            rids = self.submit(spec)
+            return [r.binary() for r in rids]
+
+        if method == "fetch_object":
+            oid = ObjectID(payload["oid"])
+            st = await self.wait_object(oid, payload.get("timeout"))
+            if st.status == PENDING:
+                return ("timeout",)
+            if st.status == ERROR:
+                return ("err", st.error)
+            return self.materialize_for_ipc(oid)
+
+        if method == "wait_objects":
+            oids = [ObjectID(b) for b in payload["oids"]]
+            num_returns = payload["num_returns"]
+            timeout = payload.get("timeout")
+            deadline = None if timeout is None else self.loop.time() + timeout
+            while True:
+                ready = [o.binary() for o in oids
+                         if self.objects.get(o) and self.objects[o].status != PENDING]
+                if len(ready) >= num_returns:
+                    return ready
+                remaining = None if deadline is None else max(0, deadline - self.loop.time())
+                if remaining == 0:
+                    return ready
+                pending = [o for o in oids
+                           if not (self.objects.get(o) and self.objects[o].status != PENDING)]
+                futs = []
+                for o in pending:
+                    f = self.loop.create_future()
+                    self._obj(o).waiters.append(f)
+                    futs.append(f)
+                try:
+                    await asyncio.wait(futs, timeout=remaining,
+                                       return_when=asyncio.FIRST_COMPLETED)
+                finally:
+                    for f in futs:
+                        if not f.done():
+                            f.cancel()
+                    for o in oids:
+                        st = self.objects.get(o)
+                        if st and st.waiters:
+                            st.waiters[:] = [x for x in st.waiters
+                                             if not x.cancelled()]
+
+        if method == "put_object":
+            oid = ObjectID(payload["oid"])
+            self._obj(oid).refcount += 1
+            if payload.get("inline") is not None:
+                self.mark_ready_bytes(oid, payload["inline"])
+            else:
+                self.mark_ready_shm(oid, payload["size"])
+            return True
+
+        if method == "decref":
+            for b in payload:
+                self.decref(ObjectID(b))
+            return True
+
+        if method == "get_actor_by_name":
+            aid = self.named_actors.get(payload)
+            if aid is None:
+                return None
+            actor = self.actors[aid]
+            meths = actor.creation_spec.runtime_env or {}
+            return {"actor_id": aid.binary(),
+                    "methods": meths.get("methods", [])}
+
+        if method == "kv":
+            op, key, val = payload
+            if op == "put":
+                self.kv[key] = val
+                return True
+            if op == "get":
+                return self.kv.get(key)
+            if op == "del":
+                return self.kv.pop(key, None) is not None
+            if op == "exists":
+                return key in self.kv
+            if op == "keys":
+                return [k for k in self.kv if k.startswith(key)]
+
+        if method == "kill_actor":
+            self.kill_actor(ActorID(payload))
+            return True
+
+        if method == "log":
+            sys.stderr.write(payload)
+            return True
+
+        raise RuntimeError(f"unknown rpc method: {method}")
+
+    async def _on_disconnect(self, conn: ServerConn):
+        w: WorkerHandle | None = conn.meta.get("worker")
+        if w is None or self._closing:
+            return
+        was = w.state
+        w.state = "DEAD"
+        self.counters["workers_died"] += 1
+        # Plain task workers: inflight tasks handled by ConnectionLost in
+        # _run_on_worker (retry path). Actor workers: restart FSM.
+        if w.actor_id is not None:
+            actor = self.actors.get(w.actor_id)
+            if actor and actor.state in ("ALIVE", "PENDING", "RESTARTING"):
+                if actor.num_restarts < actor.creation_spec.max_restarts and was != "DEAD":
+                    await self._restart_actor(actor)
+                else:
+                    actor.state = "DEAD"
+                    actor.death_cause = "worker process died"
+                    if actor.name:
+                        self.named_actors.pop(actor.name, None)
+                    for spec in actor.queue:
+                        self._fail_task(
+                            spec, ActorDiedError("actor worker died", task_name=spec.name)
+                        )
+                    actor.queue.clear()
+
+    # ------------------------------------------------------------------
+    async def shutdown(self):
+        self._closing = True
+        for w in self.workers.values():
+            if w.state != "DEAD":
+                self._kill_worker(w)
+        await self.server.stop()
+        self.device_pool.shutdown(wait=False)
+        for actor in self.actors.values():
+            if actor.device_pool:
+                actor.device_pool.shutdown(wait=False)
+        for w in self.workers.values():
+            try:
+                w.proc.wait(timeout=2)
+            except subprocess.TimeoutExpired:
+                w.proc.kill()

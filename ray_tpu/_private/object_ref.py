@@ -1,0 +1,84 @@
+"""ObjectRef — a future for a (possibly remote) immutable object.
+
+Capability parity target: the reference's ObjectRef
+(/root/reference/python/ray/_raylet.pyx ObjectRef type) including: hashable,
+picklable (travels inside args/returns), refcounted at the owner
+(/root/reference/src/ray/core_worker/reference_count.h:61 — ours is a
+centralized owner-side count in round 1), awaitable via ``.future()``.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from .ids import ObjectID
+
+if TYPE_CHECKING:
+    pass
+
+
+def _current_context():
+    from . import context
+
+    return context.get_context()
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owned", "__weakref__")
+
+    def __init__(self, oid: ObjectID, _register: bool = True):
+        """``_register=False`` means the creator already holds a count for
+        this ref (submit/put incref once on the caller's behalf); the ref
+        still *owns* that count and releases it in ``__del__``."""
+        self._id = oid
+        self._owned = True
+        if _register:
+            ctx = _current_context()
+            if ctx is not None:
+                ctx.incref(oid)
+
+    @property
+    def id(self) -> ObjectID:
+        return self._id
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def future(self):
+        """concurrent.futures.Future resolving to the object's value."""
+        return _current_context().object_future(self._id)
+
+    def __await__(self):
+        import asyncio
+
+        return asyncio.wrap_future(self.future()).__await__()
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()[:16]})"
+
+    def __reduce__(self):
+        # Travelling refs re-register at the destination so the owner-side
+        # count reflects remote holders (borrowing).
+        return (_deserialize_ref, (self._id.binary(),))
+
+    def __del__(self):
+        if self._owned:
+            try:
+                ctx = _current_context()
+                if ctx is not None:
+                    ctx.decref(self._id)
+            except Exception:
+                pass
+
+
+def _deserialize_ref(binary: bytes) -> ObjectRef:
+    return ObjectRef(ObjectID(binary))

@@ -1,0 +1,272 @@
+"""Control-plane RPC: length-prefixed pickled messages over unix sockets.
+
+Capability parity target: the reference's gRPC control plane
+(/root/reference/src/ray/rpc/grpc_server.h, grpc_client.h) — per-call
+request/response with correlation, plus server push (the reference pushes
+tasks to leased workers via CoreWorkerService.PushTask). We keep the same
+duplex shape over a single persistent unix socket per worker:
+
+  * Either side sends ``(kind, seqno, method, payload)`` frames.
+  * kind=REQ expects a matching kind=RESP with the same seqno.
+  * Both sides can originate REQs concurrently (full duplex): the node
+    service pushes ``execute_task`` REQs to a busy worker's socket while the
+    worker has its own outstanding ``submit_task`` REQs.
+
+The server side is asyncio (runs in the node service's event-loop thread).
+The client side (workers) is a blocking socket plus a reader thread that
+routes RESP frames to waiting futures and REQ frames to a handler.
+Payloads are cloudpickle: control-plane messages are small; bulk data rides
+the shared-memory store, never this channel.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import struct
+import threading
+from concurrent.futures import Future
+from typing import Any, Awaitable, Callable
+
+import cloudpickle
+
+REQ, RESP, ERR = 0, 1, 2
+_HDR = struct.Struct("<BIQ")  # kind, payload_len, seqno
+
+
+def _pack(kind: int, seqno: int, body: Any) -> bytes:
+    payload = cloudpickle.dumps(body)
+    return _HDR.pack(kind, len(payload), seqno) + payload
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Blocking client (worker side)
+# ---------------------------------------------------------------------------
+class DuplexClient:
+    """Blocking duplex peer. ``handler(method, payload) -> result`` services
+    incoming REQs on a dedicated thread pool owned by the caller."""
+
+    def __init__(self, sock_path: str, handler: Callable[[str, Any], Any],
+                 handler_threads: int = 1):
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.connect(sock_path)
+        self._wlock = threading.Lock()
+        self._seq = 0
+        self._seqlock = threading.Lock()
+        self._pending: dict[int, Future] = {}
+        self._handler = handler
+        self._closed = threading.Event()
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._exec = ThreadPoolExecutor(
+            max_workers=handler_threads, thread_name_prefix="rpc-handler"
+        )
+        self._reader = threading.Thread(target=self._read_loop, daemon=True,
+                                        name="rpc-reader")
+        self._reader.start()
+
+    def call(self, method: str, payload: Any = None, timeout: float | None = None) -> Any:
+        with self._seqlock:
+            self._seq += 1
+            seq = self._seq
+        fut: Future = Future()
+        self._pending[seq] = fut
+        self._send(REQ, seq, (method, payload))
+        return fut.result(timeout=timeout)
+
+    def notify(self, method: str, payload: Any = None):
+        """Fire-and-forget (seqno 0 never gets a response)."""
+        self._send(REQ, 0, (method, payload))
+
+    def _send(self, kind: int, seq: int, body: Any):
+        data = _pack(kind, seq, body)
+        with self._wlock:
+            try:
+                self._sock.sendall(data)
+            except OSError as e:
+                raise ConnectionLost(str(e)) from e
+
+    def _recv_exact(self, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = self._sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionLost("peer closed")
+            buf += chunk
+        return bytes(buf)
+
+    def _read_loop(self):
+        try:
+            while not self._closed.is_set():
+                hdr = self._recv_exact(_HDR.size)
+                kind, plen, seq = _HDR.unpack(hdr)
+                body = cloudpickle.loads(self._recv_exact(plen))
+                if kind == REQ:
+                    method, payload = body
+                    self._exec.submit(self._serve, method, payload, seq)
+                elif kind == RESP:
+                    fut = self._pending.pop(seq, None)
+                    if fut:
+                        fut.set_result(body)
+                else:  # ERR
+                    fut = self._pending.pop(seq, None)
+                    if fut:
+                        fut.set_exception(RpcError(body))
+        except (ConnectionLost, OSError):
+            pass
+        finally:
+            self._closed.set()
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionLost("connection lost"))
+            self._pending.clear()
+
+    def _serve(self, method: str, payload: Any, seq: int):
+        try:
+            result = self._handler(method, payload)
+            if seq:
+                self._send(RESP, seq, result)
+        except ConnectionLost:
+            pass
+        except BaseException as e:  # noqa: BLE001 - forwarded to peer
+            if seq:
+                try:
+                    self._send(ERR, seq, f"{type(e).__name__}: {e}")
+                except ConnectionLost:
+                    pass
+
+    def close(self):
+        self._closed.set()
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+        self._exec.shutdown(wait=False)
+
+
+# ---------------------------------------------------------------------------
+# Asyncio server (node service side)
+# ---------------------------------------------------------------------------
+class ServerConn:
+    """One connected peer, as seen by the asyncio server."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self._reader, self._writer = reader, writer
+        self._seq = 0
+        self._pending: dict[int, asyncio.Future] = {}
+        self.alive = True
+        self.meta: dict = {}  # filled by registration (worker id etc.)
+
+    async def call(self, method: str, payload: Any = None) -> Any:
+        self._seq += 1
+        seq = self._seq
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[seq] = fut
+        await self._write(REQ, seq, (method, payload))
+        return await fut
+
+    async def notify(self, method: str, payload: Any = None):
+        await self._write(REQ, 0, (method, payload))
+
+    async def _write(self, kind: int, seq: int, body: Any):
+        if not self.alive:
+            raise ConnectionLost("peer gone")
+        self._writer.write(_pack(kind, seq, body))
+        await self._writer.drain()
+
+    def _fail_pending(self):
+        self.alive = False
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost("connection lost"))
+        self._pending.clear()
+
+    async def close(self):
+        self._fail_pending()
+        try:
+            self._writer.close()
+            await self._writer.wait_closed()
+        except (OSError, ConnectionLost):
+            pass
+
+
+class DuplexServer:
+    """Asyncio unix-socket server. ``handler(conn, method, payload)`` is an
+    async callable invoked per incoming REQ; its return value is the RESP.
+    ``on_disconnect(conn)`` fires when a peer drops."""
+
+    def __init__(
+        self,
+        sock_path: str,
+        handler: Callable[[ServerConn, str, Any], Awaitable[Any]],
+        on_disconnect: Callable[[ServerConn], Awaitable[None]] | None = None,
+    ):
+        self.sock_path = sock_path
+        self._handler = handler
+        self._on_disconnect = on_disconnect
+        self._server: asyncio.AbstractServer | None = None
+        self.conns: set[ServerConn] = set()
+
+    async def start(self):
+        self._server = await asyncio.start_unix_server(self._accept, path=self.sock_path)
+
+    async def _accept(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        conn = ServerConn(reader, writer)
+        self.conns.add(conn)
+        try:
+            while True:
+                hdr = await reader.readexactly(_HDR.size)
+                kind, plen, seq = _HDR.unpack(hdr)
+                body = cloudpickle.loads(await reader.readexactly(plen))
+                if kind == REQ:
+                    method, payload = body
+                    asyncio.ensure_future(self._serve(conn, method, payload, seq))
+                elif kind == RESP:
+                    fut = conn._pending.pop(seq, None)
+                    if fut and not fut.done():
+                        fut.set_result(body)
+                else:
+                    fut = conn._pending.pop(seq, None)
+                    if fut and not fut.done():
+                        fut.set_exception(RpcError(body))
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            pass
+        finally:
+            self.conns.discard(conn)
+            conn._fail_pending()
+            if self._on_disconnect:
+                await self._on_disconnect(conn)
+            try:
+                writer.close()
+            except OSError:
+                pass
+
+    async def _serve(self, conn: ServerConn, method: str, payload: Any, seq: int):
+        try:
+            result = await self._handler(conn, method, payload)
+            if seq:
+                await conn._write(RESP, seq, result)
+        except ConnectionLost:
+            pass
+        except BaseException as e:  # noqa: BLE001 - forwarded to peer
+            if seq:
+                try:
+                    await conn._write(ERR, seq, f"{type(e).__name__}: {e}")
+                except (ConnectionLost, OSError):
+                    pass
+
+    async def stop(self):
+        for conn in list(self.conns):
+            await conn.close()
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()

@@ -1,0 +1,328 @@
+"""Driver-side runtime: owns the node service and bridges sync API calls.
+
+Capability parity target: the reference's driver bring-up
+(/root/reference/python/ray/_private/worker.py:1227 `init` and node.py
+process orchestration). Round-1 topology: this process is simultaneously the
+head node (control plane), the node-owner (device executor owns the TPU
+chips) and the driver. Multi-node attach comes in later rounds via the same
+RPC protocol over TCP/DCN.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import atexit
+import os
+import threading
+import uuid
+from concurrent.futures import Future
+from typing import Any, Optional, Sequence
+
+from . import context as context_mod
+from . import serialization
+from .config import get_config
+from .exceptions import GetTimeoutError
+from .ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from .node_service import ERROR, PENDING, NodeService
+from .object_ref import ObjectRef
+from .object_store import SharedMemoryStore
+from .task_spec import TaskSpec, export_function
+
+
+def _detect_resources(num_cpus=None, num_tpus=None, resources=None) -> dict:
+    out = dict(resources or {})
+    if num_cpus is None:
+        num_cpus = os.cpu_count() or 1
+    out.setdefault("CPU", float(num_cpus))
+    if num_tpus is None:
+        num_tpus = 0
+        try:
+            import jax
+
+            num_tpus = sum(1 for d in jax.devices() if d.platform != "cpu")
+        except Exception:
+            pass
+    out.setdefault("TPU", float(num_tpus))
+    # Any local accelerator counts as the "device" lane even under the CPU
+    # jax backend (tests use a virtual CPU mesh).
+    out.setdefault("device", max(out["TPU"], 1.0))
+    return out
+
+
+class Runtime:
+    """One per driver process; the execution context for the driver."""
+
+    def __init__(self, num_cpus=None, num_tpus=None, resources=None,
+                 system_config: dict | None = None):
+        self.cfg = get_config().apply_overrides(system_config)
+        self.session_id = uuid.uuid4().hex[:12]
+        self.job_id = JobID.from_random()
+        self.node_id = NodeID.from_random()
+        self.worker_id = WorkerID.from_random()
+        self._driver_task = TaskID.for_task(self.job_id)
+        self._put_counter = 0
+        self._put_lock = threading.Lock()
+
+        self.shm = SharedMemoryStore(self.session_id)
+        sock_dir = os.environ.get("RT_SOCK_DIR", "/tmp")
+        self.sock_path = os.path.join(sock_dir, f"rtpu-{self.session_id}.sock")
+
+        self.loop = asyncio.new_event_loop()
+        self._loop_thread = threading.Thread(
+            target=self._loop_main, daemon=True, name="rt-core-loop"
+        )
+        self._started = threading.Event()
+        self.node: NodeService | None = None
+        self._resources = _detect_resources(num_cpus, num_tpus, resources)
+        self._loop_thread.start()
+        self._started.wait()
+        atexit.register(self.shutdown)
+
+    def _loop_main(self):
+        asyncio.set_event_loop(self.loop)
+        self.node = NodeService(
+            self.session_id, self.sock_path, self._resources, self.shm, self.loop
+        )
+        self.loop.run_until_complete(self.node.start())
+        self._started.set()
+        self.loop.run_forever()
+
+    def _run(self, coro, timeout=None):
+        return asyncio.run_coroutine_threadsafe(coro, self.loop).result(timeout)
+
+    def _call_soon(self, fn, *args):
+        self.loop.call_soon_threadsafe(fn, *args)
+
+    # -- context protocol --------------------------------------------------
+    @property
+    def current_task_id(self):
+        from .worker import _running_task
+
+        return _running_task.get()
+
+    @property
+    def current_actor_id(self):
+        return None
+
+    def incref(self, oid: ObjectID):
+        if self.loop.is_running():
+            self._call_soon(self.node.incref, oid)
+
+    def decref(self, oid: ObjectID):
+        if self.loop.is_running():
+            try:
+                self._call_soon(self.node.decref, oid)
+            except RuntimeError:
+                pass  # interpreter shutdown
+
+    def export_function(self, fn) -> str:
+        fid, blob = export_function(fn)
+        if fid not in self.node.functions:
+            self._call_soon(self.node.functions.__setitem__, fid, blob)
+        return fid
+
+    def submit_spec(self, spec: TaskSpec) -> list[ObjectRef]:
+        async def do():
+            return self.node.submit(spec)
+
+        rids = self._run(do())
+        return [ObjectRef(r, _register=False) for r in rids]
+
+    def put(self, value: Any) -> ObjectRef:
+        with self._put_lock:
+            self._put_counter += 1
+            idx = self._put_counter
+        oid = ObjectID.for_put(self._driver_task, idx)
+        blob = serialization.serialize(value)
+        # incref strictly before mark_ready: a READY object with refcount 0
+        # is freed on arrival.
+        self._call_soon(self.node.incref, oid)
+        if len(blob) > self.cfg.max_inline_object_size:
+            self.shm.put(oid, blob)
+            self._call_soon(self.node.mark_ready_shm, oid, len(blob))
+        else:
+            self._call_soon(self.node.mark_ready_bytes, oid, bytes(blob))
+        return ObjectRef(oid, _register=False)
+
+    def _state_of(self, oid: ObjectID):
+        return self.node.objects.get(oid)
+
+    def get(self, refs, timeout: float | None = None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+
+        async def wait_all():
+            deadline = None if timeout is None else self.loop.time() + timeout
+            for r in refs:
+                # Unknown id => nothing will ever produce it (e.g. a ref from
+                # a previous session) — fail fast instead of blocking forever.
+                if r.id not in self.node.objects:
+                    from .exceptions import ObjectLostError
+
+                    raise ObjectLostError(
+                        f"{r} is unknown to this runtime (was it created in a "
+                        f"previous session?)"
+                    )
+                remaining = (None if deadline is None
+                             else max(0.0, deadline - self.loop.time()))
+                st = await self.node.wait_object(r.id, remaining)
+                if st.status == PENDING:
+                    raise GetTimeoutError(f"get() timed out on {r}")
+
+        self._run(wait_all())
+        out = []
+        for r in refs:
+            st = self.node.objects[r.id]
+            if st.status == ERROR:
+                raise st.error
+            if st.location == "shm":
+                mv = self.shm.get(r.id)
+                out.append(serialization.deserialize(mv))
+            else:
+                kind, val = st.value
+                if kind == "bytes":
+                    out.append(serialization.deserialize(val))
+                else:
+                    out.append(val)
+        return out[0] if single else out
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns=1, timeout=None):
+        async def do():
+            oids = [r.id for r in refs]
+            deadline = None if timeout is None else self.loop.time() + timeout
+            while True:
+                ready = [o for o in oids
+                         if self.node.objects.get(o)
+                         and self.node.objects[o].status != PENDING]
+                if len(ready) >= num_returns:
+                    return ready
+                remaining = (None if deadline is None
+                             else max(0.0, deadline - self.loop.time()))
+                if remaining == 0.0:
+                    return ready
+                futs = []
+                for o in oids:
+                    st = self.node._obj(o)
+                    if st.status == PENDING:
+                        f = self.loop.create_future()
+                        st.waiters.append(f)
+                        futs.append(f)
+                if not futs:
+                    return ready
+                await asyncio.wait(futs, timeout=remaining,
+                                   return_when=asyncio.FIRST_COMPLETED)
+                for f in futs:
+                    if not f.done():
+                        f.cancel()
+                for o in oids:
+                    st = self.node.objects.get(o)
+                    if st and st.waiters:
+                        st.waiters[:] = [x for x in st.waiters if not x.cancelled()]
+
+        ready_ids = set(o.binary() for o in self._run(do()))
+        ready = [r for r in refs if r.id.binary() in ready_ids]
+        not_ready = [r for r in refs if r.id.binary() not in ready_ids]
+        if len(ready) > num_returns:
+            not_ready = ready[num_returns:] + not_ready
+            ready = ready[:num_returns]
+        return ready, not_ready
+
+    def object_future(self, oid: ObjectID) -> Future:
+        fut: Future = Future()
+
+        async def do():
+            st = await self.node.wait_object(oid)
+            return st
+
+        def done(afut):
+            try:
+                st = afut.result()
+                if st.status == ERROR:
+                    fut.set_exception(st.error)
+                    return
+                if st.location == "shm":
+                    mv = self.shm.get(oid)
+                    fut.set_result(serialization.deserialize(mv))
+                else:
+                    kind, val = st.value
+                    fut.set_result(serialization.deserialize(val)
+                                   if kind == "bytes" else val)
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        asyncio.run_coroutine_threadsafe(do(), self.loop).add_done_callback(done)
+        return fut
+
+    def cancel(self, ref: ObjectRef, force=False):
+        st = self._state_of(ref.id)
+        if st is None or st.creating_spec is None:
+            return
+
+        def do():
+            self.node.cancelled.add(st.creating_spec.task_id)
+            self.node._kick()
+
+        self._call_soon(do)
+
+    def kill_actor(self, actor_id: ActorID, no_restart=True):
+        self._call_soon(self.node.kill_actor, actor_id, no_restart)
+
+    def get_actor_by_name(self, name: str):
+        aid = self.node.named_actors.get(name)
+        if aid is None:
+            return None
+        actor = self.node.actors[aid]
+        meta = actor.creation_spec.runtime_env or {}
+        return {"actor_id": aid.binary(), "methods": meta.get("methods", [])}
+
+    def kv_op(self, op, key, val=None):
+        async def do():
+            if op == "put":
+                self.node.kv[key] = val
+                return True
+            if op == "get":
+                return self.node.kv.get(key)
+            if op == "del":
+                return self.node.kv.pop(key, None) is not None
+            if op == "exists":
+                return key in self.node.kv
+            if op == "keys":
+                return [k for k in self.node.kv if k.startswith(key)]
+
+        return self._run(do())
+
+    # -- placement groups --------------------------------------------------
+    def create_placement_group(self, bundles, strategy):
+        async def do():
+            return self.node.create_placement_group(bundles, strategy)
+
+        return self._run(do())
+
+    def remove_placement_group(self, pg_id):
+        self._call_soon(self.node.remove_placement_group, pg_id)
+
+    # -- introspection -----------------------------------------------------
+    def cluster_resources(self) -> dict:
+        return dict(self.node.total_resources)
+
+    def available_resources(self) -> dict:
+        return dict(self.node.available)
+
+    def shutdown(self):
+        if getattr(self, "_shut", False):
+            return
+        self._shut = True
+        try:
+            self._run(self.node.shutdown(), timeout=10)
+        except Exception:
+            pass
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._loop_thread.join(timeout=5)
+        try:
+            os.unlink(self.sock_path)
+        except OSError:
+            pass
+        self.shm.destroy()
+        if context_mod.get_context() is self:
+            context_mod.set_context(None)

@@ -1,0 +1,93 @@
+"""Task specifications — the unit handed from submitters to executors.
+
+Capability parity target: the reference's TaskSpecification
+(/root/reference/src/ray/common/task/task_spec.h) and FunctionDescriptor
+(/root/reference/src/ray/common/function_descriptor.h): a self-contained
+description of what to run, with what args, where results go, and the
+resources/placement required.
+
+Functions and actor classes are exported once to the control plane's KV
+(keyed by content hash) and referenced by id from specs — mirroring the
+reference's function-table export via GCS KV
+(/root/reference/python/ray/_private/function_manager.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import cloudpickle
+
+from .ids import ActorID, ObjectID, PlacementGroupID, TaskID
+
+# Arg encodings inside a spec: ("v", <serialized bytes>) for by-value,
+# ("r", ObjectID) for by-reference (resolved before/at execution).
+VAL, REF = "v", "r"
+
+
+def function_id(blob: bytes) -> str:
+    return hashlib.sha1(blob).hexdigest()
+
+
+def export_function(fn) -> tuple[str, bytes]:
+    blob = cloudpickle.dumps(fn)
+    return function_id(blob), blob
+
+
+@dataclass
+class SchedulingStrategy:
+    """Where a task may run.
+
+    kind:
+      "default"  — hybrid pack/spread across CPU workers
+      "device"   — the node's device-owner executor (runs in the process that
+                   owns the TPU chips; jax work must land here)
+      "spread"   — force spread
+      "node"     — pin to node_id
+      "pg"       — inside a placement-group bundle
+    """
+
+    kind: str = "default"
+    node_id: Optional[bytes] = None
+    soft: bool = False
+    pg_id: Optional[PlacementGroupID] = None
+    pg_bundle_index: int = -1
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    name: str
+    func_id: str  # KV key of the serialized callable (or class for actors)
+    args: list = field(default_factory=list)  # [(VAL, bytes) | (REF, ObjectID)]
+    kwargs: dict = field(default_factory=dict)  # name -> same encoding
+    num_returns: int = 1
+    resources: dict = field(default_factory=lambda: {"CPU": 1.0})
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
+    # Actor binding
+    actor_id: Optional[ActorID] = None
+    method_name: Optional[str] = None
+    # Actor creation
+    is_actor_creation: bool = False
+    max_concurrency: int = 1
+    max_restarts: int = 0
+    actor_name: Optional[str] = None
+    runtime_env: Optional[dict] = None
+
+    def return_ids(self) -> list[ObjectID]:
+        return [ObjectID.for_return(self.task_id, i) for i in range(self.num_returns)]
+
+    def dependencies(self) -> list[ObjectID]:
+        deps = [a[1] for a in self.args if a[0] == REF]
+        deps += [v[1] for v in self.kwargs.values() if v[0] == REF]
+        return deps
+
+    @property
+    def scheduling_class(self) -> tuple:
+        """Tasks with equal scheduling class share lease/queue decisions
+        (reference: SchedulingClass in task_spec.h)."""
+        return (self.func_id, tuple(sorted(self.resources.items())), self.strategy.kind)

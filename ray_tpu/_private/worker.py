@@ -1,0 +1,273 @@
+"""CPU worker subprocess: executes leased tasks and hosts CPU actors.
+
+Capability parity target: the reference's worker main loop
+(/root/reference/python/ray/_raylet.pyx execute_task:1644 and
+CoreWorkerProcess.RunTaskExecutionLoop) — receive pushed tasks, resolve args,
+run user code, store results (inline if small, shared memory if large), and
+support nested task submission / get / put from inside tasks.
+
+Workers are forked with JAX_PLATFORMS=cpu so they never contend for the TPU
+chips — device work belongs to the device lane in the node-owner process
+(see node_service.py).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import sys
+import threading
+import traceback
+from typing import Any, Optional
+
+import cloudpickle
+
+from . import context as context_mod
+from . import serialization
+from .config import get_config
+from .exceptions import GetTimeoutError, TaskError
+from .ids import ActorID, JobID, ObjectID, TaskID, WorkerID
+from .object_ref import ObjectRef
+from .object_store import SharedMemoryStore
+from .rpc import DuplexClient
+from .task_spec import REF, VAL, SchedulingStrategy, TaskSpec
+
+# TaskID of the task currently executing on this thread (also used by the
+# device lane in the node-owner process).
+_running_task: contextvars.ContextVar[Optional[TaskID]] = contextvars.ContextVar(
+    "running_task", default=None
+)
+
+
+class WorkerContext:
+    """The per-worker-process execution context (see context.py)."""
+
+    def __init__(self, session_id: str, sock_path: str, worker_id: WorkerID):
+        self.cfg = get_config()
+        self.session_id = session_id
+        self.worker_id = worker_id
+        self.node_id = None
+        self.job_id = JobID.nil()
+        self.shm = SharedMemoryStore(session_id)
+        self._fn_cache: dict[str, Any] = {}
+        self._exported: set[str] = set()
+        self._actors: dict[ActorID, Any] = {}
+        self._put_counters: dict[bytes, int] = {}
+        self._put_lock = threading.Lock()
+        self._decref_buf: list[bytes] = []
+        self._decref_lock = threading.Lock()
+        # Connect last: the node service may push tasks the moment we register.
+        self.client = DuplexClient(sock_path, self._handle, handler_threads=32)
+        self.client.call("register", {"worker_id": worker_id.hex()})
+
+    # -- context protocol --------------------------------------------------
+    @property
+    def current_task_id(self):
+        return _running_task.get()
+
+    @property
+    def current_actor_id(self):
+        t = _running_task.get()
+        if t is None:
+            return None
+        aid = t.actor_id()
+        return None if aid.binary().endswith(b"\x00" * 8) else aid
+
+    def incref(self, oid: ObjectID):
+        pass  # owner-side count covers borrows conservatively in round 1
+
+    def decref(self, oid: ObjectID):
+        pass
+
+    def _next_put_id(self) -> ObjectID:
+        task = _running_task.get()
+        key = task.binary() if task else b"driverless"
+        with self._put_lock:
+            self._put_counters[key] = self._put_counters.get(key, 0) + 1
+            idx = self._put_counters[key]
+        base = task if task else TaskID.for_task(self.job_id)
+        return ObjectID.for_put(base, idx)
+
+    def put(self, value: Any) -> ObjectRef:
+        oid = self._next_put_id()
+        blob = serialization.serialize(value)
+        if len(blob) > self.cfg.max_inline_object_size:
+            self.shm.put(oid, blob)
+            self.client.call("put_object", {"oid": oid.binary(), "inline": None,
+                                            "size": len(blob)})
+        else:
+            self.client.call("put_object", {"oid": oid.binary(), "inline": bytes(blob),
+                                            "size": len(blob)})
+        return ObjectRef(oid, _register=False)
+
+    def get(self, refs, timeout: float | None = None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        out = []
+        for ref in refs:
+            mv = self.shm.get(ref.id)
+            if mv is not None:
+                out.append(serialization.deserialize(mv))
+                continue
+            res = self.client.call(
+                "fetch_object", {"oid": ref.id.binary(), "timeout": timeout}
+            )
+            if res[0] == "timeout":
+                raise GetTimeoutError(f"get() timed out on {ref}")
+            if res[0] == "err":
+                raise res[1]
+            if res[0] == "shm":
+                mv = self.shm.wait(ref.id, timeout=5.0)
+                if mv is None:
+                    raise GetTimeoutError(f"object {ref} not in shm after fetch")
+                out.append(serialization.deserialize(mv))
+            else:
+                out.append(serialization.deserialize(res[1]))
+        return out[0] if single else out
+
+    def wait(self, refs, num_returns=1, timeout=None):
+        binaries = self.client.call(
+            "wait_objects",
+            {"oids": [r.id.binary() for r in refs], "num_returns": num_returns,
+             "timeout": timeout},
+        )
+        ready_set = {b for b in binaries}
+        ready = [r for r in refs if r.id.binary() in ready_set]
+        not_ready = [r for r in refs if r.id.binary() not in ready_set]
+        return ready[:num_returns] if len(ready) > num_returns else ready, \
+            not_ready + ready[num_returns:]
+
+    def submit_spec(self, spec: TaskSpec) -> list[ObjectRef]:
+        rids = self.client.call("submit_task", spec)
+        return [ObjectRef(ObjectID(b), _register=False) for b in rids]
+
+    def export_function(self, fn) -> str:
+        from .task_spec import export_function
+
+        fid, blob = export_function(fn)
+        if fid not in self._exported:
+            self.client.call("export_function", (fid, blob))
+            self._exported.add(fid)
+        return fid
+
+    def object_future(self, oid: ObjectID):
+        from concurrent.futures import Future
+
+        fut: Future = Future()
+
+        def run():
+            try:
+                fut.set_result(self.get(ObjectRef(oid, _register=False)))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    def kill_actor(self, actor_id: ActorID, no_restart=True):
+        self.client.call("kill_actor", actor_id.binary())
+
+    def get_actor_by_name(self, name: str):
+        return self.client.call("get_actor_by_name", name)
+
+    def kv_op(self, op, key, val=None):
+        return self.client.call("kv", (op, key, val))
+
+    # -- task execution ----------------------------------------------------
+    def _get_callable(self, func_id: str):
+        fn = self._fn_cache.get(func_id)
+        if fn is None:
+            blob = self.client.call("fetch_function", func_id)
+            if blob is None:
+                raise RuntimeError(f"function {func_id} not found in KV")
+            fn = cloudpickle.loads(blob)
+            self._fn_cache[func_id] = fn
+        return fn
+
+    def _decode_arg(self, a):
+        tag = a[0]
+        if tag == "v" or tag == VAL:
+            return serialization.deserialize(a[1])
+        if tag == "o":
+            return a[1]
+        if tag == "shm":
+            oid = ObjectID(a[1])
+            mv = self.shm.wait(oid, timeout=30.0)
+            if mv is None:
+                raise RuntimeError(f"arg object {oid.hex()[:16]} not in shm")
+            return serialization.deserialize(mv)
+        raise RuntimeError(f"bad arg encoding {tag}")
+
+    def _encode_results(self, task_id: TaskID, num_returns: int, value: Any) -> list:
+        values = [value] if num_returns == 1 else list(value)
+        out = []
+        for i, v in enumerate(values):
+            blob = serialization.serialize(v)
+            if len(blob) > self.cfg.max_inline_object_size:
+                oid = ObjectID.for_return(task_id, i)
+                self.shm.put(oid, blob)
+                out.append(("shm", len(blob)))
+            else:
+                out.append(("b", bytes(blob)))
+        return out
+
+    def _handle(self, method: str, payload: Any):
+        if method == "execute_task":
+            return self._execute(payload)
+        if method == "create_actor":
+            return self._create_actor(payload)
+        if method == "ping":
+            return "pong"
+        if method == "shutdown":
+            threading.Thread(target=lambda: os._exit(0), daemon=True).start()
+            return True
+        raise RuntimeError(f"unknown worker rpc: {method}")
+
+    def _execute(self, p: dict):
+        task_id = TaskID(p["task_id"])
+        tok = _running_task.set(task_id)
+        try:
+            args = [self._decode_arg(a) for a in p["args"]]
+            kwargs = {k: self._decode_arg(v) for k, v in p["kwargs"].items()}
+            if p.get("actor_id") is not None:
+                instance = self._actors[ActorID(p["actor_id"])]
+                fn = getattr(instance, p["method_name"])
+            else:
+                fn = self._get_callable(p["func_id"])
+            value = fn(*args, **kwargs)
+            return {"results": self._encode_results(task_id, p["num_returns"], value),
+                    "error": None}
+        except BaseException as e:  # noqa: BLE001
+            return {"results": None, "error": TaskError.from_exception(e, p["name"])}
+        finally:
+            _running_task.reset(tok)
+
+    def _create_actor(self, p: dict):
+        task_id = TaskID(p["task_id"])
+        tok = _running_task.set(task_id)
+        try:
+            cls = self._get_callable(p["func_id"])
+            args = [self._decode_arg(a) for a in p["args"]]
+            kwargs = {k: self._decode_arg(v) for k, v in p["kwargs"].items()}
+            self._actors[ActorID(p["actor_id"])] = cls(*args, **kwargs)
+            return {"error": None}
+        except BaseException as e:  # noqa: BLE001
+            return {"error": TaskError.from_exception(e, p["name"])}
+        finally:
+            _running_task.reset(tok)
+
+
+def main():
+    session_id = os.environ["RT_SESSION_ID"]
+    sock_path = os.environ["RT_SOCK_PATH"]
+    worker_id = WorkerID.from_hex(os.environ["RT_WORKER_ID"])
+    ctx = WorkerContext(session_id, sock_path, worker_id)
+    context_mod.set_context(ctx)
+    # Park the main thread; all work arrives via the RPC reader.
+    ctx.client._closed.wait()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,155 @@
+"""Public API functions.
+
+Capability parity target: the reference's top-level API
+(/root/reference/python/ray/_private/worker.py: init:1227, get:2555,
+put:2687, wait:2752, remote:3145; python/ray/actor.py; python/ray/util).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Optional, Sequence, Union
+
+from ._private import context as context_mod
+from ._private.actor import ActorClass, ActorHandle, get_actor, method  # noqa: F401
+from ._private.exceptions import *  # noqa: F401,F403
+from ._private.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID  # noqa: F401
+from ._private.object_ref import ObjectRef
+from ._private.remote_function import RemoteFunction
+from ._private.runtime import Runtime
+from ._private.task_spec import SchedulingStrategy
+
+
+def init(num_cpus=None, num_tpus=None, resources=None, system_config=None,
+         ignore_reinit_error=True, **_ignored) -> Runtime:
+    """Start (or return) the runtime for this process."""
+    ctx = context_mod.get_context()
+    if ctx is not None:
+        if isinstance(ctx, Runtime) and not ignore_reinit_error:
+            raise RuntimeError("ray_tpu.init() called twice")
+        return ctx
+    rt = Runtime(num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
+                 system_config=system_config)
+    context_mod.set_context(rt)
+    return rt
+
+
+def is_initialized() -> bool:
+    return context_mod.get_context() is not None
+
+
+def shutdown():
+    ctx = context_mod.get_context()
+    if isinstance(ctx, Runtime):
+        ctx.shutdown()
+    context_mod.set_context(None)
+
+
+def _ensure() :
+    if context_mod.get_context() is None:
+        init()
+    return context_mod.require_context()
+
+
+def remote(*args, **kwargs):
+    """``@remote`` decorator for functions and classes (parity:
+    /root/reference/python/ray/_private/worker.py:3145)."""
+
+    def make(target):
+        if inspect.isclass(target):
+            return ActorClass(target, **kwargs)
+        return RemoteFunction(target, **kwargs)
+
+    if len(args) == 1 and callable(args[0]) and not kwargs:
+        return make(args[0])
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. @remote(num_cpus=2)")
+    return make
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: float | None = None):
+    return _ensure().get(refs, timeout=timeout)
+
+
+def put(value: Any) -> ObjectRef:
+    return _ensure().put(value)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: float | None = None):
+    return _ensure().wait(refs, num_returns=num_returns, timeout=timeout)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    _ensure().kill_actor(actor._actor_id, no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False):
+    _ensure().cancel(ref, force=force)
+
+
+def get_runtime_context():
+    return context_mod.RuntimeContext(context_mod.require_context())
+
+
+def cluster_resources() -> dict:
+    ctx = _ensure()
+    if hasattr(ctx, "cluster_resources"):
+        return ctx.cluster_resources()
+    return {}
+
+
+def available_resources() -> dict:
+    ctx = _ensure()
+    if hasattr(ctx, "available_resources"):
+        return ctx.available_resources()
+    return {}
+
+
+# ---------------------------------------------------------------------------
+# Placement groups (parity: /root/reference/python/ray/util/placement_group.py)
+# ---------------------------------------------------------------------------
+class PlacementGroupHandle:
+    def __init__(self, pg_id: PlacementGroupID, bundles, strategy):
+        self.id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+
+    def ready(self):
+        return put(True)  # single-node round 1: creation is synchronous
+
+    @property
+    def bundle_specs(self):
+        return self.bundles
+
+
+def placement_group(bundles: list[dict], strategy: str = "PACK",
+                    name: str = "") -> PlacementGroupHandle:
+    ctx = _ensure()
+    pg_id = ctx.create_placement_group(bundles, strategy)
+    return PlacementGroupHandle(pg_id, bundles, strategy)
+
+
+def remove_placement_group(pg: PlacementGroupHandle):
+    _ensure().remove_placement_group(pg.id)
+
+
+# Internal KV (parity: ray.experimental.internal_kv)
+def kv_put(key: str, value: bytes):
+    return _ensure().kv_op("put", key, value)
+
+
+def kv_get(key: str):
+    return _ensure().kv_op("get", key)
+
+
+def kv_del(key: str):
+    return _ensure().kv_op("del", key)
+
+
+def kv_exists(key: str) -> bool:
+    return _ensure().kv_op("exists", key)
+
+
+def kv_keys(prefix: str = ""):
+    return _ensure().kv_op("keys", prefix)

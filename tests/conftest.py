@@ -1,0 +1,38 @@
+"""Test configuration.
+
+Tests run on the CPU jax backend with 8 virtual devices so multi-chip
+sharding logic is exercised without TPU hardware (the driver separately
+dry-runs the multichip path; see __graft_entry__.py).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rt():
+    """A fresh runtime per test."""
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    rt = ray_tpu.init(num_cpus=4)
+    yield rt
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="session")
+def shared_rt():
+    """A session-scoped runtime for cheap read-only tests."""
+    import ray_tpu
+
+    rt = ray_tpu.init(num_cpus=4)
+    yield rt
+    ray_tpu.shutdown()

@@ -1,0 +1,200 @@
+"""Actor tests: lifecycle, state, ordering, named actors, device actors,
+failure/restart. Modeled on the reference's python/ray/tests/test_actor*.py
+coverage.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def incr(self, by=1):
+        self.n += by
+        return self.n
+
+    def value(self):
+        return self.n
+
+    def pid(self):
+        return os.getpid()
+
+    def crash(self):
+        os._exit(1)
+
+
+def test_actor_basic(rt):
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.incr.remote()) == 11
+    assert ray_tpu.get(c.incr.remote(5)) == 16
+    assert ray_tpu.get(c.value.remote()) == 16
+
+
+def test_actor_runs_in_subprocess(rt):
+    c = Counter.remote()
+    assert ray_tpu.get(c.pid.remote()) != os.getpid()
+
+
+def test_actor_method_ordering(rt):
+    c = Counter.remote()
+    refs = [c.incr.remote() for _ in range(20)]
+    assert ray_tpu.get(refs) == list(range(1, 21))
+
+
+def test_device_actor_in_process(rt):
+    @ray_tpu.remote(scheduling_strategy="device")
+    class DeviceCounter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def pid(self):
+            return os.getpid()
+
+    c = DeviceCounter.remote()
+    assert ray_tpu.get(c.pid.remote()) == os.getpid()
+    assert ray_tpu.get([c.incr.remote() for _ in range(5)]) == [1, 2, 3, 4, 5]
+
+
+def test_device_actor_holds_jax_state(rt):
+    @ray_tpu.remote(scheduling_strategy="device")
+    class Learner:
+        def __init__(self):
+            import jax.numpy as jnp
+
+            self.w = jnp.zeros((4,))
+
+        def step(self, g):
+            self.w = self.w + g
+            return self.w
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    l = Learner.remote()
+    out = ray_tpu.get(l.step.remote(jnp.ones((4,))))
+    np.testing.assert_allclose(np.asarray(out), np.ones(4))
+    out = ray_tpu.get(l.step.remote(jnp.ones((4,))))
+    np.testing.assert_allclose(np.asarray(out), 2 * np.ones(4))
+
+
+def test_named_actor(rt):
+    Counter.options(name="global_counter").remote(100)
+    h = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(h.incr.remote()) == 101
+
+
+def test_actor_init_failure_propagates(rt):
+    @ray_tpu.remote
+    class Bad:
+        def __init__(self):
+            raise RuntimeError("bad init")
+
+        def f(self):
+            return 1
+
+    b = Bad.remote()
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(b.f.remote(), timeout=60)
+
+
+def test_actor_method_error(rt):
+    @ray_tpu.remote
+    class E:
+        def boom(self):
+            raise ValueError("method boom")
+
+        def ok(self):
+            return "ok"
+
+    e = E.remote()
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(e.boom.remote())
+    # Actor stays alive after a method error.
+    assert ray_tpu.get(e.ok.remote()) == "ok"
+
+
+def test_actor_crash_then_dead(rt):
+    c = Counter.remote()
+    assert ray_tpu.get(c.incr.remote()) == 1
+    c.crash.remote()
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(c.incr.remote(), timeout=60)
+
+
+def test_actor_restart(rt):
+    @ray_tpu.remote(max_restarts=1)
+    class Phoenix:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def pid(self):
+            return os.getpid()
+
+        def crash(self):
+            os._exit(1)
+
+    p = Phoenix.remote()
+    pid1 = ray_tpu.get(p.pid.remote())
+    p.crash.remote()
+    # State resets after restart; new pid.
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        try:
+            pid2 = ray_tpu.get(p.pid.remote(), timeout=30)
+            break
+        except ray_tpu.TaskError:
+            time.sleep(0.5)
+    else:
+        pytest.fail("actor did not restart")
+    assert pid2 != pid1
+    assert ray_tpu.get(p.incr.remote()) == 1
+
+
+def test_kill_actor(rt):
+    c = Counter.remote()
+    ray_tpu.get(c.incr.remote())
+    ray_tpu.kill(c)
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(c.incr.remote(), timeout=60)
+
+
+def test_actor_handle_passed_to_task(rt):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def use(handle):
+        import ray_tpu as rtpu
+
+        return rtpu.get(handle.incr.remote(7))
+
+    assert ray_tpu.get(use.remote(c)) == 7
+    assert ray_tpu.get(c.value.remote()) == 7
+
+
+def test_max_concurrency(rt):
+    @ray_tpu.remote(scheduling_strategy="device", max_concurrency=4)
+    class Par:
+        def slow(self):
+            time.sleep(0.5)
+            return 1
+
+    p = Par.remote()
+    t0 = time.time()
+    ray_tpu.get([p.slow.remote() for _ in range(4)])
+    elapsed = time.time() - t0
+    assert elapsed < 1.9, f"expected concurrent execution, took {elapsed}"

@@ -1,0 +1,164 @@
+"""Core API tests: put/get/wait, tasks, errors, nesting.
+
+Modeled on the reference's python/ray/tests/test_basic.py coverage.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_put_get_roundtrip(rt):
+    for value in [1, "hello", {"a": [1, 2]}, None, (1, 2), b"bytes"]:
+        ref = ray_tpu.put(value)
+        assert ray_tpu.get(ref) == value
+
+
+def test_put_get_numpy_large(rt):
+    arr = np.arange(1_000_000, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_simple_task(rt):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_ref_arg(rt):
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    r1 = double.remote(10)
+    r2 = double.remote(r1)
+    assert ray_tpu.get(r2) == 40
+
+
+def test_task_kwargs_and_options(rt):
+    @ray_tpu.remote
+    def f(a, b=0):
+        return a - b
+
+    assert ray_tpu.get(f.remote(5, b=2)) == 3
+    assert ray_tpu.get(f.options(name="custom").remote(5)) == 5
+
+
+def test_multiple_returns(rt):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates(rt):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kapow")
+
+    with pytest.raises(ray_tpu.TaskError) as ei:
+        ray_tpu.get(boom.remote())
+    assert "kapow" in str(ei.value)
+
+
+def test_error_propagates_through_dependency(rt):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("root cause")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(consume.remote(boom.remote()))
+
+
+def test_wait(rt):
+    # Device lane: in-process execution, so timing is deterministic even on a
+    # loaded 1-core CI box (subprocess-lane behavior is covered elsewhere).
+    @ray_tpu.remote(scheduling_strategy="device")
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote(scheduling_strategy="device")
+    def slow():
+        time.sleep(8)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=6)
+    assert ready == [f]
+    assert not_ready == [s]
+
+
+def test_get_timeout(rt):
+    @ray_tpu.remote(scheduling_strategy="device")
+    def slow():
+        time.sleep(10)
+
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.2)
+
+
+def test_nested_tasks(rt):
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        import ray_tpu as rtpu
+
+        return rtpu.get(inner.remote(x)) * 10
+
+    assert ray_tpu.get(outer.remote(1)) == 20
+
+
+def test_large_result_through_shm(rt):
+    @ray_tpu.remote
+    def big():
+        return np.ones((512, 512), dtype=np.float64)  # 2 MiB > inline cap
+
+    out = ray_tpu.get(big.remote())
+    assert out.shape == (512, 512)
+    assert out.sum() == 512 * 512
+
+
+def test_device_lane_task(rt):
+    """Tasks with scheduling_strategy='device' run in-process (zero-copy)."""
+
+    @ray_tpu.remote(scheduling_strategy="device")
+    def on_device(x):
+        import jax.numpy as jnp
+
+        return jnp.sum(x)
+
+    import jax.numpy as jnp
+
+    x = jnp.arange(16.0)
+    out = ray_tpu.get(on_device.remote(x))
+    assert float(out) == float(sum(range(16)))
+
+
+def test_parallel_tasks_throughput(rt):
+    @ray_tpu.remote(scheduling_strategy="device")
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(50)]
+    assert ray_tpu.get(refs) == [i * i for i in range(50)]
+
+
+def test_cluster_resources(rt):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] == 4.0

@@ -1,0 +1,69 @@
+"""Object plane tests: refcounting/freeing, shm lifecycle, serialization.
+
+Modeled on the reference's python/ray/tests/test_object_* and
+test_reference_counting* coverage.
+"""
+
+import gc
+import os
+import time
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu._private import serialization
+
+
+def test_serialization_roundtrip_zero_copy():
+    arr = np.arange(1000, dtype=np.float64)
+    blob = serialization.serialize({"x": arr, "y": [1, "two"]})
+    out = serialization.deserialize(blob)
+    np.testing.assert_array_equal(out["x"], arr)
+    assert out["y"] == [1, "two"]
+
+
+def test_object_freed_when_refs_dropped(rt):
+    big = np.ones((1024, 1024), dtype=np.float64)  # 8 MiB -> shm
+
+    ref = ray_tpu.put(big)
+    shm_dir = rt.shm.prefix
+    time.sleep(0.3)
+    assert len(os.listdir(shm_dir)) == 1
+
+    del ref
+    gc.collect()
+    deadline = time.time() + 10
+    while time.time() < deadline and os.listdir(shm_dir):
+        time.sleep(0.1)
+    assert os.listdir(shm_dir) == [], "shm object not freed after ref drop"
+
+
+def test_chained_intermediate_freed(rt):
+    @ray_tpu.remote(scheduling_strategy="device")
+    def make():
+        return np.ones((1024, 1024), dtype=np.float64)
+
+    @ray_tpu.remote(scheduling_strategy="device")
+    def reduce_(a):
+        return float(a.sum())
+
+    # Intermediate ref is dropped immediately after chaining.
+    out = ray_tpu.get(reduce_.remote(make.remote()))
+    assert out == 1024 * 1024
+    gc.collect()
+    time.sleep(0.5)
+    # Only bookkeeping for still-held refs may remain; the 8MiB intermediate
+    # must be gone from the directory.
+    alive = [s for s in rt.node.objects.values() if s.size > 1 << 20]
+    assert not alive
+
+
+def test_put_many_objects_no_growth(rt):
+    for _ in range(20):
+        r = ray_tpu.put(np.ones((256, 1024), dtype=np.float64))  # 2 MiB
+        del r
+    gc.collect()
+    deadline = time.time() + 10
+    while time.time() < deadline and os.listdir(rt.shm.prefix):
+        time.sleep(0.1)
+    assert os.listdir(rt.shm.prefix) == []
