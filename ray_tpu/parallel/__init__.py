@@ -1,0 +1,34 @@
+"""Parallelism layer: meshes, shardings, collectives, parallel strategies.
+
+This is the TPU-native replacement for the reference's collective plane
+(/root/reference/python/ray/util/collective/) and for the parallelism that
+the reference delegates to external libraries (DDP/FSDP via torch; TP/PP/SP
+absent — see SURVEY.md §2.4): here DP/FSDP/TP/SP(/PP) are first-class mesh
+axes, and collectives compile into the training step over ICI.
+"""
+
+from .mesh import MeshSpec, ScalingConfig, get_abstract_mesh  # noqa: F401
+from .sharding import (  # noqa: F401
+    DEFAULT_RULES,
+    batch_sharding,
+    logical_to_mesh_axes,
+    named_sharding,
+    shard_params,
+    spec_for_logical,
+)
+from .collectives import (  # noqa: F401
+    CollectiveGroup,
+    all_gather,
+    all_to_all,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    destroy_collective_group,
+    get_group,
+    pmean,
+    ppermute,
+    psum,
+    reduce_scatter,
+    ring_neighbors,
+)

@@ -1,0 +1,127 @@
+"""Device meshes and scaling configuration.
+
+The reference expresses scale as ``ScalingConfig(num_workers, use_gpu)``
+(/root/reference/python/ray/air/config.py) and leaves *how* parallelism maps
+to hardware to torch (DDP/FSDP). On TPU the mapping IS the design: a slice is
+a torus of chips, and every parallelism strategy is an axis of a
+`jax.sharding.Mesh` laid out so that heavy collectives ride fast ICI
+dimensions. This module owns that mapping.
+
+Axes (outer → inner; inner axes get the fastest ICI proximity):
+
+    dp    pure data parallel (params replicated)
+    fsdp  data parallel with params/optimizer sharded (ZeRO-3 equivalent)
+    sp    sequence/context parallel (ring attention neighbors)
+    tp    tensor parallel (heaviest per-step collectives → innermost)
+
+plus an optional ``pp`` (pipeline) axis handled by parallel/pipeline.py.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_ORDER = ("dp", "fsdp", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """A factorization of the device count into parallelism axes."""
+
+    dp: int = 1
+    fsdp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def shape(self) -> dict:
+        return {"dp": self.dp, "fsdp": self.fsdp, "sp": self.sp, "tp": self.tp}
+
+    @property
+    def total(self) -> int:
+        return self.dp * self.fsdp * self.sp * self.tp
+
+    @property
+    def data_axes(self) -> tuple:
+        """Mesh axes a batch dimension is sharded over."""
+        return ("dp", "fsdp")
+
+    def build(self, devices: Optional[Sequence] = None) -> Mesh:
+        """Build a Mesh over `devices` (default: all local jax devices).
+
+        Device order matters for ICI locality: jax returns devices in
+        topology order, so reshaping row-major puts the innermost axis (tp)
+        on nearest-neighbor links.
+        """
+        if devices is None:
+            devices = jax.devices()
+        if len(devices) < self.total:
+            raise ValueError(
+                f"MeshSpec needs {self.total} devices, have {len(devices)}"
+            )
+        devices = np.asarray(devices[: self.total]).reshape(
+            self.dp, self.fsdp, self.sp, self.tp
+        )
+        return Mesh(devices, AXIS_ORDER)
+
+    @classmethod
+    def auto(cls, n_devices: Optional[int] = None, *, tp: int = 1, sp: int = 1,
+             fsdp: Optional[int] = None) -> "MeshSpec":
+        """Factorize ``n_devices`` into axes. Unspecified capacity goes to
+        fsdp (the safest default for large models: ZeRO-style sharding costs
+        one all-gather per layer but never duplicates memory)."""
+        if n_devices is None:
+            n_devices = len(jax.devices())
+        rest, rem = divmod(n_devices, tp * sp)
+        if rem:
+            raise ValueError(
+                f"tp*sp={tp * sp} does not divide device count {n_devices}"
+            )
+        if fsdp is None:
+            return cls(dp=1, fsdp=rest, sp=sp, tp=tp)
+        dp, rem = divmod(rest, fsdp)
+        if rem:
+            raise ValueError(f"fsdp={fsdp} does not divide {rest}")
+        return cls(dp=dp, fsdp=fsdp, sp=sp, tp=tp)
+
+
+@dataclass
+class ScalingConfig:
+    """User-facing scale description (parity:
+    /root/reference/python/ray/air/config.py ScalingConfig, extended with
+    mesh axes — the TPU-native capability the reference lacks).
+
+    ``num_workers`` is the number of *host processes* in the gang (one per
+    host of a slice, multi-controller SPMD); the mesh spans all their chips.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = True
+    chips_per_worker: Optional[int] = None  # default: all local chips
+    mesh: Optional[MeshSpec] = None  # default: MeshSpec.auto()
+    resources_per_worker: dict = field(default_factory=dict)
+    placement_strategy: str = "PACK"
+
+    def mesh_spec(self, n_devices: Optional[int] = None) -> MeshSpec:
+        if self.mesh is not None:
+            return self.mesh
+        return MeshSpec.auto(n_devices)
+
+    @property
+    def total_workers(self) -> int:
+        return self.num_workers
+
+
+def get_abstract_mesh(spec: MeshSpec):
+    """An AbstractMesh for shape-only tracing (no devices needed)."""
+    from jax.sharding import AbstractMesh
+
+    return AbstractMesh(
+        (spec.dp, spec.fsdp, spec.sp, spec.tp), AXIS_ORDER
+    )

@@ -1,0 +1,143 @@
+"""Logical-axis sharding rules → NamedSharding.
+
+The reference has no native sharding layer (torch DDP replicates; FSDP wraps
+modules). Here sharding is declarative: model params carry *logical* axis
+names (via flax ``nn.with_partitioning`` metadata or our tree annotator) and
+a rule table maps logical names to mesh axes. XLA's SPMD partitioner then
+inserts the collectives. This is the standard scaling-book recipe: pick a
+mesh, annotate shardings, let the compiler do the rest.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical axis -> mesh axis (or tuple of mesh axes, or None = replicate).
+# Defaults cover transformer/conv families; models may pass their own table.
+DEFAULT_RULES: dict[str, Any] = {
+    # batch-like
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",
+    # weight axes
+    "vocab": "tp",
+    "embed": "fsdp",        # ZeRO-3: shard the large embed dim of every param
+    "heads": "tp",
+    "kv": None,
+    "head_dim": None,
+    "mlp": "tp",
+    "expert": "tp",
+    # conv
+    "conv_in": None,
+    "conv_out": "fsdp",
+    "spatial": None,
+    # misc
+    "norm": None,
+}
+
+
+def logical_to_mesh_axes(logical_axes, rules=None):
+    """('batch','seq','embed') -> PartitionSpec over mesh axes."""
+    rules = {**DEFAULT_RULES, **(rules or {})}
+    out = []
+    used = set()
+    for name in logical_axes:
+        axis = rules.get(name) if name is not None else None
+        # A mesh axis may appear only once in a spec; later duplicates
+        # replicate instead (matches flax logical partitioning semantics).
+        if axis is None:
+            out.append(None)
+            continue
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        axes = tuple(a for a in axes if a not in used)
+        used.update(axes)
+        if not axes:
+            out.append(None)
+        elif len(axes) == 1:
+            out.append(axes[0])
+        else:
+            out.append(axes)
+    return P(*out)
+
+
+def spec_for_logical(*logical_axes, rules=None):
+    return logical_to_mesh_axes(logical_axes, rules)
+
+
+def named_sharding(mesh: Mesh, *logical_axes, rules=None) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_mesh_axes(logical_axes, rules))
+
+
+def batch_sharding(mesh: Mesh, extra_axes: tuple = ()) -> NamedSharding:
+    """Sharding for a [batch, ...] input: batch over all data axes."""
+    return NamedSharding(mesh, P(("dp", "fsdp"), *extra_axes))
+
+
+def _infer_param_logical(path: tuple, shape: tuple) -> tuple:
+    """Heuristic logical axes for un-annotated params.
+
+    FSDP default: shard the largest dim on 'embed' (→ fsdp), replicate the
+    rest. 1-D params (biases, norm scales) replicate.
+    """
+    if len(shape) <= 1:
+        return (None,) * len(shape)
+    largest = max(range(len(shape)), key=lambda i: shape[i])
+    return tuple("embed" if i == largest else None for i in range(len(shape)))
+
+
+def shard_params(params, mesh: Mesh, rules=None, annotations=None):
+    """device_put a param pytree with shardings.
+
+    ``annotations``: optional pytree (matching structure) of logical-axis
+    tuples; if absent, uses flax partitioning metadata when present, else the
+    FSDP heuristic.
+    """
+    rules = {**DEFAULT_RULES, **(rules or {})}
+
+    def spec_of(path, leaf, ann):
+        if ann is not None:
+            return logical_to_mesh_axes(ann, rules)
+        if hasattr(leaf, "names"):  # flax Partitioned boxed value
+            return logical_to_mesh_axes(leaf.names, rules)
+        shape = getattr(leaf, "shape", ())
+        return logical_to_mesh_axes(_infer_param_logical(path, shape), rules)
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    if annotations is not None:
+        ann_flat = jax.tree_util.tree_leaves(
+            annotations, is_leaf=lambda x: isinstance(x, tuple)
+        )
+    else:
+        ann_flat = [None] * len(flat)
+    out = []
+    for (path, leaf), ann in zip(flat, ann_flat):
+        spec = spec_of(path, leaf, ann)
+        out.append(jax.device_put(leaf, NamedSharding(mesh, spec)))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def params_pspec_tree(params, rules=None, annotations=None):
+    """PartitionSpec pytree for a param tree (for pjit in/out shardings)."""
+    rules = {**DEFAULT_RULES, **(rules or {})}
+
+    def one(path, leaf, ann):
+        if ann is not None:
+            return logical_to_mesh_axes(ann, rules)
+        if hasattr(leaf, "names"):
+            return logical_to_mesh_axes(leaf.names, rules)
+        return logical_to_mesh_axes(
+            _infer_param_logical(path, getattr(leaf, "shape", ())), rules
+        )
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    if annotations is not None:
+        ann_flat = jax.tree_util.tree_leaves(
+            annotations, is_leaf=lambda x: isinstance(x, tuple)
+        )
+    else:
+        ann_flat = [None] * len(flat)
+    return jax.tree_util.tree_unflatten(
+        treedef, [one(p, l, a) for (p, l), a in zip(flat, ann_flat)]
+    )
