@@ -1,0 +1,105 @@
+"""Headline benchmark: GPT-2-small training throughput on the local chip(s).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+North star (BASELINE.md): "Ray Train tokens/sec/chip" for GPT-2 DDP. The
+reference publishes no absolute number for this config; the baseline constant
+below is the well-known torch-DDP ballpark for GPT-2-small (124M) on one
+A100-40G with AMP — ~55k tokens/s — which is what a reference-stack user
+would see per accelerator. vs_baseline = our tokens/sec/chip ÷ that.
+
+Extra context (MFU, step time, config) goes to stderr so stdout stays a
+single JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+A100_GPT2S_TOKENS_PER_SEC = 55_000.0  # reference-stack per-accelerator ballpark
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import gpt
+    from ray_tpu.parallel import MeshSpec
+
+    devs = jax.devices()
+    n_chips = len(devs)
+    on_tpu = devs[0].platform != "cpu"
+    print(f"devices: {devs}", file=sys.stderr)
+
+    spec = MeshSpec.auto(n_chips)
+    mesh = spec.build()
+    data_shards = spec.dp * spec.fsdp
+    if on_tpu:
+        import dataclasses
+
+        cfg = dataclasses.replace(gpt.GPT2_SMALL, remat=True)
+        batch, seq = 16 * data_shards, cfg.max_seq  # 16 per data shard
+        warmup, iters = 3, 20
+    else:  # CPU smoke mode (CI): tiny model, same code path
+        cfg = gpt.TINY
+        batch, seq = 4 * data_shards, cfg.max_seq
+        warmup, iters = 1, 3
+    opt = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+    params = gpt.init(jax.random.key(0), cfg)
+    state = {"params": params, "opt_state": opt.init(params), "step": 0}
+    state = gpt.shard_state(state, mesh, cfg)
+    step = gpt.make_train_step(cfg, opt, mesh)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    tokens = jax.device_put(
+        jax.random.randint(jax.random.key(1), (batch, seq), 0, cfg.vocab_size),
+        NamedSharding(mesh, P(("dp", "fsdp"))),
+    )
+
+    t_compile = time.perf_counter()
+    for _ in range(warmup):
+        state, metrics = step(state, tokens)
+    # Fence via host materialization: the final loss depends on every prior
+    # step's state, and a host read is the one barrier every backend
+    # honors (block_until_ready is lazy on the remote axon platform).
+    float(metrics["loss"])
+    print(f"warmup+compile: {time.perf_counter() - t_compile:.1f}s",
+          file=sys.stderr)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, tokens)
+    final_loss = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    steps_per_sec = iters / dt
+    tokens_per_sec = steps_per_sec * batch * (seq - 1)
+    per_chip = tokens_per_sec / n_chips
+    flops_per_token = cfg.flops_per_token()
+    peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak per chip
+    mfu = tokens_per_sec * flops_per_token / (n_chips * peak)
+
+    print(
+        f"cfg: {cfg.num_params()/1e6:.0f}M params, batch={batch} seq={seq} "
+        f"mesh={spec.shape} step={dt/iters*1000:.0f}ms "
+        f"loss={final_loss:.3f} MFU={mfu*100:.1f}%",
+        file=sys.stderr,
+    )
+    print(json.dumps({
+        "metric": "gpt2_small_train_tokens_per_sec_per_chip" if on_tpu
+                  else "gpt_tiny_cpu_smoke_tokens_per_sec",
+        "value": round(per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(per_chip / A100_GPT2S_TOKENS_PER_SEC, 3) if on_tpu
+                       else 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

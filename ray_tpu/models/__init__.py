@@ -1,0 +1,9 @@
+"""Model families. Flagship: GPT decoder (models/gpt.py).
+
+Models are pure-JAX functional: ``init(key, cfg)`` returns the param pytree;
+``param_axes(cfg)`` returns the matching pytree of logical-axis annotations
+consumed by parallel/sharding.py; ``forward``/``loss_fn`` are jit-friendly
+and ``make_train_step`` builds the compiled SPMD training step.
+"""
+
+from . import gpt  # noqa: F401

@@ -1,0 +1,255 @@
+"""GPT-2-class decoder-only transformer, parallelism-aware.
+
+The flagship model for the Train north-star configs ("GPT-2 DDP" in
+BASELINE.md). Written TPU-first:
+
+  * bf16 activations, f32 params/optimizer (bf16 matmuls hit the MXU)
+  * scan-over-layers with optional remat (fast compiles, low memory)
+  * every param carries logical axis names; the same model runs dp-only,
+    fsdp, tp, sp or any mix purely by changing the mesh + rule table
+  * activation sharding constraints so XLA partitions along the intended
+    axes instead of guessing
+
+No counterpart exists in the reference (it orchestrates external models);
+this model exists so the framework's Train/Tune/Serve stacks have a serious
+native workload.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.attention import causal_attention
+from ..parallel.sharding import DEFAULT_RULES, logical_to_mesh_axes
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304  # GPT-2 BPE padded to a multiple of 128 (MXU tiling)
+    max_seq: int = 1024
+    d_model: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    n_kv_head: Optional[int] = None
+    d_ff: Optional[int] = None  # default 4*d_model
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+    use_flash: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_head
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_head or self.n_head
+
+    @property
+    def ff(self) -> int:
+        return self.d_ff or 4 * self.d_model
+
+    def num_params(self) -> int:
+        m, f, L = self.d_model, self.ff, self.n_layer
+        attn = m * m * 2 + 2 * m * (self.kv_heads * self.head_dim)
+        mlp = 2 * m * f
+        return self.vocab_size * m + self.max_seq * m + L * (attn + mlp + 2 * m) + m
+
+    def flops_per_token(self) -> float:
+        """Training FLOPs/token ≈ 6*N + attention term."""
+        return 6.0 * self.num_params() + 12.0 * self.n_layer * self.d_model * self.max_seq
+
+
+# Tiny/small presets used by tests, bench and the graft entry.
+TINY = GPTConfig(vocab_size=512, max_seq=128, d_model=128, n_layer=2, n_head=4)
+GPT2_SMALL = GPTConfig()  # 124M
+GPT2_MEDIUM = GPTConfig(d_model=1024, n_layer=24, n_head=16)
+
+
+def param_axes(cfg: GPTConfig) -> dict:
+    """Logical-axis annotations matching init()'s param tree."""
+    L = ("layers",)
+    return {
+        "wte": ("vocab", "embed"),
+        "wpe": (None, "embed"),
+        "blocks": {
+            "ln1": L + (None,),
+            "wq": L + ("embed", "heads", "head_dim"),
+            "wk": L + ("embed", "kv", "head_dim"),
+            "wv": L + ("embed", "kv", "head_dim"),
+            "wo": L + ("heads", "head_dim", "embed"),
+            "ln2": L + (None,),
+            "wi": L + ("embed", "mlp"),
+            "wm": L + ("mlp", "embed"),
+        },
+        "ln_f": (None,),
+    }
+
+
+def init(key, cfg: GPTConfig) -> dict:
+    """Initialize params (f32). GPT-2-style scaled init."""
+    m, d, h, hk, f, L = (cfg.d_model, cfg.head_dim, cfg.n_head, cfg.kv_heads,
+                         cfg.ff, cfg.n_layer)
+    k = iter(jax.random.split(key, 16))
+    std = 0.02
+    resid_std = std / np.sqrt(2 * L)
+
+    def rnd(key, shape, s):
+        return (jax.random.normal(key, shape) * s).astype(jnp.float32)
+
+    return {
+        "wte": rnd(next(k), (cfg.vocab_size, m), std),
+        "wpe": rnd(next(k), (cfg.max_seq, m), std),
+        "blocks": {
+            "ln1": jnp.ones((L, m), jnp.float32),
+            "wq": rnd(next(k), (L, m, h, d), std),
+            "wk": rnd(next(k), (L, m, hk, d), std),
+            "wv": rnd(next(k), (L, m, hk, d), std),
+            "wo": rnd(next(k), (L, h, d, m), resid_std),
+            "ln2": jnp.ones((L, m), jnp.float32),
+            "wi": rnd(next(k), (L, m, f), std),
+            "wm": rnd(next(k), (L, f, m), resid_std),
+        },
+        "ln_f": jnp.ones((m,), jnp.float32),
+    }
+
+
+def _layernorm(x, scale):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    out = (x32 - mu) * jax.lax.rsqrt(var + 1e-5) * scale
+    return out.astype(x.dtype)
+
+
+def _constrain(x, logical, mesh, rules):
+    if mesh is None:
+        return x
+    spec = logical_to_mesh_axes(logical, rules)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def _block(x, p, cfg: GPTConfig, mesh, rules):
+    """One transformer block. p: per-layer slice of the stacked block params."""
+    dt = cfg.dtype
+    h = _layernorm(x, p["ln1"])
+    q = jnp.einsum("bsm,mhd->bshd", h, p["wq"].astype(dt))
+    kk = jnp.einsum("bsm,mhd->bshd", h, p["wk"].astype(dt))
+    v = jnp.einsum("bsm,mhd->bshd", h, p["wv"].astype(dt))
+    q = _constrain(q, ("batch", "seq", "heads", None), mesh, rules)
+    if cfg.use_flash:
+        from ..ops.flash_attention import flash_attention
+
+        o = flash_attention(q, kk, v, causal=True)
+    else:
+        o = causal_attention(q, kk, v)
+    o = jnp.einsum("bshd,hdm->bsm", o, p["wo"].astype(dt))
+    x = x + _constrain(o, ("batch", "seq", "embed_act"), mesh, rules)
+
+    h = _layernorm(x, p["ln2"])
+    ff = jax.nn.gelu(jnp.einsum("bsm,mf->bsf", h, p["wi"].astype(dt)))
+    ff = _constrain(ff, ("batch", "seq", "mlp"), mesh, rules)
+    ff = jnp.einsum("bsf,fm->bsm", ff, p["wm"].astype(dt))
+    x = x + _constrain(ff, ("batch", "seq", "embed_act"), mesh, rules)
+    return x
+
+
+# Activation rules: batch over data axes, seq over sp, hidden replicated
+# (hidden sharding follows the matmul outputs: heads/mlp over tp).
+ACT_RULES = {"embed_act": None}
+
+
+def forward(params, tokens, cfg: GPTConfig, mesh: Optional[Mesh] = None,
+            rules: Optional[dict] = None) -> jax.Array:
+    """tokens [b, s] int32 -> logits [b, s, vocab] (cfg.dtype)."""
+    rules = {**DEFAULT_RULES, **ACT_RULES, **(rules or {})}
+    dt = cfg.dtype
+    b, s = tokens.shape
+    x = params["wte"].astype(dt)[tokens] + params["wpe"].astype(dt)[:s]
+    x = _constrain(x, ("batch", "seq", "embed_act"), mesh, rules)
+
+    block_fn = functools.partial(_block, cfg=cfg, mesh=mesh, rules=rules)
+    if cfg.remat:
+        block_fn = jax.checkpoint(
+            block_fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+
+    def scan_body(x, layer_params):
+        return block_fn(x, layer_params), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    x = _layernorm(x, params["ln_f"])
+    logits = jnp.einsum("bsm,vm->bsv", x, params["wte"].astype(dt))
+    return _constrain(logits, ("batch", "seq", "vocab"), mesh, rules)
+
+
+def loss_fn(params, tokens, cfg: GPTConfig, mesh=None, rules=None):
+    """Next-token cross-entropy (targets = tokens shifted left)."""
+    logits = forward(params, tokens[:, :-1], cfg, mesh, rules)
+    targets = tokens[:, 1:]
+    logits32 = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits32, axis=-1)
+    gold = jnp.take_along_axis(logits32, targets[..., None], axis=-1)[..., 0]
+    return (logz - gold).mean()
+
+
+def make_train_step(cfg: GPTConfig, optimizer, mesh: Optional[Mesh] = None,
+                    rules: Optional[dict] = None, donate: bool = True):
+    """Build the compiled SPMD train step: (state, tokens) -> (state, metrics).
+
+    state = {"params": ..., "opt_state": ..., "step": i}. With a mesh, XLA
+    partitions per the param/activation shardings and inserts gradient
+    reductions automatically — the in-graph equivalent of the reference's
+    NCCL allreduce in torch DDP
+    (/root/reference/python/ray/train/torch/config.py:106).
+    """
+
+    def step(state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state["params"], tokens, cfg, mesh, rules
+        )
+        updates, opt_state = optimizer.update(
+            grads, state["opt_state"], state["params"]
+        )
+        import optax
+
+        params = optax.apply_updates(state["params"], updates)
+        new_state = {"params": params, "opt_state": opt_state,
+                     "step": state["step"] + 1}
+        return new_state, {"loss": loss}
+
+    donate_argnums = (0,) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums)
+
+
+def params_pspecs(cfg: GPTConfig, rules=None) -> dict:
+    """PartitionSpec pytree matching init()'s param tree."""
+    rules = {**DEFAULT_RULES, **(rules or {})}
+    is_ann = lambda x: isinstance(x, tuple) and all(
+        isinstance(e, (str, type(None))) for e in x)
+    return jax.tree_util.tree_map(
+        lambda ann: logical_to_mesh_axes(ann, rules), param_axes(cfg),
+        is_leaf=is_ann)
+
+
+def shard_state(state, mesh: Mesh, cfg: GPTConfig, rules=None):
+    """device_put a train state with param-aligned shardings. Optimizer
+    moments mirror params *by tree structure* (see parallel.sharding
+    shard_like), so wq/wk/wv — equal shapes, different specs — stay correct.
+    """
+    from ..parallel.sharding import shard_like
+
+    pspec = params_pspecs(cfg, rules)
+    params = jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        state["params"], pspec)
+    opt_state = shard_like(state["opt_state"], state["params"], pspec, mesh)
+    return {"params": params, "opt_state": opt_state,
+            "step": jax.device_put(state["step"], NamedSharding(mesh, P()))}

@@ -1,0 +1,9 @@
+"""Compute ops: attention and fused primitives.
+
+XLA fuses most elementwise work into the surrounding matmuls; these modules
+provide the ops that benefit from explicit kernels (Pallas) or from
+collective-aware formulations (ring attention), with reference jnp
+implementations for CPU tests and as autodiff fallbacks.
+"""
+
+from .attention import causal_attention, multi_head_attention  # noqa: F401

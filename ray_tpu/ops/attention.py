@@ -1,0 +1,66 @@
+"""Attention ops.
+
+Reference implementation in jnp (XLA fuses this well on TPU for moderate
+sequence lengths); the Pallas flash kernel (ops/flash_attention.py) takes
+over for long sequences on real TPU, and parallel/ring_attention.py layers
+sequence parallelism on top via ppermute.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9  # bf16-safe large negative (not -inf: avoids NaN via 0*inf)
+
+
+def causal_attention(q, k, v, *, scale: Optional[float] = None,
+                     window: Optional[int] = None):
+    """Causal self-attention.
+
+    q,k,v: [batch, seq, heads, head_dim] (kv may have fewer heads — GQA —
+    broadcast when heads % kv_heads == 0).
+    Softmax runs in f32 regardless of input dtype (bf16-safe).
+    """
+    b, sq, hq, d = q.shape
+    _, sk, hk, _ = k.shape
+    if hq != hk:
+        if hq % hk:
+            raise ValueError(f"GQA requires heads({hq}) % kv_heads({hk}) == 0")
+        rep = hq // hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = scale if scale is not None else d ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    q_pos = jnp.arange(sq)[:, None]
+    k_pos = jnp.arange(sk)[None, :]
+    mask = q_pos >= k_pos - (sk - sq)
+    if window is not None:
+        mask &= q_pos - (k_pos - (sk - sq)) < window
+    logits = jnp.where(mask[None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def multi_head_attention(x, wq, wk, wv, wo, *, n_heads: int,
+                         n_kv_heads: Optional[int] = None,
+                         use_flash: bool = False):
+    """Full MHA block given projection weights.
+
+    x: [b, s, m]; wq: [m, h, d]; wk/wv: [m, hk, d]; wo: [h, d, m].
+    """
+    n_kv_heads = n_kv_heads or n_heads
+    q = jnp.einsum("bsm,mhd->bshd", x, wq)
+    k = jnp.einsum("bsm,mhd->bshd", x, wk)
+    v = jnp.einsum("bsm,mhd->bshd", x, wv)
+    if use_flash:
+        from .flash_attention import flash_attention
+
+        o = flash_attention(q, k, v, causal=True)
+    else:
+        o = causal_attention(q, k, v)
+    return jnp.einsum("bshd,hdm->bsm", o, wo)

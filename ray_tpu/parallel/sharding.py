@@ -118,6 +118,30 @@ def shard_params(params, mesh: Mesh, rules=None, annotations=None):
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+def shard_like(tree, params, pspec_tree, mesh: Mesh):
+    """Place `tree` (e.g. an optimizer state) whose param-shaped subtrees
+    mirror `params`' structure: such subtrees get the param specs, everything
+    else replicates. This is how adam moments inherit their param's sharding
+    without shape-keyed guessing."""
+    ptreedef = jax.tree_util.tree_structure(params)
+
+    def is_param_tree(x):
+        try:
+            return jax.tree_util.tree_structure(x) == ptreedef
+        except Exception:
+            return False
+
+    def place(sub):
+        if is_param_tree(sub):
+            return jax.tree_util.tree_map(
+                lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+                sub, pspec_tree)
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(leaf, NamedSharding(mesh, P())), sub)
+
+    return jax.tree_util.tree_map(place, tree, is_leaf=is_param_tree)
+
+
 def params_pspec_tree(params, rules=None, annotations=None):
     """PartitionSpec pytree for a param tree (for pjit in/out shardings)."""
     rules = {**DEFAULT_RULES, **(rules or {})}
