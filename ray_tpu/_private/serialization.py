@@ -48,7 +48,8 @@ class _Pickler(cloudpickle.CloudPickler):
             import numpy as np
 
             return (np.asarray, (np.asarray(obj),))
-        return NotImplemented
+        # Defer to cloudpickle's own overrides (local functions, classes, …).
+        return super().reducer_override(obj)
 
 
 def serialize(obj: Any) -> bytes:

@@ -6,4 +6,4 @@ consumed by parallel/sharding.py; ``forward``/``loss_fn`` are jit-friendly
 and ``make_train_step`` builds the compiled SPMD training step.
 """
 
-from . import gpt  # noqa: F401
+from . import gpt, resnet  # noqa: F401

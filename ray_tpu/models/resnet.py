@@ -1,0 +1,173 @@
+"""ResNet (CIFAR-style) — the vision workload of the Train north star
+("TorchTrainer-equivalent ResNet-50/CIFAR-10", BASELINE.md).
+
+Pure-JAX functional, same conventions as models/gpt.py: init -> params,
+param_axes -> logical annotations, forward/loss_fn jit-friendly. Convs run
+in NHWC (TPU-native layout); batch-norm is replaced by group norm so the
+same model is correct under any data sharding without cross-device batch
+statistics (a deliberate TPU-first choice: no syncBN collectives needed).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 10
+    stage_sizes: tuple = (3, 3, 3)      # ResNet-20 for CIFAR
+    width: int = 16
+    groups: int = 8                      # group-norm groups
+    dtype: Any = jnp.bfloat16
+
+
+RESNET20 = ResNetConfig()
+RESNET56 = ResNetConfig(stage_sizes=(9, 9, 9))
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    fan_in = kh * kw * cin
+    std = np.sqrt(2.0 / fan_in)
+    return (jax.random.normal(key, (kh, kw, cin, cout)) * std).astype(jnp.float32)
+
+
+def init(key, cfg: ResNetConfig) -> dict:
+    keys = jax.random.split(key, 256)
+    ki = iter(range(256))
+    w = cfg.width
+    params = {"stem": {"conv": _conv_init(keys[next(ki)], 3, 3, 3, w),
+                       "gn": {"scale": jnp.ones((w,)), "bias": jnp.zeros((w,))}}}
+    for s, n_blocks in enumerate(cfg.stage_sizes):
+        blocks = []
+        for b in range(n_blocks):
+            cin, cout = _channels(cfg, s, b)
+            blk = {
+                "conv1": _conv_init(keys[next(ki)], 3, 3, cin, cout),
+                "gn1": {"scale": jnp.ones((cout,)), "bias": jnp.zeros((cout,))},
+                "conv2": _conv_init(keys[next(ki)], 3, 3, cout, cout),
+                "gn2": {"scale": jnp.ones((cout,)), "bias": jnp.zeros((cout,))},
+            }
+            if _needs_proj(cfg, s, b):
+                blk["proj"] = _conv_init(keys[next(ki)], 1, 1, cin, cout)
+            blocks.append(blk)
+        params[f"stage{s}"] = blocks
+    c_last = _channels(cfg, len(cfg.stage_sizes) - 1, 0)[1]
+    params["head"] = {
+        "kernel": (jax.random.normal(keys[next(ki)], (c_last, cfg.num_classes))
+                   * 0.01).astype(jnp.float32),
+        "bias": jnp.zeros((cfg.num_classes,)),
+    }
+    return params
+
+
+def param_axes(cfg: ResNetConfig) -> Any:
+    """Conv kernels shard their output channels on fsdp; head on tp."""
+    def conv_ax():
+        return ("spatial", "spatial", "conv_in", "conv_out")
+
+    def gn_ax():
+        return {"scale": (None,), "bias": (None,)}
+
+    axes = {"stem": {"conv": conv_ax(), "gn": gn_ax()}}
+    for s, n_blocks in enumerate(cfg.stage_sizes):
+        blocks = []
+        for b in range(n_blocks):
+            blk = {"conv1": conv_ax(), "gn1": gn_ax(),
+                   "conv2": conv_ax(), "gn2": gn_ax()}
+            if _needs_proj(cfg, s, b):
+                blk["proj"] = conv_ax()
+            blocks.append(blk)
+        axes[f"stage{s}"] = blocks
+    axes["head"] = {"kernel": ("embed", "vocab"), "bias": (None,)}
+    return axes
+
+
+def _stride(s: int, b: int) -> int:
+    return 2 if (s > 0 and b == 0) else 1
+
+
+def _channels(cfg: ResNetConfig, s: int, b: int) -> tuple[int, int]:
+    """(cin, cout) for block b of stage s."""
+    cout = cfg.width * (2 ** s)
+    if b > 0:
+        cin = cout
+    else:
+        cin = cfg.width * (2 ** (s - 1)) if s > 0 else cfg.width
+    return cin, cout
+
+
+def _needs_proj(cfg: ResNetConfig, s: int, b: int) -> bool:
+    cin, cout = _channels(cfg, s, b)
+    return _stride(s, b) != 1 or cin != cout
+
+
+def _conv(x, kernel, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, kernel.astype(x.dtype), (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _group_norm(x, gn, groups):
+    import math
+
+    b, h, w, c = x.shape
+    g = math.gcd(groups, c)  # must divide the channel count
+    x32 = x.astype(jnp.float32).reshape(b, h, w, g, c // g)
+    mean = x32.mean((1, 2, 4), keepdims=True)
+    var = x32.var((1, 2, 4), keepdims=True)
+    x32 = (x32 - mean) * jax.lax.rsqrt(var + 1e-5)
+    x32 = x32.reshape(b, h, w, c) * gn["scale"] + gn["bias"]
+    return x32.astype(x.dtype)
+
+
+def forward(params, images, cfg: ResNetConfig) -> jax.Array:
+    """images [b, 32, 32, 3] -> logits [b, num_classes]."""
+    x = images.astype(cfg.dtype)
+    x = _conv(x, params["stem"]["conv"])
+    x = jax.nn.relu(_group_norm(x, params["stem"]["gn"], cfg.groups))
+    for s in range(len(cfg.stage_sizes)):
+        for b, blk in enumerate(params[f"stage{s}"]):
+            stride = _stride(s, b)
+            h = _conv(x, blk["conv1"], stride)
+            h = jax.nn.relu(_group_norm(h, blk["gn1"], cfg.groups))
+            h = _conv(h, blk["conv2"])
+            h = _group_norm(h, blk["gn2"], cfg.groups)
+            shortcut = _conv(x, blk["proj"], stride) if "proj" in blk else x
+            x = jax.nn.relu(h + shortcut)
+    x = x.mean((1, 2))  # global average pool
+    logits = x.astype(jnp.float32) @ params["head"]["kernel"] + params["head"]["bias"]
+    return logits
+
+
+def loss_fn(params, batch, cfg: ResNetConfig):
+    images, labels = batch
+    logits = forward(params, images, cfg)
+    onehot = jax.nn.one_hot(labels, cfg.num_classes)
+    loss = -(jax.nn.log_softmax(logits) * onehot).sum(-1).mean()
+    acc = (logits.argmax(-1) == labels).mean()
+    return loss, acc
+
+
+def make_train_step(cfg: ResNetConfig, optimizer):
+    def step(state, batch):
+        def lf(p):
+            loss, acc = loss_fn(p, batch, cfg)
+            return loss, acc
+
+        (loss, acc), grads = jax.value_and_grad(lf, has_aux=True)(state["params"])
+        import optax
+
+        updates, opt_state = optimizer.update(grads, state["opt_state"],
+                                              state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return ({"params": params, "opt_state": opt_state,
+                 "step": state["step"] + 1},
+                {"loss": loss, "accuracy": acc})
+
+    return jax.jit(step, donate_argnums=(0,))

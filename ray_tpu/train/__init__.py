@@ -1,0 +1,24 @@
+"""ray_tpu.train — distributed training orchestration (Ray Train parity).
+
+Public surface mirrors ray.train: JaxTrainer (TorchTrainer-equivalent),
+ScalingConfig/RunConfig/CheckpointConfig/FailureConfig, Checkpoint, Result,
+and the in-loop API report/get_context/get_checkpoint/get_dataset_shard.
+"""
+
+from ..parallel.mesh import MeshSpec, ScalingConfig  # noqa: F401
+from .checkpoint import Checkpoint, CheckpointManager, load_pytree, save_pytree  # noqa: F401
+from .session import (  # noqa: F401
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from .trainer import (  # noqa: F401
+    CheckpointConfig,
+    FailureConfig,
+    JaxTrainer,
+    Result,
+    RunConfig,
+    TrainWorker,
+)

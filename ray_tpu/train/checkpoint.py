@@ -1,0 +1,176 @@
+"""Checkpoints: directory-based, orbax-backed for jax pytrees.
+
+Capability parity target: the reference's Checkpoint
+(/root/reference/python/ray/train/_checkpoint.py:55 — a directory +
+filesystem handle with from_directory/to_directory/as_directory) and the
+top-K retention of CheckpointManager
+(/root/reference/python/ray/train/_internal/checkpoint_manager.py).
+TPU-native addition: first-class jax pytree save/restore via orbax, with
+sharding-aware restore (params land back on their mesh shardings).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import uuid
+from contextlib import contextmanager
+from typing import Any, Optional
+
+
+class Checkpoint:
+    """A directory snapshot. Cheap handle; data stays on the filesystem."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def to_directory(self, dest: Optional[str] = None) -> str:
+        dest = dest or tempfile.mkdtemp(prefix="rtpu-ckpt-")
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    @contextmanager
+    def as_directory(self):
+        yield self.path
+
+    # -- jax pytree helpers -------------------------------------------------
+    @classmethod
+    def from_state(cls, state: Any, path: Optional[str] = None) -> "Checkpoint":
+        """Save a jax pytree (train state) with orbax."""
+        path = path or os.path.join(
+            tempfile.gettempdir(), f"rtpu-ckpt-{uuid.uuid4().hex[:8]}")
+        save_pytree(state, path)
+        return cls(path)
+
+    def load_state(self, target: Any = None, mesh=None, shardings=None) -> Any:
+        return load_pytree(self.path, target=target, shardings=shardings)
+
+    def update_metadata(self, meta: dict):
+        with open(os.path.join(self.path, "rtpu_meta.json"), "w") as f:
+            json.dump(meta, f)
+
+    def get_metadata(self) -> dict:
+        p = os.path.join(self.path, "rtpu_meta.json")
+        if os.path.exists(p):
+            with open(p) as f:
+                return json.load(f)
+        return {}
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+
+def save_pytree(state: Any, path: str):
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    if os.path.exists(os.path.join(path, "pytree")):
+        shutil.rmtree(os.path.join(path, "pytree"))
+    os.makedirs(path, exist_ok=True)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.join(path, "pytree"), state)
+
+
+def load_pytree(path: str, target: Any = None, shardings=None) -> Any:
+    """Restore a pytree. With ``target`` (a pytree of arrays or
+    ShapeDtypeStructs with shardings), arrays restore directly onto the
+    target's shardings — the multi-chip-safe path."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    item = os.path.join(os.path.abspath(path), "pytree")
+    with ocp.StandardCheckpointer() as ckptr:
+        if target is not None:
+            abstract = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(
+                    x.shape, x.dtype,
+                    sharding=getattr(x, "sharding", None))
+                if hasattr(x, "shape") else x,
+                target,
+            )
+            return ckptr.restore(item, abstract)
+        return ckptr.restore(item)
+
+
+class CheckpointManager:
+    """Top-K checkpoint retention under a run directory."""
+
+    def __init__(self, root: str, num_to_keep: Optional[int] = None,
+                 score_attribute: Optional[str] = None, score_order: str = "max"):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self._ckpts: list[tuple[float, int, Checkpoint]] = []
+        self._count = 0
+
+    def register(self, ckpt: Checkpoint, metrics: dict) -> Checkpoint:
+        """Move a reported checkpoint under the run dir and apply retention."""
+        self._count += 1
+        dest = os.path.join(self.root, f"checkpoint_{self._count:06d}")
+        if ckpt.path != dest:
+            shutil.copytree(ckpt.path, dest, dirs_exist_ok=True)
+            maybe_cleanup_tmp_checkpoint(ckpt.path)
+        managed = Checkpoint(dest)
+        managed.update_metadata({"metrics": _json_safe(metrics)})
+        if self.score_attribute:
+            if self.score_attribute in metrics:
+                score = float(metrics[self.score_attribute])
+                if self.score_order == "min":
+                    score = -score
+            else:
+                # A report without the score attribute must never win "best"
+                # (and is evicted first under top-K retention).
+                score = float("-inf")
+        else:
+            score = float(self._count)  # recency
+            if self.score_order == "min":
+                score = -score
+        self._ckpts.append((score, self._count, managed))
+        if self.num_to_keep is not None and len(self._ckpts) > self.num_to_keep:
+            self._ckpts.sort()
+            _, _, evicted = self._ckpts.pop(0)
+            shutil.rmtree(evicted.path, ignore_errors=True)
+        return managed
+
+    @property
+    def best(self) -> Optional[Checkpoint]:
+        if not self._ckpts:
+            return None
+        return max(self._ckpts)[2]
+
+    @property
+    def latest(self) -> Optional[Checkpoint]:
+        if not self._ckpts:
+            return None
+        return max(self._ckpts, key=lambda t: t[1])[2]
+
+
+def maybe_cleanup_tmp_checkpoint(path: str):
+    """Delete a checkpoint source dir iff it is one of our tempdir
+    snapshots (Checkpoint.from_state default location) — never a
+    user-provided directory."""
+    tmp = tempfile.gettempdir()
+    base = os.path.basename(os.path.normpath(path))
+    if os.path.dirname(os.path.normpath(path)) == tmp and \
+            base.startswith("rtpu-ckpt-"):
+        shutil.rmtree(path, ignore_errors=True)
+
+
+def _json_safe(d: dict) -> dict:
+    out = {}
+    for k, v in d.items():
+        try:
+            json.dumps(v)
+            out[k] = v
+        except (TypeError, ValueError):
+            out[k] = repr(v)
+    return out

@@ -1,0 +1,109 @@
+"""Per-worker training session.
+
+Capability parity target: the reference's session plumbing
+(/root/reference/python/ray/train/_internal/session.py — `report:393` queues
+results that the trainable polls back; `get_context` exposes ranks). Here the
+session is a module-global bound inside each TrainWorker; ``report`` enqueues
+(metrics, checkpoint) pairs that the trainer's fit-loop drains via actor
+polling.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from .checkpoint import Checkpoint
+
+# Thread-local: several TrainWorkers (e.g. concurrent Tune trials as device
+# actors) can coexist in one process, each binding the session on its own
+# training-loop thread.
+_tls = threading.local()
+
+
+@dataclass
+class TrainContext:
+    world_rank: int = 0
+    world_size: int = 1
+    local_rank: int = 0
+    node_rank: int = 0
+    experiment_name: str = ""
+    trial_name: str = ""
+    trial_id: str = ""
+    datasets: dict = field(default_factory=dict)
+    mesh: Any = None
+    loaded_checkpoint: Optional[Checkpoint] = None
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_trial_name(self) -> str:
+        return self.trial_name
+
+    def get_experiment_name(self) -> str:
+        return self.experiment_name
+
+
+class _TrainSession:
+    def __init__(self, ctx: TrainContext):
+        self.ctx = ctx
+        self.reports: queue.Queue = queue.Queue()
+        self.stop_event = threading.Event()
+
+    def report(self, metrics: dict, checkpoint: Optional[Checkpoint] = None):
+        self.reports.put(("report", dict(metrics), checkpoint))
+        if self.stop_event.is_set():
+            raise StopIteration("training stopped by the controller")
+
+
+def _bind(session: "_TrainSession"):
+    _tls.session = session
+    return session
+
+
+def _unbind():
+    _tls.session = None
+
+
+def _get() -> Optional[_TrainSession]:
+    return getattr(_tls, "session", None)
+
+
+# -- public API (ray_tpu.train.*) -------------------------------------------
+def report(metrics: dict, checkpoint: Optional[Checkpoint] = None):
+    """Report metrics (and optionally a checkpoint) from the training loop."""
+    s = _get()
+    if s is None:
+        raise RuntimeError("ray_tpu.train.report() called outside a training loop")
+    s.report(metrics, checkpoint)
+
+
+def get_context() -> TrainContext:
+    s = _get()
+    if s is None:
+        return TrainContext()
+    return s.ctx
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    """The checkpoint to resume from (set on gang restart after failure)."""
+    s = _get()
+    return s.ctx.loaded_checkpoint if s else None
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's shard of a dataset passed to the trainer
+    (parity: ray.train.get_dataset_shard; reference streaming_split ingest
+    /root/reference/python/ray/train/_internal/data_config.py:112)."""
+    s = _get()
+    if s is None or name not in s.ctx.datasets:
+        raise KeyError(f"no dataset '{name}' attached to this training run")
+    return s.ctx.datasets[name]

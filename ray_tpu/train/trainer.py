@@ -1,0 +1,270 @@
+"""JaxTrainer: gang-scheduled SPMD training orchestration.
+
+Capability parity target: the reference's DataParallelTrainer stack
+(/root/reference/python/ray/train/data_parallel_trainer.py:26 — worker-group
+gang, per-worker train loop, report/checkpoint plumbing, failure restarts
+from the latest checkpoint via /root/reference/python/ray/train/
+_internal/backend_executor.py). TPU-native differences:
+
+  * A "worker" is one *host process* owning all its local chips
+    (multi-controller SPMD), not one process per accelerator. On a single
+    host the gang is a single device actor with an in-process mesh — chip
+    parallelism happens inside the compiled step, not across actors.
+  * No NCCL process group setup: the collective plane is in-graph
+    (XLA/ICI). Multi-host rendezvous (jax.distributed) bootstraps from the
+    runtime KV instead of a TCP store.
+  * Checkpoints are orbax pytrees (sharding-aware restore).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ..parallel.mesh import ScalingConfig
+from .checkpoint import Checkpoint, CheckpointManager
+from .session import TrainContext, _bind
+
+
+@dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclass
+class FailureConfig:
+    max_failures: int = 0
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: str = "/tmp/ray_tpu/results"
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+
+
+@dataclass
+class Result:
+    metrics: dict = field(default_factory=dict)
+    checkpoint: Optional[Checkpoint] = None
+    best_checkpoint: Optional[Checkpoint] = None
+    error: Optional[BaseException] = None
+    path: str = ""
+    metrics_history: list = field(default_factory=list)
+
+
+class TrainWorker:
+    """One gang member. Runs the user loop on a thread; the trainer polls
+    reports through actor calls (needs max_concurrency >= 2)."""
+
+    def __init__(self, rank: int, world_size: int, loop_fn: Callable,
+                 config: dict, experiment: str, trial: str,
+                 datasets: dict | None, resume_ckpt_path: Optional[str]):
+        import threading
+
+        ctx = TrainContext(
+            world_rank=rank, world_size=world_size, local_rank=rank,
+            experiment_name=experiment, trial_name=trial,
+            trial_id=trial, datasets=datasets or {},
+            loaded_checkpoint=(Checkpoint(resume_ckpt_path)
+                               if resume_ckpt_path else None),
+        )
+        from .session import _TrainSession
+
+        self._session = _TrainSession(ctx)
+        self._done = False
+        self._error: Optional[str] = None
+        self._result: Any = None
+
+        def run():
+            _bind(self._session)  # thread-local: bound on the loop's thread
+            try:
+                sig_takes_config = True
+                try:
+                    import inspect
+
+                    sig_takes_config = len(
+                        inspect.signature(loop_fn).parameters) > 0
+                except (TypeError, ValueError):
+                    pass
+                self._result = (loop_fn(config) if sig_takes_config
+                                else loop_fn())
+            except StopIteration:
+                pass
+            except BaseException as e:  # noqa: BLE001 - surfaced via poll()
+                import traceback
+
+                self._error = f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
+            finally:
+                self._done = True
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name=f"train-loop-{rank}")
+        self._thread.start()
+
+    def poll(self, timeout: float = 0.5):
+        """Drain queued reports. Returns (reports, done, error)."""
+        import queue as _q
+
+        reports = []
+        try:
+            while True:
+                kind, metrics, ckpt = self._session.reports.get(
+                    timeout=timeout if not reports and not self._done else 0)
+                reports.append((metrics, ckpt.path if ckpt else None))
+        except _q.Empty:
+            pass
+        return reports, self._done, self._error
+
+    def stop(self):
+        """Cooperative stop: the next report() in the loop raises
+        StopIteration, ending the loop cleanly (used by the trainer on
+        gang teardown and by Tune schedulers for early termination)."""
+        self._session.stop_event.set()
+        return True
+
+
+class JaxTrainer:
+    """Parity surface: TorchTrainer/DataParallelTrainer
+    (train_loop_per_worker, train_loop_config, scaling_config, run_config,
+    datasets, resume_from_checkpoint) → .fit() → Result."""
+
+    def __init__(self, train_loop_per_worker: Callable, *,
+                 train_loop_config: Optional[dict] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 datasets: Optional[dict] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        self.loop = train_loop_per_worker
+        self.config = train_loop_config or {}
+        self.scaling = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self.resume_from = resume_from_checkpoint
+
+    # -- internals ---------------------------------------------------------
+    def _make_workers(self, name: str, resume_path: Optional[str]):
+        import ray_tpu
+
+        n = self.scaling.num_workers
+        use_device = self.scaling.use_tpu
+        if use_device and n > 1:
+            raise ValueError(
+                "round-1 limitation: one TPU gang worker per host — chip "
+                "parallelism happens inside the compiled step via the mesh; "
+                "set num_workers=1 (or use_tpu=False for CPU gang testing)"
+            )
+        cls = ray_tpu.remote(TrainWorker)
+        opts = dict(max_concurrency=4)
+        if use_device:
+            opts["scheduling_strategy"] = "device"
+        else:
+            opts["num_cpus"] = self.scaling.resources_per_worker.get("CPU", 1)
+        workers = []
+        datasets_per_worker = self._split_datasets(n)
+        for rank in range(n):
+            w = cls.options(**opts).remote(
+                rank, n, self.loop, self.config, name, f"{name}_w{rank}",
+                datasets_per_worker[rank], resume_path,
+            )
+            workers.append(w)
+        return workers
+
+    def _split_datasets(self, n: int) -> list[dict]:
+        out = [dict() for _ in range(n)]
+        for key, ds in self.datasets.items():
+            if n == 1:
+                out[0][key] = ds
+            elif hasattr(ds, "streaming_split"):
+                shards = ds.streaming_split(n)
+                for i in range(n):
+                    out[i][key] = shards[i]
+            elif isinstance(ds, (list, tuple)):
+                for i in range(n):
+                    out[i][key] = list(ds[i::n])  # round-robin shard by rank
+            else:
+                raise TypeError(
+                    f"dataset '{key}' ({type(ds).__name__}) cannot be split "
+                    f"across {n} workers — provide a ray_tpu.data.Dataset "
+                    f"(streaming_split) or a list")
+        return out
+
+    def fit(self) -> Result:
+        import ray_tpu
+
+        name = self.run_config.name or f"JaxTrainer_{uuid.uuid4().hex[:6]}"
+        exp_dir = os.path.join(self.run_config.storage_path, name)
+        os.makedirs(exp_dir, exist_ok=True)
+        cc = self.run_config.checkpoint_config
+        manager = CheckpointManager(
+            os.path.join(exp_dir, "checkpoints"), cc.num_to_keep,
+            cc.checkpoint_score_attribute, cc.checkpoint_score_order)
+
+        failures_left = self.run_config.failure_config.max_failures
+        resume_path = self.resume_from.path if self.resume_from else None
+        history: list[dict] = []
+        last_metrics: dict = {}
+        error: Optional[BaseException] = None
+
+        while True:
+            workers = self._make_workers(name, resume_path)
+            gang_failed = False
+            done_flags = [False] * len(workers)
+            worker_error: Optional[str] = None
+            while not all(done_flags) and not gang_failed:
+                polls = [w.poll.remote() for w in workers]
+                try:
+                    results = ray_tpu.get(polls, timeout=600)
+                except ray_tpu.RayTpuError as e:  # TaskError, GetTimeoutError…
+                    gang_failed = True
+                    worker_error = str(e)
+                    break
+                for rank, (reports, done, err) in enumerate(results):
+                    done_flags[rank] = done
+                    if err is not None:
+                        gang_failed = True
+                        worker_error = err
+                    for metrics, ckpt_path in reports:
+                        if rank == 0:
+                            history.append(metrics)
+                            last_metrics = metrics
+                            if ckpt_path:
+                                manager.register(Checkpoint(ckpt_path), metrics)
+                        elif ckpt_path:
+                            # Non-rank-0 snapshots are redundant; reclaim tmp.
+                            from .checkpoint import maybe_cleanup_tmp_checkpoint
+
+                            maybe_cleanup_tmp_checkpoint(ckpt_path)
+                if not all(done_flags) and not gang_failed:
+                    time.sleep(0.05)
+            for w in workers:
+                try:
+                    w.stop.remote()  # cooperative stop for loops still running
+                    ray_tpu.kill(w)
+                except Exception:
+                    pass
+            if not gang_failed:
+                break
+            if failures_left > 0:
+                failures_left -= 1
+                latest = manager.latest
+                resume_path = latest.path if latest else resume_path
+                continue
+            error = ray_tpu.TaskError(
+                f"training failed (no retries left): {worker_error}")
+            break
+
+        return Result(
+            metrics=last_metrics,
+            checkpoint=manager.latest,
+            best_checkpoint=manager.best,
+            error=error,
+            path=exp_dir,
+            metrics_history=history,
+        )

@@ -99,3 +99,34 @@ def test_dryrun_shapes_divisible():
     graft = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(graft)
     graft.dryrun_multichip(6)
+
+
+def test_resnet_trains():
+    import optax
+
+    from ray_tpu.models import resnet
+
+    cfg = resnet.RESNET20
+    p = resnet.init(jax.random.key(0), cfg)
+    imgs = jax.random.normal(jax.random.key(1), (8, 32, 32, 3))
+    labels = jax.random.randint(jax.random.key(2), (8,), 0, 10)
+    opt = optax.sgd(0.1, momentum=0.9)
+    state = {"params": p, "opt_state": opt.init(p), "step": 0}
+    step = resnet.make_train_step(cfg, opt)
+    for i in range(40):
+        state, m = step(state, (imgs, labels))
+    assert float(m["accuracy"]) > 0.5  # overfits a tiny batch
+
+
+def test_resnet_param_axes_match():
+    from ray_tpu.models import resnet
+
+    cfg = resnet.RESNET20
+    p = resnet.init(jax.random.key(0), cfg)
+    ax = resnet.param_axes(cfg)
+    ps = jax.tree_util.tree_structure(p)
+    is_ann = lambda x: isinstance(x, tuple) and all(
+        isinstance(e, (str, type(None))) for e in x)
+    axs = jax.tree_util.tree_structure(
+        jax.tree_util.tree_map(lambda a: 0, ax, is_leaf=is_ann))
+    assert ps == axs
