@@ -1,0 +1,27 @@
+"""ray_tpu.data — streaming distributed datasets (Ray Data parity).
+
+Capability parity target: /root/reference/python/ray/data/ — lazy logical
+plans over columnar blocks, a streaming executor with bounded in-flight
+work (backpressure), per-worker shards via streaming_split, and
+iter_batches ingest. TPU-native addition: ``iter_batches(sharding=...)``
+yields batches already device_put onto a mesh (the Data→Train ingest path
+feeds sharded jax arrays straight into the compiled step).
+"""
+
+from .context import DataContext
+from .dataset import (  # noqa: F401
+    Dataset,
+    DatasetShard,
+    from_items,
+    range_,
+    read_csv,
+    read_json,
+    read_parquet,
+)
+
+range = range_  # ray.data.range parity (shadows the builtin in this namespace)
+
+__all__ = [
+    "DataContext", "Dataset", "DatasetShard", "from_items", "range",
+    "read_csv", "read_json", "read_parquet",
+]

@@ -1,0 +1,95 @@
+"""Blocks: the unit of data movement — columnar dicts of numpy arrays.
+
+The reference uses Arrow tables / pandas as block formats
+(/root/reference/python/ray/data/_internal/arrow_block.py). Here the native
+block is a dict[str, np.ndarray] (column-major): it round-trips zero-copy
+through the shared-memory object store via pickle5 buffers, converts to/from
+Arrow at the IO boundary, and feeds jax.device_put directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Iterator
+
+import numpy as np
+
+Block = dict  # str -> np.ndarray (equal first-dim length)
+
+
+def block_len(b: Block) -> int:
+    if not b:
+        return 0
+    return len(next(iter(b.values())))
+
+
+def rows_to_block(rows: list) -> Block:
+    """List of dicts (or scalars -> {'item': ...}) to a columnar block.
+    Columns are the union of keys; rows missing a key contribute None
+    (object dtype), matching Arrow's null semantics."""
+    if not rows:
+        return {}
+    if not isinstance(rows[0], dict):
+        return {"item": np.asarray(rows)}
+    keys: dict = {}
+    for r in rows:
+        for k in r:
+            keys[k] = True
+    cols = {}
+    for key in keys:
+        missing = object()
+        vals = [r.get(key, missing) for r in rows]
+        if any(v is missing for v in vals):
+            arr = np.empty(len(vals), dtype=object)
+            for i, v in enumerate(vals):
+                arr[i] = None if v is missing else v
+            cols[key] = arr
+            continue
+        try:
+            cols[key] = np.asarray(vals)
+        except (ValueError, TypeError):
+            cols[key] = np.asarray(vals, dtype=object)
+    return cols
+
+
+def block_to_rows(b: Block) -> Iterator[dict]:
+    n = block_len(b)
+    keys = list(b)
+    for i in range(n):
+        yield {k: b[k][i] for k in keys}
+
+
+def slice_block(b: Block, start: int, stop: int) -> Block:
+    return {k: v[start:stop] for k, v in b.items()}
+
+
+def concat_blocks(blocks: list) -> Block:
+    blocks = [b for b in blocks if block_len(b)]
+    if not blocks:
+        return {}
+    keys = blocks[0].keys()
+    return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+
+
+def block_schema(b: Block) -> dict:
+    return {k: str(v.dtype) for k, v in b.items()}
+
+
+def block_nbytes(b: Block) -> int:
+    return sum(v.nbytes for v in b.values())
+
+
+def arrow_to_block(table) -> Block:
+    out = {}
+    for name in table.column_names:
+        col = table.column(name)
+        try:
+            out[name] = col.to_numpy(zero_copy_only=False)
+        except Exception:
+            out[name] = np.asarray(col.to_pylist(), dtype=object)
+    return out
+
+
+def block_to_arrow(b: Block):
+    import pyarrow as pa
+
+    return pa.table({k: pa.array(v) for k, v in b.items()})

@@ -1,0 +1,27 @@
+"""Execution context for datasets (parity: ray.data.DataContext /
+/root/reference/python/ray/data/context.py — global execution options)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class DataContext:
+    # Streaming backpressure: max map-task outputs in flight per stage
+    # (reference: backpressure policies under
+    # _internal/execution/backpressure_policy/).
+    max_in_flight_blocks: int = 4
+    # Target rows per block for sources that chunk.
+    target_block_rows: int = 1000
+    # "cpu" -> subprocess workers (production); "device" -> in-process
+    # threads (tests / small data: avoids ~2.5s worker forks).
+    execution_lane: str = "cpu"
+
+    _current = None
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        if cls._current is None:
+            cls._current = cls()
+        return cls._current

@@ -1,0 +1,431 @@
+"""Lazy streaming Dataset.
+
+Capability parity target: /root/reference/python/ray/data/dataset.py and the
+streaming executor (_internal/execution/streaming_executor.py:57): lazy
+logical plan, operator fusion, bounded in-flight execution (backpressure),
+splits for per-worker ingest.
+
+Design: consecutive row/batch transforms are *fused* into one per-block
+function (the reference's planner does the same — TaskPoolMapOperator
+fusion), then the streaming executor keeps at most
+DataContext.max_in_flight_blocks map tasks in flight, yielding blocks in
+order. All-to-all ops (repartition/shuffle/sort) materialize, reorganize,
+and continue lazily from the new source.
+"""
+
+from __future__ import annotations
+
+import builtins
+import itertools
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+from . import block as B
+from .context import DataContext
+
+
+# ---------------------------------------------------------------------------
+# Logical stages (fused at execution time)
+# ---------------------------------------------------------------------------
+class _Stage:
+    def __init__(self, kind: str, fn: Callable | None = None,
+                 batch_size: Optional[int] = None):
+        self.kind = kind  # map_rows | map_batches | filter | flat_map
+        self.fn = fn
+        self.batch_size = batch_size
+
+
+def _fuse(stages: list[_Stage]) -> Callable[[B.Block], B.Block]:
+    """Compose stages into one Block -> Block function (operator fusion)."""
+
+    def apply_map_batches(st: _Stage, blk: B.Block) -> B.Block:
+        def one(chunk):
+            out = st.fn(chunk)
+            if not isinstance(out, dict):
+                raise TypeError(
+                    "map_batches fn must return a dict of numpy arrays, "
+                    f"got {type(out).__name__}")
+            return {k: np.asarray(v) for k, v in out.items()}
+
+        n = B.block_len(blk)
+        if st.batch_size is None or n <= st.batch_size:
+            return one(blk)
+        outs = [one(B.slice_block(blk, i, min(i + st.batch_size, n)))
+                for i in builtins.range(0, n, st.batch_size)]
+        return B.concat_blocks(outs)
+
+    def apply(blk: B.Block) -> B.Block:
+        for st in stages:
+            if not B.block_len(blk):
+                return {}
+            if st.kind == "map_batches":
+                blk = apply_map_batches(st, blk)
+            elif st.kind == "map_rows":
+                blk = B.rows_to_block([st.fn(r) for r in B.block_to_rows(blk)])
+            elif st.kind == "filter":
+                blk = B.rows_to_block(
+                    [r for r in B.block_to_rows(blk) if st.fn(r)])
+            elif st.kind == "flat_map":
+                out = []
+                for r in B.block_to_rows(blk):
+                    out.extend(st.fn(r))
+                blk = B.rows_to_block(out)
+            else:
+                raise ValueError(st.kind)
+        return blk
+
+    return apply
+
+
+def _remote_opts():
+    ctx = DataContext.get_current()
+    if ctx.execution_lane == "device":
+        return {"scheduling_strategy": "device"}
+    return {"num_cpus": 1}
+
+
+class Dataset:
+    """Lazy dataset: a source of blocks + a chain of transform stages."""
+
+    def __init__(self, source: Callable[[], Iterator[B.Block]],
+                 stages: Optional[list[_Stage]] = None):
+        self._source = source
+        self._stages = stages or []
+
+    # -- transforms (lazy) -------------------------------------------------
+    def _with(self, stage: _Stage) -> "Dataset":
+        return Dataset(self._source, self._stages + [stage])
+
+    def map(self, fn) -> "Dataset":
+        return self._with(_Stage("map_rows", fn))
+
+    def map_batches(self, fn, *, batch_size: Optional[int] = None) -> "Dataset":
+        return self._with(_Stage("map_batches", fn, batch_size))
+
+    def filter(self, fn) -> "Dataset":
+        return self._with(_Stage("filter", fn))
+
+    def flat_map(self, fn) -> "Dataset":
+        return self._with(_Stage("flat_map", fn))
+
+    def limit(self, n: int) -> "Dataset":
+        parent = self
+
+        def source():
+            remaining = n
+            for blk in parent.iter_blocks():
+                ln = B.block_len(blk)
+                if ln >= remaining:
+                    yield B.slice_block(blk, 0, remaining)
+                    return
+                remaining -= ln
+                yield blk
+
+        return Dataset(source)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        parents = (self,) + others
+
+        def source():
+            for p in parents:
+                yield from p.iter_blocks()
+
+        return Dataset(source)
+
+    # -- all-to-all (materializing) ---------------------------------------
+    def repartition(self, num_blocks: int) -> "Dataset":
+        parent = self
+
+        def source():
+            full = B.concat_blocks(list(parent.iter_blocks()))
+            n = B.block_len(full)
+            if n == 0:
+                return
+            # Balanced sizes: first (n % num_blocks) blocks get one extra
+            # row, so exactly num_blocks blocks whenever n >= num_blocks.
+            base, extra = divmod(n, num_blocks)
+            start = 0
+            for i in builtins.range(num_blocks):
+                size = base + (1 if i < extra else 0)
+                if size == 0:
+                    continue
+                yield B.slice_block(full, start, start + size)
+                start += size
+
+        return Dataset(source)
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        parent = self
+        # Pin the seed at graph-construction time: shards from
+        # streaming_split re-execute the pipeline independently, and they
+        # must all observe the SAME permutation.
+        if seed is None:
+            seed = int(np.random.default_rng().integers(2 ** 31))
+
+        def source():
+            blocks = list(parent.iter_blocks())
+            full = B.concat_blocks(blocks)
+            n = B.block_len(full)
+            if n == 0:
+                return
+            rng = np.random.default_rng(seed)
+            perm = rng.permutation(n)
+            full = {k: v[perm] for k, v in full.items()}
+            nblocks = max(1, len(blocks))
+            per = -(-n // nblocks)
+            for i in builtins.range(nblocks):
+                yield B.slice_block(full, i * per, min((i + 1) * per, n))
+
+        return Dataset(source)
+
+    def sort(self, key: str, *, descending: bool = False) -> "Dataset":
+        parent = self
+
+        def source():
+            blocks = list(parent.iter_blocks())
+            full = B.concat_blocks(blocks)
+            if not B.block_len(full):
+                return
+            order = np.argsort(full[key], kind="stable")
+            if descending:
+                order = order[::-1]
+            yield {k: v[order] for k, v in full.items()}
+
+        return Dataset(source)
+
+    # -- execution ---------------------------------------------------------
+    def iter_blocks(self) -> Iterator[B.Block]:
+        """Streaming execution with bounded in-flight transform tasks."""
+        ctx = DataContext.get_current()
+        if not self._stages:
+            yield from (b for b in self._source() if B.block_len(b))
+            return
+
+        import ray_tpu
+
+        fused = _fuse(self._stages)
+        transform = ray_tpu.remote(**_remote_opts())(fused)
+        window: list = []
+        for blk in self._source():
+            window.append(transform.remote(blk))
+            if len(window) >= ctx.max_in_flight_blocks:
+                out = ray_tpu.get(window.pop(0))
+                if B.block_len(out):
+                    yield out
+        for ref in window:
+            out = ray_tpu.get(ref)
+            if B.block_len(out):
+                yield out
+
+    # -- consumption -------------------------------------------------------
+    def iter_rows(self) -> Iterator[dict]:
+        for blk in self.iter_blocks():
+            yield from B.block_to_rows(blk)
+
+    def iter_batches(self, *, batch_size: int = 256, batch_format: str = "numpy",
+                     sharding=None, drop_last: bool = False,
+                     dtypes=None) -> Iterator[Any]:
+        """Re-batched iteration. batch_format: "numpy" | "rows" | "jax".
+        With ``sharding`` (a jax.sharding.Sharding), batches are device_put
+        — the TPU ingest path (batch dim must divide the data axes)."""
+        if batch_format == "rows" and (sharding is not None or dtypes):
+            raise ValueError(
+                "sharding/dtypes only apply to batch_format='numpy'|'jax'")
+
+        def emit(blk: B.Block):
+            if batch_format == "rows":
+                return list(B.block_to_rows(blk))
+            if dtypes:
+                blk = {k: v.astype(dtypes.get(k, v.dtype))
+                       for k, v in blk.items()}
+            if batch_format == "jax" or sharding is not None:
+                import jax
+
+                if sharding is not None:
+                    return {k: jax.device_put(np.ascontiguousarray(v), sharding)
+                            for k, v in blk.items()}
+                return {k: jax.numpy.asarray(v) for k, v in blk.items()}
+            return blk
+
+        # O(rows) rebatching: consume whole blocks via an integer offset;
+        # only the rows of the emitted batch are ever copied.
+        buf: list[B.Block] = []   # blocks, first consumed from `offset`
+        offset = 0
+        buffered = 0
+        for blk in self.iter_blocks():
+            buf.append(blk)
+            buffered += B.block_len(blk)
+            while buffered >= batch_size:
+                need = batch_size
+                parts = []
+                while need > 0:
+                    head = buf[0]
+                    avail = B.block_len(head) - offset
+                    take = min(avail, need)
+                    parts.append(B.slice_block(head, offset, offset + take))
+                    need -= take
+                    offset += take
+                    if offset == B.block_len(head):
+                        buf.pop(0)
+                        offset = 0
+                buffered -= batch_size
+                yield emit(B.concat_blocks(parts))
+        if buffered and not drop_last:
+            parts = [B.slice_block(buf[0], offset, B.block_len(buf[0]))] + buf[1:]
+            yield emit(B.concat_blocks(parts))
+
+    def take(self, n: int = 20) -> list:
+        return list(itertools.islice(self.iter_rows(), n))
+
+    def take_all(self) -> list:
+        return list(self.iter_rows())
+
+    def count(self) -> int:
+        return sum(B.block_len(b) for b in self.iter_blocks())
+
+    def schema(self) -> Optional[dict]:
+        for blk in self.iter_blocks():
+            return B.block_schema(blk)
+        return None
+
+    def materialize(self) -> "Dataset":
+        blocks = list(self.iter_blocks())
+        return Dataset(lambda: iter(blocks))
+
+    def num_blocks(self) -> int:
+        return sum(1 for _ in self.iter_blocks())
+
+    def stats(self) -> str:
+        blocks = list(self.iter_blocks())
+        total = sum(B.block_nbytes(b) for b in blocks)
+        return (f"Dataset: {len(blocks)} blocks, "
+                f"{sum(B.block_len(b) for b in blocks)} rows, "
+                f"{total / 1e6:.2f} MB")
+
+    # -- splits ------------------------------------------------------------
+    def split(self, n: int) -> list["Dataset"]:
+        """Materializing split into n datasets (parity: Dataset.split)."""
+        blocks = list(self.iter_blocks())
+        if len(blocks) < n:  # split rows, not blocks
+            full = B.concat_blocks(blocks)
+            total = B.block_len(full)
+            per = -(-total // n) if total else 0
+            blocks = [B.slice_block(full, i * per, min((i + 1) * per, total))
+                      for i in builtins.range(n)]
+            return [Dataset(lambda bs=[b]: iter(bs)) for b in blocks]
+        out = [[] for _ in builtins.range(n)]
+        for i, b in enumerate(blocks):
+            out[i % n].append(b)
+        return [Dataset(lambda bs=bs: iter(bs)) for bs in out]
+
+    def streaming_split(self, n: int) -> list["DatasetShard"]:
+        """Per-worker shards that stream round-robin slices of this dataset
+        (parity: /root/reference/python/ray/data/dataset.py streaming_split
+        feeding train workers)."""
+        return [DatasetShard(self, rank, n) for rank in builtins.range(n)]
+
+    # -- IO ----------------------------------------------------------------
+    def write_parquet(self, path: str):
+        import os
+
+        import pyarrow.parquet as pq
+
+        os.makedirs(path, exist_ok=True)
+        for i, blk in enumerate(self.iter_blocks()):
+            pq.write_table(B.block_to_arrow(blk),
+                           os.path.join(path, f"part-{i:05d}.parquet"))
+
+    def __repr__(self):
+        return f"Dataset(stages={len(self._stages)})"
+
+
+class DatasetShard:
+    """A rank's view of a dataset: streams every n-th block."""
+
+    def __init__(self, parent: Dataset, rank: int, world: int):
+        self._parent = parent
+        self._rank = rank
+        self._world = world
+
+    def iter_blocks(self):
+        for i, blk in enumerate(self._parent.iter_blocks()):
+            if i % self._world == self._rank:
+                yield blk
+
+    def iter_rows(self):
+        for blk in self.iter_blocks():
+            yield from B.block_to_rows(blk)
+
+    def iter_batches(self, **kwargs):
+        shard_ds = Dataset(self.iter_blocks)
+        return shard_ds.iter_batches(**kwargs)
+
+    def count(self):
+        return sum(B.block_len(b) for b in self.iter_blocks())
+
+
+# ---------------------------------------------------------------------------
+# Sources
+# ---------------------------------------------------------------------------
+def from_items(items: list, *, override_num_blocks: Optional[int] = None) -> Dataset:
+    ctx = DataContext.get_current()
+    n = len(items)
+    nblocks = override_num_blocks or max(1, -(-n // ctx.target_block_rows))
+    per = -(-n // nblocks) if n else 1
+
+    def source():
+        for i in builtins.range(0, n, per):
+            yield B.rows_to_block(items[i:i + per])
+
+    return Dataset(source)
+
+
+def range_(n: int, *, override_num_blocks: Optional[int] = None) -> Dataset:
+    ctx = DataContext.get_current()
+    nblocks = override_num_blocks or max(1, -(-n // ctx.target_block_rows))
+    per = -(-n // nblocks) if n else 1
+
+    def source():
+        for i in builtins.range(0, n, per):
+            yield {"id": np.arange(i, min(i + per, n))}
+
+    return Dataset(source)
+
+
+def _read_files(paths, reader) -> Dataset:
+    import glob
+    import os
+
+    if isinstance(paths, str):
+        paths = [paths]
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(os.path.join(p, "*"))))
+        else:
+            files.extend(sorted(glob.glob(p)) or [p])
+
+    def source():
+        for f in files:
+            yield B.arrow_to_block(reader(f))
+
+    return Dataset(source)
+
+
+def read_parquet(paths) -> Dataset:
+    import pyarrow.parquet as pq
+
+    return _read_files(paths, pq.read_table)
+
+
+def read_csv(paths) -> Dataset:
+    from pyarrow import csv as pacsv
+
+    return _read_files(paths, pacsv.read_csv)
+
+
+def read_json(paths) -> Dataset:
+    from pyarrow import json as pajson
+
+    return _read_files(paths, pajson.read_json)
