@@ -7,3 +7,17 @@ implementations for CPU tests and as autodiff fallbacks.
 """
 
 from .attention import causal_attention, multi_head_attention  # noqa: F401
+from .flash_attention import flash_attention  # noqa: F401
+from .ring_attention import (  # noqa: F401
+    ring_attention,
+    ring_attention_sharded,
+    ulysses_attention,
+    ulysses_attention_sharded,
+)
+from .moe import (  # noqa: F401
+    MoEConfig,
+    load_balancing_loss,
+    moe_apply,
+    moe_apply_sharded,
+    moe_init,
+)

@@ -18,8 +18,8 @@ NEG_INF = -1e9  # bf16-safe large negative (not -inf: avoids NaN via 0*inf)
 
 
 def causal_attention(q, k, v, *, scale: Optional[float] = None,
-                     window: Optional[int] = None):
-    """Causal self-attention.
+                     window: Optional[int] = None, causal: bool = True):
+    """(Causal by default) self-attention.
 
     q,k,v: [batch, seq, heads, head_dim] (kv may have fewer heads — GQA —
     broadcast when heads % kv_heads == 0).
@@ -37,10 +37,13 @@ def causal_attention(q, k, v, *, scale: Optional[float] = None,
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
     q_pos = jnp.arange(sq)[:, None]
-    k_pos = jnp.arange(sk)[None, :]
-    mask = q_pos >= k_pos - (sk - sq)
+    k_pos = jnp.arange(sk)[None, :] - (sk - sq)
+    mask = (q_pos >= k_pos) if causal else jnp.ones((sq, sk), bool)
     if window is not None:
-        mask &= q_pos - (k_pos - (sk - sq)) < window
+        if causal:
+            mask &= q_pos - k_pos < window
+        else:
+            mask &= jnp.abs(q_pos - k_pos) < window  # symmetric window
     logits = jnp.where(mask[None, None], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

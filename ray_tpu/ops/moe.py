@@ -1,0 +1,156 @@
+"""Mixture-of-Experts with expert parallelism over the ``ep`` mesh axis.
+
+The reference has no MoE/expert-parallel support (SURVEY.md §2.4). TPU-native
+design: GShard/Switch-style fixed-capacity top-k routing expressed as dense
+dispatch/combine einsums (static shapes — XLA requirement), with tokens
+exchanged between expert shards by ``lax.all_to_all`` over the ``ep`` axis.
+The all-to-all rides ICI; experts are just a leading dimension of the FFN
+weights, so the expert compute is one big batched matmul on the MXU.
+
+Call :func:`moe_apply` inside shard_map (ep_axis="ep") or unsharded
+(ep_axis=None, all experts local). :func:`moe_apply_sharded` wraps the
+common [batch, seq, d_model] case.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    d_model: int
+    d_ff: int
+    n_experts: int
+    k: int = 2                    # experts per token
+    capacity_factor: float = 1.25
+    dtype: object = jnp.float32
+
+
+def moe_init(key, cfg: MoEConfig):
+    """Router + expert FFN params. Experts are a leading dim so the whole
+    expert bank is one tensor (shardable over ep)."""
+    kg, k1, k2 = jax.random.split(key, 3)
+    scale_in = cfg.d_model ** -0.5
+    scale_hid = cfg.d_ff ** -0.5
+    return {
+        "wg": (jax.random.normal(kg, (cfg.d_model, cfg.n_experts)) *
+               scale_in).astype(cfg.dtype),
+        "w1": (jax.random.normal(k1, (cfg.n_experts, cfg.d_model, cfg.d_ff)) *
+               scale_in).astype(cfg.dtype),
+        "w2": (jax.random.normal(k2, (cfg.n_experts, cfg.d_ff, cfg.d_model)) *
+               scale_hid).astype(cfg.dtype),
+    }
+
+
+def _top_k_routing(gates, k: int, capacity: int):
+    """gates: [T, E] softmax probs. Returns dispatch [T, E, C] one-hot and
+    combine [T, E, C] weights (Switch/GShard fixed-capacity routing)."""
+    T, E = gates.shape
+    # Iteratively peel off the top-k choices so each round is a simple
+    # argmax (k is tiny: 1 or 2).
+    g = gates
+    dispatch = jnp.zeros((T, E, capacity), gates.dtype)
+    combine = jnp.zeros((T, E, capacity), gates.dtype)
+    # Track how many tokens each expert has accepted so far across rounds.
+    fill = jnp.zeros((E,), jnp.int32)
+    for _ in range(k):
+        choice = jnp.argmax(g, axis=1)                       # [T]
+        onehot = jax.nn.one_hot(choice, E, dtype=gates.dtype)  # [T, E]
+        # Position of each token within its chosen expert's buffer: tokens
+        # earlier in the shard claim earlier slots (deterministic).
+        pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [T,E]
+        pos = (pos_in_expert.sum(1) + fill[choice]).astype(jnp.int32)  # [T]
+        keep = pos < capacity
+        pos = jnp.clip(pos, 0, capacity - 1)
+        slot = jax.nn.one_hot(pos, capacity, dtype=gates.dtype)  # [T, C]
+        d = onehot[:, :, None] * slot[:, None, :]                # [T, E, C]
+        d = d * keep[:, None, None]
+        dispatch = dispatch + d
+        combine = combine + d * (gates * onehot).sum(1)[:, None, None]
+        fill = fill + (onehot * keep[:, None]).sum(0).astype(jnp.int32)
+        g = g * (1.0 - onehot)  # mask out the chosen expert for next round
+    return dispatch, combine
+
+
+def load_balancing_loss(gates, dispatch):
+    """Switch-transformer aux loss: E * Σ_e fraction_routed_e · mean_gate_e."""
+    E = gates.shape[1]
+    frac_routed = dispatch.sum(axis=(0, 2)) / jnp.maximum(
+        dispatch.sum(), 1.0)                                  # [E]
+    mean_gate = gates.mean(axis=0)                            # [E]
+    return E * jnp.sum(frac_routed * mean_gate)
+
+
+def moe_apply(params, x, cfg: MoEConfig, *, ep_axis: Optional[str] = None):
+    """x: [tokens_local, d_model] -> (y [tokens_local, d_model], aux_loss).
+
+    With ``ep_axis`` set (inside shard_map), expert banks are sharded over
+    that axis (w1/w2 leading dim = n_experts/ep locally) and token shards
+    are exchanged via all_to_all.
+    """
+    T, D = x.shape
+    E = cfg.n_experts
+    ep = jax.lax.axis_size(ep_axis) if ep_axis else 1
+    capacity = max(1, int(cfg.capacity_factor * cfg.k * T / E))
+
+    logits = jnp.einsum("td,de->te", x.astype(jnp.float32),
+                        params["wg"].astype(jnp.float32))
+    gates = jax.nn.softmax(logits, axis=-1)
+    dispatch, combine = _top_k_routing(gates, cfg.k, capacity)
+    aux = load_balancing_loss(gates, dispatch)
+
+    # [T,E,C] x [T,D] -> [E,C,D]: gather each expert's token buffer.
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+
+    if ep_axis and ep > 1:
+        # Exchange buffers so each device holds ALL shards' tokens for its
+        # local experts: [E, C, D] -> [E/ep, ep*C, D].
+        expert_in = jax.lax.all_to_all(
+            expert_in, ep_axis, split_axis=0, concat_axis=1, tiled=True)
+
+    h = jnp.einsum("ecd,edf->ecf", expert_in, params["w1"])
+    h = jax.nn.gelu(h)
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w2"])
+
+    if ep_axis and ep > 1:
+        expert_out = jax.lax.all_to_all(
+            expert_out, ep_axis, split_axis=1, concat_axis=0, tiled=True)
+
+    y = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
+    return y, aux
+
+
+def moe_apply_sharded(params, x, cfg: MoEConfig, mesh: Mesh, *,
+                      ep_axis: str = "ep",
+                      batch_axes=("dp", "fsdp", "ep")):
+    """Global [batch, seq, d_model] entry point: batch sharded over the data
+    axes (including ep — each ep rank routes its own token shard), expert
+    banks sharded over ep."""
+    p_specs = {
+        "wg": P(None, None),
+        "w1": P(ep_axis, None, None),
+        "w2": P(ep_axis, None, None),
+    }
+    # Batch shards over ep exactly once, whether or not the caller listed it.
+    other_axes = tuple(a for a in batch_axes if a != ep_axis)
+    x_spec = P(other_axes + (ep_axis,), None, None)
+
+    def body(p, xx):
+        b, s, d = xx.shape
+        y, aux = moe_apply(p, xx.reshape(b * s, d), cfg, ep_axis=ep_axis)
+        # aux is per-shard; average over all token shards.
+        aux = jax.lax.pmean(aux, ep_axis)
+        for ax in other_axes:
+            aux = jax.lax.pmean(aux, ax)
+        return y.reshape(b, s, d), aux
+
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(p_specs, x_spec),
+        out_specs=(x_spec, P()), check_vma=False,
+    )(params, x)

@@ -1,0 +1,146 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has neither (SURVEY.md §2.4: no hits for ring attention /
+Ulysses / sequence_parallel anywhere in its tree) — long-context scaling is
+a first-class obligation of this framework, built the TPU way:
+
+  * ``ring_attention`` — each of the ``sp`` devices holds a sequence shard
+    of q/k/v. K/V shards rotate around the ICI ring via
+    ``jax.lax.ppermute`` while each device accumulates blockwise
+    online-softmax attention of its local queries against every passing
+    k/v shard. O(seq/sp) memory per chip, compute/communication overlapped
+    by XLA (the ppermute of step i+1 overlaps the matmuls of step i).
+  * ``ulysses_attention`` — ``lax.all_to_all`` swaps the sharded axis:
+    sequence-sharded → head-sharded, run exact local attention over the
+    full sequence for heads/sp heads, swap back. Two all-to-alls per call;
+    cheaper than a ring when heads ≥ sp and seq fits per-chip HBM.
+
+Both are meant to be called *inside* ``shard_map`` (or a pjit body with
+manual axes) over the ``sp`` mesh axis; helpers that wrap them in
+``shard_map`` for the common [batch, seq, heads, head_dim] layout are
+provided (``ring_attention_sharded``, ``ulysses_attention_sharded``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.collectives import ring_neighbors
+from .attention import NEG_INF
+
+
+def ring_attention(q, k, v, *, axis: str = "sp", causal: bool = True,
+                   scale: Optional[float] = None):
+    """Ring attention over the ``axis`` mesh axis. Call inside shard_map.
+
+    q, k, v: [batch, seq_local, heads, head_dim] — the local sequence shard
+    (global sequence = seq_local * axis_size, sharded contiguously so that
+    device i holds positions [i*seq_local, (i+1)*seq_local)).
+
+    Returns [batch, seq_local, heads, head_dim], exact (not approximate)
+    attention over the full global sequence.
+    """
+    sp = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    b, sq, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    perm = ring_neighbors(sp)
+
+    # Positions of the local queries in the global sequence.
+    q_pos = idx * sq + jnp.arange(sq)  # [sq]
+
+    qf = q.astype(jnp.float32) * scale
+
+    def step(carry, _):
+        m, l, acc, kv, kv_idx = carry
+        k_blk, v_blk = kv
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
+        if causal:
+            k_pos = kv_idx * sq + jnp.arange(sq)  # [sq] global key positions
+            mask = q_pos[:, None] >= k_pos[None, :]  # [sq, sq]
+            s = jnp.where(mask[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+        # Rotate k/v to the next device; the block we just consumed came
+        # from device (kv_idx), the incoming one came from (kv_idx - 1).
+        kv_next = (jax.lax.ppermute(k_blk, axis, perm),
+                   jax.lax.ppermute(v_blk, axis, perm))
+        kv_idx_next = (kv_idx - 1) % sp
+        return (m_new, l_new, acc_new, kv_next, kv_idx_next), None
+
+    m0 = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, sq), jnp.float32)
+    acc0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    (m, l, acc, _, _), _ = jax.lax.scan(
+        step, (m0, l0, acc0, (k, v), idx), None, length=sp)
+    out = acc / jnp.maximum(l[..., None], 1e-30)  # [b,h,sq,d]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, *, axis: str = "sp", causal: bool = True,
+                      attn_fn=None):
+    """Ulysses-style sequence parallelism: all-to-all seq↔head reshard.
+
+    q, k, v: [batch, seq_local, heads, head_dim] with heads divisible by the
+    ``axis`` size. After the first all_to_all each device holds
+    [batch, seq_global, heads/sp, head_dim] and runs *exact* attention
+    (flash by default) on its head subset; the second all_to_all restores
+    sequence sharding. Call inside shard_map.
+
+    A custom ``attn_fn`` must accept ``(q, k, v, causal=...)`` — the
+    ``causal`` flag is forwarded to it.
+    """
+    sp = jax.lax.axis_size(axis)
+    h = q.shape[2]
+    if h % sp:
+        raise ValueError(f"heads={h} not divisible by {axis} size {sp}")
+
+    def seq2head(x):
+        # [b, s_loc, h, d] -> [b, s_glob, h/sp, d]
+        return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def head2seq(x):
+        return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qg, kg, vg = seq2head(q), seq2head(k), seq2head(v)
+    if attn_fn is None:
+        from .flash_attention import flash_attention
+
+        attn_fn = flash_attention
+    out = attn_fn(qg, kg, vg, causal=causal)
+    return head2seq(out)
+
+
+def _sharded(fn, mesh: Mesh, *, axis: str, batch_axes):
+    spec = P(batch_axes, axis, None, None)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+
+
+def ring_attention_sharded(q, k, v, mesh: Mesh, *, axis: str = "sp",
+                           causal: bool = True,
+                           batch_axes=("dp", "fsdp", "ep")):
+    """shard_map wrapper: q/k/v are global [batch, seq, heads, head_dim]
+    arrays (batch over the data axes, seq over ``axis``)."""
+    fn = functools.partial(ring_attention, axis=axis, causal=causal)
+    return _sharded(fn, mesh, axis=axis, batch_axes=batch_axes)(q, k, v)
+
+
+def ulysses_attention_sharded(q, k, v, mesh: Mesh, *, axis: str = "sp",
+                              causal: bool = True,
+                              batch_axes=("dp", "fsdp", "ep")):
+    fn = functools.partial(ulysses_attention, axis=axis, causal=causal)
+    return _sharded(fn, mesh, axis=axis, batch_axes=batch_axes)(q, k, v)

@@ -16,6 +16,11 @@ from .sharding import (  # noqa: F401
     shard_params,
     spec_for_logical,
 )
+from .pipeline import (  # noqa: F401
+    pipeline,
+    pipeline_apply,
+    stage_params_spec,
+)
 from .collectives import (  # noqa: F401
     CollectiveGroup,
     all_gather,

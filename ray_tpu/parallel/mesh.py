@@ -9,12 +9,13 @@ dimensions. This module owns that mapping.
 
 Axes (outer → inner; inner axes get the fastest ICI proximity):
 
+    pp    pipeline parallel (stage-to-stage point-to-point; least traffic)
     dp    pure data parallel (params replicated)
     fsdp  data parallel with params/optimizer sharded (ZeRO-3 equivalent)
+    ep    expert parallel (MoE all-to-all token routing; acts as extra
+          data parallelism for the dense layers)
     sp    sequence/context parallel (ring attention neighbors)
     tp    tensor parallel (heaviest per-step collectives → innermost)
-
-plus an optional ``pp`` (pipeline) axis handled by parallel/pipeline.py.
 """
 
 from __future__ import annotations
@@ -27,7 +28,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXIS_ORDER = ("dp", "fsdp", "sp", "tp")
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
 
 
 @dataclass(frozen=True)
@@ -38,19 +39,22 @@ class MeshSpec:
     fsdp: int = 1
     sp: int = 1
     tp: int = 1
+    pp: int = 1
+    ep: int = 1
 
     @property
     def shape(self) -> dict:
-        return {"dp": self.dp, "fsdp": self.fsdp, "sp": self.sp, "tp": self.tp}
+        return {a: getattr(self, a) for a in AXIS_ORDER}
 
     @property
     def total(self) -> int:
-        return self.dp * self.fsdp * self.sp * self.tp
+        return self.dp * self.fsdp * self.sp * self.tp * self.pp * self.ep
 
     @property
     def data_axes(self) -> tuple:
-        """Mesh axes a batch dimension is sharded over."""
-        return ("dp", "fsdp")
+        """Mesh axes a batch dimension is sharded over (ep devices hold
+        distinct batch shards through the dense layers)."""
+        return ("dp", "fsdp", "ep")
 
     def build(self, devices: Optional[Sequence] = None) -> Mesh:
         """Build a Mesh over `devices` (default: all local jax devices).
@@ -66,29 +70,31 @@ class MeshSpec:
                 f"MeshSpec needs {self.total} devices, have {len(devices)}"
             )
         devices = np.asarray(devices[: self.total]).reshape(
-            self.dp, self.fsdp, self.sp, self.tp
+            tuple(self.shape.values())
         )
         return Mesh(devices, AXIS_ORDER)
 
     @classmethod
     def auto(cls, n_devices: Optional[int] = None, *, tp: int = 1, sp: int = 1,
+             pp: int = 1, ep: int = 1,
              fsdp: Optional[int] = None) -> "MeshSpec":
         """Factorize ``n_devices`` into axes. Unspecified capacity goes to
         fsdp (the safest default for large models: ZeRO-style sharding costs
         one all-gather per layer but never duplicates memory)."""
         if n_devices is None:
             n_devices = len(jax.devices())
-        rest, rem = divmod(n_devices, tp * sp)
+        fixed = tp * sp * pp * ep
+        rest, rem = divmod(n_devices, fixed)
         if rem:
             raise ValueError(
-                f"tp*sp={tp * sp} does not divide device count {n_devices}"
+                f"tp*sp*pp*ep={fixed} does not divide device count {n_devices}"
             )
         if fsdp is None:
-            return cls(dp=1, fsdp=rest, sp=sp, tp=tp)
+            return cls(dp=1, fsdp=rest, sp=sp, tp=tp, pp=pp, ep=ep)
         dp, rem = divmod(rest, fsdp)
         if rem:
             raise ValueError(f"fsdp={fsdp} does not divide {rest}")
-        return cls(dp=dp, fsdp=fsdp, sp=sp, tp=tp)
+        return cls(dp=dp, fsdp=fsdp, sp=sp, tp=tp, pp=pp, ep=ep)
 
 
 @dataclass
@@ -122,6 +128,4 @@ def get_abstract_mesh(spec: MeshSpec):
     """An AbstractMesh for shape-only tracing (no devices needed)."""
     from jax.sharding import AbstractMesh
 
-    return AbstractMesh(
-        (spec.dp, spec.fsdp, spec.sp, spec.tp), AXIS_ORDER
-    )
+    return AbstractMesh(tuple(spec.shape.values()), AXIS_ORDER)

@@ -1,0 +1,131 @@
+"""Pipeline parallelism over the ``pp`` mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.4); its nearest
+analogue is the experimental compiled-DAG actor pipeline
+(/root/reference/python/ray/dag/compiled_dag_node.py:141) which moves
+activations through mutable plasma channels between actor processes. On TPU
+the right construction is radically different: the whole pipeline is ONE
+SPMD program — stages are devices along the ``pp`` mesh axis, activations
+hop stage-to-stage via ``lax.ppermute`` (point-to-point ICI neighbors), and
+the GPipe schedule is a ``lax.scan`` over ticks. XLA overlaps the permute
+of tick t with the matmuls of tick t+1, and ``jax.grad`` differentiates
+straight through the schedule (the transpose of a ppermute is the reverse
+ppermute), so backward pipelining comes for free instead of via a
+hand-written 1F1B interpreter.
+
+Usage (single-controller):
+
+    params = jax.vmap(stage_init)(keys)           # stacked [S, ...] pytree
+    y = pipeline_apply(stage_fn, params, x,
+                       n_microbatches=8, mesh=mesh)
+
+``stage_fn(stage_params, x) -> y`` must keep the activation shape/dtype
+uniform across stages (embed/unembed live outside the pipelined trunk).
+Multiple layers per stage: make ``stage_fn`` scan over a stacked leading
+layer axis of its own params (see models/gpt.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .collectives import ring_neighbors
+
+
+def pipeline(stage_fn: Callable, stage_params, x, *, n_microbatches: int,
+             axis: str = "pp"):
+    """GPipe-scheduled pipeline. Call inside ``shard_map``.
+
+    stage_params: this device's stage parameters (leading stage axis already
+        stripped by the shard_map in_spec).
+    x: [batch, ...] full (replicated) input activations; split into
+        ``n_microbatches`` along axis 0.
+
+    Returns [batch, ...] outputs, replicated across the ``axis`` devices.
+    """
+    S = jax.lax.axis_size(axis)
+    s = jax.lax.axis_index(axis)
+    M = n_microbatches
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by n_microbatches {M}")
+    mb = B // M
+    x_mb = x.reshape((M, mb) + x.shape[1:])
+    perm = ring_neighbors(S)
+
+    def tick(carry, t):
+        buf, out = carry
+        # Stage 0 feeds itself microbatch t; later stages consume what the
+        # previous stage produced last tick.
+        inp = jnp.where(
+            s == 0,
+            jax.lax.dynamic_index_in_dim(x_mb, jnp.clip(t, 0, M - 1), 0,
+                                         keepdims=False),
+            buf,
+        )
+        y = stage_fn(stage_params, inp)
+        # The last stage finished microbatch (t - S + 1) at tick t.
+        done = t - (S - 1)
+        valid = (s == S - 1) & (done >= 0) & (done < M)
+        idx = jnp.clip(done, 0, M - 1)
+        prev = jax.lax.dynamic_index_in_dim(out, idx, 0, keepdims=False)
+        out = jax.lax.dynamic_update_index_in_dim(
+            out, jnp.where(valid, y, prev), idx, 0)
+        buf_next = jax.lax.ppermute(y, axis, perm)
+        return (buf_next, out), None
+
+    buf0 = jnp.zeros_like(x_mb[0])
+    out0 = jnp.zeros((M, mb) + x.shape[1:], x.dtype)
+    (_, out), _ = jax.lax.scan(tick, (buf0, out0), jnp.arange(M + S - 1))
+    # Results live on the last stage and `out` is zeros everywhere else, so
+    # a psum replicates them to every pp rank without materializing an
+    # S-fold gather buffer.
+    out = jax.lax.psum(out, axis)
+    return out.reshape((B,) + x.shape[1:])
+
+
+def stage_params_spec(params, axis: str = "pp"):
+    """PartitionSpec pytree sharding each leaf's leading stage dim on pp."""
+    return jax.tree_util.tree_map(
+        lambda p: P(axis, *([None] * (p.ndim - 1))), params)
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x, *,
+                   n_microbatches: int, mesh: Mesh, axis: str = "pp",
+                   batch_axes=("dp", "fsdp", "ep"), x_spec: Optional[P] = None,
+                   params_spec=None):
+    """shard_map wrapper around :func:`pipeline`.
+
+    stage_params: pytree with a leading stage dimension of size
+        ``mesh.shape[axis]`` on every leaf (sharded over ``axis``).
+    x: global [batch, ...] activations, batch sharded over the data axes.
+    params_spec: optional PartitionSpec pytree when stage params carry
+        further sharding beyond the leading stage dim (e.g. expert banks
+        sharded over ep, tp-sharded projections).
+    """
+    if x_spec is None:
+        x_spec = P(batch_axes, *([None] * (x.ndim - 1)))
+    p_specs = params_spec if params_spec is not None else stage_params_spec(
+        stage_params, axis)
+    S = mesh.shape[axis]
+    for path, leaf in jax.tree_util.tree_flatten_with_path(stage_params)[0]:
+        if leaf.shape[0] != S:
+            raise ValueError(
+                f"stage param {jax.tree_util.keystr(path)} has leading dim "
+                f"{leaf.shape[0]}, expected the {axis} axis size {S} (stack "
+                f"multiple layers per stage INSIDE the stage params instead)")
+
+    def body(sp, xx):
+        squeezed = jax.tree_util.tree_map(lambda a: a[0], sp)
+        return pipeline(stage_fn, squeezed, xx,
+                        n_microbatches=n_microbatches, axis=axis)
+
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(p_specs, x_spec), out_specs=x_spec,
+        check_vma=False,
+    )(stage_params, x)

@@ -19,7 +19,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 # Defaults cover transformer/conv families; models may pass their own table.
 DEFAULT_RULES: dict[str, Any] = {
     # batch-like
-    "batch": ("dp", "fsdp"),
+    "batch": ("dp", "fsdp", "ep"),
     "seq": "sp",
     # weight axes
     "vocab": "tp",
@@ -28,7 +28,8 @@ DEFAULT_RULES: dict[str, Any] = {
     "kv": None,
     "head_dim": None,
     "mlp": "tp",
-    "expert": "tp",
+    "expert": "ep",
+    "stage": "pp",
     # conv
     "conv_in": None,
     "conv_out": "fsdp",
@@ -72,7 +73,7 @@ def named_sharding(mesh: Mesh, *logical_axes, rules=None) -> NamedSharding:
 
 def batch_sharding(mesh: Mesh, extra_axes: tuple = ()) -> NamedSharding:
     """Sharding for a [batch, ...] input: batch over all data axes."""
-    return NamedSharding(mesh, P(("dp", "fsdp"), *extra_axes))
+    return NamedSharding(mesh, P(("dp", "fsdp", "ep"), *extra_axes))
 
 
 def _infer_param_logical(path: tuple, shape: tuple) -> tuple:

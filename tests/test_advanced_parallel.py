@@ -1,0 +1,198 @@
+"""Ring attention, Ulysses, pipeline parallelism, MoE/expert parallelism.
+
+All run on the 8-virtual-device CPU mesh (conftest). Each strategy is
+checked for exactness against an unsharded dense reference, and for
+differentiability (the training path runs jax.grad through the collective
+schedules).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu.ops import (
+    MoEConfig,
+    causal_attention,
+    moe_apply,
+    moe_apply_sharded,
+    moe_init,
+    ring_attention_sharded,
+    ulysses_attention_sharded,
+)
+from ray_tpu.parallel import MeshSpec, pipeline_apply
+
+DATA_AXES = ("dp", "fsdp", "ep")
+
+
+def _qkv(b=4, s=64, h=8, d=16):
+    ks = jax.random.split(jax.random.key(0), 3)
+    return tuple(jax.random.normal(k, (b, s, h, d), jnp.float32) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_dense(causal):
+    q, k, v = _qkv()
+    ref = causal_attention(q, k, v, causal=causal)
+    mesh = MeshSpec(dp=2, sp=4).build()
+    sh = NamedSharding(mesh, P(DATA_AXES, "sp", None, None))
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+    out = ring_attention_sharded(qs, ks, vs, mesh, causal=causal)
+    assert jnp.abs(out - ref).max() < 2e-5
+
+
+def test_ring_attention_full_sp_axis():
+    q, k, v = _qkv(b=2, s=128)
+    ref = causal_attention(q, k, v)
+    mesh = MeshSpec(sp=8).build()
+    sh = NamedSharding(mesh, P(DATA_AXES, "sp", None, None))
+    out = ring_attention_sharded(*(jax.device_put(x, sh) for x in (q, k, v)),
+                                 mesh)
+    assert jnp.abs(out - ref).max() < 2e-5
+
+
+def test_ulysses_attention_matches_dense():
+    q, k, v = _qkv()
+    ref = causal_attention(q, k, v)
+    mesh = MeshSpec(dp=2, sp=4).build()
+    sh = NamedSharding(mesh, P(DATA_AXES, "sp", None, None))
+    out = ulysses_attention_sharded(
+        *(jax.device_put(x, sh) for x in (q, k, v)), mesh)
+    assert jnp.abs(out - ref).max() < 2e-5
+
+
+def test_ring_attention_grad():
+    q, k, v = _qkv(b=2, s=32, h=4, d=8)
+    mesh = MeshSpec(sp=4, dp=2).build()
+    sh = NamedSharding(mesh, P(DATA_AXES, "sp", None, None))
+    qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+
+    def loss_ring(q, k, v):
+        return (ring_attention_sharded(q, k, v, mesh) ** 2).mean()
+
+    def loss_dense(q, k, v):
+        return (causal_attention(q, k, v) ** 2).mean()
+
+    g_ring = jax.grad(loss_ring)(qs, ks, vs)
+    g_dense = jax.grad(loss_dense)(q, k, v)
+    assert jnp.abs(g_ring - g_dense).max() < 2e-5
+
+
+def test_pipeline_matches_sequential():
+    S, D, B = 4, 16, 16
+    W = jax.random.normal(jax.random.key(0), (S, D, D)) * 0.3
+    x = jax.random.normal(jax.random.key(1), (B, D))
+
+    def stage_fn(w, h):
+        return jnp.tanh(h @ w)
+
+    ref = x
+    for i in range(S):
+        ref = stage_fn(W[i], ref)
+
+    mesh = MeshSpec(pp=4, dp=2).build()
+    Wsh = jax.device_put(W, NamedSharding(mesh, P("pp", None, None)))
+    xsh = jax.device_put(x, NamedSharding(mesh, P(DATA_AXES, None)))
+    for n_mb in (1, 2, 4, 8):
+        out = pipeline_apply(stage_fn, Wsh, xsh, n_microbatches=n_mb,
+                             mesh=mesh)
+        assert jnp.abs(out - ref).max() < 1e-6, n_mb
+
+
+def test_pipeline_grad_matches_sequential():
+    S, D, B = 4, 8, 8
+    W = jax.random.normal(jax.random.key(0), (S, D, D)) * 0.3
+    x = jax.random.normal(jax.random.key(1), (B, D))
+    mesh = MeshSpec(pp=4, dp=2).build()
+    Wsh = jax.device_put(W, NamedSharding(mesh, P("pp", None, None)))
+    xsh = jax.device_put(x, NamedSharding(mesh, P(DATA_AXES, None)))
+
+    def stage_fn(w, h):
+        return jnp.tanh(h @ w)
+
+    def loss_pipe(W):
+        return (pipeline_apply(stage_fn, W, xsh, n_microbatches=4,
+                               mesh=mesh) ** 2).mean()
+
+    def loss_seq(W):
+        h = x
+        for i in range(S):
+            h = stage_fn(W[i], h)
+        return (h ** 2).mean()
+
+    g1 = jax.grad(loss_pipe)(Wsh)
+    g2 = jax.grad(loss_seq)(W)
+    assert jnp.abs(g1 - g2).max() < 1e-6
+
+
+def _moe_dense_reference(params, x, cfg):
+    """All-expert dense compute weighted by top-k gates (no capacity)."""
+    logits = x @ params["wg"]
+    gates = jax.nn.softmax(logits, -1)
+    topk_idx = jax.lax.top_k(gates, cfg.k)[1]
+    mask = jax.nn.one_hot(topk_idx, cfg.n_experts).sum(1)
+    wts = gates * mask
+    h = jax.nn.gelu(jnp.einsum("td,edf->tef", x, params["w1"]))
+    eo = jnp.einsum("tef,efd->ted", h, params["w2"])
+    return jnp.einsum("te,ted->td", wts, eo)
+
+
+def test_moe_local_matches_dense():
+    cfg = MoEConfig(d_model=16, d_ff=32, n_experts=4, k=2,
+                    capacity_factor=8.0)
+    params = moe_init(jax.random.key(0), cfg)
+    x = jax.random.normal(jax.random.key(1), (64, cfg.d_model))
+    ref = _moe_dense_reference(params, x, cfg)
+    y, aux = moe_apply(params, x, cfg)
+    assert jnp.abs(y - ref).max() < 2e-5
+    assert jnp.isfinite(aux)
+
+
+def test_moe_expert_parallel_matches_dense():
+    cfg = MoEConfig(d_model=16, d_ff=32, n_experts=4, k=2,
+                    capacity_factor=8.0)
+    params = moe_init(jax.random.key(0), cfg)
+    B, S = 8, 8
+    x = jax.random.normal(jax.random.key(1), (B, S, cfg.d_model))
+    ref = _moe_dense_reference(
+        params, x.reshape(-1, cfg.d_model), cfg).reshape(B, S, -1)
+
+    mesh = MeshSpec(dp=2, ep=4).build()
+    psh = {
+        "wg": jax.device_put(params["wg"], NamedSharding(mesh, P(None, None))),
+        "w1": jax.device_put(params["w1"],
+                             NamedSharding(mesh, P("ep", None, None))),
+        "w2": jax.device_put(params["w2"],
+                             NamedSharding(mesh, P("ep", None, None))),
+    }
+    xsh = jax.device_put(x, NamedSharding(mesh, P(DATA_AXES, None, None)))
+    y, aux = moe_apply_sharded(psh, xsh, cfg, mesh)
+    assert jnp.abs(y - ref).max() < 2e-5
+    assert jnp.isfinite(aux)
+
+
+def test_moe_capacity_drops_tokens():
+    """With a tight capacity factor some tokens are dropped, never crashing."""
+    cfg = MoEConfig(d_model=8, d_ff=16, n_experts=4, k=1,
+                    capacity_factor=0.5)
+    params = moe_init(jax.random.key(0), cfg)
+    x = jax.random.normal(jax.random.key(1), (32, cfg.d_model))
+    y, aux = moe_apply(params, x, cfg)
+    assert y.shape == x.shape
+    # Dropped tokens produce zero output rows; at least some survive.
+    assert jnp.abs(y).sum() > 0
+
+
+def test_moe_grad():
+    cfg = MoEConfig(d_model=8, d_ff=16, n_experts=4, k=2,
+                    capacity_factor=2.0)
+    params = moe_init(jax.random.key(0), cfg)
+    x = jax.random.normal(jax.random.key(1), (32, cfg.d_model))
+
+    def loss(p):
+        y, aux = moe_apply(p, x, cfg)
+        return (y ** 2).mean() + 0.01 * aux
+
+    g = jax.grad(loss)(params)
+    for leaf in jax.tree_util.tree_leaves(g):
+        assert jnp.isfinite(leaf).all()

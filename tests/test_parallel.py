@@ -30,12 +30,13 @@ def test_mesh_spec_auto():
 
 def test_mesh_build():
     mesh = MeshSpec(dp=2, fsdp=2, tp=2).build()
-    assert mesh.shape == {"dp": 2, "fsdp": 2, "sp": 1, "tp": 2}
+    assert mesh.shape == {"pp": 1, "dp": 2, "fsdp": 2, "ep": 1, "sp": 1,
+                          "tp": 2}
 
 
 def test_logical_rules():
     spec = logical_to_mesh_axes(("batch", "seq", "embed"))
-    assert spec == P(("dp", "fsdp"), "sp", None)  # embed->fsdp already used
+    assert spec == P(("dp", "fsdp", "ep"), "sp", None)  # embed->fsdp used
     spec2 = logical_to_mesh_axes(("vocab", "embed"))
     assert spec2 == P("tp", "fsdp")
 
