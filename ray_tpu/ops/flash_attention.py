@@ -60,6 +60,7 @@ def _flash_reference(q, k, v, *, causal: bool, block_size: int):
         k = jnp.pad(k, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pad_k), (0, 0), (0, 0)))
         sk += pad_k
+    orig_sk = sk - pad_k
     nq, nk = sq // blk, sk // blk
     scale = d ** -0.5
 
@@ -80,10 +81,15 @@ def _flash_reference(q, k, v, *, causal: bool, block_size: int):
             v_blk = vb[:, :, kj]
             s = jnp.einsum("bhqd,bhkd->bhqk", q_blk, k_blk,
                            preferred_element_type=jnp.float32) * scale
+            k_pos = kj * blk + jnp.arange(blk)[None, :]
             if causal:
                 q_pos = qi * blk + jnp.arange(blk)[:, None]
-                k_pos = kj * blk + jnp.arange(blk)[None, :]
                 s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            elif pad_k:
+                # Causal masking already excludes padded keys (they sit at
+                # positions beyond every real query); non-causal must mask
+                # them explicitly.
+                s = jnp.where(k_pos < orig_sk, s, NEG_INF)
             m_new = jnp.maximum(m, s.max(-1))
             p = jnp.exp(s - m_new[..., None])
             correction = jnp.exp(m - m_new)

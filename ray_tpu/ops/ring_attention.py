@@ -57,9 +57,8 @@ def ring_attention(q, k, v, *, axis: str = "sp", causal: bool = True,
 
     qf = q.astype(jnp.float32) * scale
 
-    def step(carry, _):
-        m, l, acc, kv, kv_idx = carry
-        k_blk, v_blk = kv
+    def attend(m, l, acc, k_blk, v_blk, kv_idx):
+        """Fold one k/v block into the online-softmax accumulators."""
         s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32))
         if causal:
             k_pos = kv_idx * sq + jnp.arange(sq)  # [sq] global key positions
@@ -71,18 +70,24 @@ def ring_attention(q, k, v, *, axis: str = "sp", causal: bool = True,
         l_new = l * corr + p.sum(-1)
         acc_new = acc * corr[..., None] + jnp.einsum(
             "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
-        # Rotate k/v to the next device; the block we just consumed came
-        # from device (kv_idx), the incoming one came from (kv_idx - 1).
-        kv_next = (jax.lax.ppermute(k_blk, axis, perm),
-                   jax.lax.ppermute(v_blk, axis, perm))
-        kv_idx_next = (kv_idx - 1) % sp
-        return (m_new, l_new, acc_new, kv_next, kv_idx_next), None
+        return m_new, l_new, acc_new
+
+    def step(carry, _):
+        # Rotate first, then attend: the local block was consumed before the
+        # scan, so only sp-1 rotations happen and none is wasted.
+        m, l, acc, (k_blk, v_blk), kv_idx = carry
+        k_blk = jax.lax.ppermute(k_blk, axis, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis, perm)
+        kv_idx = (kv_idx - 1) % sp
+        m, l, acc = attend(m, l, acc, k_blk, v_blk, kv_idx)
+        return (m, l, acc, (k_blk, v_blk), kv_idx), None
 
     m0 = jnp.full((b, h, sq), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, h, sq), jnp.float32)
     acc0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    m, l, acc = attend(m0, l0, acc0, k, v, idx)  # own block, no comms
     (m, l, acc, _, _), _ = jax.lax.scan(
-        step, (m0, l0, acc0, (k, v), idx), None, length=sp)
+        step, (m, l, acc, (k, v), idx), None, length=sp - 1)
     out = acc / jnp.maximum(l[..., None], 1e-30)  # [b,h,sq,d]
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 

@@ -57,8 +57,7 @@ def pipeline(stage_fn: Callable, stage_params, x, *, n_microbatches: int,
     x_mb = x.reshape((M, mb) + x.shape[1:])
     perm = ring_neighbors(S)
 
-    def tick(carry, t):
-        buf, out = carry
+    def do_tick(buf, out, t):
         # Stage 0 feeds itself microbatch t; later stages consume what the
         # previous stage produced last tick.
         inp = jnp.where(
@@ -75,12 +74,20 @@ def pipeline(stage_fn: Callable, stage_params, x, *, n_microbatches: int,
         prev = jax.lax.dynamic_index_in_dim(out, idx, 0, keepdims=False)
         out = jax.lax.dynamic_update_index_in_dim(
             out, jnp.where(valid, y, prev), idx, 0)
-        buf_next = jax.lax.ppermute(y, axis, perm)
-        return (buf_next, out), None
+        return y, out
 
+    def tick(carry, t):
+        buf, out = carry
+        y, out = do_tick(buf, out, t)
+        return (jax.lax.ppermute(y, axis, perm), out), None
+
+    T = M + S - 1
     buf0 = jnp.zeros_like(x_mb[0])
     out0 = jnp.zeros((M, mb) + x.shape[1:], x.dtype)
-    (_, out), _ = jax.lax.scan(tick, (buf0, out0), jnp.arange(M + S - 1))
+    # Scan the first T-1 ticks (each sends downstream); the final tick only
+    # drains the last microbatch on the last stage — no send needed.
+    (buf, out), _ = jax.lax.scan(tick, (buf0, out0), jnp.arange(T - 1))
+    _, out = do_tick(buf, out, jnp.int32(T - 1))
     # Results live on the last stage and `out` is zeros everywhere else, so
     # a psum replicates them to every pp rank without materializing an
     # S-fold gather buffer.

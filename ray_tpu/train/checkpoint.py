@@ -15,6 +15,7 @@ import json
 import os
 import shutil
 import tempfile
+import threading
 import uuid
 from contextlib import contextmanager
 from typing import Any, Optional
@@ -67,15 +68,22 @@ class Checkpoint:
         return f"Checkpoint({self.path})"
 
 
+# Orbax's async checkpoint machinery is not thread-safe for concurrent
+# saves in one process (device-lane trials each run on a thread), so saves
+# serialize on a process-wide lock.
+_SAVE_LOCK = threading.Lock()
+
+
 def save_pytree(state: Any, path: str):
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
-    if os.path.exists(os.path.join(path, "pytree")):
-        shutil.rmtree(os.path.join(path, "pytree"))
-    os.makedirs(path, exist_ok=True)
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(os.path.join(path, "pytree"), state)
+    with _SAVE_LOCK:
+        if os.path.exists(os.path.join(path, "pytree")):
+            shutil.rmtree(os.path.join(path, "pytree"))
+        os.makedirs(path, exist_ok=True)
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(os.path.join(path, "pytree"), state)
 
 
 def load_pytree(path: str, target: Any = None, shardings=None) -> Any:

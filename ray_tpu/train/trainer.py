@@ -47,6 +47,9 @@ class RunConfig:
     storage_path: str = "/tmp/ray_tpu/results"
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
     failure_config: FailureConfig = field(default_factory=FailureConfig)
+    # Stop criteria: {"metric": bound} — a trial stops once any reported
+    # metric reaches its bound (parity: reference RunConfig(stop=...)).
+    stop: Optional[dict] = None
 
 
 @dataclass
@@ -212,9 +215,16 @@ class JaxTrainer:
         last_metrics: dict = {}
         error: Optional[BaseException] = None
 
+        stop_criteria = self.run_config.stop or {}
+
+        def hit_stop(metrics: dict) -> bool:
+            return any(k in metrics and metrics[k] >= bound
+                       for k, bound in stop_criteria.items())
+
         while True:
             workers = self._make_workers(name, resume_path)
             gang_failed = False
+            stop_requested = False
             done_flags = [False] * len(workers)
             worker_error: Optional[str] = None
             while not all(done_flags) and not gang_failed:
@@ -236,11 +246,15 @@ class JaxTrainer:
                             last_metrics = metrics
                             if ckpt_path:
                                 manager.register(Checkpoint(ckpt_path), metrics)
+                            if hit_stop(metrics):
+                                stop_requested = True
                         elif ckpt_path:
                             # Non-rank-0 snapshots are redundant; reclaim tmp.
                             from .checkpoint import maybe_cleanup_tmp_checkpoint
 
                             maybe_cleanup_tmp_checkpoint(ckpt_path)
+                if stop_requested:
+                    break  # stop criteria met: cooperative gang stop below
                 if not all(done_flags) and not gang_failed:
                     time.sleep(0.05)
             for w in workers:

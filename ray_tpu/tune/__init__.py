@@ -1,0 +1,39 @@
+"""ray_tpu.tune — experiment runner / hyperparameter optimization.
+
+Capability parity target: Ray Tune (/root/reference/python/ray/tune/):
+Tuner.fit over trial actors, search spaces, random/grid search, ASHA /
+median-stopping / PBT schedulers, experiment checkpoint+resume. TPU-native
+notes: trials that share one chip run on the in-process device lane
+(TuneConfig.scheduling_strategy="device") so a PBT sweep multiplexes a
+single slice; everything else matches the reference's API shape.
+"""
+
+from .search import (  # noqa: F401
+    BasicVariantGenerator,
+    Searcher,
+    choice,
+    grid_search,
+    lograndint,
+    loguniform,
+    qloguniform,
+    quniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from .schedulers import (  # noqa: F401
+    ASHAScheduler,
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from .trial import Trial  # noqa: F401
+from .tuner import (  # noqa: F401
+    ResultGrid,
+    TuneConfig,
+    Tuner,
+    report,
+    with_parameters,
+)

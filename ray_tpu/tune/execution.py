@@ -1,0 +1,218 @@
+"""TuneController: the experiment event loop.
+
+Parity target: /root/reference/python/ray/tune/execution/tune_controller.py
+(step loop scheduling trial actors, feeding results to searcher+scheduler,
+checkpoint/restore, failure retry) — rebuilt over ray_tpu actors. Each trial
+is one TrainWorker actor (ray_tpu/train/trainer.py) running the trainable on
+a thread and queueing reports; the controller polls all live trials each
+step, so one driver process multiplexes the whole experiment.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Optional
+
+from ..train.checkpoint import Checkpoint, CheckpointManager
+from ..train.trainer import TrainWorker
+from . import schedulers as sched_mod
+from .schedulers import CONTINUE, PAUSE, STOP, FIFOScheduler, TrialScheduler
+from .search import BasicVariantGenerator, Searcher
+from .trial import (ERROR, PAUSED, PENDING, RUNNING, TERMINATED, Trial)
+
+POLL_INTERVAL = 0.05
+
+
+class TuneController:
+    def __init__(self, trainable: Callable, *, experiment_dir: str,
+                 searcher: Searcher, scheduler: TrialScheduler,
+                 metric: Optional[str], mode: str = "max",
+                 max_concurrent: int = 4, max_failures: int = 0,
+                 stop: Optional[dict] = None,
+                 checkpoint_keep: Optional[int] = None,
+                 scheduling_strategy: Optional[str] = None,
+                 trial_cpus: float = 1.0,
+                 restored_trials: Optional[list[Trial]] = None):
+        self.trainable = trainable
+        self.exp_dir = experiment_dir
+        self.searcher = searcher
+        self.scheduler = scheduler
+        self.metric = metric
+        self.mode = mode
+        self.max_concurrent = max_concurrent
+        self.max_failures = max_failures
+        self.stop_criteria = stop or {}
+        self.scheduling_strategy = scheduling_strategy
+        self.trial_cpus = trial_cpus
+        self.trials: list[Trial] = list(restored_trials or [])
+        self.managers: dict[str, CheckpointManager] = {}
+        for t in self.trials:
+            self._manager_for(t)
+        os.makedirs(self.exp_dir, exist_ok=True)
+
+    # -- helpers ------------------------------------------------------------
+    def _manager_for(self, trial: Trial) -> CheckpointManager:
+        m = self.managers.get(trial.trial_id)
+        if m is None:
+            m = CheckpointManager(
+                os.path.join(self.exp_dir, trial.name, "checkpoints"),
+                None, self.metric, self.mode)
+            self.managers[trial.trial_id] = m
+        return m
+
+    def _launch(self, trial: Trial):
+        import ray_tpu
+
+        cls = ray_tpu.remote(TrainWorker)
+        opts: dict = {"max_concurrency": 4}
+        if self.scheduling_strategy:
+            opts["scheduling_strategy"] = self.scheduling_strategy
+        else:
+            opts["num_cpus"] = self.trial_cpus
+        exp_name = os.path.basename(self.exp_dir)
+        trial.actor = cls.options(**opts).remote(
+            0, 1, self.trainable, trial.config, exp_name, trial.name,
+            None, trial.resume_ckpt_path)
+        trial.status = RUNNING
+
+    def _teardown(self, trial: Trial):
+        import ray_tpu
+
+        if trial.actor is not None:
+            try:
+                trial.actor.stop.remote()
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+
+    def _should_stop_by_criteria(self, result: dict) -> bool:
+        for key, bound in self.stop_criteria.items():
+            if key in result and result[key] >= bound:
+                return True
+        return False
+
+    def _next_config(self) -> Optional[dict]:
+        return self.searcher.suggest(f"t{len(self.trials)}")
+
+    # -- the loop -----------------------------------------------------------
+    def step(self) -> bool:
+        """One controller step. Returns False when the experiment is done."""
+        import ray_tpu
+
+        # Refill: new trials from the searcher, resumed PENDING trials first.
+        running = [t for t in self.trials if t.status == RUNNING]
+        pending = [t for t in self.trials if t.status == PENDING]
+        while len(running) < self.max_concurrent:
+            if pending:
+                trial = pending.pop(0)
+            else:
+                cfg = self._next_config()
+                if cfg is None:
+                    break
+                trial = Trial(config=cfg)
+                self.trials.append(trial)
+            self._launch(trial)
+            running.append(trial)
+
+        if not running:
+            return False
+
+        polls = [(t, t.actor.poll.remote(timeout=POLL_INTERVAL))
+                 for t in running]
+        for trial, ref in polls:
+            try:
+                reports, done, err = ray_tpu.get(ref, timeout=120)
+            except Exception as e:  # actor died (crash/kill)
+                self._on_trial_error(trial, str(e))
+                continue
+            decision = CONTINUE
+            for metrics, ckpt_path in reports:
+                trial.iteration += 1
+                metrics = dict(metrics)
+                metrics.setdefault("training_iteration", trial.iteration)
+                metrics.setdefault("trial_id", trial.trial_id)
+                trial.history.append(metrics)
+                trial.last_result = metrics
+                ckpt = None
+                if ckpt_path:
+                    ckpt = self._manager_for(trial).register(
+                        Checkpoint(ckpt_path), metrics)
+                    trial.resume_ckpt_path = ckpt.path
+                    if hasattr(self.scheduler, "record_checkpoint"):
+                        self.scheduler.record_checkpoint(trial, ckpt)
+                self.searcher.on_trial_result(trial.trial_id, metrics)
+                if self._should_stop_by_criteria(metrics):
+                    decision = STOP
+                    break
+                d = self.scheduler.on_trial_result(trial, metrics)
+                if d != CONTINUE:
+                    decision = d
+                    break
+            if decision == STOP:
+                self._complete(trial)
+            elif decision == PAUSE:
+                self._pause(trial)
+            elif done:
+                if err is not None:
+                    self._on_trial_error(trial, err)
+                else:
+                    self._complete(trial)
+        self._save_state()
+        return True
+
+    def run(self):
+        while self.step():
+            time.sleep(POLL_INTERVAL)
+        self._save_state()
+
+    # -- transitions --------------------------------------------------------
+    def _complete(self, trial: Trial):
+        self._teardown(trial)
+        trial.status = TERMINATED
+        self.scheduler.on_trial_complete(trial, trial.last_result)
+        self.searcher.on_trial_complete(trial.trial_id, trial.last_result)
+
+    def _pause(self, trial: Trial):
+        self._teardown(trial)
+        plan = self.scheduler.exploit(trial)
+        if plan is not None:
+            ckpt, new_config = plan
+            trial.resume_ckpt_path = getattr(ckpt, "path", ckpt)
+            trial.config = new_config
+            trial.status = PENDING  # requeued with exploited state
+        else:
+            trial.status = PAUSED
+
+    def _on_trial_error(self, trial: Trial, err: str):
+        self._teardown(trial)
+        trial.num_failures += 1
+        if trial.num_failures <= self.max_failures:
+            trial.status = PENDING  # retry (from latest checkpoint if any)
+        else:
+            trial.status = ERROR
+            trial.error = err
+            self.scheduler.on_trial_complete(trial, trial.last_result)
+            self.searcher.on_trial_complete(trial.trial_id, error=True)
+
+    # -- persistence --------------------------------------------------------
+    def _save_state(self):
+        state = {
+            "trials": [t.to_json() for t in self.trials],
+            "metric": self.metric,
+            "mode": self.mode,
+        }
+        tmp = os.path.join(self.exp_dir, ".experiment_state.tmp")
+        with open(tmp, "w") as f:
+            json.dump(state, f, default=str)
+        os.replace(tmp, os.path.join(self.exp_dir,
+                                     "experiment_state.json"))
+
+    @staticmethod
+    def load_trials(experiment_dir: str) -> list[Trial]:
+        path = os.path.join(experiment_dir, "experiment_state.json")
+        with open(path) as f:
+            state = json.load(f)
+        return [Trial.from_json(d) for d in state["trials"]]

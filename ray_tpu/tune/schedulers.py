@@ -1,0 +1,186 @@
+"""Trial schedulers: FIFO, ASHA, HyperBand-style rungs, median stopping,
+Population Based Training.
+
+Parity target: /root/reference/python/ray/tune/schedulers/
+(async_hyperband.py ASHA, median_stopping_rule.py, pbt.py). Decisions are
+the same tri-state the reference uses: CONTINUE / STOP / PAUSE; the
+controller enacts them (PAUSE+exploit implements PBT's checkpoint-based
+weight cloning).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Callable, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+PAUSE = "PAUSE"
+
+
+class TrialScheduler:
+    def set_search_properties(self, metric: str, mode: str):
+        self.metric, self.mode = metric, mode
+
+    def _score(self, result: dict) -> float:
+        v = result.get(self.metric)
+        if v is None:
+            raise KeyError(
+                f"scheduler metric {self.metric!r} missing from report "
+                f"(got keys {sorted(result)})")
+        return float(v) if self.mode == "max" else -float(v)
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial, result: Optional[dict]):
+        pass
+
+    # PBT hook: controller asks whether a paused trial should restart with a
+    # new (config, checkpoint). Default: no.
+    def exploit(self, trial):
+        return None
+
+
+class FIFOScheduler(TrialScheduler):
+    """Run every trial to completion in submission order."""
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (parity: /root/reference/python/ray/tune/schedulers/
+    async_hyperband.py): promotion rungs at grace_period·rf^k; a trial
+    reaching a rung stops unless it is in the top 1/rf of peers there."""
+
+    def __init__(self, *, time_attr: str = "training_iteration",
+                 grace_period: int = 1, reduction_factor: int = 4,
+                 max_t: int = 100):
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.max_t = max_t
+        # rung milestone -> list of scores recorded there
+        self.rungs: dict[int, list[float]] = {}
+        r = grace_period
+        while r < max_t:
+            self.rungs[r] = []
+            r *= reduction_factor
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        t = result.get(self.time_attr, 0)
+        if t >= self.max_t:
+            return STOP
+        score = self._score(result)
+        decision = CONTINUE
+        for milestone in sorted(self.rungs):
+            if t == milestone:
+                peers = self.rungs[milestone]
+                peers.append(score)
+                if len(peers) >= self.rf:
+                    cutoff = sorted(peers, reverse=True)[
+                        max(0, len(peers) // self.rf - 1)]
+                    if score < cutoff:
+                        decision = STOP
+        return decision
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose running-average score falls below the median of
+    other trials' running averages at the same step (parity:
+    /root/reference/python/ray/tune/schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, *, time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self._sums: dict[str, tuple[float, int]] = {}  # trial -> (sum, n)
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        t = result.get(self.time_attr, 0)
+        score = self._score(result)
+        s, n = self._sums.get(trial.trial_id, (0.0, 0))
+        self._sums[trial.trial_id] = (s + score, n + 1)
+        if t < self.grace or len(self._sums) < self.min_samples:
+            return CONTINUE
+        avgs = {tid: s / n for tid, (s, n) in self._sums.items() if n}
+        mine = avgs.pop(trial.trial_id, None)
+        if mine is None or not avgs:
+            return CONTINUE
+        med = sorted(avgs.values())[len(avgs) // 2]
+        return STOP if mine < med else CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (parity: /root/reference/python/ray/tune/schedulers/pbt.py).
+
+    Every ``perturbation_interval`` steps a trial in the bottom quantile is
+    PAUSEd; the controller then calls :meth:`exploit`, which hands back the
+    top-quantile peer's checkpoint plus a perturbed config, and restarts the
+    trial from that state.
+    """
+
+    def __init__(self, *, time_attr: str = "training_iteration",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[dict] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self.rng = random.Random(seed)
+        self._last: dict[str, dict] = {}      # trial_id -> last result
+        self._ckpt: dict[str, object] = {}    # trial_id -> latest Checkpoint
+        self._cfg: dict[str, dict] = {}       # trial_id -> current config
+        self._exploit_plan: dict[str, tuple] = {}
+
+    def record_checkpoint(self, trial, checkpoint):
+        self._ckpt[trial.trial_id] = checkpoint
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        self._last[trial.trial_id] = result
+        self._cfg[trial.trial_id] = trial.config
+        t = result.get(self.time_attr, 0)
+        if t == 0 or t % self.interval:
+            return CONTINUE
+        scores = {tid: self._score(r) for tid, r in self._last.items()}
+        if len(scores) < 2:
+            return CONTINUE
+        ranked = sorted(scores, key=scores.get)
+        k = max(1, int(len(ranked) * self.quantile))
+        bottom, top = ranked[:k], ranked[-k:]
+        if trial.trial_id in bottom:
+            src = self.rng.choice(top)
+            if src != trial.trial_id and src in self._ckpt:
+                self._exploit_plan[trial.trial_id] = (
+                    self._ckpt[src], self._explore(self._cfg.get(src, {})))
+                return PAUSE
+        return CONTINUE
+
+    def _explore(self, config: dict) -> dict:
+        new = dict(config)
+        for key, domain in self.mutations.items():
+            if self.rng.random() < self.resample_p or key not in new:
+                from .search import Domain
+
+                if isinstance(domain, Domain):
+                    new[key] = domain.sample(self.rng)
+                elif isinstance(domain, (list, tuple)):
+                    new[key] = self.rng.choice(list(domain))
+                elif callable(domain):
+                    new[key] = domain()
+            else:
+                factor = self.rng.choice([0.8, 1.2])
+                if isinstance(new[key], (int, float)):
+                    new[key] = type(new[key])(new[key] * factor)
+        return new
+
+    def exploit(self, trial):
+        return self._exploit_plan.pop(trial.trial_id, None)
+
+
+# Reference exposes ASHAScheduler as the recommended alias.
+ASHAScheduler = AsyncHyperBandScheduler

@@ -1,0 +1,190 @@
+"""Search spaces and search algorithms.
+
+Parity target: the reference's tune.search
+(/root/reference/python/ray/tune/search/: sample.py domains,
+basic_variant.py BasicVariantGenerator, searcher base). Third-party
+searchers (Optuna/HyperOpt/...) are pluggable via the same Searcher
+interface; the built-ins here (random/grid) cover the reference's default
+path without external deps.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from typing import Any, Callable, Optional, Sequence
+
+
+class Domain:
+    """A sampleable hyperparameter domain."""
+
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Float(Domain):
+    def __init__(self, lower: float, upper: float, log: bool = False,
+                 q: Optional[float] = None):
+        if log and lower <= 0:
+            raise ValueError("loguniform requires lower > 0")
+        self.lower, self.upper, self.log, self.q = lower, upper, log, q
+
+    def sample(self, rng):
+        if self.log:
+            v = math.exp(rng.uniform(math.log(self.lower),
+                                     math.log(self.upper)))
+        else:
+            v = rng.uniform(self.lower, self.upper)
+        if self.q:
+            v = round(v / self.q) * self.q
+        return v
+
+
+class Integer(Domain):
+    def __init__(self, lower: int, upper: int, log: bool = False):
+        self.lower, self.upper, self.log = lower, upper, log
+
+    def sample(self, rng):
+        if self.log:
+            return int(math.exp(rng.uniform(math.log(self.lower),
+                                            math.log(self.upper))))
+        return rng.randint(self.lower, self.upper - 1)
+
+
+class Categorical(Domain):
+    def __init__(self, categories: Sequence):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class Function(Domain):
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn(None) if self.fn.__code__.co_argcount else self.fn()
+
+
+class GridSearch:
+    """Marker: expand these values as a cartesian grid axis."""
+
+    def __init__(self, values: Sequence):
+        self.values = list(values)
+
+
+# -- public constructors (reference names: tune.uniform etc.) ---------------
+def uniform(lower, upper):
+    return Float(lower, upper)
+
+
+def quniform(lower, upper, q):
+    return Float(lower, upper, q=q)
+
+
+def loguniform(lower, upper):
+    return Float(lower, upper, log=True)
+
+
+def qloguniform(lower, upper, q):
+    return Float(lower, upper, log=True, q=q)
+
+
+def randint(lower, upper):
+    return Integer(lower, upper)
+
+
+def lograndint(lower, upper):
+    return Integer(lower, upper, log=True)
+
+
+def choice(categories):
+    return Categorical(categories)
+
+
+def sample_from(fn):
+    return Function(fn)
+
+
+def grid_search(values):
+    return GridSearch(values)
+
+
+# -- resolution -------------------------------------------------------------
+def _walk(space: dict, path=()):
+    for k, v in space.items():
+        p = path + (k,)
+        if isinstance(v, dict):
+            yield from _walk(v, p)
+        else:
+            yield p, v
+
+
+def _set(cfg: dict, path: tuple, value):
+    for k in path[:-1]:
+        cfg = cfg.setdefault(k, {})
+    cfg[path[-1]] = value
+
+
+def resolve(space: dict, rng: random.Random) -> list[dict]:
+    """One draw of every sampleable; grid axes expand to the full cartesian
+    product. Returns the list of concrete configs for this draw."""
+    grid_axes = [(p, v.values) for p, v in _walk(space)
+                 if isinstance(v, GridSearch)]
+    combos = (itertools.product(*(vals for _, vals in grid_axes))
+              if grid_axes else [()])
+    out = []
+    for combo in combos:
+        cfg: dict = {}
+        for p, v in _walk(space):
+            if isinstance(v, GridSearch):
+                continue
+            _set(cfg, p, v.sample(rng) if isinstance(v, Domain) else v)
+        for (p, _), val in zip(grid_axes, combo):
+            _set(cfg, p, val)
+        out.append(cfg)
+    return out
+
+
+class Searcher:
+    """Pluggable search algorithm interface (parity:
+    /root/reference/python/ray/tune/search/searcher.py)."""
+
+    def set_search_properties(self, metric: str, mode: str, space: dict):
+        self.metric, self.mode, self.space = metric, mode, space
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: dict):
+        pass
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict] = None,
+                          error: bool = False):
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Random sampling + grid expansion (the reference default,
+    /root/reference/python/ray/tune/search/basic_variant.py)."""
+
+    def __init__(self, *, num_samples: int = 1, seed: Optional[int] = None):
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+        self._queue: list[dict] = []
+        self._space: Optional[dict] = None
+        self._draws = 0
+
+    def set_search_properties(self, metric, mode, space):
+        super().set_search_properties(metric, mode, space)
+        self._space = space
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        if not self._queue:
+            if self._draws >= self.num_samples:
+                return None
+            self._queue.extend(resolve(self._space or {}, self.rng))
+            self._draws += 1
+        return self._queue.pop(0)

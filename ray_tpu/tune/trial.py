@@ -1,0 +1,58 @@
+"""Trial state (parity: /root/reference/python/ray/tune/experiment/trial.py,
+reduced to the fields the controller actually drives)."""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+PAUSED = "PAUSED"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+@dataclass
+class Trial:
+    config: dict
+    trial_id: str = field(default_factory=lambda: uuid.uuid4().hex[:8])
+    status: str = PENDING
+    iteration: int = 0
+    history: list = field(default_factory=list)
+    last_result: dict = field(default_factory=dict)
+    error: Optional[str] = None
+    num_failures: int = 0
+    resume_ckpt_path: Optional[str] = None
+    actor: Any = None  # ActorHandle while RUNNING
+
+    @property
+    def name(self) -> str:
+        return f"trial_{self.trial_id}"
+
+    def to_json(self) -> dict:
+        return {
+            "trial_id": self.trial_id,
+            "config": self.config,
+            "status": self.status if self.status not in (RUNNING,)
+            else PENDING,  # a live trial resumes as pending
+            "iteration": self.iteration,
+            "history": self.history,
+            "last_result": self.last_result,
+            "error": self.error,
+            "num_failures": self.num_failures,
+            "resume_ckpt_path": self.resume_ckpt_path,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Trial":
+        t = cls(config=d["config"], trial_id=d["trial_id"])
+        t.status = d["status"]
+        t.iteration = d.get("iteration", 0)
+        t.history = d.get("history", [])
+        t.last_result = d.get("last_result", {})
+        t.error = d.get("error")
+        t.num_failures = d.get("num_failures", 0)
+        t.resume_ckpt_path = d.get("resume_ckpt_path")
+        return t

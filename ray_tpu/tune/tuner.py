@@ -1,0 +1,182 @@
+"""Tuner: the user-facing experiment API.
+
+Parity target: /root/reference/python/ray/tune/tuner.py (Tuner.fit →
+ResultGrid) and tune_config.py. Trainables are functions taking a config
+dict and calling ``ray_tpu.train.report`` (the reference's function-trainable
+API); JaxTrainer instances are accepted and swept via
+``param_space["train_loop_config"]``, mirroring how the reference runs every
+Trainer through a single-trial Tuner (base_trainer.py:579 as_trainable).
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ..train.checkpoint import Checkpoint
+from ..train.trainer import JaxTrainer, Result, RunConfig
+from .execution import TuneController
+from .schedulers import FIFOScheduler, TrialScheduler
+from .search import BasicVariantGenerator, Searcher
+from .trial import ERROR, TERMINATED, Trial
+
+
+@dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    search_alg: Optional[Searcher] = None
+    scheduler: Optional[TrialScheduler] = None
+    seed: Optional[int] = None
+    # ray_tpu extension: run trials on the in-process device lane ("device")
+    # instead of subprocess workers — used when trials share the chip.
+    scheduling_strategy: Optional[str] = None
+    trial_cpus: float = 1.0
+
+
+class ResultGrid:
+    def __init__(self, results: list[Result], trials: list[Trial],
+                 metric: Optional[str], mode: str):
+        self._results = results
+        self._trials = trials
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def errors(self) -> list:
+        return [r.error for r in self._results if r.error is not None]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("no metric given (TuneConfig.metric or arg)")
+        scored = [r for r in self._results if metric in r.metrics]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        best = (max if mode == "max" else min)(
+            scored, key=lambda r: r.metrics[metric])
+        return best
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame([r.metrics for r in self._results])
+
+
+class Tuner:
+    def __init__(self, trainable: Any, *, param_space: Optional[dict] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 _restored_trials: Optional[list[Trial]] = None):
+        self.param_space = dict(param_space or {})
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self._restored = _restored_trials
+        if isinstance(trainable, JaxTrainer):
+            self._trainer = trainable
+            self.trainable = _trainer_as_trainable(trainable)
+            # Sweeping a trainer: the param space targets its loop config.
+            if "train_loop_config" in self.param_space:
+                self.param_space = self.param_space["train_loop_config"]
+        else:
+            self._trainer = None
+            self.trainable = trainable
+
+    @classmethod
+    def restore(cls, path: str, trainable: Any,
+                tune_config: Optional[TuneConfig] = None) -> "Tuner":
+        """Resume an interrupted experiment from its directory."""
+        trials = TuneController.load_trials(path)
+        run_config = RunConfig(name=os.path.basename(path),
+                               storage_path=os.path.dirname(path))
+        return cls(trainable, tune_config=tune_config,
+                   run_config=run_config, _restored_trials=trials)
+
+    def fit(self) -> ResultGrid:
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        tc = self.tune_config
+        name = self.run_config.name or f"tune_{uuid.uuid4().hex[:6]}"
+        exp_dir = os.path.join(self.run_config.storage_path, name)
+
+        searcher = tc.search_alg or BasicVariantGenerator(
+            num_samples=tc.num_samples, seed=tc.seed)
+        searcher.set_search_properties(tc.metric, tc.mode, self.param_space)
+        scheduler = tc.scheduler or FIFOScheduler()
+        scheduler.set_search_properties(tc.metric, tc.mode)
+
+        controller = TuneController(
+            self.trainable,
+            experiment_dir=exp_dir,
+            searcher=searcher,
+            scheduler=scheduler,
+            metric=tc.metric,
+            mode=tc.mode,
+            max_concurrent=tc.max_concurrent_trials,
+            max_failures=self.run_config.failure_config.max_failures,
+            stop=getattr(self.run_config, "stop", None),
+            scheduling_strategy=tc.scheduling_strategy,
+            trial_cpus=tc.trial_cpus,
+            restored_trials=self._restored,
+        )
+        controller.run()
+
+        results = []
+        for t in controller.trials:
+            manager = controller.managers.get(t.trial_id)
+            results.append(Result(
+                metrics=t.last_result,
+                checkpoint=manager.latest if manager else None,
+                best_checkpoint=manager.best if manager else None,
+                error=(ray_tpu.TaskError(t.error) if t.status == ERROR
+                       else None),
+                path=os.path.join(exp_dir, t.name),
+                metrics_history=t.history,
+            ))
+        return ResultGrid(results, controller.trials, tc.metric, tc.mode)
+
+
+def _trainer_as_trainable(trainer: JaxTrainer) -> Callable:
+    """A function trainable that runs the trainer's loop with a per-trial
+    config overlaying the base train_loop_config."""
+
+    def run_trial(config: dict):
+        merged = {**trainer.config, **config}
+        return trainer.loop(merged)
+
+    return run_trial
+
+
+def with_parameters(fn: Callable, **bound) -> Callable:
+    """Bind large constant objects to a trainable (parity:
+    /root/reference/python/ray/tune/trainable/util.py with_parameters)."""
+
+    def wrapped(config: dict):
+        return fn(config, **bound)
+
+    wrapped.__name__ = getattr(fn, "__name__", "trainable")
+    return wrapped
+
+
+def report(metrics: dict, checkpoint: Optional[Checkpoint] = None):
+    """tune.report — same session plumbing as ray_tpu.train.report."""
+    from ..train.session import report as _report
+
+    _report(metrics, checkpoint)

@@ -196,3 +196,16 @@ def test_moe_grad():
     g = jax.grad(loss)(params)
     for leaf in jax.tree_util.tree_leaves(g):
         assert jnp.isfinite(leaf).all()
+
+
+def test_flash_noncausal_padding_masked():
+    """Non-causal flash with seq not a block multiple must ignore the
+    zero-padded phantom keys (regression: padded keys got softmax weight)."""
+    from ray_tpu.ops.flash_attention import _flash_reference
+
+    b, s, h, d = 2, 48, 2, 8  # 48 % block(32) != 0
+    ks = jax.random.split(jax.random.key(7), 3)
+    q, k, v = (jax.random.normal(kk, (b, s, h, d), jnp.float32) for kk in ks)
+    ref = causal_attention(q, k, v, causal=False)
+    out = _flash_reference(q, k, v, causal=False, block_size=32)
+    assert jnp.abs(out - ref).max() < 2e-5

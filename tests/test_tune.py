@@ -1,0 +1,276 @@
+"""Tune-equivalent: search spaces, Tuner.fit, schedulers, PBT, resume.
+
+Trials run on the in-process device lane (scheduling_strategy="device") so
+the suite doesn't pay a subprocess fork per trial; the subprocess path is
+covered by one test at the end.
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import Checkpoint, RunConfig
+from ray_tpu.tune.search import resolve
+
+import random
+
+
+def _tc(**kw):
+    kw.setdefault("scheduling_strategy", "device")
+    kw.setdefault("mode", "max")
+    return tune.TuneConfig(**kw)
+
+
+def test_search_space_sampling():
+    rng = random.Random(0)
+    space = {
+        "lr": tune.loguniform(1e-5, 1e-1),
+        "layers": tune.randint(1, 5),
+        "act": tune.choice(["relu", "gelu"]),
+        "nested": {"dropout": tune.uniform(0.0, 0.5)},
+    }
+    cfgs = resolve(space, rng)
+    assert len(cfgs) == 1
+    c = cfgs[0]
+    assert 1e-5 <= c["lr"] <= 1e-1
+    assert 1 <= c["layers"] < 5
+    assert c["act"] in ("relu", "gelu")
+    assert 0.0 <= c["nested"]["dropout"] <= 0.5
+
+
+def test_grid_search_expansion():
+    rng = random.Random(0)
+    space = {
+        "a": tune.grid_search([1, 2, 3]),
+        "b": tune.grid_search(["x", "y"]),
+        "c": tune.uniform(0, 1),
+    }
+    cfgs = resolve(space, rng)
+    assert len(cfgs) == 6
+    assert {(c["a"], c["b"]) for c in cfgs} == {
+        (a, b) for a in (1, 2, 3) for b in ("x", "y")}
+
+
+def test_tuner_random_search(rt):
+    def trainable(config):
+        # quadratic bowl: best near x=3
+        score = -(config["x"] - 3.0) ** 2
+        tune.report({"score": score})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.uniform(0, 6)},
+        tune_config=_tc(metric="score", num_samples=8,
+                        max_concurrent_trials=4, seed=42),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 8
+    assert not grid.errors
+    best = grid.get_best_result()
+    assert best.metrics["score"] > -4.0  # better than worst corner
+
+
+def test_tuner_grid_and_best(rt):
+    def trainable(config):
+        tune.report({"val": config["a"] * 10 + config["b"]})
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={"a": tune.grid_search([1, 2]),
+                     "b": tune.grid_search([3, 4])},
+        tune_config=_tc(metric="val"),
+    ).fit()
+    assert len(grid) == 4
+    assert grid.get_best_result().metrics["val"] == 24
+
+
+def test_asha_rung_promotion_logic():
+    """Deterministic unit drive: four trials report in lockstep; the weak
+    ones are cut at promotion rungs, the strongest survives to max_t."""
+    sched = tune.ASHAScheduler(grace_period=2, reduction_factor=2, max_t=16)
+    sched.set_search_properties("acc", "max")
+    # Strongest reports first at each step, as the frontrunner does in an
+    # async experiment; weaker arrivals then compare against its rung marks.
+    trials = [tune.Trial(config={"q": q}) for q in (1.0, 0.5, 0.2, 0.1)]
+    alive = {t.trial_id for t in trials}
+    stopped_at = {}
+    for step in range(1, 17):
+        for t in trials:
+            if t.trial_id not in alive:
+                continue
+            d = sched.on_trial_result(
+                t, {"acc": t.config["q"] * step, "training_iteration": step})
+            if d == "STOP":
+                alive.discard(t.trial_id)
+                stopped_at[t.config["q"]] = step
+    assert stopped_at.get(1.0, 16) == 16  # best trial ran to max_t
+    assert stopped_at.get(0.1, 99) <= 4   # weakest cut at an early rung
+    assert stopped_at.get(0.2, 99) <= 4
+    assert sum(1 for q, s in stopped_at.items() if s < 16) >= 2
+
+
+def test_asha_integration(rt):
+    def trainable(config):
+        import time as _t
+
+        for step in range(1, 17):
+            tune.report({"acc": config["quality"] * step})
+            _t.sleep(0.01)  # let trials interleave so rungs see peers
+
+    sched = tune.ASHAScheduler(grace_period=2, reduction_factor=2, max_t=16)
+    grid = tune.Tuner(
+        trainable,
+        param_space={"quality": tune.grid_search(
+            [0.1, 0.2, 0.5, 1.0])},
+        tune_config=_tc(metric="acc", scheduler=sched,
+                        max_concurrent_trials=4),
+    ).fit()
+    assert not grid.errors
+    best = grid.get_best_result()
+    assert best.metrics["acc"] == pytest.approx(16.0)  # 1.0 * max_t
+
+
+def test_median_stopping():
+    sched = tune.MedianStoppingRule(grace_period=2, min_samples_required=3)
+    sched.set_search_properties("m", "max")
+    trials = [tune.Trial(config={"level": lv})
+              for lv in (10.0, 5.0, 0.1, 0.0)]
+    stopped = {}
+    for step in range(1, 11):
+        for t in trials:
+            if t.config["level"] in stopped:
+                continue
+            d = sched.on_trial_result(
+                t, {"m": t.config["level"], "training_iteration": step})
+            if d == "STOP":
+                stopped[t.config["level"]] = step
+    assert 10.0 not in stopped       # above-median trial never stopped
+    assert stopped.get(0.0, 99) <= 3  # far-below-median trial cut early
+
+
+def test_stop_criteria(rt):
+    def trainable(config):
+        for step in range(100):
+            tune.report({"loss_inv": step})
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={},
+        tune_config=_tc(metric="loss_inv"),
+        run_config=RunConfig(stop={"loss_inv": 5}),
+    ).fit()
+    assert len(grid[0].metrics_history) <= 7  # stopped at the bound
+
+
+def test_pbt_exploits_checkpoints(rt):
+    def trainable(config):
+        import tempfile
+
+        ckpt = ray_tpu.train.get_checkpoint()
+        theta = 0.0
+        if ckpt:
+            theta = ckpt.get_metadata().get("theta", 0.0)
+        for step in range(1, 25):
+            theta += config["lr"]  # higher lr climbs faster
+            c = Checkpoint.from_directory(
+                tempfile.mkdtemp(prefix="rtpu-ckpt-"))
+            c.update_metadata({"theta": theta})
+            tune.report({"theta": theta}, checkpoint=c)
+
+    sched = tune.PopulationBasedTraining(
+        perturbation_interval=4,
+        hyperparam_mutations={"lr": tune.uniform(0.5, 2.0)},
+        seed=0,
+    )
+    grid = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.01, 0.02, 1.0, 1.5])},
+        tune_config=_tc(metric="theta", scheduler=sched,
+                        max_concurrent_trials=4),
+    ).fit()
+    assert not grid.errors
+    # Weak trials cloned strong peers' state: every trial's final theta
+    # should be far above what lr=0.01 alone could reach (24*0.01=0.24).
+    finals = [r.metrics.get("theta", 0.0) for r in grid]
+    assert max(finals) > 10
+    assert min(finals) > 0.24
+
+
+def test_trial_failure_retry(rt, tmp_path):
+    marker = tmp_path / "failed_once"
+
+    def trainable(config):
+        if not marker.exists():
+            marker.write_text("x")
+            raise RuntimeError("transient")
+        tune.report({"ok": 1.0})
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={},
+        tune_config=_tc(metric="ok"),
+        run_config=RunConfig(
+            failure_config=__import__(
+                "ray_tpu.train.trainer", fromlist=["FailureConfig"]
+            ).FailureConfig(max_failures=1)),
+    ).fit()
+    assert not grid.errors
+    assert grid[0].metrics["ok"] == 1.0
+
+
+def test_experiment_state_saved_and_restorable(rt, tmp_path):
+    def trainable(config):
+        tune.report({"v": config["x"]})
+
+    rc = RunConfig(name="exp1", storage_path=str(tmp_path))
+    grid = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=_tc(metric="v"),
+        run_config=rc,
+    ).fit()
+    assert len(grid) == 2
+    state_file = tmp_path / "exp1" / "experiment_state.json"
+    assert state_file.exists()
+    trials = __import__(
+        "ray_tpu.tune.execution", fromlist=["TuneController"]
+    ).TuneController.load_trials(str(tmp_path / "exp1"))
+    assert len(trials) == 2
+    assert all(t.status == "TERMINATED" for t in trials)
+
+
+def test_tuner_wraps_jax_trainer(rt):
+    from ray_tpu.train import JaxTrainer
+    from ray_tpu.parallel import ScalingConfig
+
+    def loop(config):
+        tune.report({"obj": -abs(config["lr"] - 0.1)})
+
+    trainer = JaxTrainer(loop, train_loop_config={"lr": 0.5},
+                         scaling_config=ScalingConfig(num_workers=1))
+    grid = tune.Tuner(
+        trainer,
+        param_space={"train_loop_config": {
+            "lr": tune.grid_search([0.05, 0.1, 0.2])}},
+        tune_config=_tc(metric="obj"),
+    ).fit()
+    assert len(grid) == 3
+    assert abs(grid.get_best_result().metrics["obj"]) < 1e-9
+
+
+def test_tuner_subprocess_lane(rt):
+    """One run through the real subprocess worker path."""
+
+    def trainable(config):
+        tune.report({"pid_ok": 1.0})
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={},
+        tune_config=tune.TuneConfig(metric="pid_ok", mode="max",
+                                    num_samples=1),
+    ).fit()
+    assert not grid.errors
+    assert grid[0].metrics["pid_ok"] == 1.0
