@@ -362,8 +362,16 @@ class NodeService:
     def _spawn_worker(self, actor_id: ActorID | None = None) -> WorkerHandle:
         wid = WorkerID.from_random()
         env = dict(os.environ)
-        # CPU workers must not grab the TPU chips.
-        env.setdefault("JAX_PLATFORMS", "cpu")
+        # CPU-lane workers must never touch the TPU: the device lane owns
+        # the chips. Force the cpu backend (setdefault is not enough — the
+        # ambient env pins the TPU platform) and drop the TPU-plugin
+        # bootstrap vars so sitecustomize doesn't dial the chip tunnel at
+        # interpreter start (a second claimant would block on the
+        # single-tenant chip).
+        env["JAX_PLATFORMS"] = "cpu"
+        for var in ("PALLAS_AXON_POOL_IPS", "TPU_VISIBLE_CHIPS",
+                    "TPU_WORKER_HOSTNAMES"):
+            env.pop(var, None)
         env["RT_SESSION_ID"] = self.session_id
         env["RT_SOCK_PATH"] = self.sock_path
         env["RT_WORKER_ID"] = wid.hex()

@@ -1,0 +1,25 @@
+"""ray_tpu.rllib — reinforcement learning on TPU.
+
+Capability parity target: RLlib's new API stack
+(/root/reference/rllib/: Algorithm/AlgorithmConfig, RLModule, Learner/
+LearnerGroup, EnvRunner, replay buffers) rebuilt jax-first: policy/value
+modules are functional pytrees, the learner update is one jitted
+loss+grad+optimizer step (data-parallel via mesh-sharded batches instead of
+DDP), and env runners are CPU actors feeding the TPU learner.
+"""
+
+from .algorithm import DQN, PPO, Algorithm, AlgorithmConfig  # noqa: F401
+from .env import SyncVectorEnv, make_env  # noqa: F401
+from .env_runner import (  # noqa: F401
+    SingleAgentEnvRunner,
+    compute_gae,
+    flatten_batch,
+)
+from .learner import (  # noqa: F401
+    DQNLearner,
+    Learner,
+    LearnerGroup,
+    PPOLearner,
+)
+from .models import DiscreteActorCritic, ModelConfig  # noqa: F401
+from .replay import PrioritizedReplayBuffer, ReplayBuffer  # noqa: F401

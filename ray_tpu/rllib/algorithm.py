@@ -1,0 +1,333 @@
+"""Algorithm + AlgorithmConfig: the RL training drivers.
+
+Parity target: /root/reference/rllib/algorithms/algorithm.py:189
+(Algorithm(Trainable): step:790, training_step:1569) and
+algorithm_config.py's builder API. PPO mirrors
+/root/reference/rllib/algorithms/ppo/ppo.py:379 training_step
+(synchronous_parallel_sample → learner update → weight sync); DQN mirrors
+dqn's replay-driven step. Env runners are ray_tpu actors when
+num_env_runners > 0 (the reference's WorkerSet), local otherwise.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from collections import deque
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+from ..train.checkpoint import Checkpoint
+from .env_runner import (SingleAgentEnvRunner, compute_gae, flatten_batch)
+from .learner import DQNLearner, LearnerGroup, PPOLearner
+from .models import DiscreteActorCritic, ModelConfig, space_dims
+from .replay import ReplayBuffer
+
+
+class AlgorithmConfig:
+    """Builder (reference algorithm_config.py shape):
+    config.environment(...).training(...).env_runners(...) → .build()."""
+
+    def __init__(self, algo_class: Optional[type] = None):
+        self.algo_class = algo_class
+        self.env = None
+        self.env_config: dict = {}
+        self.seed: Optional[int] = 0
+        # env runners
+        self.num_env_runners = 0
+        self.num_envs_per_runner = 1
+        self.rollout_fragment_length = 64
+        # training (shared)
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.train_batch_size = 256
+        self.minibatch_size = 128
+        self.num_epochs = 4
+        self.grad_clip: Optional[float] = 0.5
+        self.model_config = ModelConfig()
+        # PPO
+        self.lambda_ = 0.95
+        self.clip_param = 0.2
+        self.vf_coeff = 0.5
+        self.entropy_coeff = 0.0
+        # DQN
+        self.replay_buffer_capacity = 50_000
+        self.target_update_freq = 100
+        self.epsilon = (1.0, 0.05, 10_000)  # start, end, decay steps
+        self.learning_starts = 1_000
+
+    # -- builder steps ------------------------------------------------------
+    def environment(self, env=None, *, env_config: Optional[dict] = None,
+                    **_):
+        if env is not None:
+            self.env = env
+        if env_config is not None:
+            self.env_config = env_config
+        return self
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None, **_):
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs):
+        for k, v in kwargs.items():
+            if k == "lambda":
+                k = "lambda_"
+            if not hasattr(self, k):
+                raise AttributeError(f"unknown training option {k!r}")
+            setattr(self, k, v)
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None, **_):
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def framework(self, *_args, **_kw):
+        return self  # jax is the only framework
+
+    def build(self) -> "Algorithm":
+        if self.algo_class is None:
+            raise ValueError("no algo_class bound to this config")
+        return self.algo_class(copy.deepcopy(self))
+
+    def runner_config(self) -> dict:
+        return {
+            "env": self.env,
+            "env_config": self.env_config,
+            "num_envs_per_runner": self.num_envs_per_runner,
+            "model_config": self.model_config,
+            "seed": self.seed,
+        }
+
+
+class Algorithm:
+    """Trainable-shaped driver: .train() returns one iteration's results."""
+
+    config_class = AlgorithmConfig
+
+    @classmethod
+    def get_default_config(cls) -> AlgorithmConfig:
+        return AlgorithmConfig(cls)
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.iteration = 0
+        # Rolling window: train() reports mean-of-last-100 plus a count.
+        self._episode_returns: deque = deque(maxlen=100)
+        self._num_episodes = 0
+        self.setup(config)
+
+    # -- lifecycle ----------------------------------------------------------
+    def setup(self, config: AlgorithmConfig):
+        self.local_runner = SingleAgentEnvRunner(config.runner_config())
+        self.remote_runners = []
+        if config.num_env_runners > 0:
+            import ray_tpu
+
+            cls = ray_tpu.remote(SingleAgentEnvRunner)
+            self.remote_runners = [
+                cls.options(num_cpus=1).remote(
+                    {**config.runner_config(),
+                     "seed": (config.seed or 0) + 1000 * (i + 1)})
+                for i in range(config.num_env_runners)
+            ]
+        self.learner_group = self._make_learner_group()
+        # Runners seed their own params; they must start from the learner's.
+        self._sync_weights()
+
+    def _make_module(self):
+        vec = self.local_runner.vec
+        obs_dim, n_act = space_dims(vec.single_observation_space,
+                                    vec.single_action_space)
+        return DiscreteActorCritic(obs_dim, n_act, self.config.model_config)
+
+    def _make_learner_group(self) -> LearnerGroup:
+        raise NotImplementedError
+
+    def training_step(self) -> dict:
+        raise NotImplementedError
+
+    def _record_episodes(self, returns):
+        self._episode_returns.extend(returns)
+        self._num_episodes += len(returns)
+
+    def train(self) -> dict:
+        t0 = time.time()
+        metrics = self.training_step()
+        self.iteration += 1
+        rets = list(self._episode_returns)
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": float(np.mean(rets)) if rets else np.nan,
+            "num_episodes": self._num_episodes,
+            "time_this_iter_s": time.time() - t0,
+            **metrics,
+        }
+
+    # -- sampling -----------------------------------------------------------
+    def _sample(self, num_steps: int) -> list[dict]:
+        """One synchronous sampling round across all runners (parity:
+        synchronous_parallel_sample, rllib/execution/rollout_ops.py)."""
+        if not self.remote_runners:
+            batch = self.local_runner.sample(num_steps)
+            self._record_episodes(self.local_runner.episode_returns())
+            return [batch]
+        import ray_tpu
+
+        refs = [r.sample.remote(num_steps) for r in self.remote_runners]
+        batches = ray_tpu.get(refs)
+        for rets in ray_tpu.get(
+                [r.episode_returns.remote() for r in self.remote_runners]):
+            self._record_episodes(rets)
+        return batches
+
+    def _sync_weights(self):
+        weights = self.learner_group.get_weights()
+        self.local_runner.set_state(weights)
+        if self.remote_runners:
+            import ray_tpu
+
+            ray_tpu.get([r.set_state.remote(weights)
+                         for r in self.remote_runners])
+
+    # -- checkpointing (Trainable parity) -----------------------------------
+    def save(self, path: Optional[str] = None) -> Checkpoint:
+        # Full learner state: params AND optimizer moments (plus subclass
+        # extras like the DQN target network) — a params-only snapshot
+        # would silently train wrong after restore.
+        ckpt = Checkpoint.from_state(
+            self.learner_group.learner.get_full_state(), path)
+        ckpt.update_metadata({"iteration": self.iteration,
+                              "algorithm": type(self).__name__})
+        return ckpt
+
+    def restore(self, ckpt: Checkpoint):
+        learner = self.learner_group.learner
+        # Restore against the live state as target so optax's namedtuple
+        # opt_state structure comes back intact (a bare orbax restore
+        # returns plain dicts/lists).
+        state = ckpt.load_state(target=learner.get_full_state())
+        learner.set_full_state(state)
+        self.iteration = ckpt.get_metadata().get("iteration", 0)
+        self._sync_weights()
+
+    def stop(self):
+        import ray_tpu
+
+        self.local_runner.stop()
+        for r in self.remote_runners:
+            try:
+                r.stop.remote()
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+
+
+class PPO(Algorithm):
+    def _make_learner_group(self):
+        learner = PPOLearner(
+            self._make_module(),
+            clip_param=self.config.clip_param,
+            vf_coeff=self.config.vf_coeff,
+            entropy_coeff=self.config.entropy_coeff,
+            lr=self.config.lr,
+            grad_clip=self.config.grad_clip,
+            seed=self.config.seed or 0,
+        )
+        return LearnerGroup(learner)
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        n_runners = max(1, cfg.num_env_runners)
+        per_runner = max(
+            1, cfg.train_batch_size
+            // (n_runners * cfg.num_envs_per_runner))
+        batches = self._sample(per_runner)
+        flat = [flatten_batch(compute_gae(b, cfg.gamma, cfg.lambda_))
+                for b in batches]
+        train_batch = {k: np.concatenate([f[k] for f in flat])
+                       for k in flat[0]}
+        metrics = self.learner_group.update_from_batch(
+            train_batch, minibatch_size=cfg.minibatch_size,
+            num_epochs=cfg.num_epochs,
+            shuffle_key=(cfg.seed or 0) + self.iteration)
+        self._sync_weights()
+        metrics["num_env_steps_sampled"] = len(train_batch["obs"])
+        return metrics
+
+
+class DQN(Algorithm):
+    def _make_learner_group(self):
+        learner = DQNLearner(
+            self._make_module(),
+            gamma=self.config.gamma,
+            target_update_freq=self.config.target_update_freq,
+            lr=self.config.lr,
+            grad_clip=self.config.grad_clip,
+            seed=self.config.seed or 0,
+        )
+        return LearnerGroup(learner)
+
+    def setup(self, config):
+        if config.num_env_runners > 0:
+            raise ValueError(
+                "DQN samples from its local runner only (replay dominates, "
+                "not rollout throughput) — set num_env_runners=0")
+        super().setup(config)
+        self.buffer = ReplayBuffer(config.replay_buffer_capacity,
+                                   seed=config.seed)
+        self._env_steps = 0
+        self._rng = np.random.default_rng(config.seed)
+
+    def _epsilon(self) -> float:
+        start, end, decay = self.config.epsilon
+        frac = min(1.0, self._env_steps / decay)
+        return start + frac * (end - start)
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        runner = self.local_runner
+        module, params = runner.module, runner.params
+        # ε-greedy rollouts into the buffer (DQN is sample-inefficient by
+        # design; rollouts stay local — replay dominates, not sampling).
+        obs = runner._obs
+        for _ in range(cfg.rollout_fragment_length):
+            if self._rng.random() < self._epsilon():
+                action = self._rng.integers(
+                    0, module.n_actions, runner.vec.num_envs)
+            else:
+                action = np.asarray(module.forward_inference(
+                    params, obs.astype(np.float32)))
+            nobs, rew, term, trunc = runner.vec.step(action)
+            done = term | trunc
+            self.buffer.add_batch(
+                obs=obs.astype(np.float32), actions=action, rewards=rew,
+                next_obs=nobs.astype(np.float32), dones=done)
+            runner._episode_returns += rew
+            for i in np.nonzero(done)[0]:
+                self._record_episodes([float(runner._episode_returns[i])])
+                runner._episode_returns[i] = 0.0
+            obs = nobs
+            self._env_steps += runner.vec.num_envs
+        runner._obs = obs
+
+        metrics = {"epsilon": self._epsilon(),
+                   "buffer_size": len(self.buffer)}
+        if self._env_steps >= cfg.learning_starts:
+            for _ in range(cfg.num_epochs):
+                sample = self.buffer.sample(cfg.train_batch_size)
+                metrics.update(
+                    self.learner_group.learner.update_from_batch(sample))
+            runner.set_state(self.learner_group.get_weights())
+        metrics["num_env_steps_sampled"] = self._env_steps
+        return metrics

@@ -1,0 +1,75 @@
+"""Environment plumbing.
+
+Parity target: the reference's EnvRunner env handling
+(/root/reference/rllib/env/single_agent_env_runner.py:31 builds gym.vector
+envs from a registered env id or callable). Env stepping is host/CPU work —
+it stays numpy; only the policy forward/update touch jax.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Union
+
+import numpy as np
+
+
+def make_env(env: Union[str, Callable, Any], env_config: Optional[dict] = None):
+    """env may be a gymnasium id, a zero/one-arg callable, or an env object."""
+    if isinstance(env, str):
+        import gymnasium as gym
+
+        return gym.make(env, **(env_config or {}))
+    if callable(env) and not hasattr(env, "step"):
+        try:
+            return env(env_config or {})
+        except TypeError:
+            return env()
+    return env
+
+
+class SyncVectorEnv:
+    """N independent env copies stepped in lockstep with auto-reset.
+
+    The reference uses gym.vector; this inlines the same semantics (done →
+    reset, terminal obs replaced by reset obs) without depending on the
+    vector API's episode-boundary quirks.
+    """
+
+    def __init__(self, env_fn: Callable[[], Any], num_envs: int,
+                 seed: Optional[int] = None):
+        self.envs = [env_fn() for _ in range(num_envs)]
+        self.num_envs = num_envs
+        self._seed = seed
+
+    def reset(self):
+        obs = []
+        for i, e in enumerate(self.envs):
+            seed = None if self._seed is None else self._seed + i
+            o, _ = e.reset(seed=seed)
+            obs.append(o)
+        return np.stack(obs)
+
+    def step(self, actions):
+        obs, rews, terms, truncs = [], [], [], []
+        for e, a in zip(self.envs, actions):
+            o, r, term, trunc, _ = e.step(a)
+            if term or trunc:
+                o, _ = e.reset()
+            obs.append(o)
+            rews.append(r)
+            terms.append(term)
+            truncs.append(trunc)
+        return (np.stack(obs), np.asarray(rews, np.float32),
+                np.asarray(terms), np.asarray(truncs))
+
+    @property
+    def single_observation_space(self):
+        return self.envs[0].observation_space
+
+    @property
+    def single_action_space(self):
+        return self.envs[0].action_space
+
+    def close(self):
+        for e in self.envs:
+            e.close()

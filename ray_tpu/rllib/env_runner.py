@@ -1,0 +1,122 @@
+"""SingleAgentEnvRunner: CPU rollout collection.
+
+Parity target: /root/reference/rllib/env/single_agent_env_runner.py (:66
+``sample`` over vectorized gym envs). Runs either locally inside the
+Algorithm or as a ray_tpu actor (the reference's remote worker set); policy
+forwards run eagerly on CPU jax — the TPU stays dedicated to the learner.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from .env import SyncVectorEnv, make_env
+from .models import DiscreteActorCritic, ModelConfig, space_dims
+
+
+class SingleAgentEnvRunner:
+    def __init__(self, config: dict):
+        self.config = config
+        env_fn = lambda: make_env(config["env"], config.get("env_config"))
+        self.vec = SyncVectorEnv(env_fn, config.get("num_envs_per_runner", 1),
+                                 seed=config.get("seed"))
+        obs_dim, n_act = space_dims(self.vec.single_observation_space,
+                                    self.vec.single_action_space)
+        self.module = DiscreteActorCritic(
+            obs_dim, n_act, config.get("model_config") or ModelConfig())
+        self.params = self.module.init(
+            jax.random.key(config.get("seed", 0) or 0))
+        self._key = jax.random.key((config.get("seed", 0) or 0) + 1)
+        self._obs = self.vec.reset()
+        self._episode_returns = np.zeros(self.vec.num_envs, np.float32)
+        self._completed: list[float] = []
+        self._explore_fn = jax.jit(self.module.forward_exploration)
+
+    def set_state(self, params):
+        """Weight sync from the learner (reference: sync_weights)."""
+        self.params = params
+        return True
+
+    def get_state(self):
+        return self.params
+
+    def sample(self, num_steps: int) -> dict:
+        """Collect ``num_steps`` vector steps. Returns a flat batch plus the
+        bootstrap values needed for GAE."""
+        n_envs = self.vec.num_envs
+        obs_buf, act_buf, logp_buf, val_buf = [], [], [], []
+        rew_buf, done_buf = [], []
+        for _ in range(num_steps):
+            self._key, k = jax.random.split(self._key)
+            action, logp, value = self._explore_fn(
+                self.params, self._obs.astype(np.float32), k)
+            action = np.asarray(action)
+            obs_buf.append(self._obs.astype(np.float32))
+            act_buf.append(action)
+            logp_buf.append(np.asarray(logp))
+            val_buf.append(np.asarray(value))
+            obs, rew, term, trunc = self.vec.step(action)
+            done = term | trunc
+            rew_buf.append(rew)
+            done_buf.append(done)
+            self._episode_returns += rew
+            for i in np.nonzero(done)[0]:
+                self._completed.append(float(self._episode_returns[i]))
+                self._episode_returns[i] = 0.0
+            self._obs = obs
+        bootstrap = np.asarray(
+            self.module.value(self.params, self._obs.astype(np.float32)))
+        return {
+            "obs": np.stack(obs_buf),        # [T, N, obs_dim]
+            "actions": np.stack(act_buf),    # [T, N]
+            "logp": np.stack(logp_buf),      # [T, N]
+            "values": np.stack(val_buf),     # [T, N]
+            "rewards": np.stack(rew_buf),    # [T, N]
+            "dones": np.stack(done_buf),     # [T, N]
+            "bootstrap_value": bootstrap,    # [N]
+        }
+
+    def episode_returns(self, clear: bool = True) -> list[float]:
+        out = list(self._completed)
+        if clear:
+            self._completed.clear()
+        return out
+
+    def stop(self):
+        self.vec.close()
+        return True
+
+
+def compute_gae(batch: dict, gamma: float, lam: float) -> dict:
+    """Generalized advantage estimation over a [T, N] batch (parity:
+    /root/reference/rllib/evaluation/postprocessing.py compute_advantages).
+    Auto-reset semantics: a done at step t means no bootstrap across t."""
+    rewards, values, dones = (batch["rewards"], batch["values"],
+                              batch["dones"])
+    T, N = rewards.shape
+    adv = np.zeros((T, N), np.float32)
+    last = np.zeros(N, np.float32)
+    next_value = batch["bootstrap_value"]
+    for t in range(T - 1, -1, -1):
+        nonterminal = 1.0 - dones[t].astype(np.float32)
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        last = delta + gamma * lam * nonterminal * last
+        adv[t] = last
+        next_value = values[t]
+    out = dict(batch)
+    out["advantages"] = adv
+    out["value_targets"] = adv + values
+    return out
+
+
+def flatten_batch(batch: dict) -> dict:
+    """[T, N, ...] -> [T*N, ...] for minibatch SGD."""
+    out = {}
+    for k, v in batch.items():
+        if k == "bootstrap_value":
+            continue
+        out[k] = v.reshape((-1,) + v.shape[2:])
+    return out

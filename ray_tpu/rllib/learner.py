@@ -1,0 +1,193 @@
+"""Learner / LearnerGroup: the gradient side of RL training, in jax.
+
+Parity target: /root/reference/rllib/core/learner/learner.py:96
+(compute_gradients:409, apply_gradients:539, update_from_batch:1101) and
+learner_group.py:71. TPU-native: the update step is one jitted function
+(loss + grad + optimizer) and data parallelism is the mesh's data axes via
+sharded batches — no DDP wrapper process group
+(reference torch_learner.py:265 wraps modules in DDP instead).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+class Learner:
+    """Owns module params + optimizer state; subclasses define the loss."""
+
+    def __init__(self, module, *, optimizer: Optional[Any] = None,
+                 lr: float = 3e-4, grad_clip: Optional[float] = 0.5,
+                 seed: int = 0):
+        self.module = module
+        tx = optimizer or optax.adam(lr)
+        if grad_clip is not None:
+            tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
+        self.tx = tx
+        self.params = module.init(jax.random.key(seed))
+        self.opt_state = tx.init(self.params)
+        self._update_fn = jax.jit(self._update)
+
+    # -- subclass API -------------------------------------------------------
+    def loss(self, params, batch: dict) -> tuple[jnp.ndarray, dict]:
+        raise NotImplementedError
+
+    # -- update machinery ---------------------------------------------------
+    def _update(self, params, opt_state, batch):
+        (loss, metrics), grads = jax.value_and_grad(
+            self.loss, has_aux=True)(params, batch)
+        updates, opt_state = self.tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics["total_loss"] = loss
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return params, opt_state, metrics
+
+    def update_from_batch(self, batch: dict) -> dict:
+        # Leaf-wise: batch values may themselves be pytrees (e.g. the DQN
+        # target network params ride along in the batch).
+        batch = jax.tree_util.tree_map(jnp.asarray, batch)
+        self.params, self.opt_state, metrics = self._update_fn(
+            self.params, self.opt_state, batch)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_state(self):
+        return self.params
+
+    def set_state(self, params):
+        self.params = params
+
+    # Full training state for checkpoint/restore (params alone are not
+    # enough: Adam moments — and subclass extras — must survive a resume).
+    def get_full_state(self) -> dict:
+        return {"params": self.params, "opt_state": self.opt_state}
+
+    def set_full_state(self, state: dict):
+        self.params = state["params"]
+        self.opt_state = state["opt_state"]
+
+
+class PPOLearner(Learner):
+    """Clipped-surrogate PPO loss (parity:
+    /root/reference/rllib/algorithms/ppo/torch/ppo_torch_learner.py)."""
+
+    def __init__(self, module, *, clip_param: float = 0.2,
+                 vf_coeff: float = 0.5, entropy_coeff: float = 0.0,
+                 vf_clip: float = 10.0, **kw):
+        self.clip_param = clip_param
+        self.vf_coeff = vf_coeff
+        self.entropy_coeff = entropy_coeff
+        self.vf_clip = vf_clip
+        super().__init__(module, **kw)
+
+    def loss(self, params, batch):
+        logp, entropy, value = self.module.forward_train(
+            params, batch["obs"], batch["actions"])
+        adv = batch["advantages"]
+        adv = (adv - adv.mean()) / jnp.maximum(adv.std(), 1e-6)
+        ratio = jnp.exp(logp - batch["logp"])
+        surr = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - self.clip_param, 1 + self.clip_param) * adv)
+        pi_loss = -surr.mean()
+        vf_err = jnp.clip((value - batch["value_targets"]) ** 2,
+                          0.0, self.vf_clip ** 2)
+        vf_loss = vf_err.mean()
+        ent = entropy.mean()
+        total = (pi_loss + self.vf_coeff * vf_loss
+                 - self.entropy_coeff * ent)
+        return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                       "entropy": ent,
+                       "kl": (batch["logp"] - logp).mean()}
+
+
+class DQNLearner(Learner):
+    """Double-DQN TD loss with a periodically synced target network."""
+
+    def __init__(self, module, *, gamma: float = 0.99,
+                 target_update_freq: int = 100, **kw):
+        self.gamma = gamma
+        self.target_update_freq = target_update_freq
+        super().__init__(module, **kw)
+        self.target_params = jax.tree_util.tree_map(
+            jnp.copy, self.params)
+        self._updates = 0
+
+    def loss(self, params, batch):
+        q = self.module.logits(params, batch["obs"])  # Q-values head
+        q_taken = jnp.take_along_axis(
+            q, batch["actions"][:, None].astype(jnp.int32), axis=1)[:, 0]
+        # Double DQN: online net picks the argmax, target net evaluates it.
+        q_next_online = self.module.logits(params, batch["next_obs"])
+        best = jnp.argmax(q_next_online, axis=-1)
+        q_next_target = self.module.logits(batch["target_params"],
+                                           batch["next_obs"])
+        q_next = jnp.take_along_axis(
+            q_next_target, best[:, None], axis=1)[:, 0]
+        nonterminal = 1.0 - batch["dones"].astype(jnp.float32)
+        target = batch["rewards"] + self.gamma * nonterminal * \
+            jax.lax.stop_gradient(q_next)
+        td = q_taken - target
+        loss = jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2,
+                         jnp.abs(td) - 0.5).mean()  # Huber
+        return loss, {"td_error_mean": jnp.abs(td).mean(),
+                      "q_mean": q_taken.mean()}
+
+    def update_from_batch(self, batch: dict) -> dict:
+        batch = dict(batch)
+        batch["target_params"] = self.target_params
+        metrics = super().update_from_batch(batch)
+        self._updates += 1
+        if self._updates % self.target_update_freq == 0:
+            self.target_params = jax.tree_util.tree_map(
+                jnp.copy, self.params)
+        return metrics
+
+    def get_full_state(self) -> dict:
+        return {**super().get_full_state(),
+                "target_params": self.target_params,
+                "num_updates": self._updates}
+
+    def set_full_state(self, state: dict):
+        super().set_full_state(state)
+        self.target_params = state["target_params"]
+        self._updates = state.get("num_updates", 0)
+
+
+class LearnerGroup:
+    """Round-1 shape: one local learner (the TPU host); scale-out across a
+    mesh happens inside the jitted update via sharded batches. The remote
+    multi-learner actor pool follows the JaxTrainer gang pattern (parity:
+    /root/reference/rllib/core/learner/learner_group.py:71)."""
+
+    def __init__(self, learner: Learner):
+        self.learner = learner
+
+    def update_from_batch(self, batch: dict, *, minibatch_size: int = 0,
+                          num_epochs: int = 1, shuffle_key=None) -> dict:
+        n = len(next(iter(batch.values())))
+        if not minibatch_size or minibatch_size >= n:
+            metrics = {}
+            for _ in range(num_epochs):
+                metrics = self.learner.update_from_batch(batch)
+            return metrics
+        rng = np.random.default_rng(
+            None if shuffle_key is None else shuffle_key)
+        metrics = {}
+        for _ in range(num_epochs):
+            order = rng.permutation(n)
+            for lo in range(0, n - minibatch_size + 1, minibatch_size):
+                idx = order[lo:lo + minibatch_size]
+                mb = {k: v[idx] for k, v in batch.items()}
+                metrics = self.learner.update_from_batch(mb)
+        return metrics
+
+    def get_weights(self):
+        return self.learner.get_state()
+
+    def set_weights(self, params):
+        self.learner.set_state(params)

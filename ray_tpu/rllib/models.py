@@ -1,0 +1,106 @@
+"""RLModule: the policy/value network abstraction, in jax.
+
+Parity target: /root/reference/rllib/core/rl_module/rl_module.py (the new
+API stack's module with forward_inference / forward_exploration /
+forward_train) — here a functional jax module: params are a pytree, forward
+passes are pure functions, so the same apply runs under jit on the learner
+and eagerly (CPU) in env runners.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    hidden: tuple = (64, 64)
+    activation: str = "tanh"
+
+
+def _act(name):
+    return {"tanh": jnp.tanh, "relu": jax.nn.relu,
+            "gelu": jax.nn.gelu}[name]
+
+
+def _mlp_init(key, sizes, scale_last=0.01):
+    params = []
+    for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, k = jax.random.split(key)
+        scale = (scale_last if i == len(sizes) - 2 else 1.0) * (
+            2.0 / (fan_in + fan_out)) ** 0.5
+        params.append({
+            "w": jax.random.normal(k, (fan_in, fan_out)) * scale,
+            "b": jnp.zeros((fan_out,)),
+        })
+    return params
+
+
+def _mlp_apply(params, x, activation, final_act=False):
+    h = x
+    for i, layer in enumerate(params):
+        h = h @ layer["w"] + layer["b"]
+        if i < len(params) - 1 or final_act:
+            h = activation(h)
+    return h
+
+
+class DiscreteActorCritic:
+    """Separate policy/value MLPs over a flat observation, categorical
+    action distribution (the reference's default fcnet for discrete
+    spaces)."""
+
+    def __init__(self, obs_dim: int, n_actions: int,
+                 config: Optional[ModelConfig] = None):
+        self.obs_dim = obs_dim
+        self.n_actions = n_actions
+        self.config = config or ModelConfig()
+
+    def init(self, key) -> dict:
+        kp, kv = jax.random.split(key)
+        h = self.config.hidden
+        return {
+            "pi": _mlp_init(kp, (self.obs_dim, *h, self.n_actions)),
+            "vf": _mlp_init(kv, (self.obs_dim, *h, 1), scale_last=1.0),
+        }
+
+    def logits(self, params, obs):
+        obs = obs.reshape(obs.shape[0], -1)  # flatten multi-dim Box obs
+        return _mlp_apply(params["pi"], obs, _act(self.config.activation))
+
+    def value(self, params, obs):
+        obs = obs.reshape(obs.shape[0], -1)
+        return _mlp_apply(params["vf"], obs,
+                          _act(self.config.activation))[..., 0]
+
+    # -- RLModule-style forwards -------------------------------------------
+    def forward_inference(self, params, obs):
+        return jnp.argmax(self.logits(params, obs), axis=-1)
+
+    def forward_exploration(self, params, obs, key):
+        logits = self.logits(params, obs)
+        action = jax.random.categorical(key, logits)
+        logp = jax.nn.log_softmax(logits)[
+            jnp.arange(logits.shape[0]), action]
+        return action, logp, self.value(params, obs)
+
+    def forward_train(self, params, obs, actions):
+        logits = self.logits(params, obs)
+        logp_all = jax.nn.log_softmax(logits)
+        logp = jnp.take_along_axis(
+            logp_all, actions[:, None].astype(jnp.int32), axis=1)[:, 0]
+        entropy = -(jnp.exp(logp_all) * logp_all).sum(-1)
+        return logp, entropy, self.value(params, obs)
+
+
+def space_dims(obs_space, act_space) -> tuple[int, int]:
+    obs_dim = int(np.prod(obs_space.shape))
+    if hasattr(act_space, "n"):
+        return obs_dim, int(act_space.n)
+    raise NotImplementedError(
+        f"only discrete action spaces in round 1, got {act_space}")

@@ -1,0 +1,76 @@
+"""Replay buffers (parity:
+/root/reference/rllib/utils/replay_buffers/replay_buffer.py and
+prioritized_episode_buffer — uniform + proportional-priority sampling over
+flat transition storage)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class ReplayBuffer:
+    """Uniform ring-buffer over transitions stored as column arrays."""
+
+    def __init__(self, capacity: int, seed: Optional[int] = None):
+        self.capacity = capacity
+        self._cols: dict[str, np.ndarray] = {}
+        self._size = 0
+        self._next = 0
+        self.rng = np.random.default_rng(seed)
+
+    def __len__(self):
+        return self._size
+
+    def add(self, **transition):
+        if not self._cols:
+            for k, v in transition.items():
+                v = np.asarray(v)
+                self._cols[k] = np.zeros((self.capacity,) + v.shape, v.dtype)
+        i = self._next
+        for k, v in transition.items():
+            self._cols[k][i] = v
+        self._next = (self._next + 1) % self.capacity
+        self._size = min(self._size + 1, self.capacity)
+
+    def add_batch(self, **columns):
+        n = len(next(iter(columns.values())))
+        for j in range(n):
+            self.add(**{k: v[j] for k, v in columns.items()})
+
+    def sample(self, batch_size: int) -> dict:
+        idx = self.rng.integers(0, self._size, batch_size)
+        return {k: v[idx] for k, v in self._cols.items()}
+
+
+class PrioritizedReplayBuffer(ReplayBuffer):
+    """Proportional prioritization with importance weights."""
+
+    def __init__(self, capacity: int, *, alpha: float = 0.6,
+                 beta: float = 0.4, seed: Optional[int] = None):
+        super().__init__(capacity, seed)
+        self.alpha = alpha
+        self.beta = beta
+        self._prio = np.zeros(capacity, np.float64)
+        self._max_prio = 1.0
+
+    def add(self, **transition):
+        self._prio[self._next] = self._max_prio
+        super().add(**transition)
+
+    def sample(self, batch_size: int) -> dict:
+        p = self._prio[: self._size] ** self.alpha
+        p = p / p.sum()
+        idx = self.rng.choice(self._size, batch_size, p=p)
+        weights = (self._size * p[idx]) ** (-self.beta)
+        weights = weights / weights.max()
+        out = {k: v[idx] for k, v in self._cols.items()}
+        out["weights"] = weights.astype(np.float32)
+        out["batch_indexes"] = idx
+        return out
+
+    def update_priorities(self, idx, priorities):
+        priorities = np.abs(np.asarray(priorities)) + 1e-6
+        self._prio[idx] = priorities
+        self._max_prio = max(self._max_prio, priorities.max())
