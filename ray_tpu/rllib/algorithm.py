@@ -297,29 +297,21 @@ class DQN(Algorithm):
     def training_step(self) -> dict:
         cfg = self.config
         runner = self.local_runner
-        module, params = runner.module, runner.params
+        module = runner.module
+
         # ε-greedy rollouts into the buffer (DQN is sample-inefficient by
         # design; rollouts stay local — replay dominates, not sampling).
-        obs = runner._obs
-        for _ in range(cfg.rollout_fragment_length):
+        def epsilon_greedy(obs):
             if self._rng.random() < self._epsilon():
-                action = self._rng.integers(
-                    0, module.n_actions, runner.vec.num_envs)
-            else:
-                action = np.asarray(module.forward_inference(
-                    params, obs.astype(np.float32)))
-            nobs, rew, term, trunc = runner.vec.step(action)
-            done = term | trunc
-            self.buffer.add_batch(
-                obs=obs.astype(np.float32), actions=action, rewards=rew,
-                next_obs=nobs.astype(np.float32), dones=done)
-            runner._episode_returns += rew
-            for i in np.nonzero(done)[0]:
-                self._record_episodes([float(runner._episode_returns[i])])
-                runner._episode_returns[i] = 0.0
-            obs = nobs
-            self._env_steps += runner.vec.num_envs
-        runner._obs = obs
+                return self._rng.integers(
+                    0, module.n_actions, len(obs))
+            return module.forward_inference(runner.params, obs)
+
+        transitions = runner.rollout_transitions(
+            cfg.rollout_fragment_length, epsilon_greedy)
+        self.buffer.add_batch(**transitions)
+        self._env_steps += len(transitions["obs"])
+        self._record_episodes(runner.episode_returns())
 
         metrics = {"epsilon": self._epsilon(),
                    "buffer_size": len(self.buffer)}

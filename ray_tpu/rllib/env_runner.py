@@ -79,6 +79,31 @@ class SingleAgentEnvRunner:
             "bootstrap_value": bootstrap,    # [N]
         }
 
+    def rollout_transitions(self, num_steps: int, action_fn) -> dict:
+        """Collect flat (obs, action, reward, next_obs, done) transitions
+        with a caller-supplied action function (e.g. ε-greedy for DQN) —
+        one rollout implementation for every value-based algorithm."""
+        obs_b, act_b, rew_b, next_b, done_b = [], [], [], [], []
+        for _ in range(num_steps):
+            obs = self._obs.astype(np.float32)
+            action = np.asarray(action_fn(obs))
+            nobs, rew, term, trunc = self.vec.step(action)
+            done = term | trunc
+            obs_b.append(obs)
+            act_b.append(action)
+            rew_b.append(rew)
+            next_b.append(nobs.astype(np.float32))
+            done_b.append(done)
+            self._episode_returns += rew
+            for i in np.nonzero(done)[0]:
+                self._completed.append(float(self._episode_returns[i]))
+                self._episode_returns[i] = 0.0
+            self._obs = nobs
+        cat = lambda xs: np.concatenate(xs, axis=0)
+        return {"obs": cat(obs_b), "actions": cat(act_b),
+                "rewards": cat(rew_b), "next_obs": cat(next_b),
+                "dones": cat(done_b)}
+
     def episode_returns(self, clear: bool = True) -> list[float]:
         out = list(self._completed)
         if clear:

@@ -1,0 +1,29 @@
+"""ray_tpu.serve — model serving.
+
+Capability parity target: Ray Serve (/root/reference/python/ray/serve/):
+@deployment replicas behind power-of-two-choices routing, dynamic request
+batching, model multiplexing, request-load autoscaling, deployment-graph
+composition, HTTP ingress. TPU-native note: a deployment whose replicas
+need chips uses ray_actor_options={"scheduling_strategy": "device"} so the
+replica shares the in-process device lane (batched inference compiles once
+and stays resident in HBM).
+"""
+
+from .api import (  # noqa: F401
+    get_app_handle,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from .batching import batch  # noqa: F401
+from .deployment import (  # noqa: F401
+    Application,
+    AutoscalingConfig,
+    Deployment,
+    DeploymentHandle,
+    DeploymentResponse,
+    deployment,
+)
+from .multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401

@@ -1,0 +1,79 @@
+"""serve.* public API (parity: /root/reference/python/ray/serve/api.py:
+serve.run, serve.start, serve.shutdown, serve.get_app_handle,
+serve.get_deployment_handle, serve.status)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .controller import ServeController
+from .deployment import Application, DeploymentHandle
+from .http_proxy import HTTPProxy
+
+_controller: Optional[ServeController] = None
+_proxy: Optional[HTTPProxy] = None
+
+
+def _get_controller(create: bool = True) -> ServeController:
+    global _controller
+    if _controller is None and create:
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        _controller = ServeController()
+    if _controller is None:
+        raise RuntimeError("serve is not running (call serve.run first)")
+    return _controller
+
+
+# Route prefixes by app name, kept even when no proxy exists yet so a
+# later serve.start() serves already-running apps (reference behavior).
+_routes: dict[str, str] = {}
+
+
+def start(*, http_host: str = "127.0.0.1", http_port: int = 8000,
+          detached: bool = True):
+    """Start the HTTP proxy (handles work without it)."""
+    global _proxy
+    controller = _get_controller()
+    if _proxy is None:
+        _proxy = HTTPProxy(controller, http_host, http_port)
+        for app_name, prefix in _routes.items():
+            _proxy.add_route(prefix, app_name)
+    return _proxy
+
+
+def run(app: Application, *, name: str = "default",
+        route_prefix: Optional[str] = "/") -> DeploymentHandle:
+    controller = _get_controller()
+    handle = controller.deploy_application(app, name)
+    if route_prefix is not None:
+        _routes[name] = route_prefix
+        if _proxy is not None:
+            _proxy.add_route(route_prefix, name)
+    return handle
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    return _get_controller(create=False).get_app_handle(name)
+
+
+def get_deployment_handle(deployment_name: str, app_name: str = "default"
+                          ) -> DeploymentHandle:
+    return _get_controller(create=False).get_handle(deployment_name)
+
+
+def status() -> dict:
+    return _get_controller(create=False).status()
+
+
+def shutdown():
+    global _controller, _proxy
+    _routes.clear()
+    if _proxy is not None:
+        _proxy.shutdown()
+        _proxy = None
+    if _controller is not None:
+        _controller.shutdown()
+        _controller = None

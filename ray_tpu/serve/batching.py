@@ -1,0 +1,111 @@
+"""Dynamic request batching (parity:
+/root/reference/python/ray/serve/batching.py @serve.batch).
+
+Thread-based: replicas execute requests on a thread pool
+(max_concurrency), so callers block on an Event while a collector thread
+fires the batch when it is full or the wait timeout lapses. The decorated
+method must accept a LIST of inputs and return a list of outputs of equal
+length.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional
+
+
+class _Pending:
+    __slots__ = ("item", "event", "result", "error")
+
+    def __init__(self, item):
+        self.item = item
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+
+class _Batcher:
+    def __init__(self, fn: Callable[[Any, List], List], max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self.fn = fn
+        self.max_batch_size = max_batch_size
+        self.timeout = batch_wait_timeout_s
+        self._lock = threading.Lock()
+        self._queue: list[_Pending] = []
+        self._flush = threading.Condition(self._lock)
+        self._collector: Optional[threading.Thread] = None
+
+    def submit(self, owner, item):
+        p = _Pending(item)
+        with self._lock:
+            self._queue.append(p)
+            if len(self._queue) >= self.max_batch_size:
+                self._flush.notify()
+            # The collector clears self._collector under this same lock
+            # right before exiting, so this check cannot see a collector
+            # that will never serve us (no is_alive() race).
+            if self._collector is None:
+                self._collector = threading.Thread(
+                    target=self._collect_loop, args=(owner,), daemon=True)
+                self._collector.start()
+        p.event.wait()
+        if p.error is not None:
+            raise p.error
+        return p.result
+
+    def _collect_loop(self, owner):
+        while True:
+            with self._lock:
+                if not self._queue:
+                    self._collector = None  # hand off restart duty
+                    return
+                if len(self._queue) < self.max_batch_size:
+                    self._flush.wait(self.timeout)
+                batch, self._queue = (
+                    self._queue[: self.max_batch_size],
+                    self._queue[self.max_batch_size:],
+                )
+            try:
+                results = self.fn(owner, [p.item for p in batch])
+                if len(results) != len(batch):
+                    raise ValueError(
+                        f"@serve.batch function returned {len(results)} "
+                        f"results for a batch of {len(batch)}")
+                for p, r in zip(batch, results):
+                    p.result = r
+            except BaseException as e:  # noqa: BLE001 - delivered to callers
+                for p in batch:
+                    p.error = e
+            finally:
+                for p in batch:
+                    p.event.set()
+
+
+def batch(_func=None, *, max_batch_size: int = 10,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator: turn ``def method(self, items: list) -> list`` into a
+    per-call API that transparently batches concurrent callers.
+
+    The batcher (queue + locks) is created lazily per instance, inside the
+    replica process — decoration must leave the class picklable so it can
+    ship to replica actors (no lock objects may leak into the closure;
+    dict.setdefault makes the lazy creation race-safe under the GIL).
+    """
+
+    def deco(fn):
+        attr = f"_serve_batcher_{fn.__name__}"
+
+        def wrapped(self, item):
+            b = self.__dict__.get(attr)
+            if b is None:
+                b = self.__dict__.setdefault(
+                    attr, _Batcher(fn, max_batch_size,
+                                   batch_wait_timeout_s))
+            return b.submit(self, item)
+
+        wrapped.__name__ = fn.__name__
+        return wrapped
+
+    if _func is not None:
+        return deco(_func)
+    return deco

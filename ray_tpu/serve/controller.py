@@ -1,0 +1,214 @@
+"""ServeController: deployment lifecycle + request-rate autoscaling.
+
+Parity target: /root/reference/python/ray/serve/_private/controller.py:89
+(run_control_loop reconciling DeploymentState, application_state.py,
+deployment_state.py) and autoscaling_policy.py. Differences: the controller
+runs in the driver process with a background reconcile thread rather than
+as a detached actor — the capability (declarative target state, replica
+actors reconciled to it, scaling on observed ongoing-request load) is the
+same shape.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from .deployment import (Application, AutoscalingConfig, Deployment,
+                         DeploymentHandle, Router)
+from .replica import Replica
+
+
+@dataclass
+class DeploymentState:
+    deployment: Deployment
+    target_replicas: int
+    replicas: list = field(default_factory=list)  # ActorHandles
+    router: Router = field(default_factory=Router)
+    # Seeded with now so delays apply from deploy time (0.0 against
+    # monotonic() would make the first scale decision bypass its delay).
+    last_scale_up: float = field(default_factory=time.monotonic)
+    last_scale_down: float = field(default_factory=time.monotonic)
+
+
+def _drain_and_kill(victims, drain_timeout_s: float = 30.0):
+    import ray_tpu
+
+    deadline = time.monotonic() + drain_timeout_s
+    pending = list(victims)
+    while pending and time.monotonic() < deadline:
+        still = []
+        for v in pending:
+            try:
+                if ray_tpu.get(v.stats.remote(), timeout=5)["ongoing"] > 0:
+                    still.append(v)
+            except Exception:
+                pass  # dead already — nothing to drain
+        pending = still
+        if pending:
+            time.sleep(0.2)
+    for v in victims:
+        try:
+            ray_tpu.kill(v)
+        except Exception:
+            pass
+
+
+class ServeController:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._deployments: dict[str, DeploymentState] = {}
+        self._apps: dict[str, str] = {}  # app name -> ingress deployment
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- deploy -------------------------------------------------------------
+    def deploy_application(self, app: Application, name: str
+                           ) -> DeploymentHandle:
+        """Deploy the app's deployment graph (children bound as init args
+        deploy first, parents get handles to them)."""
+        with self._lock:
+            handle = self._deploy_node(app)
+            self._apps[name] = app.deployment.name
+            self._ensure_loop()
+            return handle
+
+    def _deploy_node(self, app: Application) -> DeploymentHandle:
+        d = app.deployment
+        init_args = tuple(
+            self._deploy_node(a) if isinstance(a, Application) else a
+            for a in d.init_args)
+        init_kwargs = {
+            k: (self._deploy_node(v) if isinstance(v, Application) else v)
+            for k, v in d.init_kwargs.items()}
+        d = Deployment(**{**d.__dict__, "init_args": init_args,
+                          "init_kwargs": init_kwargs})
+        target = (d.autoscaling_config.min_replicas
+                  if d.autoscaling_config else d.num_replicas)
+        state = self._deployments.get(d.name)
+        if state is None:
+            state = DeploymentState(deployment=d, target_replicas=target)
+            self._deployments[d.name] = state
+        else:
+            state.deployment = d
+            state.target_replicas = target
+            if d.user_config is not None:
+                import ray_tpu
+
+                ray_tpu.get([r.reconfigure.remote(d.user_config)
+                             for r in state.replicas])
+        self._reconcile_one(state)
+        return DeploymentHandle(d.name, state.router)
+
+    # -- reconcile ----------------------------------------------------------
+    def _reconcile_one(self, state: DeploymentState):
+        import ray_tpu
+
+        d = state.deployment
+        while len(state.replicas) < state.target_replicas:
+            opts = dict(d.ray_actor_options)
+            opts.setdefault("max_concurrency", max(4, min(
+                32, d.max_ongoing_requests)))
+            actor = ray_tpu.remote(Replica).options(**opts).remote(
+                d.func_or_class, d.init_args, d.init_kwargs, d.user_config)
+            state.replicas.append(actor)
+        victims = []
+        while len(state.replicas) > state.target_replicas:
+            victims.append(state.replicas.pop())
+        # Routing switches away first; victims drain in-flight work in the
+        # background before the kill (reference: graceful replica stop).
+        state.router.update_replicas(state.replicas)
+        if victims:
+            threading.Thread(target=_drain_and_kill, args=(victims,),
+                             daemon=True).start()
+
+    def _ensure_loop(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._control_loop, daemon=True, name="serve-ctrl")
+            self._thread.start()
+
+    def _control_loop(self):
+        """Reference run_control_loop: reconcile + autoscale forever."""
+        import ray_tpu
+
+        while not self._stop.wait(0.25):
+            # Snapshot under the lock; the blocking stats gather runs
+            # outside it so deploy/status/get_handle never stall on a slow
+            # replica.
+            with self._lock:
+                targets = [
+                    (s, s.deployment.autoscaling_config, list(s.replicas))
+                    for s in self._deployments.values()
+                    if s.deployment.autoscaling_config is not None]
+            for state, cfg, replicas in targets:
+                try:
+                    stats = ray_tpu.get(
+                        [r.stats.remote() for r in replicas], timeout=5)
+                except Exception:
+                    continue
+                with self._lock:
+                    if self._deployments.get(
+                            state.deployment.name) is state:
+                        self._autoscale(state, cfg, stats)
+
+    def _autoscale(self, state: DeploymentState, cfg: AutoscalingConfig,
+                   stats: list[dict]):
+        now = time.monotonic()
+        ongoing = sum(s["ongoing"] for s in stats)
+        desired = max(cfg.min_replicas, min(
+            cfg.max_replicas,
+            round(ongoing / max(cfg.target_ongoing_requests, 1e-6)) or
+            cfg.min_replicas))
+        if desired > state.target_replicas and \
+                now - state.last_scale_up >= cfg.upscale_delay_s:
+            state.target_replicas = desired
+            state.last_scale_up = now
+            self._reconcile_one(state)
+        elif desired < state.target_replicas and \
+                now - state.last_scale_down >= cfg.downscale_delay_s:
+            state.target_replicas = desired
+            state.last_scale_down = now
+            self._reconcile_one(state)
+
+    # -- queries ------------------------------------------------------------
+    def get_handle(self, deployment_name: str) -> DeploymentHandle:
+        with self._lock:
+            state = self._deployments[deployment_name]
+            return DeploymentHandle(deployment_name, state.router)
+
+    def get_app_handle(self, app_name: str) -> DeploymentHandle:
+        with self._lock:
+            return self.get_handle(self._apps[app_name])
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                name: {"target_replicas": s.target_replicas,
+                       "num_replicas": len(s.replicas)}
+                for name, s in self._deployments.items()
+            }
+
+    def num_replicas(self, name: str) -> int:
+        with self._lock:
+            return len(self._deployments[name].replicas)
+
+    # -- teardown -----------------------------------------------------------
+    def shutdown(self):
+        import ray_tpu
+
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        with self._lock:
+            for state in self._deployments.values():
+                for r in state.replicas:
+                    try:
+                        ray_tpu.kill(r)
+                    except Exception:
+                        pass
+            self._deployments.clear()
+            self._apps.clear()

@@ -1,0 +1,237 @@
+"""Deployments, handles, and the request router.
+
+Parity targets:
+  * @serve.deployment / Deployment.bind / options —
+    /root/reference/python/ray/serve/deployment.py
+  * DeploymentHandle / DeploymentResponse —
+    /root/reference/python/ray/serve/handle.py
+  * power-of-two-choices routing —
+    /root/reference/python/ray/serve/_private/router.py:295
+    (PowerOfTwoChoicesReplicaScheduler): pick 2 random replicas, send to
+    the one with fewer in-flight requests. Queue lengths are tracked
+    client-side per handle, as the reference's handle-local tracker does.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Optional
+
+DEFAULT_MAX_ONGOING = 100
+
+
+@dataclass(frozen=True)
+class AutoscalingConfig:
+    """Request-rate autoscaling (parity:
+    /root/reference/python/ray/serve/config.py AutoscalingConfig +
+    autoscaling_policy.py): replicas sized so each sees
+    ~target_ongoing_requests concurrent requests."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 2.0
+
+
+@dataclass
+class Deployment:
+    func_or_class: Any
+    name: str
+    num_replicas: int = 1
+    max_ongoing_requests: int = DEFAULT_MAX_ONGOING
+    user_config: Optional[dict] = None
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    ray_actor_options: dict = field(default_factory=dict)
+    init_args: tuple = ()
+    init_kwargs: dict = field(default_factory=dict)
+
+    def options(self, **overrides) -> "Deployment":
+        if "autoscaling_config" in overrides and isinstance(
+                overrides["autoscaling_config"], dict):
+            overrides["autoscaling_config"] = AutoscalingConfig(
+                **overrides["autoscaling_config"])
+        return replace(self, **overrides)
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(replace(self, init_args=args,
+                                   init_kwargs=kwargs))
+
+
+@dataclass
+class Application:
+    """A deployment bound to its init args; args may themselves be
+    Applications (model composition — the bound child resolves to a
+    DeploymentHandle inside the parent's constructor)."""
+
+    deployment: Deployment
+
+    @property
+    def name(self) -> str:
+        return self.deployment.name
+
+
+def deployment(_func_or_class=None, *, name: Optional[str] = None,
+               num_replicas: int = 1,
+               max_ongoing_requests: int = DEFAULT_MAX_ONGOING,
+               user_config: Optional[dict] = None,
+               autoscaling_config=None,
+               ray_actor_options: Optional[dict] = None):
+    """@serve.deployment decorator."""
+    if isinstance(autoscaling_config, dict):
+        autoscaling_config = AutoscalingConfig(**autoscaling_config)
+
+    def deco(target):
+        return Deployment(
+            func_or_class=target,
+            name=name or getattr(target, "__name__", "deployment"),
+            num_replicas=num_replicas,
+            max_ongoing_requests=max_ongoing_requests,
+            user_config=user_config,
+            autoscaling_config=autoscaling_config,
+            ray_actor_options=dict(ray_actor_options or {}),
+        )
+
+    if _func_or_class is not None:
+        return deco(_func_or_class)
+    return deco
+
+
+class DeploymentResponse:
+    """Future-like response (reference handle.py DeploymentResponse)."""
+
+    def __init__(self, ref, router: "Router", replica_idx: int):
+        self._ref = ref
+        self._router = router
+        self._replica_idx = replica_idx
+        self._done = False
+
+    def result(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout)
+        finally:
+            self._settle()
+
+    def _to_object_ref(self):
+        self._settle()  # ref handed off; router stops tracking it
+        return self._ref
+
+    def _settle(self):
+        if not self._done:
+            self._done = True
+            self._router.request_done(self._replica_idx)
+
+    def __del__(self):
+        # Fire-and-forget callers drop responses without result(); the
+        # router's in-flight count must not leak or p2c routing skews
+        # toward replicas that never served an unsettled request.
+        try:
+            self._settle()
+        except Exception:
+            pass
+
+
+class Router:
+    """Client-side power-of-two-choices over the replica set."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._replicas: list = []
+        self._inflight: list[int] = []
+        self._model_affinity: dict[str, set[int]] = {}
+        self._rng = random.Random()
+
+    def update_replicas(self, replicas: list):
+        with self._lock:
+            self._replicas = list(replicas)
+            self._inflight = [0] * len(self._replicas)
+            self._model_affinity.clear()
+
+    def pick(self, multiplexed_model_id: str = "") -> int:
+        with self._lock:
+            n = len(self._replicas)
+            if n == 0:
+                raise RuntimeError("no replicas available")
+            if n == 1:
+                self._inflight[0] += 1
+                return 0
+            # Multiplexing: prefer a replica that already has the model hot.
+            if multiplexed_model_id:
+                hot = [i for i in
+                       self._model_affinity.get(multiplexed_model_id, ())
+                       if i < n]
+                if hot:
+                    i = min(hot, key=lambda j: self._inflight[j])
+                    self._inflight[i] += 1
+                    return i
+            a, b = self._rng.sample(range(n), 2)
+            i = a if self._inflight[a] <= self._inflight[b] else b
+            self._inflight[i] += 1
+            if multiplexed_model_id:
+                self._model_affinity.setdefault(
+                    multiplexed_model_id, set()).add(i)
+            return i
+
+    def replica(self, idx: int):
+        with self._lock:
+            return self._replicas[idx]
+
+    def request_done(self, idx: int):
+        with self._lock:
+            if idx < len(self._inflight):
+                self._inflight[idx] = max(0, self._inflight[idx] - 1)
+
+
+class DeploymentHandle:
+    """Callable handle to a running deployment (reference handle.py).
+
+    Driver-side handles share the controller's per-deployment router (so
+    autoscaling updates propagate); handles pickled into replica processes
+    (model composition) rebuild a private router over the replica set as it
+    was at serialization time.
+    """
+
+    def __init__(self, deployment_name: str, router: Router,
+                 method_name: str = "__call__",
+                 multiplexed_model_id: str = ""):
+        self._name = deployment_name
+        self._router = router
+        self._method = method_name
+        self._model_id = multiplexed_model_id
+
+    def options(self, *, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self._name, self._router,
+            method_name if method_name is not None else self._method,
+            (multiplexed_model_id if multiplexed_model_id is not None
+             else self._model_id))
+
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        idx = self._router.pick(self._model_id)
+        actor = self._router.replica(idx)
+        ref = actor.handle_request.remote(
+            self._method, args, kwargs, self._model_id)
+        return DeploymentResponse(ref, self._router, idx)
+
+    def __reduce__(self):
+        with self._router._lock:
+            replicas = list(self._router._replicas)
+        return (_rebuild_handle,
+                (self._name, self._method, self._model_id, replicas))
+
+
+def _rebuild_handle(name, method, model_id, replicas):
+    router = Router()
+    router.update_replicas(replicas)
+    return DeploymentHandle(name, router, method, model_id)

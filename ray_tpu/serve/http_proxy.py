@@ -1,0 +1,81 @@
+"""HTTP ingress (parity:
+/root/reference/python/ray/serve/_private/proxy.py — uvicorn HTTPProxy per
+node routing to apps by route prefix). Stdlib ThreadingHTTPServer: each
+request resolves its route prefix to an app handle, forwards the JSON body
+(or raw text), and returns the JSON-encoded result.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class HTTPProxy:
+    def __init__(self, controller, host: str = "127.0.0.1", port: int = 8000):
+        self.controller = controller
+        self.routes: dict[str, str] = {}  # prefix -> app name
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _dispatch(self, body):
+                app = proxy.resolve(self.path)
+                if app is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    self.wfile.write(b'{"error": "no route"}')
+                    return
+                try:
+                    handle = proxy.controller.get_app_handle(app)
+                    result = handle.remote(body).result(timeout=60)
+                    payload = json.dumps(result).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.end_headers()
+                    self.wfile.write(payload)
+                except Exception as e:  # noqa: BLE001 - surfaced as 500
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(
+                        json.dumps({"error": str(e)}).encode())
+
+            def do_GET(self):
+                self._dispatch(None)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n) if n else b""
+                try:
+                    body = json.loads(raw) if raw else None
+                except json.JSONDecodeError:
+                    body = raw.decode()
+                self._dispatch(body)
+
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.server.server_port
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True, name="serve-http")
+        self._thread.start()
+
+    def add_route(self, prefix: str, app_name: str):
+        self.routes[prefix.rstrip("/") or "/"] = app_name
+
+    def resolve(self, path: str) -> Optional[str]:
+        path = path.split("?")[0].rstrip("/") or "/"
+        best = None
+        for prefix, app in self.routes.items():
+            if path == prefix or path.startswith(
+                    prefix if prefix.endswith("/") else prefix + "/") or \
+                    prefix == "/":
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, app)
+        return best[1] if best else None
+
+    def shutdown(self):
+        self.server.shutdown()
+        self.server.server_close()

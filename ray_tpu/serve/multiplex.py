@@ -1,0 +1,80 @@
+"""Model multiplexing (parity:
+/root/reference/python/ray/serve/multiplex.py @serve.multiplexed +
+get_multiplexed_model_id): one replica hosts many models behind an LRU;
+the handle routes a request to a replica that already has the model hot.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, Optional
+
+_tls = threading.local()
+
+
+def _set_request_model_id(model_id: Optional[str]):
+    _tls.model_id = model_id
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a request: the model id the caller asked for
+    (handle.options(multiplexed_model_id=...))."""
+    return getattr(_tls, "model_id", None) or ""
+
+
+def multiplexed(_func=None, *, max_num_models_per_replica: int = 3):
+    """Decorator for a model-loader method: ``def load(self, model_id)``.
+    Calls are LRU-cached per replica; the oldest model is evicted (and its
+    ``__del__``/``unload`` hook runs) when the cache is full."""
+
+    def deco(load_fn: Callable):
+        # Cache + lock are created lazily per instance (inside the replica
+        # process) so decoration leaves the class picklable.
+        attr = f"_serve_mux_{load_fn.__name__}"
+
+        def state(self):
+            s = self.__dict__.get(attr)
+            if s is None:
+                s = self.__dict__.setdefault(
+                    attr, (threading.Lock(), OrderedDict(), {}))
+            return s
+
+        def wrapped(self, model_id: Optional[str] = None):
+            lock, cache, loading = state(self)
+            mid = model_id if model_id is not None else \
+                get_multiplexed_model_id()
+            while True:
+                with lock:
+                    if mid in cache:
+                        cache.move_to_end(mid)
+                        return cache[mid]
+                    ev = loading.get(mid)
+                    if ev is None:
+                        # This thread loads; racers wait (single-flight —
+                        # a double load would leak the losing copy without
+                        # its unload() hook ever firing).
+                        loading[mid] = threading.Event()
+                        break
+                ev.wait()
+            try:
+                model = load_fn(self, mid)
+                with lock:
+                    cache[mid] = model
+                    cache.move_to_end(mid)
+                    while len(cache) > max_num_models_per_replica:
+                        _, evicted = cache.popitem(last=False)
+                        unload = getattr(evicted, "unload", None)
+                        if callable(unload):
+                            unload()
+                return model
+            finally:
+                with lock:
+                    loading.pop(mid).set()
+
+        wrapped.__name__ = load_fn.__name__
+        return wrapped
+
+    if _func is not None:
+        return deco(_func)
+    return deco

@@ -1,0 +1,73 @@
+"""Replica actor: hosts one copy of a deployment's user class.
+
+Parity target: /root/reference/python/ray/serve/_private/replica.py — the
+replica wraps the user callable, tracks ongoing/total request counts for
+autoscaling, applies user_config reconfiguration, and answers health
+checks. Batching/multiplexing live in decorators on the user class
+(serve/batching.py, serve/multiplex.py) and work unchanged here because
+replicas run methods on a thread pool (max_concurrency), not an event loop.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+from .multiplex import _set_request_model_id
+
+
+class Replica:
+    def __init__(self, cls_or_fn, init_args, init_kwargs,
+                 user_config: Optional[dict] = None):
+        self._lock = threading.Lock()
+        self._ongoing = 0
+        self._total = 0
+        self._window: list[float] = []  # request-arrival timestamps
+        if isinstance(cls_or_fn, type):
+            self.instance = cls_or_fn(*init_args, **init_kwargs)
+        else:
+            self.instance = cls_or_fn  # plain function deployment
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    def reconfigure(self, user_config: dict):
+        """Push a new user_config without restarting (reference:
+        Deployment user_config → replica.reconfigure)."""
+        fn = getattr(self.instance, "reconfigure", None)
+        if callable(fn):
+            fn(user_config)
+        return True
+
+    def handle_request(self, method: str, args: tuple, kwargs: dict,
+                       multiplexed_model_id: str = "") -> Any:
+        with self._lock:
+            self._ongoing += 1
+            self._total += 1
+            self._window.append(time.monotonic())
+            if len(self._window) > 1000:
+                del self._window[:-1000]
+        try:
+            _set_request_model_id(multiplexed_model_id)
+            if callable(self.instance) and method == "__call__":
+                target = self.instance
+            else:
+                target = getattr(self.instance, method)
+            return target(*args, **kwargs)
+        finally:
+            _set_request_model_id(None)
+            with self._lock:
+                self._ongoing -= 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            now = time.monotonic()
+            recent = sum(1 for t in self._window if now - t < 10.0)
+            return {"ongoing": self._ongoing, "total": self._total,
+                    "rate_10s": recent / 10.0}
+
+    def check_health(self) -> bool:
+        fn = getattr(self.instance, "check_health", None)
+        if callable(fn):
+            fn()
+        return True

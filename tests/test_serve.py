@@ -1,0 +1,234 @@
+"""Serve-equivalent: deployments, routing, batching, multiplexing,
+composition, autoscaling, HTTP ingress.
+
+Replicas run on the in-process device lane where possible so the suite
+doesn't pay subprocess forks; the subprocess replica path is covered once.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+DEVICE = {"scheduling_strategy": "device"}
+
+
+@pytest.fixture
+def serve_rt(rt):
+    yield rt
+    serve.shutdown()
+
+
+def test_basic_deployment_and_handle(serve_rt):
+    @serve.deployment(ray_actor_options=DEVICE)
+    class Greeter:
+        def __call__(self, name):
+            return f"hello {name}"
+
+        def shout(self, name):
+            return f"HELLO {name}"
+
+    handle = serve.run(Greeter.bind())
+    assert handle.remote("tpu").result() == "hello tpu"
+    assert handle.options(method_name="shout").remote("x").result() == \
+        "HELLO x"
+    assert handle.shout.remote("y").result() == "HELLO y"
+    assert serve.status()["Greeter"]["num_replicas"] == 1
+
+
+def test_function_deployment(serve_rt):
+    @serve.deployment(ray_actor_options=DEVICE)
+    def double(x):
+        return x * 2
+
+    handle = serve.run(double.bind())
+    assert handle.remote(21).result() == 42
+
+
+def test_multiple_replicas_route_all(serve_rt):
+    @serve.deployment(num_replicas=3, ray_actor_options=DEVICE)
+    class WhoAmI:
+        def __init__(self):
+            self.id = id(self)
+
+        def __call__(self, _):
+            return self.id
+
+    handle = serve.run(WhoAmI.bind())
+    seen = {handle.remote(None).result() for _ in range(40)}
+    assert len(seen) == 3  # p2c spreads load over every replica
+
+
+def test_batching(serve_rt):
+    @serve.deployment(max_ongoing_requests=32, ray_actor_options=DEVICE)
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.1)
+        def __call__(self, items):
+            self.batch_sizes.append(len(items))
+            return [i * 10 for i in items]
+
+        def get_batch_sizes(self):
+            return self.batch_sizes
+
+    handle = serve.run(Batched.bind())
+    responses = [handle.remote(i) for i in range(16)]
+    assert [r.result() for r in responses] == [i * 10 for i in range(16)]
+    sizes = handle.get_batch_sizes.remote().result()
+    assert max(sizes) > 1  # concurrent callers actually batched
+    assert sum(sizes) == 16
+
+
+def test_multiplexing(serve_rt):
+    @serve.deployment(ray_actor_options=DEVICE)
+    class MultiModel:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id):
+            self.loads.append(model_id)
+            return {"id": model_id}
+
+        def __call__(self, x):
+            model = self.get_model()
+            return (model["id"], serve.get_multiplexed_model_id(), x)
+
+        def get_loads(self):
+            return self.loads
+
+    handle = serve.run(MultiModel.bind())
+    h_a = handle.options(multiplexed_model_id="a")
+    h_b = handle.options(multiplexed_model_id="b")
+    assert h_a.remote(1).result() == ("a", "a", 1)
+    assert h_b.remote(2).result() == ("b", "b", 2)
+    assert h_a.remote(3).result() == ("a", "a", 3)
+    # "a" served from cache the second time.
+    assert handle.get_loads.remote().result() == ["a", "b"]
+    # Third model evicts the LRU entry ("b" — "a" was touched last).
+    handle.options(multiplexed_model_id="c").remote(4).result()
+    h_b.remote(5).result()
+    assert handle.get_loads.remote().result() == ["a", "b", "c", "b"]
+
+
+def test_composition(serve_rt):
+    @serve.deployment(ray_actor_options=DEVICE)
+    class Adder:
+        def __init__(self, offset):
+            self.offset = offset
+
+        def __call__(self, x):
+            return x + self.offset
+
+    @serve.deployment(ray_actor_options=DEVICE)
+    class Pipeline:
+        def __init__(self, adder):
+            self.adder = adder
+
+        def __call__(self, x):
+            return self.adder.remote(x).result() * 100
+
+    handle = serve.run(Pipeline.bind(Adder.bind(5)))
+    assert handle.remote(1).result() == 600
+
+
+def test_user_config_reconfigure(serve_rt):
+    @serve.deployment(user_config={"threshold": 1},
+                      ray_actor_options=DEVICE)
+    class Thresholder:
+        def __init__(self):
+            self.threshold = None
+
+        def reconfigure(self, config):
+            self.threshold = config["threshold"]
+
+        def __call__(self, x):
+            return x > self.threshold
+
+    app = Thresholder.bind()
+    handle = serve.run(app)
+    assert handle.remote(2).result() is True
+    # Redeploy with a new user_config: replicas reconfigure in place.
+    serve.run(Thresholder.options(user_config={"threshold": 10}).bind())
+    assert handle.remote(2).result() is False
+
+
+def test_autoscaling_up(serve_rt):
+    @serve.deployment(
+        autoscaling_config={"min_replicas": 1, "max_replicas": 3,
+                            "target_ongoing_requests": 1.0,
+                            "upscale_delay_s": 0.0},
+        max_ongoing_requests=16,
+        ray_actor_options=DEVICE)
+    class Slow:
+        def __call__(self, x):
+            time.sleep(0.4)
+            return x
+
+    handle = serve.run(Slow.bind())
+    assert serve.status()["Slow"]["num_replicas"] == 1
+    # Sustained concurrent load → controller scales toward max.
+    stop = threading.Event()
+    responses = []
+
+    def pump():
+        while not stop.is_set():
+            responses.append(handle.remote(1))
+            time.sleep(0.05)
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if serve.status()["Slow"]["num_replicas"] >= 2:
+                break
+            time.sleep(0.2)
+        assert serve.status()["Slow"]["num_replicas"] >= 2
+    finally:
+        stop.set()
+        t.join()
+    for r in responses[:5]:
+        assert r.result(timeout=30) == 1
+
+
+def test_http_ingress(serve_rt):
+    @serve.deployment(ray_actor_options=DEVICE)
+    class Echo:
+        def __call__(self, body):
+            return {"echo": body}
+
+    serve.start(http_port=0)  # ephemeral port
+    serve.run(Echo.bind(), route_prefix="/")
+    from ray_tpu.serve import api as serve_api
+
+    port = serve_api._proxy.port
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/", data=json.dumps({"a": 1}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        out = json.loads(resp.read())
+    assert out == {"echo": {"a": 1}}
+
+
+def test_subprocess_replicas(serve_rt):
+    @serve.deployment(num_replicas=2)  # cpu lane → subprocess workers
+    class PidReporter:
+        def __call__(self, _):
+            import os
+
+            return os.getpid()
+
+    handle = serve.run(PidReporter.bind())
+    pids = {handle.remote(None).result(timeout=60) for _ in range(10)}
+    assert len(pids) == 2
+    import os
+
+    assert os.getpid() not in pids
