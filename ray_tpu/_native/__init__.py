@@ -1,0 +1,95 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The hot object-plane path (capacity-managed shared-memory store with LRU
+eviction, spilling, restore, and cross-process pinning) is C++
+(cc/store.cc), mirroring the reference's native surface
+(/root/reference/src/ray/object_manager/plasma/). The library is compiled
+on first use with the system toolchain and cached next to the sources;
+callers fall back to the pure-Python store if no compiler is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_CC_DIR = os.path.join(os.path.dirname(__file__), "cc")
+_LIB_DIR = os.path.join(os.path.dirname(__file__), "lib")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+
+def _build(src: str, out: str) -> bool:
+    os.makedirs(_LIB_DIR, exist_ok=True)
+    tmp = out + f".tmp.{os.getpid()}"
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    if r.returncode != 0:
+        import sys
+
+        print(f"ray_tpu native build failed:\n{r.stderr}", file=sys.stderr)
+        return False
+    os.replace(tmp, out)
+    return True
+
+
+def store_lib() -> Optional[ctypes.CDLL]:
+    """The store library, building it if missing or stale; None on failure."""
+    global _lib, _lib_failed
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        src = os.path.join(_CC_DIR, "store.cc")
+        out = os.path.join(_LIB_DIR, "libray_tpu_store.so")
+        try:
+            stale = (not os.path.exists(out) or
+                     os.path.getmtime(out) < os.path.getmtime(src))
+            if stale and not _build(src, out):
+                _lib_failed = True
+                return None
+            lib = ctypes.CDLL(out)
+        except OSError:
+            _lib_failed = True
+            return None
+        # signatures
+        lib.rt_store_open.restype = ctypes.c_void_p
+        lib.rt_store_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                      ctypes.c_char_p]
+        lib.rt_store_close.argtypes = [ctypes.c_void_p]
+        lib.rt_store_put.restype = ctypes.c_int
+        lib.rt_store_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_char_p, ctypes.c_uint64]
+        lib.rt_store_create.restype = ctypes.c_int
+        lib.rt_store_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                        ctypes.c_uint64]
+        lib.rt_store_seal.restype = ctypes.c_int
+        lib.rt_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rt_store_abort.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rt_store_get.restype = ctypes.c_int
+        lib.rt_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.POINTER(ctypes.c_uint64)]
+        lib.rt_store_contains.restype = ctypes.c_int
+        lib.rt_store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rt_store_delete.restype = ctypes.c_int
+        lib.rt_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rt_store_pin.restype = ctypes.c_int
+        lib.rt_store_pin.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rt_store_unpin.restype = ctypes.c_int
+        lib.rt_store_unpin.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rt_store_used_bytes.restype = ctypes.c_uint64
+        lib.rt_store_used_bytes.argtypes = [ctypes.c_void_p]
+        lib.rt_store_evict.restype = ctypes.c_uint64
+        lib.rt_store_evict.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.rt_store_stats.argtypes = [ctypes.c_void_p] + \
+            [ctypes.POINTER(ctypes.c_uint64)] * 4
+        lib.rt_store_reserve.restype = ctypes.c_int
+        lib.rt_store_reserve.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        _lib = lib
+        return _lib

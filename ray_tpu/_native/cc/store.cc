@@ -1,0 +1,327 @@
+// Native per-node object store.
+//
+// Capability parity target: the reference's plasma store
+// (/root/reference/src/ray/object_manager/plasma/: store.h:55 PlasmaStore,
+// object_lifecycle_manager.h, eviction_policy.h LRU, create_request_queue.h
+// fallback allocation) re-designed for this framework's segment layout:
+// one tmpfs file per object under <root>, sealed by atomic rename — the
+// filesystem IS the shared index, so no unix-socket protocol or fd-passing
+// daemon is needed and any process can operate on the store concurrently.
+//
+// This library adds what the Python client lacks: capacity accounting, LRU
+// eviction, disk spilling with transparent restore (reference:
+// local_object_manager.h spill/restore orchestration), and cross-process
+// pinning via marker files (reference: raylet pins via PinObjectIDs RPC).
+//
+// Concurrency/coherence model: every mutation is a filesystem operation
+// that is atomic at the VFS layer (rename, link, unlink). The in-memory
+// mutex only serializes threads within one process; cross-process safety
+// comes from the atomicity of the FS ops themselves.
+
+#include <dirent.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <signal.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Entry {
+  std::string name;   // oid hex
+  uint64_t size;
+  int64_t mtime_ns;   // LRU key (updated on access)
+};
+
+struct Store {
+  std::string root;       // sealed segments live here (tmpfs)
+  std::string spill_dir;  // spilled segments live here ("" = drop on evict)
+  uint64_t capacity;      // soft cap on bytes under root
+  std::mutex mu;
+  // counters
+  uint64_t n_created = 0, n_evicted = 0, n_spilled = 0, n_restored = 0;
+};
+
+std::string seg_path(const Store* s, const char* oid) {
+  return s->root + "/" + oid;
+}
+std::string tmp_path(const Store* s, const char* oid) {
+  return s->root + "/" + oid + ".tmp." + std::to_string(getpid());
+}
+std::string pin_dir(const Store* s) { return s->root + "/.pins"; }
+std::string pin_path(const Store* s, const char* oid) {
+  return pin_dir(s) + "/" + oid + "." + std::to_string(getpid());
+}
+std::string spill_path(const Store* s, const char* oid) {
+  return s->spill_dir + "/" + oid;
+}
+
+bool is_internal(const char* name) {
+  return name[0] == '.' || strstr(name, ".tmp.") != nullptr;
+}
+
+int64_t now_mtime(const struct stat& st) {
+  return int64_t(st.st_mtim.tv_sec) * 1000000000 + st.st_mtim.tv_nsec;
+}
+
+// Scan sealed segments under root (skips tmp files and .pins).
+std::vector<Entry> scan(Store* s) {
+  std::vector<Entry> out;
+  DIR* d = opendir(s->root.c_str());
+  if (!d) return out;
+  while (struct dirent* e = readdir(d)) {
+    if (is_internal(e->d_name)) continue;
+    struct stat st;
+    std::string p = s->root + "/" + e->d_name;
+    if (stat(p.c_str(), &st) != 0 || !S_ISREG(st.st_mode)) continue;
+    out.push_back({e->d_name, uint64_t(st.st_size), now_mtime(st)});
+  }
+  closedir(d);
+  return out;
+}
+
+// Is some live process pinning this object? Reaps pins of dead pids
+// (reference: raylet unpins when the owning worker dies).
+bool is_pinned(Store* s, const std::string& name) {
+  DIR* d = opendir(pin_dir(s).c_str());
+  if (!d) return false;
+  bool pinned = false;
+  std::string prefix = name + ".";
+  while (struct dirent* e = readdir(d)) {
+    if (strncmp(e->d_name, prefix.c_str(), prefix.size()) != 0) continue;
+    pid_t pid = atoi(e->d_name + prefix.size());
+    if (pid > 0 && kill(pid, 0) == 0) {
+      pinned = true;
+    } else {
+      unlink((pin_dir(s) + "/" + e->d_name).c_str());  // dead owner
+    }
+  }
+  closedir(d);
+  return pinned;
+}
+
+uint64_t used_bytes_locked(Store* s) {
+  uint64_t total = 0;
+  for (const auto& e : scan(s)) total += e.size;
+  return total;
+}
+
+// Copy src -> dst (cross-filesystem safe), then unlink src.
+int move_file(const std::string& src, const std::string& dst) {
+  if (rename(src.c_str(), dst.c_str()) == 0) return 0;
+  if (errno != EXDEV) return -1;
+  int in = open(src.c_str(), O_RDONLY);
+  if (in < 0) return -1;
+  std::string dtmp = dst + ".tmp." + std::to_string(getpid());
+  int out = open(dtmp.c_str(), O_CREAT | O_WRONLY | O_TRUNC, 0600);
+  if (out < 0) { close(in); return -1; }
+  char buf[1 << 16];
+  ssize_t n;
+  while ((n = read(in, buf, sizeof buf)) > 0) {
+    ssize_t off = 0;
+    while (off < n) {
+      ssize_t w = write(out, buf + off, n - off);
+      if (w < 0) { close(in); close(out); unlink(dtmp.c_str()); return -1; }
+      off += w;
+    }
+  }
+  close(in);
+  if (fsync(out) != 0 || close(out) != 0) { unlink(dtmp.c_str()); return -1; }
+  if (rename(dtmp.c_str(), dst.c_str()) != 0) { unlink(dtmp.c_str()); return -1; }
+  unlink(src.c_str());
+  return 0;
+}
+
+// Free at least `need` bytes by spilling (or dropping) LRU unpinned
+// segments. Returns bytes freed. Caller holds s->mu.
+uint64_t evict_locked(Store* s, uint64_t need) {
+  std::vector<Entry> entries = scan(s);
+  std::sort(entries.begin(), entries.end(),
+            [](const Entry& a, const Entry& b) {
+              return a.mtime_ns < b.mtime_ns;  // oldest access first
+            });
+  uint64_t freed = 0;
+  for (const auto& e : entries) {
+    if (freed >= need) break;
+    if (is_pinned(s, e.name)) continue;
+    std::string src = s->root + "/" + e.name;
+    if (!s->spill_dir.empty()) {
+      if (move_file(src, s->spill_dir + "/" + e.name) != 0) continue;
+      s->n_spilled++;
+    } else {
+      if (unlink(src.c_str()) != 0) continue;
+    }
+    s->n_evicted++;
+    freed += e.size;
+  }
+  return freed;
+}
+
+}  // namespace
+
+extern "C" {
+
+Store* rt_store_open(const char* root, uint64_t capacity_bytes,
+                     const char* spill_dir) {
+  Store* s = new Store();
+  s->root = root;
+  s->capacity = capacity_bytes;
+  s->spill_dir = spill_dir ? spill_dir : "";
+  mkdir(root, 0700);
+  mkdir(pin_dir(s).c_str(), 0700);
+  if (!s->spill_dir.empty()) mkdir(s->spill_dir.c_str(), 0700);
+  return s;
+}
+
+void rt_store_close(Store* s) { delete s; }
+
+// Ensure room for `size` more bytes (evicting LRU if needed). Returns 0 on
+// success, -1 if the store cannot fit the object even after eviction.
+int rt_store_reserve(Store* s, uint64_t size) {
+  std::lock_guard<std::mutex> g(s->mu);
+  if (size > s->capacity) return -1;
+  uint64_t used = used_bytes_locked(s);
+  if (used + size <= s->capacity) return 0;
+  uint64_t need = used + size - s->capacity;
+  uint64_t freed = evict_locked(s, need);
+  return freed >= need ? 0 : -1;
+}
+
+int rt_store_put(Store* s, const char* oid, const void* data, uint64_t size) {
+  if (rt_store_reserve(s, size) != 0) return -1;
+  std::string tmp = tmp_path(s, oid);
+  int fd = open(tmp.c_str(), O_CREAT | O_WRONLY | O_TRUNC, 0600);
+  if (fd < 0) return -1;
+  const char* p = static_cast<const char*>(data);
+  uint64_t off = 0;
+  while (off < size) {
+    ssize_t w = write(fd, p + off, size - off);
+    if (w < 0) { close(fd); unlink(tmp.c_str()); return -1; }
+    off += uint64_t(w);
+  }
+  close(fd);
+  if (rename(tmp.c_str(), seg_path(s, oid).c_str()) != 0) {
+    unlink(tmp.c_str());
+    return -1;
+  }
+  std::lock_guard<std::mutex> g(s->mu);
+  s->n_created++;
+  return 0;
+}
+
+// Two-phase create: returns a writable fd sized to `size`; seal with
+// rt_store_seal. The caller mmaps the fd and must close it.
+int rt_store_create(Store* s, const char* oid, uint64_t size) {
+  if (rt_store_reserve(s, size) != 0) return -1;
+  std::string tmp = tmp_path(s, oid);
+  int fd = open(tmp.c_str(), O_CREAT | O_RDWR | O_TRUNC, 0600);
+  if (fd < 0) return -1;
+  if (ftruncate(fd, off_t(size)) != 0) {
+    close(fd);
+    unlink(tmp.c_str());
+    return -1;
+  }
+  return fd;  // caller closes after mmap
+}
+
+int rt_store_seal(Store* s, const char* oid) {
+  if (rename(tmp_path(s, oid).c_str(), seg_path(s, oid).c_str()) != 0)
+    return -1;
+  std::lock_guard<std::mutex> g(s->mu);
+  s->n_created++;
+  return 0;
+}
+
+int rt_store_abort(Store* s, const char* oid) {
+  return unlink(tmp_path(s, oid).c_str());
+}
+
+// Open a sealed object for reading. Restores from spill transparently.
+// Returns the fd (>= 0) and writes the size; -1 if absent.
+int rt_store_get(Store* s, const char* oid, uint64_t* out_size) {
+  std::string p = seg_path(s, oid);
+  int fd = open(p.c_str(), O_RDONLY);
+  if (fd < 0 && !s->spill_dir.empty()) {
+    std::lock_guard<std::mutex> g(s->mu);
+    fd = open(p.c_str(), O_RDONLY);  // raced restore?
+    if (fd < 0) {
+      std::string sp = spill_path(s, oid);
+      struct stat st;
+      if (stat(sp.c_str(), &st) == 0) {
+        // Make room, then pull the segment back into the tmpfs.
+        uint64_t used = used_bytes_locked(s);
+        uint64_t size = uint64_t(st.st_size);
+        if (used + size > s->capacity)
+          evict_locked(s, used + size - s->capacity);
+        if (move_file(sp, p) == 0) {
+          s->n_restored++;
+          fd = open(p.c_str(), O_RDONLY);
+        }
+      }
+    }
+  }
+  if (fd < 0) return -1;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { close(fd); return -1; }
+  *out_size = uint64_t(st.st_size);
+  // Touch for LRU: mark as most-recently-used.
+  futimens(fd, nullptr);
+  return fd;
+}
+
+// 0 = absent, 1 = in store, 2 = spilled.
+int rt_store_contains(Store* s, const char* oid) {
+  struct stat st;
+  if (stat(seg_path(s, oid).c_str(), &st) == 0) return 1;
+  if (!s->spill_dir.empty() &&
+      stat(spill_path(s, oid).c_str(), &st) == 0) return 2;
+  return 0;
+}
+
+int rt_store_delete(Store* s, const char* oid) {
+  int r1 = unlink(seg_path(s, oid).c_str());
+  int r2 = s->spill_dir.empty() ? -1
+           : unlink(spill_path(s, oid).c_str());
+  return (r1 == 0 || r2 == 0) ? 0 : -1;
+}
+
+int rt_store_pin(Store* s, const char* oid) {
+  int fd = open(pin_path(s, oid).c_str(), O_CREAT | O_WRONLY, 0600);
+  if (fd < 0) return -1;
+  close(fd);
+  return 0;
+}
+
+int rt_store_unpin(Store* s, const char* oid) {
+  return unlink(pin_path(s, oid).c_str());
+}
+
+uint64_t rt_store_used_bytes(Store* s) {
+  std::lock_guard<std::mutex> g(s->mu);
+  return used_bytes_locked(s);
+}
+
+uint64_t rt_store_evict(Store* s, uint64_t need) {
+  std::lock_guard<std::mutex> g(s->mu);
+  return evict_locked(s, need);
+}
+
+void rt_store_stats(Store* s, uint64_t* created, uint64_t* evicted,
+                    uint64_t* spilled, uint64_t* restored) {
+  std::lock_guard<std::mutex> g(s->mu);
+  *created = s->n_created;
+  *evicted = s->n_evicted;
+  *spilled = s->n_spilled;
+  *restored = s->n_restored;
+}
+
+}  // extern "C"

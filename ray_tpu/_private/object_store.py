@@ -157,3 +157,141 @@ class _PendingSeal:
             os.unlink(self._tmp)
         except FileNotFoundError:
             pass
+
+
+class NativeObjectStore(SharedMemoryStore):
+    """The C++-backed store (ray_tpu/_native/cc/store.cc): same segment
+    layout and client API as SharedMemoryStore, plus capacity accounting,
+    LRU eviction, disk spilling with transparent restore, and
+    cross-process pinning. Used automatically when the native library
+    builds (see make_store)."""
+
+    def __init__(self, session_id: str, *, capacity_bytes: int | None = None,
+                 spill_dir: str | None = None):
+        super().__init__(session_id)
+        import ctypes
+
+        from .._native import store_lib
+
+        self._lib = store_lib()
+        if self._lib is None:
+            raise RuntimeError("native store library unavailable")
+        if capacity_bytes is None:
+            capacity_bytes = int(os.environ.get(
+                "RT_STORE_CAPACITY", 2 * 1024 ** 3))
+        if spill_dir is None:
+            spill_dir = os.environ.get(
+                "RT_SPILL_DIR", f"/tmp/rtpu-spill-{session_id}")
+        self.capacity_bytes = capacity_bytes
+        self.spill_dir = spill_dir
+        self._ctypes = ctypes
+        self._h = self._lib.rt_store_open(
+            self.prefix.encode(), capacity_bytes, spill_dir.encode())
+
+    # -- writer API ---------------------------------------------------------
+    def put(self, oid: ObjectID, blob) -> int:
+        b = bytes(blob) if not isinstance(blob, bytes) else blob
+        if self._lib.rt_store_put(self._h, oid.hex().encode(), b,
+                                  len(b)) != 0:
+            from .exceptions import OutOfMemoryError
+
+            raise OutOfMemoryError(
+                f"object ({len(b)} bytes) exceeds store capacity "
+                f"({self.capacity_bytes} bytes) even after eviction")
+        return len(b)
+
+    def create(self, oid: ObjectID, size: int):
+        fd = self._lib.rt_store_create(self._h, oid.hex().encode(), size)
+        if fd < 0:
+            from .exceptions import OutOfMemoryError
+
+            raise OutOfMemoryError(
+                f"cannot reserve {size} bytes in store "
+                f"(capacity {self.capacity_bytes})")
+        mm = mmap.mmap(fd, size)
+        os.close(fd)
+        return memoryview(mm), _NativePendingSeal(self, oid, mm)
+
+    # -- reader API ---------------------------------------------------------
+    def get(self, oid: ObjectID) -> Optional[memoryview]:
+        cached = self._mmaps.get(oid)
+        if cached is not None:
+            return cached[1]
+        size = self._ctypes.c_uint64()
+        fd = self._lib.rt_store_get(self._h, oid.hex().encode(),
+                                    self._ctypes.byref(size))
+        if fd < 0:
+            return None
+        try:
+            mm = mmap.mmap(fd, size.value, prot=mmap.PROT_READ)
+        finally:
+            os.close(fd)
+        mv = memoryview(mm)
+        self._mmaps[oid] = (mm, mv)
+        return mv
+
+    def contains(self, oid: ObjectID) -> bool:
+        return oid in self._mmaps or \
+            self._lib.rt_store_contains(self._h, oid.hex().encode()) != 0
+
+    def delete(self, oid: ObjectID):
+        self.release(oid)
+        self._lib.rt_store_delete(self._h, oid.hex().encode())
+
+    # -- native extensions --------------------------------------------------
+    def pin(self, oid: ObjectID):
+        self._lib.rt_store_pin(self._h, oid.hex().encode())
+
+    def unpin(self, oid: ObjectID):
+        self._lib.rt_store_unpin(self._h, oid.hex().encode())
+
+    def used_bytes(self) -> int:
+        return self._lib.rt_store_used_bytes(self._h)
+
+    def evict(self, num_bytes: int) -> int:
+        return self._lib.rt_store_evict(self._h, num_bytes)
+
+    def stats(self) -> dict:
+        c = self._ctypes
+        created, evicted, spilled, restored = (c.c_uint64() for _ in range(4))
+        self._lib.rt_store_stats(self._h, c.byref(created), c.byref(evicted),
+                                 c.byref(spilled), c.byref(restored))
+        return {"created": created.value, "evicted": evicted.value,
+                "spilled": spilled.value, "restored": restored.value}
+
+    def destroy(self):
+        super().destroy()
+        import shutil
+
+        shutil.rmtree(self.spill_dir, ignore_errors=True)
+        if self._h:
+            self._lib.rt_store_close(self._h)
+            self._h = None
+
+
+class _NativePendingSeal:
+    def __init__(self, store: NativeObjectStore, oid: ObjectID, mm: mmap.mmap):
+        self._store, self._oid, self._mm = store, oid, mm
+
+    def seal(self):
+        self._mm.flush()
+        self._mm.close()
+        if self._store._lib.rt_store_seal(
+                self._store._h, self._oid.hex().encode()) != 0:
+            raise OSError(f"seal failed for {self._oid.hex()}")
+
+    def abort(self):
+        self._mm.close()
+        self._store._lib.rt_store_abort(
+            self._store._h, self._oid.hex().encode())
+
+
+def make_store(session_id: str) -> SharedMemoryStore:
+    """The node's object store: native (C++) when the library builds,
+    pure-Python otherwise (RT_NATIVE_STORE=0 forces the fallback)."""
+    if os.environ.get("RT_NATIVE_STORE", "1") != "0":
+        try:
+            return NativeObjectStore(session_id)
+        except (RuntimeError, OSError):
+            pass
+    return SharedMemoryStore(session_id)
