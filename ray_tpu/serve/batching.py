@@ -13,15 +13,20 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, List, Optional
 
+from .multiplex import _set_request_model_id, get_multiplexed_model_id
+
 
 class _Pending:
-    __slots__ = ("item", "event", "result", "error")
+    __slots__ = ("item", "event", "result", "error", "model_id")
 
     def __init__(self, item):
         self.item = item
         self.event = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
+        # Request context is thread-local and the batch executes on the
+        # collector thread — capture it at submit time (caller's thread).
+        self.model_id = get_multiplexed_model_id()
 
 
 class _Batcher:
@@ -65,20 +70,35 @@ class _Batcher:
                     self._queue[: self.max_batch_size],
                     self._queue[self.max_batch_size:],
                 )
-            try:
-                results = self.fn(owner, [p.item for p in batch])
-                if len(results) != len(batch):
-                    raise ValueError(
-                        f"@serve.batch function returned {len(results)} "
-                        f"results for a batch of {len(batch)}")
-                for p, r in zip(batch, results):
-                    p.result = r
-            except BaseException as e:  # noqa: BLE001 - delivered to callers
-                for p in batch:
-                    p.error = e
-            finally:
-                for p in batch:
-                    p.event.set()
+            # One fn call per model id so get_multiplexed_model_id() inside
+            # the batched method is correct for every item it sees —
+            # batching and multiplexing compose. Grouping is by id across
+            # the whole batch (each _Pending gets its own result back, so
+            # cross-model ordering carries no contract): interleaved a,b,a,b
+            # traffic still yields full per-model batches.
+            groups: dict[str, list[_Pending]] = {}
+            for p in batch:
+                groups.setdefault(p.model_id, []).append(p)
+            for group in groups.values():
+                self._run_batch(owner, group)
+
+    def _run_batch(self, owner, batch: list[_Pending]):
+        _set_request_model_id(batch[0].model_id or None)
+        try:
+            results = self.fn(owner, [p.item for p in batch])
+            if len(results) != len(batch):
+                raise ValueError(
+                    f"@serve.batch function returned {len(results)} "
+                    f"results for a batch of {len(batch)}")
+            for p, r in zip(batch, results):
+                p.result = r
+        except BaseException as e:  # noqa: BLE001 - delivered to callers
+            for p in batch:
+                p.error = e
+        finally:
+            _set_request_model_id(None)
+            for p in batch:
+                p.event.set()
 
 
 def batch(_func=None, *, max_batch_size: int = 10,

@@ -102,10 +102,10 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
 class DeploymentResponse:
     """Future-like response (reference handle.py DeploymentResponse)."""
 
-    def __init__(self, ref, router: "Router", replica_idx: int):
+    def __init__(self, ref, router: "Router", replica_key):
         self._ref = ref
         self._router = router
-        self._replica_idx = replica_idx
+        self._replica_key = replica_key
         self._done = False
 
     def result(self, timeout: Optional[float] = None):
@@ -123,7 +123,7 @@ class DeploymentResponse:
     def _settle(self):
         if not self._done:
             self._done = True
-            self._router.request_done(self._replica_idx)
+            self._router.request_done(self._replica_key)
 
     def __del__(self):
         # Fire-and-forget callers drop responses without result(); the
@@ -135,55 +135,78 @@ class DeploymentResponse:
             pass
 
 
+def _replica_key(replica):
+    """Stable identity for a replica across update_replicas() calls —
+    in-flight counts must survive autoscale/redeploy reindexing."""
+    aid = getattr(replica, "_actor_id", None)
+    return aid.binary() if aid is not None else id(replica)
+
+
 class Router:
-    """Client-side power-of-two-choices over the replica set."""
+    """Client-side power-of-two-choices over the replica set.
+
+    In-flight counts and model affinity are keyed by stable replica
+    identity (actor id), not list index: update_replicas() preserves
+    counts for surviving replicas, so p2c load estimates stay accurate
+    across autoscaling/redeploy events.
+    """
 
     def __init__(self):
         self._lock = threading.Lock()
         self._replicas: list = []
-        self._inflight: list[int] = []
-        self._model_affinity: dict[str, set[int]] = {}
+        self._keys: list = []
+        self._inflight: dict = {}
+        self._model_affinity: dict[str, set] = {}
         self._rng = random.Random()
 
     def update_replicas(self, replicas: list):
         with self._lock:
             self._replicas = list(replicas)
-            self._inflight = [0] * len(self._replicas)
-            self._model_affinity.clear()
+            self._keys = [_replica_key(r) for r in self._replicas]
+            live = set(self._keys)
+            self._inflight = {k: self._inflight.get(k, 0) for k in live}
+            for mid in list(self._model_affinity):
+                kept = self._model_affinity[mid] & live
+                if kept:
+                    self._model_affinity[mid] = kept
+                else:
+                    del self._model_affinity[mid]
 
-    def pick(self, multiplexed_model_id: str = "") -> int:
+    def pick_replica(self, multiplexed_model_id: str = ""):
+        """Choose a replica; returns ``(replica, key)`` atomically (a
+        concurrent update_replicas() must not be able to reindex between
+        the choice and the caller reading the handle)."""
         with self._lock:
             n = len(self._replicas)
             if n == 0:
                 raise RuntimeError("no replicas available")
             if n == 1:
-                self._inflight[0] += 1
-                return 0
-            # Multiplexing: prefer a replica that already has the model hot.
-            if multiplexed_model_id:
-                hot = [i for i in
-                       self._model_affinity.get(multiplexed_model_id, ())
-                       if i < n]
-                if hot:
-                    i = min(hot, key=lambda j: self._inflight[j])
-                    self._inflight[i] += 1
-                    return i
-            a, b = self._rng.sample(range(n), 2)
-            i = a if self._inflight[a] <= self._inflight[b] else b
-            self._inflight[i] += 1
+                i = 0
+            elif multiplexed_model_id and (hot := [
+                    i for i, k in enumerate(self._keys)
+                    if k in self._model_affinity.get(
+                        multiplexed_model_id, ())]):
+                # Multiplexing: prefer a replica with the model already hot.
+                i = min(hot, key=lambda j: self._inflight[self._keys[j]])
+            else:
+                a, b = self._rng.sample(range(n), 2)
+                i = (a if self._inflight[self._keys[a]]
+                     <= self._inflight[self._keys[b]] else b)
+            key = self._keys[i]
+            self._inflight[key] += 1
             if multiplexed_model_id:
                 self._model_affinity.setdefault(
-                    multiplexed_model_id, set()).add(i)
-            return i
+                    multiplexed_model_id, set()).add(key)
+            return self._replicas[i], key
 
     def replica(self, idx: int):
         with self._lock:
             return self._replicas[idx]
 
-    def request_done(self, idx: int):
+    def request_done(self, key):
         with self._lock:
-            if idx < len(self._inflight):
-                self._inflight[idx] = max(0, self._inflight[idx] - 1)
+            if key in self._inflight:
+                self._inflight[key] = max(0, self._inflight[key] - 1)
 
 
 class DeploymentHandle:
@@ -218,11 +241,10 @@ class DeploymentHandle:
         return self.options(method_name=name)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
-        idx = self._router.pick(self._model_id)
-        actor = self._router.replica(idx)
+        actor, key = self._router.pick_replica(self._model_id)
         ref = actor.handle_request.remote(
             self._method, args, kwargs, self._model_id)
-        return DeploymentResponse(ref, self._router, idx)
+        return DeploymentResponse(ref, self._router, key)
 
     def __reduce__(self):
         with self._router._lock:

@@ -65,23 +65,34 @@ class AsyncHyperBandScheduler(TrialScheduler):
         while r < max_t:
             self.rungs[r] = []
             r *= reduction_factor
+        # trial_id -> largest milestone already recorded (trials that report
+        # every N>1 iterations must still hit each rung once: promote on
+        # t >= milestone, like the reference's async_hyperband)
+        self._last_rung: dict[str, int] = {}
 
     def on_trial_result(self, trial, result: dict) -> str:
         t = result.get(self.time_attr, 0)
         if t >= self.max_t:
             return STOP
         score = self._score(result)
-        decision = CONTINUE
-        for milestone in sorted(self.rungs):
-            if t == milestone:
-                peers = self.rungs[milestone]
-                peers.append(score)
-                if len(peers) >= self.rf:
-                    cutoff = sorted(peers, reverse=True)[
-                        max(0, len(peers) // self.rf - 1)]
-                    if score < cutoff:
-                        decision = STOP
-        return decision
+        last = self._last_rung.get(trial.trial_id, 0)
+        # Record at the single LARGEST unrecorded milestone <= t (reference
+        # async_hyperband cuts at one rung per report): a sparse reporter
+        # competes at the rung matching its progress, not at every rung it
+        # skipped past.
+        for milestone in sorted(self.rungs, reverse=True):
+            if milestone <= last or t < milestone:
+                continue
+            self._last_rung[trial.trial_id] = milestone
+            peers = self.rungs[milestone]
+            peers.append(score)
+            if len(peers) >= self.rf:
+                cutoff = sorted(peers, reverse=True)[
+                    max(0, len(peers) // self.rf - 1)]
+                if score < cutoff:
+                    return STOP
+            break
+        return CONTINUE
 
 
 class MedianStoppingRule(TrialScheduler):

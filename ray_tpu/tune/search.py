@@ -47,8 +47,11 @@ class Integer(Domain):
 
     def sample(self, rng):
         if self.log:
-            return int(math.exp(rng.uniform(math.log(self.lower),
-                                            math.log(self.upper))))
+            # [lower, upper) like the non-log branch and the reference's
+            # lograndint; exp() can land exactly on upper, so clamp.
+            v = int(math.exp(rng.uniform(math.log(self.lower),
+                                         math.log(self.upper))))
+            return min(v, self.upper - 1)
         return rng.randint(self.lower, self.upper - 1)
 
 

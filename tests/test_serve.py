@@ -118,6 +118,55 @@ def test_multiplexing(serve_rt):
     assert handle.get_loads.remote().result() == ["a", "b", "c", "b"]
 
 
+def test_batching_with_multiplexing(serve_rt):
+    """get_multiplexed_model_id() must be correct inside a @serve.batch
+    method (the batch runs on the collector thread, not the request
+    thread) — batches are split per model id."""
+    @serve.deployment(max_ongoing_requests=32, ray_actor_options=DEVICE)
+    class BatchedMux:
+        @serve.multiplexed(max_num_models_per_replica=4)
+        def get_model(self, model_id):
+            return {"id": model_id}
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.1)
+        def __call__(self, items):
+            model = self.get_model()  # no explicit id: uses request context
+            mid = serve.get_multiplexed_model_id()
+            return [(model["id"], mid, i) for i in items]
+
+    handle = serve.run(BatchedMux.bind())
+    h_a = handle.options(multiplexed_model_id="a")
+    h_b = handle.options(multiplexed_model_id="b")
+    rs = [h_a.remote(i) if i % 2 == 0 else h_b.remote(i) for i in range(12)]
+    for i, r in enumerate(rs):
+        want = "a" if i % 2 == 0 else "b"
+        assert r.result() == (want, want, i)
+
+
+def test_router_inflight_survives_update():
+    """p2c in-flight counts are keyed by replica identity, not index —
+    update_replicas() must preserve counts for surviving replicas."""
+    from ray_tpu.serve.deployment import Router
+
+    class FakeReplica:
+        def __init__(self, name):
+            self._name = name
+
+    r1, r2, r3 = FakeReplica("r1"), FakeReplica("r2"), FakeReplica("r3")
+    router = Router()
+    router.update_replicas([r1, r2])
+    _, key = router.pick_replica()
+    # Autoscale event: r3 added, order shuffled, while request in flight.
+    router.update_replicas([r3, r2, r1])
+    assert router._inflight[key] == 1  # surviving replica kept its count
+    router.request_done(key)
+    assert router._inflight[key] == 0
+    # A settled request for a removed replica is a no-op, not a skew.
+    router.update_replicas([r2])
+    router.request_done(key)
+    assert all(v == 0 for v in router._inflight.values())
+
+
 def test_composition(serve_rt):
     @serve.deployment(ray_actor_options=DEVICE)
     class Adder:

@@ -111,6 +111,43 @@ def test_asha_rung_promotion_logic():
     assert sum(1 for q, s in stopped_at.items() if s < 16) >= 2
 
 
+def test_asha_sparse_reporting_hits_rungs():
+    """A trial reporting every 3 iterations (never exactly on a power-of-2
+    milestone) must still be recorded at each rung it passes — promotion is
+    on t >= milestone with last-rung tracking, like the reference."""
+    sched = tune.ASHAScheduler(grace_period=2, reduction_factor=2, max_t=100)
+    sched.set_search_properties("acc", "max")
+    trials = [tune.Trial(config={"q": q}) for q in (1.0, 0.5, 0.2, 0.1)]
+    alive = {t.trial_id for t in trials}
+    stopped = {}
+    for step in range(3, 31, 3):  # 3, 6, 9, ... never == 2, 4, 8, 16
+        for t in trials:
+            if t.trial_id not in alive:
+                continue
+            d = sched.on_trial_result(
+                t, {"acc": t.config["q"] * step, "training_iteration": step})
+            if d == "STOP":
+                alive.discard(t.trial_id)
+                stopped[t.config["q"]] = step
+    # Rungs were populated despite no exact-milestone report...
+    assert any(sched.rungs[m] for m in sched.rungs)
+    # ...and underperformers were actually cut.
+    assert 1.0 not in stopped
+    assert 0.1 in stopped
+    # Each trial recorded at most once per rung.
+    for m, scores in sched.rungs.items():
+        assert len(scores) <= len(trials)
+
+
+def test_lograndint_upper_exclusive():
+    import random as _random
+
+    dom = tune.lograndint(1, 4)
+    r = _random.Random(0)
+    vals = {dom.sample(r) for _ in range(500)}
+    assert vals <= {1, 2, 3}, vals  # upper bound exclusive
+
+
 def test_asha_integration(rt):
     def trainable(config):
         import time as _t
