@@ -9,6 +9,17 @@ below is the well-known torch-DDP ballpark for GPT-2-small (124M) on one
 A100-40G with AMP — ~55k tokens/s — which is what a reference-stack user
 would see per accelerator. vs_baseline = our tokens/sec/chip ÷ that.
 
+Robustness: the TPU backend on this box arrives through a tunnel that can be
+wedged or mid-handshake when the bench runs (round-1 failure mode: a single
+``jax.devices()`` died with UNAVAILABLE and the round recorded no perf data).
+So this file is a *supervisor*: measurements run in child processes with
+hard timeouts, retried with backoff; orphaned worker processes that might
+pin the chip are reaped first. The base config and the flash-kernel config
+run as SEPARATE children, so a hang in one cannot discard the other's
+result. If the TPU never comes up, the supervisor falls back to a
+CPU-backend smoke measurement so stdout always carries one valid JSON line,
+with the diagnostic history on stderr.
+
 Extra context (MFU, step time, config) goes to stderr so stdout stays a
 single JSON line.
 """
@@ -17,15 +28,25 @@ from __future__ import annotations
 
 import json
 import os
+import signal
+import subprocess
 import sys
 import time
 
 A100_GPT2S_TOKENS_PER_SEC = 55_000.0  # reference-stack per-accelerator ballpark
 
+ATTEMPTS = 5            # TPU attempts before falling back to CPU smoke
+PROBE_TIMEOUT_S = 90    # backend-init probe (a wedged tunnel hangs, not errors)
+CHILD_TIMEOUT_S = 600   # one config: compile (~20-40s) + 20 steps, ample
+BACKOFF_S = (5, 15, 30, 60, 60)
 
-def main():
+
+# --------------------------------------------------------------------------
+# Child: the actual measurement (runs under a supervisor timeout).
+# --------------------------------------------------------------------------
+
+def run_bench(use_flash: bool) -> dict:
     import jax
-    import jax.numpy as jnp
     import optax
 
     from ray_tpu.models import gpt
@@ -39,67 +60,247 @@ def main():
     spec = MeshSpec.auto(n_chips)
     mesh = spec.build()
     data_shards = spec.dp * spec.fsdp
-    if on_tpu:
-        import dataclasses
 
-        cfg = dataclasses.replace(gpt.GPT2_SMALL, remat=True)
-        batch, seq = 16 * data_shards, cfg.max_seq  # 16 per data shard
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import dataclasses
+
+    if on_tpu:
+        cfg = dataclasses.replace(gpt.GPT2_SMALL, remat=True,
+                                  use_flash=use_flash)
+        batch = 16 * data_shards  # 16 per data shard
         warmup, iters = 3, 20
-    else:  # CPU smoke mode (CI): tiny model, same code path
+    else:  # CPU smoke mode (CI / TPU-unavailable fallback): same code path
         cfg = gpt.TINY
-        batch, seq = 4 * data_shards, cfg.max_seq
+        batch = 4 * data_shards
         warmup, iters = 1, 3
+
     opt = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
     params = gpt.init(jax.random.key(0), cfg)
     state = {"params": params, "opt_state": opt.init(params), "step": 0}
     state = gpt.shard_state(state, mesh, cfg)
     step = gpt.make_train_step(cfg, opt, mesh)
-
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
+    seq = cfg.max_seq
     tokens = jax.device_put(
-        jax.random.randint(jax.random.key(1), (batch, seq), 0, cfg.vocab_size),
+        jax.random.randint(jax.random.key(1), (batch, seq), 0,
+                           cfg.vocab_size),
         NamedSharding(mesh, P(("dp", "fsdp"))),
     )
-
     t_compile = time.perf_counter()
     for _ in range(warmup):
         state, metrics = step(state, tokens)
     # Fence via host materialization: the final loss depends on every prior
-    # step's state, and a host read is the one barrier every backend
-    # honors (block_until_ready is lazy on the remote axon platform).
+    # step's state, and a host read is the one barrier every backend honors
+    # (block_until_ready is lazy on the remote axon platform).
     float(metrics["loss"])
     print(f"warmup+compile: {time.perf_counter() - t_compile:.1f}s",
           file=sys.stderr)
-
     t0 = time.perf_counter()
     for _ in range(iters):
         state, metrics = step(state, tokens)
     final_loss = float(metrics["loss"])
     dt = time.perf_counter() - t0
-
-    steps_per_sec = iters / dt
-    tokens_per_sec = steps_per_sec * batch * (seq - 1)
+    tokens_per_sec = iters / dt * batch * (seq - 1)
     per_chip = tokens_per_sec / n_chips
-    flops_per_token = cfg.flops_per_token()
     peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak per chip
-    mfu = tokens_per_sec * flops_per_token / (n_chips * peak)
-
+    mfu = tokens_per_sec * cfg.flops_per_token() / (n_chips * peak)
     print(
-        f"cfg: {cfg.num_params()/1e6:.0f}M params, batch={batch} seq={seq} "
-        f"mesh={spec.shape} step={dt/iters*1000:.0f}ms "
-        f"loss={final_loss:.3f} MFU={mfu*100:.1f}%",
-        file=sys.stderr,
-    )
-    print(json.dumps({
-        "metric": "gpt2_small_train_tokens_per_sec_per_chip" if on_tpu
-                  else "gpt_tiny_cpu_smoke_tokens_per_sec",
+        f"cfg: {cfg.num_params()/1e6:.0f}M params flash={cfg.use_flash} "
+        f"batch={batch} seq={seq} mesh={spec.shape} "
+        f"step={dt/iters*1000:.0f}ms loss={final_loss:.3f} "
+        f"MFU={mfu*100:.1f}%", file=sys.stderr)
+    if on_tpu:
+        return {
+            "metric": "gpt2_small_train_tokens_per_sec_per_chip",
+            "value": round(per_chip, 1),
+            "unit": "tokens/s/chip",
+            "vs_baseline": round(per_chip / A100_GPT2S_TOKENS_PER_SEC, 3),
+            "mfu": round(mfu, 4),
+            "flash": use_flash,
+        }
+    return {
+        "metric": "gpt_tiny_cpu_smoke_tokens_per_sec",
         "value": round(per_chip, 1),
         "unit": "tokens/s/chip",
-        "vs_baseline": round(per_chip / A100_GPT2S_TOKENS_PER_SEC, 3) if on_tpu
-                       else 0.0,
+        "vs_baseline": 0.0,
+    }
+
+
+# --------------------------------------------------------------------------
+# Supervisor: timeout + retry + stale-process reaping + CPU fallback.
+# --------------------------------------------------------------------------
+
+def _reap_stale_chip_claimants():
+    """Kill ORPHANED leftovers from earlier runs that may pin the TPU chip.
+
+    Only processes reparented to init (ppid 1) are touched: workers of a
+    live runtime are parented to their driver/node service, so a running
+    training/serve session on the same box is never harmed.
+    """
+    me = os.getpid()
+    try:
+        pids = [int(p) for p in os.listdir("/proc") if p.isdigit()]
+    except OSError:
+        return
+    for pid in pids:
+        if pid == me:
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\x00", b" ").decode(errors="replace")
+            with open(f"/proc/{pid}/stat") as f:
+                # field 4 (after the parenthesised comm) is ppid
+                ppid = int(f.read().rsplit(")", 1)[1].split()[1])
+        except (OSError, IndexError, ValueError):
+            continue
+        stale = ppid == 1 and (
+            "ray_tpu._private.worker" in cmd
+            or ("bench.py" in cmd and ("--child" in cmd or "--probe" in cmd)))
+        if stale:
+            print(f"reaping orphan {pid}: {cmd[:120]}", file=sys.stderr)
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+
+
+def _run_child(args: list[str], extra_env: dict, timeout_s: float):
+    """Run `bench.py <args>` in its own session; return (rc, stdout, stderr).
+    rc None = timeout. The whole process group is killed on timeout so a
+    wedged backend handshake can't leak a chip-holding grandchild."""
+    env = {**os.environ, **extra_env}
+    proc = subprocess.Popen(
+        [sys.executable, "-u", os.path.abspath(__file__)] + args,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        env=env, start_new_session=True, text=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+        return proc.returncode, out, err
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            proc.kill()
+        out, err = proc.communicate()
+        return None, out, err
+
+
+def _extract_json_line(out: str):
+    for line in reversed(out.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                d = json.loads(line)
+                if {"metric", "value", "unit", "vs_baseline"} <= set(d):
+                    return d
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def _probe_tpu() -> bool:
+    """One probe attempt: does the backend come up with a non-cpu device?
+    (A TPU-init failure that silently falls back to CPU must count as a
+    failed probe, or the retry machinery never engages.)"""
+    rc, out, err = _run_child(["--probe"], {}, PROBE_TIMEOUT_S)
+    ok = rc == 0 and "PROBE_OK" in out and "'cpu'" not in out
+    if not ok:
+        tail = "\n".join((err or "").strip().splitlines()[-3:])
+        print(f"probe: rc={rc} out={out.strip()!r} tail={tail!r}",
+              file=sys.stderr)
+    return ok
+
+
+def supervise() -> int:
+    expect_tpu = os.environ.get("JAX_PLATFORMS", "") != "cpu"
+    history = []
+    if expect_tpu:
+        for attempt in range(ATTEMPTS):
+            _reap_stale_chip_claimants()
+            t0 = time.time()
+            # Cheap probe first: a wedged tunnel hangs at backend init, so
+            # a failed attempt costs PROBE_TIMEOUT_S, not the bench budget.
+            if _probe_tpu():
+                rc, out, err = _run_child(["--child"], {}, CHILD_TIMEOUT_S)
+                result = _extract_json_line(out)
+                if result is not None:
+                    sys.stderr.write(err)
+                    return _finish_with_flash_pass(result)
+                stage = "bench"
+            else:
+                stage = "probe"
+                rc, err = None, ""
+            took = time.time() - t0
+            tail = "\n".join((err or "").strip().splitlines()[-4:])
+            history.append(f"attempt {attempt + 1} ({stage}): rc={rc} "
+                           f"took={took:.0f}s tail={tail!r}")
+            print(history[-1], file=sys.stderr)
+            if attempt < ATTEMPTS - 1:
+                time.sleep(BACKOFF_S[attempt])
+        print("TPU backend unavailable after retries; "
+              "falling back to CPU smoke", file=sys.stderr)
+
+    # CPU-backend smoke (explicit CPU env, or TPU never came up): the round
+    # still records a valid, parseable measurement (clearly labeled).
+    rc, out, err = _run_child(
+        ["--child"],
+        {"JAX_PLATFORMS": "cpu",
+         "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
+                       + " --xla_force_host_platform_device_count=1").strip()},
+        CHILD_TIMEOUT_S)
+    result = _extract_json_line(out)
+    sys.stderr.write(err if rc is not None else "(cpu fallback timed out)\n")
+    if result is not None:
+        if expect_tpu:
+            result["tpu_unavailable"] = True
+        print(json.dumps(result))
+        return 0
+    # Even the CPU path failed — emit a diagnostic JSON line, not a traceback.
+    print(json.dumps({
+        "metric": "bench_backend_unavailable",
+        "value": 0.0,
+        "unit": "tokens/s/chip",
+        "vs_baseline": 0.0,
+        "error": "; ".join(history)[-1500:],
     }))
+    return 0
+
+
+def _finish_with_flash_pass(base: dict) -> int:
+    """Base TPU result in hand; try the Pallas-flash config in its own
+    child (a flash hang/failure can't lose the base measurement) and
+    report whichever is faster."""
+    best = base
+    rc, out, err = _run_child(["--child", "--flash"], {}, CHILD_TIMEOUT_S)
+    flash = _extract_json_line(out)
+    if flash is not None:
+        sys.stderr.write(err)
+        print(f"flash delta: {flash['value']/base['value'] - 1:+.1%} "
+              f"(MFU {base.get('mfu', 0)*100:.1f}% -> "
+              f"{flash.get('mfu', 0)*100:.1f}%)", file=sys.stderr)
+        if flash["value"] > base["value"]:
+            best = flash
+    else:
+        tail = "\n".join((err or "").strip().splitlines()[-4:])
+        print(f"flash config failed: rc={rc} tail={tail!r}", file=sys.stderr)
+    print(json.dumps(best))
+    return 0
+
+
+def main():
+    if "--probe" in sys.argv:
+        import jax
+
+        devs = jax.devices()
+        print(f"probe devices: {devs}", file=sys.stderr)
+        print("PROBE_OK", [d.platform for d in devs])
+        return 0
+    if "--child" in sys.argv:
+        print(json.dumps(run_bench(use_flash="--flash" in sys.argv)))
+        return 0
+    return supervise()
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -172,7 +172,20 @@ def forward(params, tokens, cfg: GPTConfig, mesh: Optional[Mesh] = None,
     rules = {**DEFAULT_RULES, **ACT_RULES, **(rules or {})}
     dt = cfg.dtype
     b, s = tokens.shape
-    x = params["wte"].astype(dt)[tokens] + params["wpe"].astype(dt)[:s]
+    wte = params["wte"].astype(dt)
+    if mesh is not None:
+        tokens = _constrain(tokens, ("batch", "seq"), mesh, rules)
+        # Replicate the table before the lookup: a gather from a
+        # vocab/embed-sharded table cannot yield batch-sharded output
+        # without XLA's "involuntary full rematerialization" of the
+        # activation; one hoisted all-gather of the (modest) table is the
+        # cheap way to cross that sharding boundary. The logits matmul
+        # below still consumes the sharded table.
+        wte_lookup = jax.lax.with_sharding_constraint(
+            wte, NamedSharding(mesh, P(None, None)))
+    else:
+        wte_lookup = wte
+    x = wte_lookup[tokens] + params["wpe"].astype(dt)[:s]
     x = _constrain(x, ("batch", "seq", "embed_act"), mesh, rules)
 
     block_fn = functools.partial(_block, cfg=cfg, mesh=mesh, rules=rules)

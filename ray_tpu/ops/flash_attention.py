@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from .attention import NEG_INF
 
 DEFAULT_BLOCK = 512
+_PALLAS_FALLBACK_WARNED = False
 
 
 def flash_attention(q, k, v, *, causal: bool = True,
@@ -34,14 +35,33 @@ def flash_attention(q, k, v, *, causal: bool = True,
     Softmax statistics are computed in f32; inputs may be bf16.
     """
     if use_pallas is None:
-        use_pallas = jax.default_backend() not in ("cpu",)
+        # TPU-shaped backends only (the axon tunnel reports its own name);
+        # gpu/cpu lower the reference path instead of a TPU Mosaic kernel.
+        use_pallas = jax.default_backend() not in ("cpu", "gpu", "cuda",
+                                                   "rocm", "METAL")
     if use_pallas:
-        try:
-            from .pallas.flash import flash_attention_pallas
+        from .pallas.flash import flash_attention_pallas
 
-            return flash_attention_pallas(q, k, v, causal=causal)
-        except Exception:
-            pass  # fall back to the reference implementation
+        try:
+            return flash_attention_pallas(
+                q, k, v, causal=causal,
+                block_q=block_size, block_k=block_size)
+        except Exception as e:  # noqa: BLE001
+            # Loud, once-per-process fallback: a kernel lowering failure
+            # must not abort training, but it must not hide either (a
+            # silent fallback here is how round 1 shipped a phantom
+            # kernel).
+            global _PALLAS_FALLBACK_WARNED
+            if not _PALLAS_FALLBACK_WARNED:
+                _PALLAS_FALLBACK_WARNED = True
+                import warnings
+
+                warnings.warn(
+                    f"Pallas flash attention failed ({e!r}); falling back "
+                    f"to the jax blockwise reference implementation",
+                    RuntimeWarning, stacklevel=2)
+            return _flash_reference(q, k, v, causal=causal,
+                                    block_size=block_size)
     return _flash_reference(q, k, v, causal=causal, block_size=block_size)
 
 

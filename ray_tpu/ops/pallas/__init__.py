@@ -1,0 +1,8 @@
+"""Pallas TPU kernels for the hot ops (flash attention et al.).
+
+These are the hand-scheduled VMEM-resident paths; every kernel has a pure
+jax reference implementation next to it that serves as the CPU fallback
+and the ground truth in tests.
+"""
+
+from .flash import flash_attention_pallas  # noqa: F401

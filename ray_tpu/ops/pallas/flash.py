@@ -1,0 +1,169 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Blockwise online-softmax attention with the running max / denominator /
+accumulator resident in VMEM (carried through the key-block loop), one
+MXU matmul per (q-block, k-block) pair plus one for the PV product.
+Causal programs skip key blocks strictly above the diagonal — the inner
+loop bound is computed from the q-block index, so the causal kernel does
+~half the work of the dense one.
+
+Layout: q,k,v arrive as [batch, seq, heads, head_dim] (the model's native
+layout) and are blocked as (1, blk, 1, d) tiles directly — no transpose.
+K/V for the whole (batch, head) stay VMEM-resident across q-blocks (their
+BlockSpec index does not depend on the q grid dimension, so Pallas keeps
+the block loaded).
+
+Backward: `jax.custom_vjp` whose bwd recomputes through the pure-jax
+blockwise reference (O(seq) memory). Forward is the perf-critical path in
+training (the bwd is matmul-dominated and XLA-fused); a hand-written bwd
+kernel can slot in later without changing the API.
+
+The reference framework has no attention kernels at all (it orchestrates
+external libs; see SURVEY §2.4 — ring/flash attention are "not
+implemented" upstream). This kernel is part of our native model stack.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ..attention import NEG_INF
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, blk_q: int, blk_k: int,
+                nk: int, orig_sk: int, causal: bool, scale: float):
+    qi = pl.program_id(2)
+    q = q_ref[0, :, 0, :]                      # (blk_q, d), input dtype
+    d = q.shape[-1]
+
+    m0 = jnp.full((blk_q, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((blk_q, 1), jnp.float32)
+    acc0 = jnp.zeros((blk_q, d), jnp.float32)
+
+    q_pos = qi * blk_q + jax.lax.broadcasted_iota(
+        jnp.int32, (blk_q, blk_k), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(j * blk_k, blk_k), 0, :]   # (blk_k, d)
+        v_blk = v_ref[0, pl.ds(j * blk_k, blk_k), 0, :]
+        # q·kᵀ on the MXU in input precision, accumulated f32.
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (blk_q, blk_k)
+        k_pos = j * blk_k + jax.lax.broadcasted_iota(
+            jnp.int32, (blk_q, blk_k), 1)
+        mask = k_pos < orig_sk                 # padded keys contribute 0
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(-1, keepdims=True))
+        p = jnp.exp(s - m_new)                 # (blk_q, blk_k) f32
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (blk_q, d)
+        acc_new = acc * corr + pv
+        return m_new, l_new, acc_new
+
+    if causal:
+        # Key blocks strictly above the diagonal never contribute.
+        upper = jnp.minimum(((qi + 1) * blk_q + blk_k - 1) // blk_k, nk)
+    else:
+        upper = nk
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+    o_ref[0, :, 0, :] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _pad_seq(x, blk):
+    pad = (-x.shape[1]) % blk
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    return x
+
+
+def _fwd(q, k, v, *, causal: bool, blk_q: int, blk_k: int, interpret: bool):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    blk_q = min(blk_q, max(sq, 8))
+    blk_k = min(blk_k, max(sk, 8))
+    qp = _pad_seq(q, blk_q)
+    kp = _pad_seq(k, blk_k)
+    vp = _pad_seq(v, blk_k)
+    sq_p, sk_p = qp.shape[1], kp.shape[1]
+    nq, nk = sq_p // blk_q, sk_p // blk_k
+    scale = d ** -0.5
+
+    kernel = functools.partial(
+        _fwd_kernel, blk_q=blk_q, blk_k=blk_k, nk=nk, orig_sk=sk,
+        causal=causal, scale=scale)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq),
+        in_specs=[
+            pl.BlockSpec((1, blk_q, 1, d), lambda bi, hi, qi: (bi, qi, hi, 0)),
+            pl.BlockSpec((1, sk_p, 1, d), lambda bi, hi, qi: (bi, 0, hi, 0)),
+            pl.BlockSpec((1, sk_p, 1, d), lambda bi, hi, qi: (bi, 0, hi, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, blk_q, 1, d), lambda bi, hi, qi: (bi, qi, hi, 0)),
+        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :sq]
+
+
+@functools.lru_cache(maxsize=None)
+def _make_op(causal: bool, blk_q: int, blk_k: int, interpret: bool):
+    @jax.custom_vjp
+    def op(q, k, v):
+        return _fwd(q, k, v, causal=causal, blk_q=blk_q, blk_k=blk_k,
+                    interpret=interpret)
+
+    def fwd(q, k, v):
+        return op(q, k, v), (q, k, v)
+
+    def bwd(res, g):
+        # Recompute through the pure-jax blockwise reference: O(seq)
+        # memory, matmul-dominated, XLA-fused. Ground truth for the
+        # forward kernel in tests, so fwd/bwd stay consistent.
+        from ..flash_attention import _flash_reference
+
+        q, k, v = res
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: _flash_reference(
+                q_, k_, v_, causal=causal, block_size=blk_k), q, k, v)
+        return vjp(g)
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
+def flash_attention_pallas(q, k, v, *, causal: bool = True,
+                           block_q: int = DEFAULT_BLOCK_Q,
+                           block_k: int = DEFAULT_BLOCK_K,
+                           interpret: bool | None = None):
+    """q,k,v: [batch, seq, heads, head_dim] -> same shape as q.
+
+    GQA (fewer kv heads) is expanded before the kernel. ``interpret=None``
+    auto-selects interpreter mode off-TPU so the same kernel is testable
+    on the CPU backend.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    hq, hk = q.shape[2], k.shape[2]
+    if hq != hk:
+        if hq % hk:
+            raise ValueError(f"GQA requires heads({hq}) % kv_heads({hk})==0")
+        k = jnp.repeat(k, hq // hk, axis=2)
+        v = jnp.repeat(v, hq // hk, axis=2)
+    op = _make_op(causal, block_q, block_k, interpret)
+    return op(q, k, v)
