@@ -163,6 +163,12 @@ class ActorClass:
             merged["scheduling_strategy"] = (
                 SchedulingStrategy(kind=s) if isinstance(s, str) else s
             )
+        if "placement_group" in overrides:
+            pg = overrides.pop("placement_group")
+            idx = int(overrides.pop("placement_group_bundle_index", -1))
+            if pg is not None:
+                merged["scheduling_strategy"] = SchedulingStrategy(
+                    kind="pg", pg_id=pg.id, pg_bundle_index=idx)
         overrides.pop("lifetime", None)
         merged.update(overrides)
         return ActorClass(self._cls, **merged)

@@ -68,6 +68,14 @@ class Config:
     controller_port: int = _cfg(0)  # 0 = unix socket only
     pubsub_poll_timeout_s: float = _cfg(60.0)
     kv_max_value_bytes: int = _cfg(512 * 1024 * 1024)
+    # Multi-node: head bind host, node heartbeat cadence, death detection.
+    head_host: str = _cfg("127.0.0.1")
+    heartbeat_interval_s: float = _cfg(0.25)
+    node_death_timeout_s: float = _cfg(3.0)
+    node_register_timeout_s: float = _cfg(30.0)
+    # A locally-feasible task waiting longer than this with zero local
+    # capacity is offered to the head for spillback to another node.
+    spillback_delay_s: float = _cfg(0.2)
 
     # --- metrics / events ---
     metrics_export_interval_s: float = _cfg(5.0)

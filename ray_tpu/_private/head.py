@@ -1,0 +1,628 @@
+"""Head service — the cluster control plane (GCS equivalent).
+
+Capability parity target: the reference's GcsServer
+(/root/reference/src/ray/gcs/gcs_server/gcs_server.h:78) composing node
+membership + health checks (gcs_health_check_manager.h:39), the internal
+KV / function table (gcs_kv_manager), the named-actor directory
+(gcs_actor_manager.h), cluster-wide scheduling decisions
+(gcs_actor_scheduler.h) and placement-group bundle reservation 2PC
+(gcs_placement_group_scheduler.h).
+
+Deployment shape: the head runs on the driver's asyncio loop (the driver
+node *is* the head node, like `ray start --head`). Worker nodes dial in
+over TCP (`ray_tpu._private.node_main`), register, heartbeat their
+available resources, and receive pushes (node-death broadcasts) over the
+same duplex connection. The driver's own NodeService talks to the head
+through direct in-process calls (`LocalHeadClient`) — same interface, no
+socket hop.
+
+TPU-native note: scheduling treats resource *shapes* (e.g. {"TPU": 4} or
+{"slice-v5e-16": 1}) atomically; a TPU slice is a gang by construction, so
+bundle reservation (placement groups) is the primary placement primitive
+rather than an add-on (SURVEY §7 stage 3).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from .config import get_config
+from .ids import ActorID, NodeID, PlacementGroupID
+from .rpc import ConnectionLost, DuplexServer, ServerConn
+
+ALIVE, DEAD = "ALIVE", "DEAD"
+
+
+@dataclass
+class NodeEntry:
+    node_id: NodeID
+    address: tuple  # (host, port) where the node's peer server listens
+    resources: dict  # totals
+    available: dict  # last heartbeat snapshot
+    state: str = ALIVE
+    is_head_node: bool = False
+    conn: Optional[ServerConn] = None  # node -> head connection (push channel)
+    last_heartbeat: float = field(default_factory=time.monotonic)
+    # PG bundle reservations on this node: (pg_id, bundle_idx) -> resources
+    reservations: dict = field(default_factory=dict)
+
+
+@dataclass
+class PGEntry:
+    pg_id: PlacementGroupID
+    bundles: list  # list[dict]
+    strategy: str
+    state: str = "PENDING"  # PENDING / CREATED / REMOVED
+    # bundle_idx -> NodeID (filled when reserved)
+    placement: dict = field(default_factory=dict)
+    ready_event: Optional[asyncio.Event] = None
+
+
+class HeadService:
+    """Cluster tables + policy. All state owned by one asyncio loop."""
+
+    def __init__(self, session_id: str, loop: asyncio.AbstractEventLoop,
+                 port: int = 0):
+        self.cfg = get_config()
+        self.session_id = session_id
+        self.loop = loop
+        self.nodes: dict[NodeID, NodeEntry] = {}
+        self.kv: dict[str, Any] = {}
+        self.functions: dict[str, bytes] = {}
+        self.named_actors: dict[str, dict] = {}  # name -> {actor_id, node_id, methods}
+        self.actor_nodes: dict[ActorID, NodeID] = {}
+        self.placement_groups: dict[PlacementGroupID, PGEntry] = {}
+        self._local_node_service = None  # driver node (in-process)
+        self.server = DuplexServer(
+            (self.cfg.head_host, port), self._handle_rpc, self._on_disconnect)
+        self._monitor_task: Optional[asyncio.Task] = None
+        self._closing = False
+
+    async def start(self):
+        await self.server.start()
+        self._monitor_task = self.loop.create_task(self._health_monitor())
+
+    @property
+    def address(self) -> tuple:
+        return self.server.address
+
+    def attach_local_node(self, node_service, entry: NodeEntry):
+        """The driver process's own NodeService (head node)."""
+        self._local_node_service = node_service
+        self.nodes[entry.node_id] = entry
+
+    # ------------------------------------------------------------------
+    # Membership & health
+    # ------------------------------------------------------------------
+    def register_node(self, node_id: NodeID, address: tuple, resources: dict,
+                      conn: Optional[ServerConn]) -> dict:
+        entry = NodeEntry(
+            node_id=node_id, address=tuple(address),
+            resources=dict(resources), available=dict(resources), conn=conn)
+        self.nodes[node_id] = entry
+        if conn is not None:
+            conn.meta["node_id"] = node_id
+        self._notify_membership()
+        return {"session_id": self.session_id,
+                "head_address": self.address}
+
+    def heartbeat(self, node_id: NodeID, available: dict):
+        entry = self.nodes.get(node_id)
+        if entry is None or entry.state == DEAD:
+            return False  # node should re-register (head restarted / expired)
+        entry.available = dict(available)
+        entry.last_heartbeat = time.monotonic()
+        return True
+
+    async def _health_monitor(self):
+        """Mark nodes dead on heartbeat silence (reference:
+        GcsHealthCheckManager probes; here the node pushes, we watch the
+        clock — same failure bound, fewer RPCs)."""
+        while not self._closing:
+            await asyncio.sleep(self.cfg.heartbeat_interval_s)
+            now = time.monotonic()
+            for entry in list(self.nodes.values()):
+                if entry.state == ALIVE and not entry.is_head_node \
+                        and entry.conn is not None \
+                        and now - entry.last_heartbeat > self.cfg.node_death_timeout_s:
+                    await self._mark_node_dead(entry, "heartbeat timeout")
+
+    async def _on_disconnect(self, conn: ServerConn):
+        node_id = conn.meta.get("node_id")
+        if node_id is None or self._closing:
+            return
+        entry = self.nodes.get(node_id)
+        if entry is not None and entry.state == ALIVE:
+            await self._mark_node_dead(entry, "connection lost")
+
+    async def _mark_node_dead(self, entry: NodeEntry, cause: str):
+        entry.state = DEAD
+        entry.available = {}
+        # Drop directory entries that pointed at the dead node.
+        for name in [n for n, info in self.named_actors.items()
+                     if info["node_id"] == entry.node_id]:
+            del self.named_actors[name]
+        for aid in [a for a, n in self.actor_nodes.items()
+                    if n == entry.node_id]:
+            del self.actor_nodes[aid]
+        for pg in self.placement_groups.values():
+            lost = [i for i, nid in pg.placement.items()
+                    if nid == entry.node_id]
+            if not lost:
+                continue
+            # A group that lost bundles goes back to PENDING and is
+            # re-placed wholesale (reference: GCS reschedules the group on
+            # node death); surviving reservations are released first so
+            # the fresh placement starts from a clean slate.
+            for idx, nid in list(pg.placement.items()):
+                if nid == entry.node_id:
+                    del pg.placement[idx]
+                    entry.reservations.pop((pg.pg_id, idx), None)
+                    continue
+                surv = self.nodes.get(nid)
+                if surv is None:
+                    del pg.placement[idx]
+                    continue
+                res = surv.reservations.pop((pg.pg_id, idx), None)
+                del pg.placement[idx]
+                if res and surv.state == ALIVE:
+                    for k, v in res.items():
+                        surv.available[k] = surv.available.get(k, 0) + v
+                    if surv.is_head_node and self._local_node_service:
+                        self._local_node_service.release_bundle(pg.pg_id, idx)
+                    elif surv.conn is not None:
+                        try:
+                            await surv.conn.notify(
+                                "release_bundle",
+                                {"pg_id": pg.pg_id.binary(),
+                                 "bundle_index": idx})
+                        except (ConnectionLost, OSError):
+                            pass
+            if pg.state == "CREATED":
+                pg.state = "PENDING"
+                if pg.ready_event is not None:
+                    pg.ready_event.clear()
+        self._notify_membership()
+        # Broadcast so owners can fail/retry work on the dead node.
+        await self._broadcast("node_dead",
+                              {"node_id": entry.node_id.binary(),
+                               "cause": cause})
+
+    def _notify_membership(self):
+        pass  # hook for the state API / dashboard (observability MVP)
+
+    async def _broadcast(self, method: str, payload):
+        if self._local_node_service is not None:
+            await self._local_node_service.on_head_push(method, payload)
+        for entry in self.nodes.values():
+            if entry.conn is not None and entry.state == ALIVE:
+                try:
+                    await entry.conn.notify(method, payload)
+                except (ConnectionLost, OSError):
+                    pass
+
+    # ------------------------------------------------------------------
+    # Scheduling policy (cluster-wide placement)
+    # ------------------------------------------------------------------
+    def _feasible(self, entry: NodeEntry, resources: dict) -> bool:
+        return entry.state == ALIVE and all(
+            entry.resources.get(k, 0) >= v for k, v in resources.items())
+
+    def _has_available(self, entry: NodeEntry, resources: dict) -> bool:
+        return all(entry.available.get(k, 0) >= v
+                   for k, v in resources.items())
+
+    def schedule(self, resources: dict, strategy_kind: str = "default",
+                 exclude: Optional[set] = None) -> Optional[NodeID]:
+        """Pick a node for a task/actor with the given resource demand.
+
+        Hybrid policy (reference: hybrid_scheduling_policy.h:50): pack onto
+        the busiest node that still has availability while utilization is
+        below the spread threshold, else spread to the least utilized.
+        "spread" forces least-utilized.
+        """
+        exclude = exclude or set()
+        candidates = [e for e in self.nodes.values()
+                      if e.node_id not in exclude
+                      and self._feasible(e, resources)]
+        if not candidates:
+            return None
+        with_room = [e for e in candidates
+                     if self._has_available(e, resources)]
+        pool = with_room or candidates
+
+        def utilization(e: NodeEntry) -> float:
+            scores = []
+            for k, total in e.resources.items():
+                if total > 0:
+                    scores.append(1.0 - e.available.get(k, 0) / total)
+            return max(scores) if scores else 0.0
+
+        if strategy_kind == "spread":
+            return min(pool, key=utilization).node_id
+        # hybrid: pack (most utilized under threshold) else spread
+        under = [e for e in pool
+                 if utilization(e) < self.cfg.scheduler_spread_threshold]
+        if under:
+            return max(under, key=utilization).node_id
+        return min(pool, key=utilization).node_id
+
+    def node_address(self, node_id: NodeID) -> Optional[tuple]:
+        e = self.nodes.get(node_id)
+        return e.address if e is not None and e.state == ALIVE else None
+
+    # ------------------------------------------------------------------
+    # Placement groups — cluster-wide bundle reservation (2PC-lite)
+    # ------------------------------------------------------------------
+    async def create_placement_group(self, pg_id: PlacementGroupID,
+                                     bundles: list, strategy: str) -> PGEntry:
+        pg = PGEntry(pg_id=pg_id, bundles=[dict(b) for b in bundles],
+                     strategy=strategy, ready_event=asyncio.Event())
+        self.placement_groups[pg_id] = pg
+        await self._try_place_pg(pg)
+        return pg
+
+    async def _try_place_pg(self, pg: PGEntry):
+        """Reserve every bundle or nothing (prepare/commit in one pass —
+        single-loop head owns all reservation state, so prepare==commit;
+        the reference needs true 2PC because raylets own their resources:
+        node_manager.proto Prepare/CommitBundleResources)."""
+        if pg.state != "PENDING":
+            return
+        # Work on a scratch copy of availability so a failed attempt
+        # leaves nothing reserved.
+        avail = {e.node_id: dict(e.available) for e in self.nodes.values()
+                 if e.state == ALIVE}
+        placement: dict[int, NodeID] = {}
+
+        def fits(nid, res):
+            a = avail[nid]
+            return all(a.get(k, 0) >= v for k, v in res.items())
+
+        def take(nid, res):
+            a = avail[nid]
+            for k, v in res.items():
+                a[k] = a.get(k, 0) - v
+
+        node_ids = list(avail.keys())
+        ok = True
+        for idx, res in enumerate(pg.bundles):
+            if pg.strategy in ("PACK", "STRICT_PACK"):
+                order = sorted(
+                    node_ids,
+                    key=lambda n: sum(1 for i in placement.values() if i == n),
+                    reverse=True)
+            else:  # SPREAD / STRICT_SPREAD: prefer nodes not yet used
+                order = sorted(
+                    node_ids,
+                    key=lambda n: sum(1 for i in placement.values() if i == n))
+            placed = False
+            for nid in order:
+                if pg.strategy == "STRICT_SPREAD" and nid in placement.values():
+                    continue
+                if pg.strategy == "STRICT_PACK" and placement \
+                        and nid not in placement.values():
+                    continue
+                if fits(nid, res):
+                    take(nid, res)
+                    placement[idx] = nid
+                    placed = True
+                    break
+            if not placed:
+                ok = False
+                break
+        if not ok:
+            return  # stays PENDING; retried on membership/resource change
+        # Commit: record reservations and subtract from live availability.
+        pg.placement = placement
+        pg.state = "CREATED"
+        for idx, nid in placement.items():
+            entry = self.nodes[nid]
+            res = pg.bundles[idx]
+            entry.reservations[(pg.pg_id, idx)] = dict(res)
+            for k, v in res.items():
+                entry.available[k] = entry.available.get(k, 0) - v
+            # Tell the node to set aside the bundle resources.
+            await self._reserve_on_node(entry, pg.pg_id, idx, res)
+        pg.ready_event.set()
+
+    async def _reserve_on_node(self, entry: NodeEntry, pg_id, idx, res):
+        if entry.is_head_node and self._local_node_service is not None:
+            self._local_node_service.reserve_bundle(pg_id, idx, res)
+        elif entry.conn is not None:
+            try:
+                await entry.conn.call(
+                    "reserve_bundle",
+                    {"pg_id": pg_id.binary(), "bundle_index": idx,
+                     "resources": res})
+            except (ConnectionLost, OSError):
+                pass
+
+    async def remove_placement_group(self, pg_id: PlacementGroupID):
+        pg = self.placement_groups.pop(pg_id, None)
+        if pg is None:
+            return
+        pg.state = "REMOVED"
+        for idx, nid in pg.placement.items():
+            entry = self.nodes.get(nid)
+            if entry is None:
+                continue
+            res = entry.reservations.pop((pg_id, idx), None)
+            if res and entry.state == ALIVE:
+                for k, v in res.items():
+                    entry.available[k] = entry.available.get(k, 0) + v
+                if entry.is_head_node and self._local_node_service is not None:
+                    self._local_node_service.release_bundle(pg_id, idx)
+                elif entry.conn is not None:
+                    try:
+                        await entry.conn.notify(
+                            "release_bundle",
+                            {"pg_id": pg_id.binary(), "bundle_index": idx})
+                    except (ConnectionLost, OSError):
+                        pass
+
+    def pg_state(self, pg_id: PlacementGroupID) -> Optional[dict]:
+        pg = self.placement_groups.get(pg_id)
+        if pg is None:
+            return None
+        return {"state": pg.state,
+                "placement": {i: n.binary() for i, n in pg.placement.items()},
+                "bundles": pg.bundles,
+                "strategy": pg.strategy}
+
+    async def retry_pending_pgs(self):
+        for pg in self.placement_groups.values():
+            if pg.state == "PENDING":
+                await self._try_place_pg(pg)
+
+    # ------------------------------------------------------------------
+    # KV / functions / named actors
+    # ------------------------------------------------------------------
+    def kv_op(self, op: str, key: str, val=None):
+        if op == "put":
+            self.kv[key] = val
+            return True
+        if op == "get":
+            return self.kv.get(key)
+        if op == "del":
+            return self.kv.pop(key, None) is not None
+        if op == "exists":
+            return key in self.kv
+        if op == "keys":
+            return [k for k in self.kv if k.startswith(key)]
+        raise ValueError(f"bad kv op {op}")
+
+    def register_named_actor(self, name: str, actor_id: ActorID,
+                             node_id: NodeID, methods: list) -> bool:
+        if name in self.named_actors:
+            return False
+        self.named_actors[name] = {
+            "actor_id": actor_id.binary(), "node_id": node_id.binary(),
+            "methods": methods}
+        self.actor_nodes[actor_id] = node_id
+        return True
+
+    def unregister_named_actor(self, name: str, actor_id: ActorID):
+        info = self.named_actors.get(name)
+        if info is not None and info["actor_id"] == actor_id.binary():
+            del self.named_actors[name]
+
+    def record_actor_node(self, actor_id: ActorID, node_id: NodeID):
+        self.actor_nodes[actor_id] = node_id
+
+    def drop_actor(self, actor_id: ActorID):
+        self.actor_nodes.pop(actor_id, None)
+
+    # ------------------------------------------------------------------
+    # RPC surface (remote nodes over TCP)
+    # ------------------------------------------------------------------
+    async def _handle_rpc(self, conn: ServerConn, method: str, payload: Any):
+        if method == "register_node":
+            return self.register_node(
+                NodeID(payload["node_id"]), tuple(payload["address"]),
+                payload["resources"], conn)
+        if method == "heartbeat":
+            ok = self.heartbeat(NodeID(payload["node_id"]),
+                                payload["available"])
+            # Heartbeats double as the resource-view sync (reference:
+            # ray_syncer) — piggyback pending-PG retries on fresh info.
+            await self.retry_pending_pgs()
+            return ok
+        if method == "kv":
+            op, key, val = payload
+            return self.kv_op(op, key, val)
+        if method == "export_function":
+            fid, blob = payload
+            if blob is not None and fid not in self.functions:
+                self.functions[fid] = blob
+            return fid in self.functions
+        if method == "fetch_function":
+            return self.functions.get(payload)
+        if method == "schedule":
+            nid = self.schedule(payload["resources"],
+                                payload.get("strategy", "default"),
+                                {NodeID(b) for b in payload.get("exclude", [])})
+            if nid is None:
+                return None
+            return {"node_id": nid.binary(),
+                    "address": self.node_address(nid)}
+        if method == "node_address":
+            addr = self.node_address(NodeID(payload))
+            return addr
+        if method == "register_named_actor":
+            ok = self.register_named_actor(
+                payload["name"], ActorID(payload["actor_id"]),
+                NodeID(payload["node_id"]), payload.get("methods", []))
+            return ok
+        if method == "unregister_named_actor":
+            self.unregister_named_actor(payload["name"],
+                                        ActorID(payload["actor_id"]))
+            return True
+        if method == "get_actor_by_name":
+            return self.named_actors.get(payload)
+        if method == "record_actor_node":
+            self.record_actor_node(ActorID(payload["actor_id"]),
+                                   NodeID(payload["node_id"]))
+            return True
+        if method == "actor_node":
+            nid = self.actor_nodes.get(ActorID(payload))
+            return nid.binary() if nid is not None else None
+        if method == "list_nodes":
+            return [{"node_id": e.node_id.binary(), "address": e.address,
+                     "state": e.state, "resources": e.resources,
+                     "available": e.available,
+                     "is_head_node": e.is_head_node}
+                    for e in self.nodes.values()]
+        if method == "create_pg":
+            pg = await self.create_placement_group(
+                PlacementGroupID(payload["pg_id"]), payload["bundles"],
+                payload["strategy"])
+            return {"state": pg.state}
+        if method == "remove_pg":
+            await self.remove_placement_group(PlacementGroupID(payload))
+            return True
+        if method == "pg_state":
+            return self.pg_state(PlacementGroupID(payload))
+        raise RuntimeError(f"unknown head rpc: {method}")
+
+    async def shutdown(self):
+        self._closing = True
+        if self._monitor_task is not None:
+            self._monitor_task.cancel()
+        await self.server.stop()
+
+
+class LocalHeadClient:
+    """Head access for the node living in the same process/loop as the
+    head (the driver node) — direct calls, no socket hop."""
+
+    def __init__(self, head: HeadService):
+        self.head = head
+
+    async def kv_op(self, op, key, val=None):
+        return self.head.kv_op(op, key, val)
+
+    async def export_function(self, fid, blob):
+        if blob is not None and fid not in self.head.functions:
+            self.head.functions[fid] = blob
+        return True
+
+    async def fetch_function(self, fid):
+        return self.head.functions.get(fid)
+
+    async def schedule(self, resources, strategy="default", exclude=()):
+        # Exclusion is NodeID-keyed inside the head; callers hand us raw
+        # bytes (same wire shape as the RPC path) — normalize or the
+        # membership test silently never matches.
+        ex = {NodeID(b) if isinstance(b, (bytes, bytearray)) else b
+              for b in exclude}
+        nid = self.head.schedule(resources, strategy, ex)
+        if nid is None:
+            return None
+        return {"node_id": nid.binary(),
+                "address": self.head.node_address(nid)}
+
+    async def register_named_actor(self, name, actor_id, node_id, methods):
+        return self.head.register_named_actor(name, actor_id, node_id,
+                                              methods)
+
+    async def unregister_named_actor(self, name, actor_id):
+        self.head.unregister_named_actor(name, actor_id)
+
+    async def get_actor_by_name(self, name):
+        return self.head.named_actors.get(name)
+
+    async def record_actor_node(self, actor_id, node_id):
+        self.head.record_actor_node(actor_id, node_id)
+
+    async def actor_node(self, actor_id):
+        nid = self.head.actor_nodes.get(actor_id)
+        return nid.binary() if nid is not None else None
+
+    async def heartbeat(self, node_id, available):
+        ok = self.head.heartbeat(node_id, available)
+        await self.head.retry_pending_pgs()
+        return ok
+
+    async def list_nodes(self):
+        return [{"node_id": e.node_id.binary(), "address": e.address,
+                 "state": e.state, "resources": e.resources,
+                 "available": e.available, "is_head_node": e.is_head_node}
+                for e in self.head.nodes.values()]
+
+    async def create_pg(self, pg_id, bundles, strategy):
+        pg = await self.head.create_placement_group(pg_id, bundles, strategy)
+        return {"state": pg.state}
+
+    async def remove_pg(self, pg_id):
+        await self.head.remove_placement_group(pg_id)
+        return True
+
+    async def pg_state(self, pg_id):
+        return self.head.pg_state(pg_id)
+
+
+class RemoteHeadClient:
+    """Head access for worker nodes: TCP duplex connection; the same
+    connection carries head→node pushes (node_dead, reserve_bundle)."""
+
+    def __init__(self, conn: ServerConn):
+        self.conn = conn
+
+    async def kv_op(self, op, key, val=None):
+        return await self.conn.call("kv", (op, key, val))
+
+    async def export_function(self, fid, blob):
+        return await self.conn.call("export_function", (fid, blob))
+
+    async def fetch_function(self, fid):
+        return await self.conn.call("fetch_function", fid)
+
+    async def schedule(self, resources, strategy="default", exclude=()):
+        return await self.conn.call(
+            "schedule", {"resources": resources, "strategy": strategy,
+                         "exclude": [bytes(b) for b in exclude]})
+
+    async def register_named_actor(self, name, actor_id, node_id, methods):
+        return await self.conn.call(
+            "register_named_actor",
+            {"name": name, "actor_id": actor_id.binary(),
+             "node_id": node_id.binary(), "methods": methods})
+
+    async def unregister_named_actor(self, name, actor_id):
+        return await self.conn.call(
+            "unregister_named_actor",
+            {"name": name, "actor_id": actor_id.binary()})
+
+    async def get_actor_by_name(self, name):
+        return await self.conn.call("get_actor_by_name", name)
+
+    async def record_actor_node(self, actor_id, node_id):
+        return await self.conn.call(
+            "record_actor_node",
+            {"actor_id": actor_id.binary(), "node_id": node_id.binary()})
+
+    async def actor_node(self, actor_id):
+        return await self.conn.call("actor_node", actor_id.binary())
+
+    async def heartbeat(self, node_id, available):
+        return await self.conn.call(
+            "heartbeat", {"node_id": node_id.binary(),
+                          "available": available})
+
+    async def list_nodes(self):
+        return await self.conn.call("list_nodes", None)
+
+    async def create_pg(self, pg_id, bundles, strategy):
+        return await self.conn.call(
+            "create_pg", {"pg_id": pg_id.binary(), "bundles": bundles,
+                          "strategy": strategy})
+
+    async def remove_pg(self, pg_id):
+        return await self.conn.call("remove_pg", pg_id.binary())
+
+    async def pg_state(self, pg_id):
+        return await self.conn.call("pg_state", pg_id.binary())

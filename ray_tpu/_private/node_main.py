@@ -1,0 +1,89 @@
+"""Standalone worker-node process (`python -m ray_tpu._private.node_main`).
+
+Capability parity target: the reference raylet main
+(/root/reference/src/ray/raylet/main.cc) — a per-node daemon that
+registers with the head control plane, heartbeats, hosts a worker pool +
+object store, and executes work forwarded by owners.
+
+Spawned by `ray_tpu.cluster_utils.Cluster.add_node` (tests) or by cluster
+tooling. Environment contract:
+
+    RT_HEAD_ADDR       host:port of the head service
+    RT_SESSION_ID      cluster session id
+    RT_NODE_ID         hex node id chosen by the parent (optional)
+    RT_NODE_RESOURCES  json resource dict, e.g. {"CPU": 2, "x": 1}
+
+The process exits when the head connection drops (driver gone).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+
+from .config import get_config
+from .head import RemoteHeadClient
+from .ids import NodeID
+from .node_service import NodeService
+from .object_store import SharedMemoryStore
+from .rpc import async_connect
+
+
+async def amain():
+    head_host, head_port = os.environ["RT_HEAD_ADDR"].rsplit(":", 1)
+    head_addr = (head_host, int(head_port))
+    session_id = os.environ["RT_SESSION_ID"]
+    node_id = (NodeID.from_hex(os.environ["RT_NODE_ID"])
+               if os.environ.get("RT_NODE_ID") else NodeID.from_random())
+    resources = json.loads(os.environ.get("RT_NODE_RESOURCES", '{"CPU": 1}'))
+
+    # Per-node shm namespace: this node's workers mmap segments the node
+    # wrote, and vice versa; other nodes exchange bytes over the peer plane.
+    node_session = f"{session_id}-{node_id.hex()[:8]}"
+    shm = SharedMemoryStore(node_session)
+    sock_dir = os.environ.get("RT_SOCK_DIR", "/tmp")
+    sock_path = os.path.join(sock_dir, f"rtpu-{node_session}.sock")
+
+    loop = asyncio.get_running_loop()
+    node = NodeService(node_session, sock_path, resources, shm, loop,
+                       node_id=node_id, head=None, is_head_node=False)
+
+    async def handle_head_push(conn, method, payload):
+        await node.on_head_push(method, payload)
+        return True
+
+    async def on_head_lost(conn):
+        # Head gone => cluster gone; die rather than orphan.
+        sys.stderr.write(f"node {node_id.hex()[:12]}: head connection lost; "
+                         f"exiting\n")
+        os._exit(0)
+
+    conn = await async_connect(head_addr, handle_head_push, on_head_lost)
+    node.head = RemoteHeadClient(conn)
+    await node.start()
+
+    async def register():
+        await conn.call("register_node", {
+            "node_id": node_id.binary(),
+            "address": node.peer_address,
+            "resources": resources,
+        })
+
+    node.register_cb = register
+    await register()
+    sys.stderr.write(f"node {node_id.hex()[:12]} up: peer={node.peer_address} "
+                     f"resources={resources}\n")
+    # Park forever; work arrives via the peer server / head pushes.
+    await asyncio.Event().wait()
+
+
+def main():
+    # Worker nodes in the test cluster must not touch the TPU tunnel.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    main()

@@ -44,9 +44,9 @@ from .exceptions import (
     TaskError,
     WorkerCrashedError,
 )
-from .ids import ActorID, ObjectID, PlacementGroupID, TaskID, WorkerID
+from .ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
 from .object_store import SharedMemoryStore
-from .rpc import ConnectionLost, DuplexServer, ServerConn
+from .rpc import ConnectionLost, DuplexServer, ServerConn, async_connect
 from .task_spec import REF, VAL, SchedulingStrategy, TaskSpec
 
 PENDING, READY, ERROR = "PENDING", "READY", "ERROR"
@@ -95,29 +95,59 @@ class ActorState:
 
 
 @dataclass
-class PlacementGroup:
-    pg_id: PlacementGroupID
-    bundles: list  # list[dict resource->amount]
-    strategy: str = "PACK"
-    state: str = "CREATED"
+class RemoteActorEntry:
+    """Owner-side record of an actor living on another node (the actor's
+    ActorState lives on its home node; we route calls there and restart it
+    elsewhere when the node dies — reference: GcsActorManager restart FSM)."""
+
+    actor_id: ActorID
+    node_id: NodeID
+    address: tuple
+    creation_spec: Optional[TaskSpec] = None  # None => looked up by name
+    state: str = "ALIVE"  # ALIVE / RESTARTING / DEAD
+    num_restarts: int = 0
+    death_cause: Optional[str] = None
+    queue: collections.deque = field(default_factory=collections.deque)
+    pumping: bool = False
+    ready: Optional[asyncio.Event] = None
+
+
+@dataclass
+class BundlePool:
+    """Resources set aside on this node for one placement-group bundle."""
+
+    total: dict
+    available: dict
 
 
 class NodeService:
-    """Single-node scheduler + object directory + actor manager + KV."""
+    """Per-node scheduler + object directory + actor manager.
+
+    Multi-node shape (round 2): every node registers with the head
+    (head.py), heartbeats its availability, and exchanges work with peer
+    nodes over TCP: an owner forwards a fully-resolved TaskSpec with
+    ``remote_execute`` and the executor replies with result blobs
+    (reference: the lease/PushTask pipeline of direct_task_transport.h,
+    collapsed to one RPC because args are owner-resolved).
+    """
 
     def __init__(self, session_id: str, sock_path: str, resources: dict,
-                 shm_store: SharedMemoryStore, loop: asyncio.AbstractEventLoop):
+                 shm_store: SharedMemoryStore, loop: asyncio.AbstractEventLoop,
+                 node_id: NodeID | None = None, head=None,
+                 is_head_node: bool = True, peer_port: int = 0):
         self.cfg = get_config()
         self.session_id = session_id
         self.sock_path = sock_path
         self.loop = loop
         self.shm = shm_store
+        self.node_id = node_id or NodeID.from_random()
+        self.head = head  # LocalHeadClient | RemoteHeadClient | None
+        self.is_head_node = is_head_node
         self.total_resources = dict(resources)
         self.available = dict(resources)
 
         self.objects: dict[ObjectID, ObjectState] = {}
-        self.kv: dict[str, bytes] = {}
-        self.functions: dict[str, bytes] = {}
+        self.functions: dict[str, bytes] = {}  # local cache; source of truth: head
         self._fn_cache: dict[str, Any] = {}  # deserialized, device lane only
 
         self.workers: dict[WorkerID, WorkerHandle] = {}
@@ -126,9 +156,15 @@ class NodeService:
         self.cancelled: set[TaskID] = set()
 
         self.actors: dict[ActorID, ActorState] = {}
-        self.named_actors: dict[str, ActorID] = {}
+        self.remote_actors: dict[ActorID, RemoteActorEntry] = {}
 
-        self.placement_groups: dict[PlacementGroupID, PlacementGroup] = {}
+        # (pg_id, bundle_index) -> BundlePool reserved on this node.
+        self.bundles: dict[tuple, BundlePool] = {}
+
+        # Peer plumbing: node_id -> ServerConn (lazily dialed).
+        self.peer_conns: dict[NodeID, ServerConn] = {}
+        self.dead_nodes: set[NodeID] = set()
+        self._pending_remote: collections.deque = collections.deque()
 
         # Device lane: tasks with TPU resources (or strategy "device").
         self.device_pool = ThreadPoolExecutor(
@@ -136,7 +172,11 @@ class NodeService:
             thread_name_prefix="device-exec",
         )
         self.server = DuplexServer(sock_path, self._handle_rpc, self._on_disconnect)
+        # Peer-facing TCP server (object plane + remote execution).
+        self.peer_server = DuplexServer(
+            (self.cfg.head_host, peer_port), self._handle_peer_rpc, None)
         self._closing = False
+        self._bg_tasks: list[asyncio.Task] = []
         # metrics / introspection counters
         self.counters = collections.Counter()
         self.task_events: collections.deque = collections.deque(
@@ -145,6 +185,130 @@ class NodeService:
 
     async def start(self):
         await self.server.start()
+        await self.peer_server.start()
+        if self.head is not None:
+            self._bg_tasks.append(self.loop.create_task(self._heartbeat_loop()))
+            self._bg_tasks.append(
+                self.loop.create_task(self._pending_remote_loop()))
+
+    @property
+    def peer_address(self) -> tuple:
+        return self.peer_server.address
+
+    # ------------------------------------------------------------------
+    # Cluster plumbing: heartbeats, peers, head pushes
+    # ------------------------------------------------------------------
+    async def _heartbeat_loop(self):
+        while not self._closing:
+            try:
+                ok = await self.head.heartbeat(self.node_id, dict(self.available))
+                if ok is False:
+                    # Head lost track of us (restart/expiry): re-register.
+                    await self._register_with_head()
+            except (ConnectionLost, OSError):
+                pass
+            await asyncio.sleep(self.cfg.heartbeat_interval_s)
+
+    async def _register_with_head(self):
+        cb = getattr(self, "register_cb", None)
+        if cb is not None:
+            await cb()
+
+    async def _pending_remote_loop(self):
+        """Retry remote placements that found no feasible node (nodes may
+        join; resources free up)."""
+        while not self._closing:
+            await asyncio.sleep(0.25)
+            n = len(self._pending_remote)
+            for _ in range(n):
+                spec, exclude = self._pending_remote.popleft()
+                self.loop.create_task(self._execute_remotely(spec, exclude))
+
+    async def _addr_conn(self, address: tuple) -> ServerConn:
+        """Peer connection keyed by address (object-plane fetches from an
+        owner we only know by the address stamped into an ObjectRef)."""
+        if not hasattr(self, "_addr_conns"):
+            self._addr_conns = {}
+        address = tuple(address)
+        conn = self._addr_conns.get(address)
+        if conn is not None and conn.alive:
+            return conn
+
+        async def on_disc(c):
+            if self._addr_conns.get(address) is c:
+                del self._addr_conns[address]
+
+        conn = await async_connect(address, self._handle_peer_rpc, on_disc)
+        self._addr_conns[address] = conn
+        return conn
+
+    async def ensure_object(self, oid: ObjectID, owner_addr, timeout=None):
+        """Pull a copy of a foreign-owned object from its owner into the
+        local store (reference: PullManager/ObjectManager push-pull,
+        object_manager.h:117 — collapsed to one fetch RPC)."""
+        if owner_addr is None or tuple(owner_addr) == tuple(self.peer_address):
+            return
+        st = self._obj(oid)
+        if st.status != PENDING:
+            return
+        if not hasattr(self, "_fetching"):
+            self._fetching = set()
+        if oid in self._fetching:
+            return  # in-flight fetch will wake the waiters
+        self._fetching.add(oid)
+        try:
+            conn = await self._addr_conn(owner_addr)
+            res = await conn.call("fetch_object",
+                                  {"oid": oid.binary(), "timeout": timeout})
+            if st.status != PENDING:
+                return
+            if res[0] == "err":
+                self.mark_error(oid, res[1])
+            elif res[0] == "b":
+                self._ingest_result_blob(oid, res[1])
+            # ("timeout",): stays pending; the caller's own deadline rules.
+        except (ConnectionLost, OSError) as e:
+            self.mark_error(oid, ObjectLostError(
+                f"owner of {oid.hex()[:16]} unreachable: {e}"))
+        finally:
+            self._fetching.discard(oid)
+
+    async def _peer_conn(self, node_id: NodeID, address: tuple) -> ServerConn:
+        conn = self.peer_conns.get(node_id)
+        if conn is not None and conn.alive:
+            return conn
+
+        async def on_disc(c):
+            if self.peer_conns.get(node_id) is c:
+                del self.peer_conns[node_id]
+
+        conn = await async_connect(tuple(address), self._handle_peer_rpc,
+                                   on_disc)
+        conn.meta["node_id"] = node_id
+        self.peer_conns[node_id] = conn
+        return conn
+
+    async def on_head_push(self, method: str, payload):
+        """Pushes from the head (over the node's head connection, or direct
+        calls for the head node itself)."""
+        if method == "node_dead":
+            await self._on_node_dead(NodeID(payload["node_id"]),
+                                     payload.get("cause", ""))
+        elif method == "reserve_bundle":
+            self.reserve_bundle(PlacementGroupID(payload["pg_id"]),
+                                payload["bundle_index"], payload["resources"])
+        elif method == "release_bundle":
+            self.release_bundle(PlacementGroupID(payload["pg_id"]),
+                                payload["bundle_index"])
+
+    async def _on_node_dead(self, node_id: NodeID, cause: str):
+        self.dead_nodes.add(node_id)
+        conn = self.peer_conns.pop(node_id, None)
+        if conn is not None:
+            await conn.close()  # fails in-flight forwards -> retry paths
+        for entry in list(self.remote_actors.values()):
+            if entry.node_id == node_id and entry.state == "ALIVE":
+                await self._remote_actor_died(entry, f"node died: {cause}")
 
     # ------------------------------------------------------------------
     # Object directory
@@ -259,7 +423,7 @@ class NodeService:
     # Task submission & scheduling
     # ------------------------------------------------------------------
     def submit(self, spec: TaskSpec) -> list[ObjectID]:
-        """Register returns + enqueue. Loop thread only."""
+        """Register returns + route. Loop thread only."""
         rids = spec.return_ids()
         for rid in rids:
             st = self._obj(rid)
@@ -274,14 +438,123 @@ class NodeService:
             {"task_id": spec.task_id.hex(), "name": spec.name, "state": "SUBMITTED",
              "ts": time.time()}
         )
+        self._route(spec)
+        return rids
+
+    def _route(self, spec: TaskSpec):
+        """Decide where a spec runs: this node's queues, a pinned node, a
+        placement-group bundle's node, or head-chosen placement."""
+        if getattr(spec, "_remote", False):
+            # Forwarded to us by its owner — the routing decision is made.
+            self._enqueue_local(spec)
+            return
+        if spec.actor_id is not None and not spec.is_actor_creation:
+            if spec.actor_id in self.actors:
+                self._submit_actor_task(spec)
+            elif spec.actor_id in self.remote_actors:
+                self._enqueue_remote_actor_task(
+                    self.remote_actors[spec.actor_id], spec)
+            else:
+                self.loop.create_task(self._route_unknown_actor_task(spec))
+            return
+        strat = spec.strategy
+        if strat.kind == "node" and strat.node_id is not None \
+                and strat.node_id != self.node_id.binary():
+            self.loop.create_task(self._execute_remotely(
+                spec, pin_node=NodeID(strat.node_id)))
+            return
+        if strat.kind == "pg" and strat.pg_id is not None:
+            self.loop.create_task(self._route_pg_task(spec))
+            return
+        needs_placement = (strat.kind == "spread"
+                           or not self._locally_feasible(spec))
+        if needs_placement and self.head is not None:
+            if spec.is_actor_creation:
+                self.loop.create_task(self._create_actor_remotely(spec))
+            else:
+                self.loop.create_task(self._execute_remotely(spec))
+            return
+        self._enqueue_local(spec)
+
+    def _enqueue_local(self, spec: TaskSpec):
         if spec.is_actor_creation:
             self.loop.create_task(self._create_actor(spec))
         elif spec.actor_id is not None:
             self._submit_actor_task(spec)
         else:
+            spec._pending_since = time.monotonic()
             self.pending_cpu.append(spec)
             self._kick()
-        return rids
+
+    def _locally_feasible(self, spec: TaskSpec) -> bool:
+        if self._is_device_task(spec):
+            # The device lane exists wherever this process owns chips (or
+            # the CPU jax backend in tests); "device" resource advertises it.
+            if spec.resources.get("TPU", 0) > 0:
+                return self.total_resources.get("TPU", 0) >= spec.resources["TPU"]
+            return self.total_resources.get("device", 0) > 0
+        return all(self.total_resources.get(k, 0) >= v
+                   for k, v in spec.resources.items() if v > 0)
+
+    async def _route_pg_task(self, spec: TaskSpec):
+        """Placement-group tasks run where their bundle is reserved."""
+        try:
+            info = await self.head.pg_state(spec.strategy.pg_id)
+        except (ConnectionLost, OSError):
+            info = None
+        if info is None or info["state"] != "CREATED":
+            self._fail_task(spec, TaskError(
+                f"placement group {spec.strategy.pg_id.hex()[:12]} is not "
+                f"ready (state={info['state'] if info else 'UNKNOWN'})"))
+            return
+        idx = max(spec.strategy.pg_bundle_index, 0)
+        target = info["placement"].get(idx)
+        if target is None:
+            self._fail_task(spec, TaskError(
+                f"placement group bundle {idx} has no reservation"))
+            return
+        target = NodeID(target)
+        if target == self.node_id:
+            self._enqueue_local(spec)
+        else:
+            await self._execute_remotely(spec, pin_node=target)
+
+    async def _route_unknown_actor_task(self, spec: TaskSpec):
+        """Actor handle deserialized away from the actor's home node (e.g.
+        fetched by name): resolve home via the head directory and forward."""
+        node_b = None
+        if self.head is not None:
+            try:
+                node_b = await self.head.actor_node(spec.actor_id)
+            except (ConnectionLost, OSError):
+                node_b = None
+        if node_b is None:
+            self._fail_task(spec, ActorDiedError(
+                "actor is dead: unknown actor", task_name=spec.name))
+            return
+        node_id = NodeID(node_b)
+        if node_id == self.node_id:
+            # Directory says here, but no local state: it died.
+            self._fail_task(spec, ActorDiedError(
+                "actor is dead", task_name=spec.name))
+            return
+        entry = self.remote_actors.get(spec.actor_id)
+        if entry is None:
+            addr = await self._node_address(node_id)
+            if addr is None:
+                self._fail_task(spec, ActorDiedError(
+                    "actor is dead: its node is gone", task_name=spec.name))
+                return
+            entry = RemoteActorEntry(
+                actor_id=spec.actor_id, node_id=node_id, address=addr)
+            self.remote_actors[spec.actor_id] = entry
+        self._enqueue_remote_actor_task(entry, spec)
+
+    async def _node_address(self, node_id: NodeID):
+        for n in await self.head.list_nodes():
+            if n["node_id"] == node_id.binary() and n["state"] == "ALIVE":
+                return tuple(n["address"])
+        return None
 
     def _kick(self):
         if not self._closing:
@@ -330,7 +603,11 @@ class NodeService:
                 continue
             worker = self._acquire_worker(spec)
             if worker is None:
-                still_pending.append(spec)
+                if self._should_spill(spec):
+                    spec._spill_inflight = True
+                    self.loop.create_task(self._try_spill(spec))
+                else:
+                    still_pending.append(spec)
                 continue
             self.loop.create_task(self._run_on_worker(worker, spec))
         self.pending_cpu = still_pending
@@ -338,16 +615,60 @@ class NodeService:
             if actor.queue:
                 self._pump_actor(actor)
 
+    def _should_spill(self, spec: TaskSpec) -> bool:
+        """A locally-queued task stuck behind zero capacity is offered to
+        the head for spillback to a node with room (reference: raylet
+        spillback in local_task_manager.h)."""
+        if (self.head is None or getattr(spec, "_remote", False)
+                or getattr(spec, "_spill_inflight", False)
+                or spec.strategy.kind != "default"
+                or spec.actor_id is not None):
+            return False
+        now = time.monotonic()
+        if now - getattr(spec, "_pending_since", now) < self.cfg.spillback_delay_s:
+            return False
+        cooldown = getattr(spec, "_spill_cooldown", 0.0)
+        return now - cooldown >= self.cfg.spillback_delay_s
+
+    async def _try_spill(self, spec: TaskSpec):
+        try:
+            placed = await self.head.schedule(
+                spec.resources, "spill", [self.node_id.binary()])
+        except (ConnectionLost, OSError):
+            placed = None
+        spec._spill_inflight = False
+        if placed is None:
+            spec._spill_cooldown = time.monotonic()
+            self.pending_cpu.append(spec)
+            self._kick()
+            return
+        self.counters["tasks_spilled"] += 1
+        await self._execute_remotely(spec,
+                                     pin_node=NodeID(placed["node_id"]))
+
     # -- CPU worker lane ------------------------------------------------
+    def _charge_pool(self, spec: TaskSpec):
+        """The CPU pool a spec draws from: its reserved PG bundle when the
+        bundle reserves CPU, else the node's free pool (a bundle of pure
+        custom resources doesn't gate CPU)."""
+        if spec.strategy.kind == "pg" and spec.strategy.pg_id is not None:
+            pool = self.bundles.get(
+                (spec.strategy.pg_id, max(spec.strategy.pg_bundle_index, 0)))
+            if pool is not None and "CPU" in pool.total:
+                return pool.available
+        return self.available
+
     def _acquire_worker(self, spec: TaskSpec) -> Optional[WorkerHandle]:
         need = spec.resources.get("CPU", 1.0)
-        if self.available.get("CPU", 0) < need:
+        pool = self._charge_pool(spec)
+        if pool.get("CPU", 0) < need:
             return None
         while self.idle_workers:
             w = self.idle_workers.popleft()
             if w.state == "IDLE" and w.conn is not None and w.conn.alive:
                 w.state = "BUSY"
-                self.available["CPU"] -= need
+                pool["CPU"] = pool.get("CPU", 0) - need
+                spec._charged = pool
                 return w
         # No idle worker: fork one, but never more STARTING workers than CPU
         # slots could run concurrently (forks cost ~2.5s on small hosts).
@@ -401,7 +722,11 @@ class NodeService:
             self._fail_task(spec, TaskError.from_exception(e, spec.name))
         finally:
             worker.inflight.pop(spec.task_id, None)
-            self.available["CPU"] = self.available.get("CPU", 0) + spec.resources.get("CPU", 1.0)
+            pool = getattr(spec, "_charged", None)
+            if pool is None:
+                pool = self.available
+            pool["CPU"] = pool.get("CPU", 0) + spec.resources.get("CPU", 1.0)
+            spec._charged = None
             if worker.state == "BUSY":
                 worker.state = "IDLE"
                 worker.last_idle = time.monotonic()
@@ -573,6 +898,385 @@ class NodeService:
         fut.add_done_callback(done)
 
     # ------------------------------------------------------------------
+    # Remote execution (owner side)
+    # ------------------------------------------------------------------
+    async def _await_deps(self, spec: TaskSpec):
+        """Wait until every dep is terminal; raises the first dep error."""
+        for dep in spec.dependencies():
+            st = await self.wait_object(dep)
+            if st.status == ERROR:
+                raise st.error
+
+    def _resolved_copy(self, spec: TaskSpec) -> TaskSpec:
+        """A copy of the spec with every REF arg resolved to a value blob —
+        the executor needs nothing but the head (for the function) to run
+        it. Deps must be terminal."""
+        import copy as _copy
+
+        def enc(a):
+            if a[0] != REF:
+                return a
+            st = self.objects[a[1]]
+            if st.status == ERROR:
+                raise st.error
+            return (VAL, self._materialize_blob(a[1]))
+
+        out = _copy.copy(spec)
+        out.args = [enc(a) for a in spec.args]
+        out.kwargs = {k: enc(v) for k, v in spec.kwargs.items()}
+        return out
+
+    def _materialize_blob(self, oid: ObjectID) -> bytes:
+        """Serialized bytes of a READY object (from memory store or shm)."""
+        st = self.objects[oid]
+        if st.location == "shm":
+            mv = self.shm.get(oid)
+            if mv is None:
+                raise ObjectLostError(
+                    f"object {oid.hex()[:16]} missing from store")
+            return bytes(mv)
+        kind, val = st.value
+        return val if kind == "bytes" else serialization.serialize(val)
+
+    def _ingest_result_blob(self, rid: ObjectID, blob: bytes):
+        if len(blob) > self.cfg.max_inline_object_size:
+            self.shm.put(rid, blob)
+            self.mark_ready_shm(rid, len(blob))
+        else:
+            self.mark_ready_bytes(rid, blob)
+
+    async def _execute_remotely(self, spec: TaskSpec,
+                                exclude: frozenset | set = frozenset(),
+                                pin_node: NodeID | None = None):
+        """Place a spec on another node via the head and run it there.
+
+        The full round trip: resolve deps locally -> head picks a node ->
+        dial the node -> ``remote_execute`` -> ingest result blobs. Node
+        death mid-flight retries elsewhere (plain tasks) or defers to the
+        actor-restart path.
+        """
+        exclude = set(exclude)
+        try:
+            await self._await_deps(spec)
+            payload_spec = self._resolved_copy(spec)
+        except TaskError as e:
+            self._fail_task(spec, e)
+            return
+        except BaseException as e:  # noqa: BLE001
+            self._fail_task(spec, TaskError.from_exception(e, spec.name))
+            return
+        # Ensure the function is fetchable cluster-wide before forwarding.
+        blob = self.functions.get(spec.func_id)
+        if blob is not None:
+            try:
+                await self.head.export_function(spec.func_id, blob)
+            except (ConnectionLost, OSError):
+                pass
+
+        while True:
+            if pin_node is not None:
+                if pin_node in self.dead_nodes:
+                    self._fail_task(spec, WorkerCrashedError(
+                        task_name=spec.name))
+                    return
+                addr = (self.peer_address if pin_node == self.node_id
+                        else await self._node_address(pin_node))
+                if addr is None:
+                    self._fail_task(spec, TaskError(
+                        f"node {pin_node.hex()[:12]} is not in the cluster"))
+                    return
+                target, address = pin_node, addr
+            else:
+                try:
+                    placed = await self.head.schedule(
+                        spec.resources, spec.strategy.kind,
+                        [n.binary() for n in exclude])
+                except (ConnectionLost, OSError):
+                    placed = None
+                if placed is None:
+                    # Nothing feasible right now: park and retry (nodes may
+                    # join / free up) — reference keeps infeasible tasks
+                    # queued rather than failing them.
+                    self._pending_remote.append((spec, frozenset(exclude)))
+                    return
+                target = NodeID(placed["node_id"])
+                address = placed["address"]
+            if target == self.node_id:
+                self._enqueue_local(spec)
+                return
+            try:
+                conn = await self._peer_conn(target, address)
+                reply = await conn.call("remote_execute", {
+                    "spec": payload_spec,
+                    "owner": self.node_id.binary(),
+                })
+            except (ConnectionLost, OSError):
+                self.counters["remote_forward_failures"] += 1
+                if spec.actor_id is not None and not spec.is_actor_creation:
+                    # Actor call: restart is the actor FSM's job.
+                    self._fail_task(spec, ActorDiedError(
+                        "actor node died mid-call", task_name=spec.name))
+                    return
+                if spec.max_retries > 0 or spec.is_actor_creation:
+                    if not spec.is_actor_creation:
+                        spec.max_retries -= 1
+                    exclude.add(target)
+                    if pin_node is not None:
+                        pin_node = None  # pinned node is gone; re-place
+                    continue
+                self._fail_task(spec, WorkerCrashedError(task_name=spec.name))
+                return
+            self._handle_remote_reply(spec, reply)
+            return
+
+    def _handle_remote_reply(self, spec: TaskSpec, reply: dict):
+        rids = spec.return_ids()
+        err = reply.get("error")
+        if err is not None:
+            for rid in rids:
+                self.mark_error(rid, err if isinstance(err, TaskError)
+                                else TaskError(str(err)))
+            self._release_deps(spec)
+            self.counters["tasks_failed"] += 1
+            return
+        results = reply["results"]
+        for rid, blob in zip(rids, results):
+            self._ingest_result_blob(rid, blob)
+        self._release_deps(spec)
+        self.counters["tasks_finished"] += 1
+        self.counters["tasks_finished_remote"] += 1
+
+    # -- remote actors (owner side) -------------------------------------
+    async def _create_actor_remotely(self, spec: TaskSpec):
+        """Place an actor whose resources this node can't satisfy."""
+        entry = RemoteActorEntry(
+            actor_id=spec.actor_id, node_id=NodeID.nil(), address=(),
+            creation_spec=spec, state="RESTARTING",
+            ready=asyncio.Event())
+        self.remote_actors[spec.actor_id] = entry
+        await self._place_remote_actor(entry, first=True)
+
+    async def _place_remote_actor(self, entry: RemoteActorEntry,
+                                  first: bool = False,
+                                  exclude: set | None = None):
+        spec = entry.creation_spec
+        exclude = set(exclude or ())
+        try:
+            await self._await_deps(spec)
+            payload_spec = self._resolved_copy(spec)
+        except BaseException as e:  # noqa: BLE001
+            err = e if isinstance(e, TaskError) else \
+                TaskError.from_exception(e, spec.name)
+            entry.state = "DEAD"
+            entry.death_cause = str(err)
+            self._fail_task(spec, err)
+            self._fail_remote_actor_queue(entry)
+            return
+        blob = self.functions.get(spec.func_id)
+        if blob is not None:
+            try:
+                await self.head.export_function(spec.func_id, blob)
+            except (ConnectionLost, OSError):
+                pass
+        while True:
+            try:
+                placed = await self.head.schedule(
+                    spec.resources, spec.strategy.kind,
+                    [n.binary() for n in exclude])
+            except (ConnectionLost, OSError):
+                placed = None
+            if placed is None:
+                await asyncio.sleep(0.25)
+                if self._closing:
+                    return
+                continue
+            target = NodeID(placed["node_id"])
+            if target == self.node_id:
+                # Became feasible locally (e.g. the blocking resource was
+                # freed): fall back to the local actor path.
+                del self.remote_actors[entry.actor_id]
+                self._enqueue_local(spec)
+                return
+            try:
+                conn = await self._peer_conn(target, placed["address"])
+                reply = await conn.call("remote_execute", {
+                    "spec": payload_spec, "owner": self.node_id.binary()})
+            except (ConnectionLost, OSError):
+                exclude.add(target)
+                continue
+            err = reply.get("error")
+            if err is not None:
+                entry.state = "DEAD"
+                entry.death_cause = str(err)
+                self._fail_task(spec, err if isinstance(err, TaskError)
+                                else ActorDiedError(str(err)))
+                self._fail_remote_actor_queue(entry)
+                return
+            entry.node_id = target
+            entry.address = tuple(placed["address"])
+            entry.state = "ALIVE"
+            if entry.ready is not None:
+                entry.ready.set()
+            if first:
+                # Creation return = handle-ready signal (same contract as
+                # the local path).
+                self.mark_ready_value(spec.return_ids()[0], None)
+                self._release_deps(spec)
+            try:
+                await self.head.record_actor_node(entry.actor_id, target)
+            except (ConnectionLost, OSError):
+                pass
+            self._pump_remote_actor(entry)
+            return
+
+    def _enqueue_remote_actor_task(self, entry: RemoteActorEntry,
+                                   spec: TaskSpec):
+        if entry.state == "DEAD":
+            self._fail_task(spec, ActorDiedError(
+                f"actor is dead: {entry.death_cause}", task_name=spec.name))
+            return
+        entry.queue.append(spec)
+        self._pump_remote_actor(entry)
+
+    def _pump_remote_actor(self, entry: RemoteActorEntry):
+        if entry.pumping or entry.state == "DEAD":
+            return
+        entry.pumping = True
+        self.loop.create_task(self._remote_actor_pump(entry))
+
+    async def _remote_actor_pump(self, entry: RemoteActorEntry):
+        """Forward queued actor tasks in submission order. Requests are
+        written sequentially (ordering) but replies are awaited out of band
+        up to the actor's max_concurrency (pipelining)."""
+        try:
+            while entry.queue and not self._closing:
+                if entry.state == "RESTARTING" and entry.ready is not None:
+                    await entry.ready.wait()
+                if entry.state == "DEAD":
+                    self._fail_remote_actor_queue(entry)
+                    return
+                spec = entry.queue.popleft()
+                try:
+                    await self._await_deps(spec)
+                    payload_spec = self._resolved_copy(spec)
+                except BaseException as e:  # noqa: BLE001
+                    err = e if isinstance(e, TaskError) else \
+                        TaskError.from_exception(e, spec.name)
+                    self._fail_task(spec, err)
+                    continue
+                try:
+                    conn = await self._peer_conn(entry.node_id, entry.address)
+                    fut = asyncio.ensure_future(conn.call("remote_execute", {
+                        "spec": payload_spec, "owner": self.node_id.binary()}))
+                except (ConnectionLost, OSError):
+                    self._fail_task(spec, ActorDiedError(
+                        "actor node unreachable", task_name=spec.name))
+                    continue
+                # Let the write go out before sending the next (ordering);
+                # the reply resolves in its own task (pipelining).
+                await asyncio.sleep(0)
+                self.loop.create_task(self._finish_remote_actor_task(
+                    entry, spec, fut))
+        finally:
+            entry.pumping = False
+            if entry.queue and entry.state != "DEAD":
+                self._pump_remote_actor(entry)
+
+    async def _finish_remote_actor_task(self, entry: RemoteActorEntry,
+                                        spec: TaskSpec, fut):
+        try:
+            reply = await fut
+        except (ConnectionLost, OSError):
+            self._fail_task(spec, ActorDiedError(
+                "actor node died mid-call", task_name=spec.name))
+            return
+        self._handle_remote_reply(spec, reply)
+
+    def _fail_remote_actor_queue(self, entry: RemoteActorEntry):
+        while entry.queue:
+            spec = entry.queue.popleft()
+            self._fail_task(spec, ActorDiedError(
+                f"actor is dead: {entry.death_cause}", task_name=spec.name))
+
+    async def _remote_actor_died(self, entry: RemoteActorEntry, cause: str):
+        spec = entry.creation_spec
+        can_restart = (spec is not None
+                       and entry.num_restarts < spec.max_restarts)
+        if can_restart:
+            entry.state = "RESTARTING"
+            entry.num_restarts += 1
+            entry.ready = asyncio.Event()
+            self.counters["actors_restarted"] += 1
+            await self._place_remote_actor(
+                entry, exclude={entry.node_id})
+        else:
+            entry.state = "DEAD"
+            entry.death_cause = cause
+            if self.head is not None and spec is not None \
+                    and spec.actor_name:
+                try:
+                    await self.head.unregister_named_actor(
+                        spec.actor_name, entry.actor_id)
+                except (ConnectionLost, OSError):
+                    pass
+            self._fail_remote_actor_queue(entry)
+
+    # ------------------------------------------------------------------
+    # Peer RPC (executor side + object plane)
+    # ------------------------------------------------------------------
+    async def _handle_peer_rpc(self, conn: ServerConn, method: str,
+                               payload: Any):
+        if method == "remote_execute":
+            return await self._remote_execute(payload)
+        if method == "fetch_object":
+            oid = ObjectID(payload["oid"])
+            st = await self.wait_object(oid, payload.get("timeout"))
+            if st.status == PENDING:
+                return ("timeout",)
+            if st.status == ERROR:
+                return ("err", st.error)
+            return ("b", self._materialize_blob(oid))
+        if method == "incref":
+            self.incref(ObjectID(payload))
+            return True
+        if method == "decref":
+            self.decref(ObjectID(payload))
+            return True
+        if method == "kill_actor":
+            self.kill_actor(ActorID(payload))
+            return True
+        if method == "ping":
+            return "pong"
+        raise RuntimeError(f"unknown peer rpc: {method}")
+
+    async def _remote_execute(self, payload: dict) -> dict:
+        """Run a forwarded spec locally and reply with result blobs. The
+        owner keeps the authoritative object states; our local copies are
+        freed once the reply ships."""
+        spec: TaskSpec = payload["spec"]
+        spec._remote = True
+        self.counters["remote_tasks_received"] += 1
+        rids = self.submit(spec)
+        results = []
+        err = None
+        for rid in rids:
+            st = await self.wait_object(rid)
+            if st.status == ERROR:
+                err = st.error
+                break
+        if err is None:
+            try:
+                results = [self._materialize_blob(rid) for rid in rids]
+            except BaseException as e:  # noqa: BLE001
+                err = TaskError.from_exception(e, spec.name)
+        if not spec.is_actor_creation:
+            for rid in rids:
+                self.decref(rid)  # drop the submitter ref; owner has its own
+        if err is not None:
+            return {"error": err}
+        return {"results": results}
+
+    # ------------------------------------------------------------------
     # Actors
     # ------------------------------------------------------------------
     async def _create_actor(self, spec: TaskSpec):
@@ -585,14 +1289,24 @@ class NodeService:
         )
         actor.ready_fut = self.loop.create_future()
         self.actors[aid] = actor
-        if spec.actor_name:
-            if spec.actor_name in self.named_actors:
+        if spec.actor_name and self.head is not None:
+            meths = (spec.runtime_env or {}).get("methods", [])
+            try:
+                ok = await self.head.register_named_actor(
+                    spec.actor_name, aid, self.node_id, meths)
+            except (ConnectionLost, OSError):
+                ok = False
+            if not ok:
                 self._actor_creation_failed(
                     actor,
                     ActorDiedError(f"actor name '{spec.actor_name}' already taken"),
                 )
                 return
-            self.named_actors[spec.actor_name] = aid
+        elif self.head is not None:
+            try:
+                await self.head.record_actor_node(aid, self.node_id)
+            except (ConnectionLost, OSError):
+                pass
         await self._start_actor(actor)
 
     async def _start_actor(self, actor: ActorState):
@@ -656,15 +1370,29 @@ class NodeService:
             actor.ready_fut.set_result(None)
         self._pump_actor(actor)
 
+    def _unregister_actor(self, actor: ActorState):
+        """Drop the actor's directory entries at the head. Unregistration is
+        keyed by actor id, so a duplicate-name failure never unregisters
+        the original name holder."""
+        if self.head is None:
+            return
+
+        async def do():
+            try:
+                if actor.name:
+                    await self.head.unregister_named_actor(
+                        actor.name, actor.actor_id)
+            except (ConnectionLost, OSError):
+                pass
+
+        self.loop.create_task(do())
+
     def _actor_creation_failed(self, actor: ActorState, err):
         if not isinstance(err, TaskError):
             err = ActorDiedError(f"actor creation failed: {err}")
         actor.state = "DEAD"
         actor.death_cause = str(err)
-        # Free the name unless another live actor holds it (duplicate-name
-        # failures must not unregister the original holder).
-        if actor.name and self.named_actors.get(actor.name) == actor.actor_id:
-            self.named_actors.pop(actor.name, None)
+        self._unregister_actor(actor)
         self._fail_task(actor.creation_spec, err)
         for spec in actor.queue:
             self._fail_task(spec, ActorDiedError(str(err), task_name=spec.name))
@@ -737,8 +1465,7 @@ class NodeService:
             return
         actor.state = "DEAD"
         actor.death_cause = "killed via kill()"
-        if actor.name:
-            self.named_actors.pop(actor.name, None)
+        self._unregister_actor(actor)
         for spec in actor.queue:
             self._fail_task(spec, ActorDiedError("actor was killed", task_name=spec.name))
         actor.queue.clear()
@@ -748,6 +1475,34 @@ class NodeService:
             actor.device_pool.shutdown(wait=False)
             actor.instance = None
 
+    async def kill_actor_anywhere(self, aid: ActorID, no_restart: bool = True):
+        """kill() that also reaches actors living on other nodes."""
+        if aid in self.actors:
+            self.kill_actor(aid, no_restart)
+            return
+        entry = self.remote_actors.get(aid)
+        if entry is not None and entry.state != "DEAD":
+            entry.state = "DEAD"
+            entry.death_cause = "killed via kill()"
+            self._fail_remote_actor_queue(entry)
+            try:
+                conn = await self._peer_conn(entry.node_id, entry.address)
+                await conn.call("kill_actor", aid.binary())
+            except (ConnectionLost, OSError):
+                pass
+            return
+        # Unknown here: resolve the home node through the head.
+        if self.head is not None:
+            node_b = await self.head.actor_node(aid)
+            if node_b is not None and NodeID(node_b) != self.node_id:
+                addr = await self._node_address(NodeID(node_b))
+                if addr is not None:
+                    try:
+                        conn = await self._peer_conn(NodeID(node_b), addr)
+                        await conn.call("kill_actor", aid.binary())
+                    except (ConnectionLost, OSError):
+                        pass
+
     def _kill_worker(self, worker: WorkerHandle):
         worker.state = "DEAD"
         try:
@@ -756,26 +1511,25 @@ class NodeService:
             pass
 
     # ------------------------------------------------------------------
-    # Placement groups (single-node round 1: bundle accounting)
+    # Placement groups — node-side bundle reservation (the cluster-wide
+    # placement decision lives in the head, gcs_placement_group_scheduler
+    # equivalent; this node just sets resources aside)
     # ------------------------------------------------------------------
-    def create_placement_group(self, bundles: list[dict], strategy: str) -> PlacementGroupID:
-        pg_id = PlacementGroupID.from_random()
-        needed: dict[str, float] = {}
-        for b in bundles:
-            for k, v in b.items():
-                needed[k] = needed.get(k, 0) + v
-        for k, v in needed.items():
-            if self.total_resources.get(k, 0) < v:
-                raise ValueError(
-                    f"placement group infeasible: needs {v} {k}, node has "
-                    f"{self.total_resources.get(k, 0)}"
-                )
-        pg = PlacementGroup(pg_id=pg_id, bundles=bundles, strategy=strategy)
-        self.placement_groups[pg_id] = pg
-        return pg_id
+    def reserve_bundle(self, pg_id: PlacementGroupID, bundle_index: int,
+                       resources: dict):
+        self.bundles[(pg_id, bundle_index)] = BundlePool(
+            total=dict(resources), available=dict(resources))
+        # Reserved resources leave the general pool so ordinary tasks
+        # cannot oversubscribe them.
+        for k, v in resources.items():
+            self.available[k] = self.available.get(k, 0) - v
 
-    def remove_placement_group(self, pg_id: PlacementGroupID):
-        self.placement_groups.pop(pg_id, None)
+    def release_bundle(self, pg_id: PlacementGroupID, bundle_index: int):
+        pool = self.bundles.pop((pg_id, bundle_index), None)
+        if pool is not None:
+            for k, v in pool.total.items():
+                self.available[k] = self.available.get(k, 0) + v
+        self._kick()
 
     # ------------------------------------------------------------------
     # RPC handling (worker -> node service)
@@ -797,15 +1551,23 @@ class NodeService:
             if w.registered and not w.registered.done():
                 w.registered.set_result(None)
             self._kick()
-            return {"session_id": self.session_id}
+            return {"session_id": self.session_id,
+                    "peer_address": self.peer_address}
 
         if method == "fetch_function":
-            return self.functions.get(payload)
+            blob = self.functions.get(payload)
+            if blob is None and self.head is not None:
+                blob = await self.head.fetch_function(payload)
+                if blob is not None:
+                    self.functions[payload] = blob
+            return blob
 
         if method == "export_function":
             fid, blob = payload
             if blob is not None and fid not in self.functions:
                 self.functions[fid] = blob
+            if self.head is not None:
+                await self.head.export_function(fid, blob)
             return fid in self.functions
 
         if method == "submit_task":
@@ -815,6 +1577,10 @@ class NodeService:
 
         if method == "fetch_object":
             oid = ObjectID(payload["oid"])
+            owner = payload.get("owner")
+            if owner is not None:
+                await self.ensure_object(oid, tuple(owner),
+                                         payload.get("timeout"))
             st = await self.wait_object(oid, payload.get("timeout"))
             if st.status == PENDING:
                 return ("timeout",)
@@ -824,6 +1590,11 @@ class NodeService:
 
         if method == "wait_objects":
             oids = [ObjectID(b) for b in payload["oids"]]
+            for b, owner in zip(payload["oids"],
+                                payload.get("owners") or []):
+                if owner is not None:
+                    self.loop.create_task(
+                        self.ensure_object(ObjectID(b), tuple(owner)))
             num_returns = payload["num_returns"]
             timeout = payload.get("timeout")
             deadline = None if timeout is None else self.loop.time() + timeout
@@ -870,30 +1641,16 @@ class NodeService:
             return True
 
         if method == "get_actor_by_name":
-            aid = self.named_actors.get(payload)
-            if aid is None:
+            if self.head is None:
                 return None
-            actor = self.actors[aid]
-            meths = actor.creation_spec.runtime_env or {}
-            return {"actor_id": aid.binary(),
-                    "methods": meths.get("methods", [])}
+            return await self.head.get_actor_by_name(payload)
 
         if method == "kv":
             op, key, val = payload
-            if op == "put":
-                self.kv[key] = val
-                return True
-            if op == "get":
-                return self.kv.get(key)
-            if op == "del":
-                return self.kv.pop(key, None) is not None
-            if op == "exists":
-                return key in self.kv
-            if op == "keys":
-                return [k for k in self.kv if k.startswith(key)]
+            return await self.head.kv_op(op, key, val)
 
         if method == "kill_actor":
-            self.kill_actor(ActorID(payload))
+            await self.kill_actor_anywhere(ActorID(payload))
             return True
 
         if method == "log":
@@ -919,8 +1676,7 @@ class NodeService:
                 else:
                     actor.state = "DEAD"
                     actor.death_cause = "worker process died"
-                    if actor.name:
-                        self.named_actors.pop(actor.name, None)
+                    self._unregister_actor(actor)
                     for spec in actor.queue:
                         self._fail_task(
                             spec, ActorDiedError("actor worker died", task_name=spec.name)
@@ -930,10 +1686,15 @@ class NodeService:
     # ------------------------------------------------------------------
     async def shutdown(self):
         self._closing = True
+        for t in self._bg_tasks:
+            t.cancel()
+        for conn in list(self.peer_conns.values()):
+            await conn.close()
         for w in self.workers.values():
             if w.state != "DEAD":
                 self._kill_worker(w)
         await self.server.stop()
+        await self.peer_server.stop()
         self.device_pool.shutdown(wait=False)
         for actor in self.actors.values():
             if actor.device_pool:

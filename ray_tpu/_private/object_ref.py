@@ -24,18 +24,31 @@ def _current_context():
 
 
 class ObjectRef:
-    __slots__ = ("_id", "_owned", "__weakref__")
+    __slots__ = ("_id", "_owned", "_owner_addr", "__weakref__")
 
-    def __init__(self, oid: ObjectID, _register: bool = True):
+    def __init__(self, oid: ObjectID, _register: bool = True,
+                 owner_addr: tuple | None = None):
         """``_register=False`` means the creator already holds a count for
         this ref (submit/put incref once on the caller's behalf); the ref
-        still *owns* that count and releases it in ``__del__``."""
+        still *owns* that count and releases it in ``__del__``.
+
+        ``owner_addr`` is the peer address of the node service that owns
+        the object's state (reference: the owner address embedded in
+        serialized ObjectRefs, reference_count.h ownership model). A ref
+        that travels to another node carries it, so any process can reach
+        the owner to fetch the value.
+        """
         self._id = oid
+        self._owner_addr = tuple(owner_addr) if owner_addr else None
         self._owned = True
         if _register:
             ctx = _current_context()
             if ctx is not None:
                 ctx.incref(oid)
+
+    @property
+    def owner_addr(self):
+        return self._owner_addr
 
     @property
     def id(self) -> ObjectID:
@@ -49,7 +62,11 @@ class ObjectRef:
 
     def future(self):
         """concurrent.futures.Future resolving to the object's value."""
-        return _current_context().object_future(self._id)
+        ctx = _current_context()
+        try:
+            return ctx.object_future(self._id, self._owner_addr)
+        except TypeError:
+            return ctx.object_future(self._id)
 
     def __await__(self):
         import asyncio
@@ -67,8 +84,13 @@ class ObjectRef:
 
     def __reduce__(self):
         # Travelling refs re-register at the destination so the owner-side
-        # count reflects remote holders (borrowing).
-        return (_deserialize_ref, (self._id.binary(),))
+        # count reflects remote holders (borrowing), and carry the owner's
+        # address so foreign processes can fetch the value.
+        owner = self._owner_addr
+        if owner is None:
+            ctx = _current_context()
+            owner = getattr(ctx, "node_addr", None)
+        return (_deserialize_ref, (self._id.binary(), owner))
 
     def __del__(self):
         if self._owned:
@@ -80,5 +102,5 @@ class ObjectRef:
                 pass
 
 
-def _deserialize_ref(binary: bytes) -> ObjectRef:
-    return ObjectRef(ObjectID(binary))
+def _deserialize_ref(binary: bytes, owner_addr=None) -> ObjectRef:
+    return ObjectRef(ObjectID(binary), owner_addr=owner_addr)

@@ -1,10 +1,11 @@
-"""Control-plane RPC: length-prefixed pickled messages over unix sockets.
+"""Control-plane RPC: length-prefixed pickled messages over unix or TCP
+sockets.
 
 Capability parity target: the reference's gRPC control plane
 (/root/reference/src/ray/rpc/grpc_server.h, grpc_client.h) — per-call
 request/response with correlation, plus server push (the reference pushes
 tasks to leased workers via CoreWorkerService.PushTask). We keep the same
-duplex shape over a single persistent unix socket per worker:
+duplex shape over a single persistent socket per peer:
 
   * Either side sends ``(kind, seqno, method, payload)`` frames.
   * kind=REQ expects a matching kind=RESP with the same seqno.
@@ -12,11 +13,17 @@ duplex shape over a single persistent unix socket per worker:
     service pushes ``execute_task`` REQs to a busy worker's socket while the
     worker has its own outstanding ``submit_task`` REQs.
 
+Addresses: a ``str`` is a unix-socket path (node ↔ its local workers); a
+``(host, port)`` tuple is TCP (node ↔ head, node ↔ node across the
+cluster — the reference's DCN control plane).
+
 The server side is asyncio (runs in the node service's event-loop thread).
-The client side (workers) is a blocking socket plus a reader thread that
-routes RESP frames to waiting futures and REQ frames to a handler.
-Payloads are cloudpickle: control-plane messages are small; bulk data rides
-the shared-memory store, never this channel.
+The blocking ``DuplexClient`` (workers) is a socket plus a reader thread
+that routes RESP frames to waiting futures and REQ frames to a handler.
+``async_connect`` gives the asyncio side a client-initiated peer with the
+same interface as a server-accepted one. Payloads are cloudpickle:
+control-plane messages are small; bulk data rides the shared-memory store
+or the object plane, never this channel.
 """
 
 from __future__ import annotations
@@ -26,17 +33,30 @@ import socket
 import struct
 import threading
 from concurrent.futures import Future
-from typing import Any, Awaitable, Callable
+from typing import Any, Awaitable, Callable, Union
 
 import cloudpickle
 
 REQ, RESP, ERR = 0, 1, 2
-_HDR = struct.Struct("<BIQ")  # kind, payload_len, seqno
+_HDR = struct.Struct("<BQQ")  # kind, payload_len, seqno
+
+Address = Union[str, tuple]  # unix path | (host, port)
 
 
 def _pack(kind: int, seqno: int, body: Any) -> bytes:
     payload = cloudpickle.dumps(body)
     return _HDR.pack(kind, len(payload), seqno) + payload
+
+
+def _open_socket(address: Address) -> socket.socket:
+    if isinstance(address, str):
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(address)
+    else:
+        host, port = address
+        s = socket.create_connection((host, port))
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return s
 
 
 class RpcError(Exception):
@@ -54,10 +74,9 @@ class DuplexClient:
     """Blocking duplex peer. ``handler(method, payload) -> result`` services
     incoming REQs on a dedicated thread pool owned by the caller."""
 
-    def __init__(self, sock_path: str, handler: Callable[[str, Any], Any],
+    def __init__(self, address: Address, handler: Callable[[str, Any], Any],
                  handler_threads: int = 1):
-        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._sock.connect(sock_path)
+        self._sock = _open_socket(address)
         self._wlock = threading.Lock()
         self._seq = 0
         self._seqlock = threading.Lock()
@@ -200,46 +219,39 @@ class ServerConn:
 
 
 class DuplexServer:
-    """Asyncio unix-socket server. ``handler(conn, method, payload)`` is an
-    async callable invoked per incoming REQ; its return value is the RESP.
-    ``on_disconnect(conn)`` fires when a peer drops."""
+    """Asyncio socket server (unix path or TCP). ``handler(conn, method,
+    payload)`` is an async callable invoked per incoming REQ; its return
+    value is the RESP. ``on_disconnect(conn)`` fires when a peer drops."""
 
     def __init__(
         self,
-        sock_path: str,
+        address: Address,
         handler: Callable[[ServerConn, str, Any], Awaitable[Any]],
         on_disconnect: Callable[[ServerConn], Awaitable[None]] | None = None,
     ):
-        self.sock_path = sock_path
+        self.address = address
         self._handler = handler
         self._on_disconnect = on_disconnect
         self._server: asyncio.AbstractServer | None = None
         self.conns: set[ServerConn] = set()
 
     async def start(self):
-        self._server = await asyncio.start_unix_server(self._accept, path=self.sock_path)
+        if isinstance(self.address, str):
+            self._server = await asyncio.start_unix_server(
+                self._accept, path=self.address)
+        else:
+            host, port = self.address
+            self._server = await asyncio.start_server(
+                self._accept, host=host, port=port)
+            # Resolve an ephemeral port (port=0) to the bound one.
+            bound = self._server.sockets[0].getsockname()
+            self.address = (self.address[0], bound[1])
 
     async def _accept(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         conn = ServerConn(reader, writer)
         self.conns.add(conn)
         try:
-            while True:
-                hdr = await reader.readexactly(_HDR.size)
-                kind, plen, seq = _HDR.unpack(hdr)
-                body = cloudpickle.loads(await reader.readexactly(plen))
-                if kind == REQ:
-                    method, payload = body
-                    asyncio.ensure_future(self._serve(conn, method, payload, seq))
-                elif kind == RESP:
-                    fut = conn._pending.pop(seq, None)
-                    if fut and not fut.done():
-                        fut.set_result(body)
-                else:
-                    fut = conn._pending.pop(seq, None)
-                    if fut and not fut.done():
-                        fut.set_exception(RpcError(body))
-        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
-            pass
+            await _peer_read_loop(conn, reader, self._handler)
         finally:
             self.conns.discard(conn)
             conn._fail_pending()
@@ -250,9 +262,21 @@ class DuplexServer:
             except OSError:
                 pass
 
-    async def _serve(self, conn: ServerConn, method: str, payload: Any, seq: int):
+    async def stop(self):
+        for conn in list(self.conns):
+            await conn.close()
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+
+async def _peer_read_loop(conn: ServerConn, reader: asyncio.StreamReader,
+                          handler):
+    """Shared frame loop for server-accepted and client-initiated peers."""
+
+    async def serve(method, payload, seq):
         try:
-            result = await self._handler(conn, method, payload)
+            result = await handler(conn, method, payload)
             if seq:
                 await conn._write(RESP, seq, result)
         except ConnectionLost:
@@ -264,9 +288,56 @@ class DuplexServer:
                 except (ConnectionLost, OSError):
                     pass
 
-    async def stop(self):
-        for conn in list(self.conns):
-            await conn.close()
-        if self._server:
-            self._server.close()
-            await self._server.wait_closed()
+    try:
+        while True:
+            hdr = await reader.readexactly(_HDR.size)
+            kind, plen, seq = _HDR.unpack(hdr)
+            body = cloudpickle.loads(await reader.readexactly(plen))
+            if kind == REQ:
+                method, payload = body
+                asyncio.ensure_future(serve(method, payload, seq))
+            elif kind == RESP:
+                fut = conn._pending.pop(seq, None)
+                if fut and not fut.done():
+                    fut.set_result(body)
+            else:
+                fut = conn._pending.pop(seq, None)
+                if fut and not fut.done():
+                    fut.set_exception(RpcError(body))
+    except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+        pass
+
+
+async def async_connect(
+    address: Address,
+    handler: Callable[[ServerConn, str, Any], Awaitable[Any]],
+    on_disconnect: Callable[[ServerConn], Awaitable[None]] | None = None,
+) -> ServerConn:
+    """Dial a DuplexServer from an asyncio context; returns a full-duplex
+    peer with the same interface as a server-accepted conn (both sides can
+    originate REQs — this is how a node receives pushes from the head over
+    the connection the node itself opened)."""
+    if isinstance(address, str):
+        reader, writer = await asyncio.open_unix_connection(address)
+    else:
+        host, port = address
+        reader, writer = await asyncio.open_connection(host, port)
+        sock = writer.get_extra_info("socket")
+        if sock is not None:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    conn = ServerConn(reader, writer)
+
+    async def run():
+        try:
+            await _peer_read_loop(conn, reader, handler)
+        finally:
+            conn._fail_pending()
+            if on_disconnect:
+                await on_disconnect(conn)
+            try:
+                writer.close()
+            except OSError:
+                pass
+
+    conn._loop_task = asyncio.ensure_future(run())
+    return conn

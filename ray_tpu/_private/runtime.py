@@ -80,12 +80,30 @@ class Runtime:
 
     def _loop_main(self):
         asyncio.set_event_loop(self.loop)
+        from .head import HeadService, LocalHeadClient, NodeEntry
+
+        # The driver process is the head node (`ray start --head` shape):
+        # head control plane + its own node service share this loop.
+        self.head = HeadService(self.session_id, self.loop)
+        self.loop.run_until_complete(self.head.start())
         self.node = NodeService(
-            self.session_id, self.sock_path, self._resources, self.shm, self.loop
+            self.session_id, self.sock_path, self._resources, self.shm,
+            self.loop, node_id=self.node_id, head=LocalHeadClient(self.head),
+            is_head_node=True,
         )
         self.loop.run_until_complete(self.node.start())
+        entry = NodeEntry(
+            node_id=self.node_id, address=self.node.peer_address,
+            resources=dict(self._resources),
+            available=dict(self._resources),  # refreshed by heartbeats
+            is_head_node=True)
+        self.head.attach_local_node(self.node, entry)
         self._started.set()
         self.loop.run_forever()
+
+    @property
+    def head_address(self) -> tuple:
+        return self.head.address
 
     def _run(self, coro, timeout=None):
         return asyncio.run_coroutine_threadsafe(coro, self.loop).result(timeout)
@@ -121,12 +139,17 @@ class Runtime:
             self._call_soon(self.node.functions.__setitem__, fid, blob)
         return fid
 
+    @property
+    def node_addr(self) -> tuple:
+        return self.node.peer_address
+
     def submit_spec(self, spec: TaskSpec) -> list[ObjectRef]:
         async def do():
             return self.node.submit(spec)
 
         rids = self._run(do())
-        return [ObjectRef(r, _register=False) for r in rids]
+        return [ObjectRef(r, _register=False, owner_addr=self.node_addr)
+                for r in rids]
 
     def put(self, value: Any) -> ObjectRef:
         with self._put_lock:
@@ -142,7 +165,7 @@ class Runtime:
             self._call_soon(self.node.mark_ready_shm, oid, len(blob))
         else:
             self._call_soon(self.node.mark_ready_bytes, oid, bytes(blob))
-        return ObjectRef(oid, _register=False)
+        return ObjectRef(oid, _register=False, owner_addr=self.node_addr)
 
     def _state_of(self, oid: ObjectID):
         return self.node.objects.get(oid)
@@ -152,12 +175,22 @@ class Runtime:
         if single:
             refs = [refs]
 
+        my_addr = self.node_addr
+
+        def is_foreign(r):
+            return r.owner_addr is not None and tuple(r.owner_addr) != my_addr
+
         async def wait_all():
             deadline = None if timeout is None else self.loop.time() + timeout
+            # Foreign-owned refs: pull copies from their owners first.
+            for r in refs:
+                if is_foreign(r):
+                    self.loop.create_task(
+                        self.node.ensure_object(r.id, r.owner_addr, timeout))
             for r in refs:
                 # Unknown id => nothing will ever produce it (e.g. a ref from
                 # a previous session) — fail fast instead of blocking forever.
-                if r.id not in self.node.objects:
+                if r.id not in self.node.objects and not is_foreign(r):
                     from .exceptions import ObjectLostError
 
                     raise ObjectLostError(
@@ -188,7 +221,13 @@ class Runtime:
         return out[0] if single else out
 
     def wait(self, refs: Sequence[ObjectRef], num_returns=1, timeout=None):
+        my_addr = self.node_addr
+
         async def do():
+            for r in refs:
+                if r.owner_addr is not None and tuple(r.owner_addr) != my_addr:
+                    self.loop.create_task(
+                        self.node.ensure_object(r.id, r.owner_addr))
             oids = [r.id for r in refs]
             deadline = None if timeout is None else self.loop.time() + timeout
             while True:
@@ -228,10 +267,12 @@ class Runtime:
             ready = ready[:num_returns]
         return ready, not_ready
 
-    def object_future(self, oid: ObjectID) -> Future:
+    def object_future(self, oid: ObjectID, owner_addr=None) -> Future:
         fut: Future = Future()
 
         async def do():
+            if owner_addr is not None and tuple(owner_addr) != self.node_addr:
+                self.loop.create_task(self.node.ensure_object(oid, owner_addr))
             st = await self.node.wait_object(oid)
             return st
 
@@ -266,48 +307,76 @@ class Runtime:
         self._call_soon(do)
 
     def kill_actor(self, actor_id: ActorID, no_restart=True):
-        self._call_soon(self.node.kill_actor, actor_id, no_restart)
+        asyncio.run_coroutine_threadsafe(
+            self.node.kill_actor_anywhere(actor_id, no_restart), self.loop)
 
     def get_actor_by_name(self, name: str):
-        aid = self.node.named_actors.get(name)
-        if aid is None:
-            return None
-        actor = self.node.actors[aid]
-        meta = actor.creation_spec.runtime_env or {}
-        return {"actor_id": aid.binary(), "methods": meta.get("methods", [])}
+        return self._run(self.node.head.get_actor_by_name(name))
 
     def kv_op(self, op, key, val=None):
-        async def do():
-            if op == "put":
-                self.node.kv[key] = val
-                return True
-            if op == "get":
-                return self.node.kv.get(key)
-            if op == "del":
-                return self.node.kv.pop(key, None) is not None
-            if op == "exists":
-                return key in self.node.kv
-            if op == "keys":
-                return [k for k in self.node.kv if k.startswith(key)]
-
-        return self._run(do())
+        return self._run(self.node.head.kv_op(op, key, val))
 
     # -- placement groups --------------------------------------------------
     def create_placement_group(self, bundles, strategy):
-        async def do():
-            return self.node.create_placement_group(bundles, strategy)
+        from .ids import PlacementGroupID
 
-        return self._run(do())
+        pg_id = PlacementGroupID.from_random()
+        # Feasibility gate (matches the reference's fail-fast on bundles no
+        # node shape could ever satisfy): every bundle must fit on SOME
+        # node's total resources.
+        nodes = self._run(self.node.head.list_nodes())
+        for i, b in enumerate(bundles):
+            if not any(all(n["resources"].get(k, 0) >= v
+                           for k, v in b.items())
+                       for n in nodes if n["state"] == "ALIVE"):
+                raise ValueError(
+                    f"placement group infeasible: bundle {i} ({b}) fits on "
+                    f"no node in the cluster")
+        self._run(self.node.head.create_pg(pg_id, bundles, strategy))
+        return pg_id
 
     def remove_placement_group(self, pg_id):
-        self._call_soon(self.node.remove_placement_group, pg_id)
+        asyncio.run_coroutine_threadsafe(
+            self.node.head.remove_pg(pg_id), self.loop)
+
+    def placement_group_state(self, pg_id) -> dict | None:
+        return self._run(self.node.head.pg_state(pg_id))
+
+    def wait_placement_group_ready(self, pg_id, timeout=None) -> bool:
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            st = self.placement_group_state(pg_id)
+            if st is not None and st["state"] == "CREATED":
+                return True
+            if deadline is not None and _time.monotonic() >= deadline:
+                return False
+            _time.sleep(0.05)
 
     # -- introspection -----------------------------------------------------
     def cluster_resources(self) -> dict:
-        return dict(self.node.total_resources)
+        out: dict = {}
+        for n in self._run(self.node.head.list_nodes()):
+            if n["state"] != "ALIVE":
+                continue
+            for k, v in n["resources"].items():
+                out[k] = out.get(k, 0) + v
+        return out
 
     def available_resources(self) -> dict:
-        return dict(self.node.available)
+        out: dict = {}
+        for n in self._run(self.node.head.list_nodes()):
+            if n["state"] != "ALIVE":
+                continue
+            avail = (self.node.available if n["node_id"] == self.node_id.binary()
+                     else n["available"])
+            for k, v in avail.items():
+                out[k] = out.get(k, 0) + v
+        return out
+
+    def list_nodes(self) -> list:
+        return self._run(self.node.head.list_nodes())
 
     def shutdown(self):
         if getattr(self, "_shut", False):
@@ -315,6 +384,10 @@ class Runtime:
         self._shut = True
         try:
             self._run(self.node.shutdown(), timeout=10)
+        except Exception:
+            pass
+        try:
+            self._run(self.head.shutdown(), timeout=5)
         except Exception:
             pass
         self.loop.call_soon_threadsafe(self.loop.stop)

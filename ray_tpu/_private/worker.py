@@ -58,7 +58,11 @@ class WorkerContext:
         self._decref_lock = threading.Lock()
         # Connect last: the node service may push tasks the moment we register.
         self.client = DuplexClient(sock_path, self._handle, handler_threads=32)
-        self.client.call("register", {"worker_id": worker_id.hex()})
+        reply = self.client.call("register", {"worker_id": worker_id.hex()})
+        # Our node's peer address: stamped into refs we create so they stay
+        # resolvable when they travel to other nodes.
+        self.node_addr = tuple(reply["peer_address"]) \
+            if isinstance(reply, dict) and reply.get("peer_address") else None
 
     # -- context protocol --------------------------------------------------
     @property
@@ -98,7 +102,7 @@ class WorkerContext:
         else:
             self.client.call("put_object", {"oid": oid.binary(), "inline": bytes(blob),
                                             "size": len(blob)})
-        return ObjectRef(oid, _register=False)
+        return ObjectRef(oid, _register=False, owner_addr=self.node_addr)
 
     def get(self, refs, timeout: float | None = None):
         single = isinstance(refs, ObjectRef)
@@ -111,7 +115,8 @@ class WorkerContext:
                 out.append(serialization.deserialize(mv))
                 continue
             res = self.client.call(
-                "fetch_object", {"oid": ref.id.binary(), "timeout": timeout}
+                "fetch_object", {"oid": ref.id.binary(), "timeout": timeout,
+                                 "owner": ref.owner_addr}
             )
             if res[0] == "timeout":
                 raise GetTimeoutError(f"get() timed out on {ref}")
@@ -130,7 +135,8 @@ class WorkerContext:
         binaries = self.client.call(
             "wait_objects",
             {"oids": [r.id.binary() for r in refs], "num_returns": num_returns,
-             "timeout": timeout},
+             "timeout": timeout,
+             "owners": [r.owner_addr for r in refs]},
         )
         ready_set = {b for b in binaries}
         ready = [r for r in refs if r.id.binary() in ready_set]
@@ -140,7 +146,8 @@ class WorkerContext:
 
     def submit_spec(self, spec: TaskSpec) -> list[ObjectRef]:
         rids = self.client.call("submit_task", spec)
-        return [ObjectRef(ObjectID(b), _register=False) for b in rids]
+        return [ObjectRef(ObjectID(b), _register=False,
+                          owner_addr=self.node_addr) for b in rids]
 
     def export_function(self, fn) -> str:
         from .task_spec import export_function
@@ -262,7 +269,12 @@ def main():
     session_id = os.environ["RT_SESSION_ID"]
     sock_path = os.environ["RT_SOCK_PATH"]
     worker_id = WorkerID.from_hex(os.environ["RT_WORKER_ID"])
-    ctx = WorkerContext(session_id, sock_path, worker_id)
+    try:
+        ctx = WorkerContext(session_id, sock_path, worker_id)
+    except (FileNotFoundError, ConnectionRefusedError):
+        # The node shut down between forking us and our connect: exit
+        # quietly rather than spraying a traceback during teardown.
+        os._exit(0)
     context_mod.set_context(ctx)
     # Park the main thread; all work arrives via the RPC reader.
     ctx.client._closed.wait()

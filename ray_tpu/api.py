@@ -116,7 +116,30 @@ class PlacementGroupHandle:
         self.strategy = strategy
 
     def ready(self):
-        return put(True)  # single-node round 1: creation is synchronous
+        """ObjectRef resolving to True once every bundle is RESERVED on a
+        node (reference: PlacementGroup.ready() gates on the GCS 2PC
+        commit)."""
+        ctx = _ensure()
+        if hasattr(ctx, "wait_placement_group_ready"):
+            pg_id = self.id
+
+            @remote(num_cpus=0, scheduling_strategy="device")
+            def _pg_ready():
+                import ray_tpu
+
+                ctx = ray_tpu._private.context.get_context()
+                return ctx.wait_placement_group_ready(pg_id)
+
+            return _pg_ready.remote()
+        return put(True)
+
+    def state(self) -> dict:
+        ctx = _ensure()
+        return ctx.placement_group_state(self.id)
+
+    def wait(self, timeout: float | None = None) -> bool:
+        ctx = _ensure()
+        return ctx.wait_placement_group_ready(self.id, timeout)
 
     @property
     def bundle_specs(self):

@@ -1,0 +1,295 @@
+"""Multi-node cluster: membership, cross-node scheduling, spillback,
+remote actors, placement groups, and node-death fault tolerance.
+
+Parity model: /root/reference/python/ray/tests with `ray_start_cluster`
+(cluster_utils.Cluster) — one machine, N node daemons, chaos by SIGKILL.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+def _session_expr():
+    """Inline-able session probe: remote fns must not reference module
+    globals (cloudpickle would import this test module on worker nodes)."""
+    import os as _os
+
+    return _os.environ.get("RT_SESSION_ID", "driver")
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(init_args={"num_cpus": 1})
+    try:
+        yield c
+    finally:
+        c.shutdown()
+
+
+def test_membership_and_resources(cluster):
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=1, resources={"x": 1})
+    cluster.wait_for_nodes(3)
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] >= 4.0
+    assert total.get("x") == 1.0
+    nodes = cluster.runtime.list_nodes()
+    assert sum(1 for n in nodes if n["state"] == "ALIVE") == 3
+    assert sum(1 for n in nodes if n.get("is_head_node")) == 1
+
+
+def test_cross_node_task_by_resource(cluster):
+    cluster.add_node(num_cpus=1, resources={"x": 1})
+    cluster.wait_for_nodes(2)
+
+    @ray_tpu.remote(resources={"x": 1})
+    def where():
+        import os as _os
+        return _os.environ.get("RT_SESSION_ID", "driver")
+
+    # Runs on the x-node, not the driver.
+    assert ray_tpu.get(where.remote(), timeout=60) != "driver"
+
+
+def test_cross_node_args_and_results(cluster):
+    cluster.add_node(num_cpus=1, resources={"x": 1})
+    cluster.wait_for_nodes(2)
+
+    import numpy as np
+
+    big = np.arange(200_000, dtype=np.int64)  # > inline threshold
+    ref = ray_tpu.put(big)
+
+    @ray_tpu.remote(resources={"x": 1})
+    def crunch(a, offset):
+        return a.sum() + offset
+
+    # Ref arg resolved by the owner and shipped cross-node; large result
+    # comes back and is readable by the driver.
+    assert ray_tpu.get(crunch.remote(ref, 5), timeout=60) == big.sum() + 5
+
+    @ray_tpu.remote(resources={"x": 1})
+    def make_big():
+        import numpy as np
+
+        return np.ones(300_000, dtype=np.float64)
+
+    out = ray_tpu.get(make_big.remote(), timeout=60)
+    assert out.shape == (300_000,) and out[0] == 1.0
+
+
+def test_spillback_uses_idle_node(cluster):
+    # Driver has 1 CPU; a second node adds 2 more. Six 1s tasks must use
+    # the remote node or take ~6s; with spillback wall-time stays bounded
+    # and some tasks report the remote session.
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes(2)
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(1.0)
+        import os as _os
+        return _os.environ.get("RT_SESSION_ID", "driver")
+
+    t0 = time.monotonic()
+    sessions = ray_tpu.get([slow.remote() for _ in range(6)], timeout=120)
+    took = time.monotonic() - t0
+    # Worker-node sessions carry a "-<node>" suffix; at least some tasks
+    # must have spilled there, and wall time must beat the serial 6s.
+    assert any("-" in s for s in sessions), sessions
+    assert took < 5.8, f"no spillback parallelism: {took:.1f}s {sessions}"
+
+
+def test_remote_actor_lifecycle(cluster):
+    cluster.add_node(num_cpus=1, resources={"x": 1})
+    cluster.wait_for_nodes(2)
+
+    @ray_tpu.remote(resources={"x": 0.5})
+    class Counter:
+        def __init__(self, start):
+            self.v = start
+
+        def add(self, n):
+            self.v += n
+            return self.v
+
+        def where(self):
+            import os as _os
+
+            return _os.environ.get("RT_SESSION_ID", "driver")
+
+    c = Counter.remote(100)
+    assert ray_tpu.get(c.where.remote(), timeout=60) != "driver"
+    # Ordered increments across the wire.
+    refs = [c.add.remote(1) for _ in range(5)]
+    assert ray_tpu.get(refs, timeout=60) == [101, 102, 103, 104, 105]
+    ray_tpu.kill(c)
+    time.sleep(0.3)
+    with pytest.raises(ray_tpu.ActorDiedError):
+        ray_tpu.get(c.add.remote(1), timeout=30)
+
+
+def test_named_actor_across_nodes(cluster):
+    cluster.add_node(num_cpus=1, resources={"x": 1})
+    cluster.wait_for_nodes(2)
+
+    @ray_tpu.remote(resources={"x": 0.5})
+    class Registry:
+        def __init__(self):
+            self.d = {}
+
+        def put(self, k, v):
+            self.d[k] = v
+            return True
+
+        def get(self, k):
+            return self.d.get(k)
+
+    reg = Registry.options(name="cluster-registry").remote()
+    ray_tpu.get(reg.put.remote("a", 1), timeout=60)
+    # Lookup from the driver resolves through the head directory.
+    again = ray_tpu.get_actor("cluster-registry")
+    assert ray_tpu.get(again.get.remote("a"), timeout=60) == 1
+
+
+def test_task_retry_on_node_death(cluster):
+    n1 = cluster.add_node(num_cpus=1, resources={"y": 1})
+    cluster.wait_for_nodes(2)
+
+    @ray_tpu.remote(resources={"y": 1}, max_retries=2)
+    def slow_id():
+        time.sleep(3.0)
+        import os as _os
+        return _os.environ.get("RT_SESSION_ID", "driver")
+
+    ref = slow_id.remote()
+    time.sleep(1.2)  # in flight on n1
+    # Add a replacement node BEFORE the kill so the retry has a home.
+    cluster.add_node(num_cpus=1, resources={"y": 1})
+    cluster.wait_for_nodes(3)
+    cluster.remove_node(n1, force=True)  # SIGKILL mid-task
+    out = ray_tpu.get(ref, timeout=120)
+    assert "-" in out  # re-ran on the replacement node
+
+
+def test_actor_restart_on_node_death(cluster):
+    n1 = cluster.add_node(num_cpus=1, resources={"y": 2})
+    cluster.wait_for_nodes(2)
+
+    @ray_tpu.remote(resources={"y": 1}, max_restarts=1)
+    class Stateful:
+        def __init__(self):
+            self.calls = 0
+
+        def bump(self):
+            self.calls += 1
+            return self.calls
+
+        def where(self):
+            import os as _os
+
+            return _os.environ.get("RT_SESSION_ID", "driver")
+
+    a = Stateful.remote()
+    first_home = ray_tpu.get(a.where.remote(), timeout=60)
+    assert first_home != "driver"
+    assert ray_tpu.get(a.bump.remote(), timeout=60) == 1
+    cluster.add_node(num_cpus=1, resources={"y": 2})
+    cluster.wait_for_nodes(3)
+    cluster.remove_node(n1, force=True)
+    # Restarted elsewhere with fresh state (reference semantics: restart
+    # re-runs __init__; state is lost unless checkpointed).
+    deadline = time.monotonic() + 60
+    home2 = None
+    while time.monotonic() < deadline:
+        try:
+            home2 = ray_tpu.get(a.where.remote(), timeout=30)
+            break
+        except ray_tpu.ActorDiedError:
+            time.sleep(0.2)
+    assert home2 is not None and home2 != first_home
+    assert ray_tpu.get(a.bump.remote(), timeout=30) == 1  # fresh state
+
+
+def test_cluster_survives_node_kill_for_new_work(cluster):
+    n1 = cluster.add_node(num_cpus=1)
+    cluster.wait_for_nodes(2)
+    cluster.remove_node(n1, force=True)
+
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    # The cluster (head + driver node) keeps serving new work.
+    assert ray_tpu.get(f.remote(1), timeout=60) == 2
+
+
+def test_placement_group_spread_across_nodes(cluster):
+    cluster.add_node(num_cpus=1, resources={"slot": 1})
+    cluster.add_node(num_cpus=1, resources={"slot": 1})
+    cluster.wait_for_nodes(3)
+
+    pg = ray_tpu.placement_group(
+        [{"slot": 1}, {"slot": 1}], strategy="STRICT_SPREAD")
+    assert pg.wait(timeout=30)
+    st = pg.state()
+    assert st["state"] == "CREATED"
+    homes = set(st["placement"].values())
+    assert len(homes) == 2  # strictly spread over two distinct nodes
+
+    # Reservation is real: a 3rd slot-consuming PG bundle can't be placed.
+    pg2 = ray_tpu.placement_group([{"slot": 1}], strategy="PACK")
+    assert not pg2.wait(timeout=1.0)
+    assert pg2.state()["state"] == "PENDING"
+    # Freeing the first PG lets the pending one place.
+    ray_tpu.remove_placement_group(pg)
+    assert pg2.wait(timeout=30)
+
+    @ray_tpu.remote(resources={"slot": 1})
+    def in_bundle():
+        import os as _os
+        return _os.environ.get("RT_SESSION_ID", "driver")
+
+    out = ray_tpu.get(
+        in_bundle.options(
+            placement_group=pg2, placement_group_bundle_index=0).remote(),
+        timeout=60)
+    assert "-" in out  # ran on a worker node holding the bundle
+
+
+def test_foreign_refs_returned_across_nodes(cluster):
+    """A ref created on a worker node (nested task) travels back to the
+    driver inside a result and stays resolvable: the driver pulls the
+    value from the owning node via the address stamped into the ref."""
+    cluster.add_node(num_cpus=2, resources={"x": 1})
+    cluster.wait_for_nodes(2)
+
+    @ray_tpu.remote(resources={"x": 1})
+    def outer():
+        import numpy as np
+
+        import ray_tpu as rt
+
+        @rt.remote
+        def inner():
+            return np.full(50_000, 7, dtype=np.int64)  # > inline threshold
+
+        return inner.remote()  # ObjectRef owned by the worker node
+
+    inner_ref = ray_tpu.get(outer.remote(), timeout=120)
+    val = ray_tpu.get(inner_ref, timeout=60)
+    assert val.shape == (50_000,) and int(val[0]) == 7
+    # wait() also resolves foreign refs.
+    ready, not_ready = ray_tpu.wait([inner_ref], num_returns=1, timeout=30)
+    assert ready and not not_ready
+
+
+def test_placement_group_infeasible_shape(cluster):
+    cluster.wait_for_nodes(1)
+    with pytest.raises(ValueError, match="infeasible"):
+        ray_tpu.placement_group([{"CPU": 64_000}])
