@@ -8,7 +8,10 @@ loop bound is computed from the q-block index, so the causal kernel does
 ~half the work of the dense one.
 
 Layout: q,k,v arrive as [batch, seq, heads, head_dim] (the model's native
-layout) and are blocked as (1, blk, 1, d) tiles directly — no transpose.
+layout) and are transposed to [batch, heads, seq, head_dim] around the
+kernel so each block's trailing dims are (seq_block, head_dim) — the TPU
+lowering requires the last two block dims to be (8,128)-divisible or
+equal to the array dims, which a heads-minor layout cannot satisfy.
 K/V for the whole (batch, head) stay VMEM-resident across q-blocks (their
 BlockSpec index does not depend on the q grid dimension, so Pallas keeps
 the block loaded).
@@ -40,7 +43,7 @@ DEFAULT_BLOCK_K = 512
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, blk_q: int, blk_k: int,
                 nk: int, orig_sk: int, causal: bool, scale: float):
     qi = pl.program_id(2)
-    q = q_ref[0, :, 0, :]                      # (blk_q, d), input dtype
+    q = q_ref[0, 0, :, :]                      # (blk_q, d), input dtype
     d = q.shape[-1]
 
     m0 = jnp.full((blk_q, 1), -jnp.inf, jnp.float32)
@@ -52,8 +55,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, blk_q: int, blk_k: int,
 
     def body(j, carry):
         m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(j * blk_k, blk_k), 0, :]   # (blk_k, d)
-        v_blk = v_ref[0, pl.ds(j * blk_k, blk_k), 0, :]
+        k_blk = k_ref[0, 0, pl.ds(j * blk_k, blk_k), :]   # (blk_k, d)
+        v_blk = v_ref[0, 0, pl.ds(j * blk_k, blk_k), :]
         # q·kᵀ on the MXU in input precision, accumulated f32.
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
@@ -80,13 +83,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, blk_q: int, blk_k: int,
     else:
         upper = nk
     m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
-    o_ref[0, :, 0, :] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    o_ref[0, 0, :, :] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
 
 
 def _pad_seq(x, blk):
-    pad = (-x.shape[1]) % blk
+    """x: [b, h, s, d] — pad s up to a multiple of blk."""
+    pad = (-x.shape[2]) % blk
     if pad:
-        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
     return x
 
 
@@ -95,10 +99,11 @@ def _fwd(q, k, v, *, causal: bool, blk_q: int, blk_k: int, interpret: bool):
     sk = k.shape[1]
     blk_q = min(blk_q, max(sq, 8))
     blk_k = min(blk_k, max(sk, 8))
-    qp = _pad_seq(q, blk_q)
-    kp = _pad_seq(k, blk_k)
-    vp = _pad_seq(v, blk_k)
-    sq_p, sk_p = qp.shape[1], kp.shape[1]
+    # heads-major layout: trailing block dims become (seq_block, head_dim).
+    qp = _pad_seq(q.transpose(0, 2, 1, 3), blk_q)
+    kp = _pad_seq(k.transpose(0, 2, 1, 3), blk_k)
+    vp = _pad_seq(v.transpose(0, 2, 1, 3), blk_k)
+    sq_p, sk_p = qp.shape[2], kp.shape[2]
     nq, nk = sq_p // blk_q, sk_p // blk_k
     scale = d ** -0.5
 
@@ -109,16 +114,16 @@ def _fwd(q, k, v, *, causal: bool, blk_q: int, blk_k: int, interpret: bool):
         kernel,
         grid=(b, h, nq),
         in_specs=[
-            pl.BlockSpec((1, blk_q, 1, d), lambda bi, hi, qi: (bi, qi, hi, 0)),
-            pl.BlockSpec((1, sk_p, 1, d), lambda bi, hi, qi: (bi, 0, hi, 0)),
-            pl.BlockSpec((1, sk_p, 1, d), lambda bi, hi, qi: (bi, 0, hi, 0)),
+            pl.BlockSpec((1, 1, blk_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, sk_p, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, sk_p, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
         ],
         out_specs=pl.BlockSpec(
-            (1, blk_q, 1, d), lambda bi, hi, qi: (bi, qi, hi, 0)),
+            (1, 1, blk_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
         interpret=interpret,
     )(qp, kp, vp)
-    return out[:, :sq]
+    return out[:, :, :sq].transpose(0, 2, 1, 3)
 
 
 @functools.lru_cache(maxsize=None)
