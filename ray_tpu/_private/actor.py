@@ -124,7 +124,11 @@ class ActorClass:
             res["CPU"] = float(num_cpus)
         if num_tpus is not None and num_tpus > 0:
             res["TPU"] = float(num_tpus)
-        res.setdefault("CPU", 0.0 if res.get("TPU") else 1.0)
+        # Actors hold their resources for their whole lifetime, so the
+        # implicit CPU default is 0 (reference parity: ray actors default to
+        # num_cpus=0 lifetime — python/ray/actor.py — precisely so idle
+        # actors don't starve task scheduling). Explicit num_cpus is charged.
+        res.setdefault("CPU", 0.0)
         self._resources = res
         self._max_restarts = max_restarts
         self._max_concurrency = max_concurrency

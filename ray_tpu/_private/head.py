@@ -242,13 +242,21 @@ class HeadService:
             return max(scores) if scores else 0.0
 
         if strategy_kind == "spread":
-            return min(pool, key=utilization).node_id
-        # hybrid: pack (most utilized under threshold) else spread
-        under = [e for e in pool
-                 if utilization(e) < self.cfg.scheduler_spread_threshold]
-        if under:
-            return max(under, key=utilization).node_id
-        return min(pool, key=utilization).node_id
+            chosen = min(pool, key=utilization)
+        else:
+            # hybrid: pack (most utilized under threshold) else spread
+            under = [e for e in pool
+                     if utilization(e) < self.cfg.scheduler_spread_threshold]
+            chosen = (max(under, key=utilization) if under
+                      else min(pool, key=utilization))
+        # Optimistic decrement so back-to-back placements (e.g. a gang of
+        # actors) spread before the next heartbeat trues availability up;
+        # the node's own accounting is ground truth and will park work if
+        # the hint was stale.
+        for k, v in resources.items():
+            if v:
+                chosen.available[k] = chosen.available.get(k, 0) - v
+        return chosen.node_id
 
     def node_address(self, node_id: NodeID) -> Optional[tuple]:
         e = self.nodes.get(node_id)

@@ -38,6 +38,11 @@ async def amain():
     node_id = (NodeID.from_hex(os.environ["RT_NODE_ID"])
                if os.environ.get("RT_NODE_ID") else NodeID.from_random())
     resources = json.loads(os.environ.get("RT_NODE_RESOURCES", '{"CPU": 1}'))
+    # One TPU_HOST slot = the right to own this host's chips as a
+    # gang-worker process. Only chip-bearing nodes get one by default
+    # (see runtime._detect_resources); virtual test nodes opt in via
+    # explicit resources={"TPU_HOST": 1}.
+    resources.setdefault("TPU_HOST", 1.0 if resources.get("TPU", 0) > 0 else 0.0)
 
     # Per-node shm namespace: this node's workers mmap segments the node
     # wrote, and vice versa; other nodes exchange bytes over the peer plane.

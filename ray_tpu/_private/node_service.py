@@ -92,6 +92,10 @@ class ActorState:
     name: Optional[str] = None
     death_cause: Optional[str] = None
     ready_fut: Optional[asyncio.Future] = None
+    # Resources held for the actor's lifetime (released on terminal DEAD,
+    # kept across restarts) — reference: actors reserve their resources
+    # while alive (src/ray/raylet/scheduling/cluster_resource_manager).
+    charged: Optional[dict] = None
 
 
 @dataclass
@@ -145,6 +149,8 @@ class NodeService:
         self.is_head_node = is_head_node
         self.total_resources = dict(resources)
         self.available = dict(resources)
+        # Actor creations parked for lifetime-resource availability.
+        self._pending_actor_creations: collections.deque = collections.deque()
 
         self.objects: dict[ObjectID, ObjectState] = {}
         self.functions: dict[str, bytes] = {}  # local cache; source of truth: head
@@ -467,7 +473,13 @@ class NodeService:
             self.loop.create_task(self._route_pg_task(spec))
             return
         needs_placement = (strat.kind == "spread"
-                           or not self._locally_feasible(spec))
+                           or not self._locally_feasible(spec)
+                           # Actors reserve lifetime resources: if this node
+                           # lacks availability, let the head place them on
+                           # one that has it instead of parking locally.
+                           or (spec.is_actor_creation
+                               and not self._is_device_task(spec)
+                               and self._lacks_lifetime_room(spec.resources)))
         if needs_placement and self.head is not None:
             if spec.is_actor_creation:
                 self.loop.create_task(self._create_actor_remotely(spec))
@@ -558,6 +570,9 @@ class NodeService:
 
     def _kick(self):
         if not self._closing:
+            # Any resource release (task finish, actor death, bundle free)
+            # routes through here, so parked actor creations get their retry.
+            self._retry_pending_actor_creations()
             self.loop.call_soon(self._dispatch)
 
     def _deps_ready(self, spec: TaskSpec) -> bool:
@@ -680,7 +695,8 @@ class NodeService:
             self._spawn_worker()
         return None
 
-    def _spawn_worker(self, actor_id: ActorID | None = None) -> WorkerHandle:
+    def _spawn_worker(self, actor_id: ActorID | None = None,
+                      preserve_platform_env: bool = False) -> WorkerHandle:
         wid = WorkerID.from_random()
         env = dict(os.environ)
         # CPU-lane workers must never touch the TPU: the device lane owns
@@ -688,11 +704,16 @@ class NodeService:
         # ambient env pins the TPU platform) and drop the TPU-plugin
         # bootstrap vars so sitecustomize doesn't dial the chip tunnel at
         # interpreter start (a second claimant would block on the
-        # single-tenant chip).
-        env["JAX_PLATFORMS"] = "cpu"
-        for var in ("PALLAS_AXON_POOL_IPS", "TPU_VISIBLE_CHIPS",
-                    "TPU_WORKER_HOSTNAMES"):
-            env.pop(var, None)
+        # single-tenant chip). Exception: gang workers holding the node's
+        # TPU_HOST slot own the host's chips (multi-controller SPMD, one
+        # process per host — reference: python/ray/train/_internal/
+        # backend_executor.py:124's one-worker-per-host gang) and keep the
+        # ambient platform env.
+        if not preserve_platform_env:
+            env["JAX_PLATFORMS"] = "cpu"
+            for var in ("PALLAS_AXON_POOL_IPS", "TPU_VISIBLE_CHIPS",
+                        "TPU_WORKER_HOSTNAMES"):
+                env.pop(var, None)
         env["RT_SESSION_ID"] = self.session_id
         env["RT_SOCK_PATH"] = self.sock_path
         env["RT_WORKER_ID"] = wid.hex()
@@ -1281,11 +1302,23 @@ class NodeService:
     # ------------------------------------------------------------------
     async def _create_actor(self, spec: TaskSpec):
         aid = spec.actor_id
+        is_device = self._is_device_task(spec)
+        need = {k: v for k, v in spec.resources.items() if v > 0}
+        if not is_device:
+            # Lifetime reservation: park until the node has availability
+            # (matches the reference's pending-actor semantics — an actor
+            # whose resources are taken waits, it does not oversubscribe).
+            if self._lacks_lifetime_room(need):
+                self._pending_actor_creations.append(spec)
+                return
+            for k, v in need.items():
+                self.available[k] = self.available.get(k, 0) - v
         actor = ActorState(
             actor_id=aid,
             creation_spec=spec,
-            is_device=self._is_device_task(spec),
+            is_device=is_device,
             name=spec.actor_name,
+            charged=(need if not is_device else None),
         )
         actor.ready_fut = self.loop.create_future()
         self.actors[aid] = actor
@@ -1336,7 +1369,10 @@ class NodeService:
             actor.instance = value
             self._actor_alive(actor)
         else:
-            worker = self._spawn_worker(actor_id=actor.actor_id)
+            worker = self._spawn_worker(
+                actor_id=actor.actor_id,
+                preserve_platform_env=spec.resources.get("TPU_HOST", 0) > 0,
+            )
             actor.worker = worker
             try:
                 await asyncio.wait_for(
@@ -1360,6 +1396,27 @@ class NodeService:
                 self._actor_creation_failed(actor, reply["error"])
                 return
             self._actor_alive(actor)
+
+    def _release_actor_resources(self, actor: ActorState):
+        """Return a dead actor's lifetime reservation to the pool and wake
+        anything parked on it."""
+        if actor.charged:
+            for k, v in actor.charged.items():
+                self.available[k] = self.available.get(k, 0) + v
+            actor.charged = None
+            self._kick()
+
+    def _lacks_lifetime_room(self, resources: dict) -> bool:
+        return any(self.available.get(k, 0) < v
+                   for k, v in resources.items() if v > 0)
+
+    def _retry_pending_actor_creations(self):
+        if not self._pending_actor_creations:
+            return
+        pending = list(self._pending_actor_creations)
+        self._pending_actor_creations.clear()
+        for spec in pending:
+            self.loop.create_task(self._create_actor(spec))
 
     def _actor_alive(self, actor: ActorState):
         actor.state = "ALIVE"
@@ -1392,6 +1449,7 @@ class NodeService:
             err = ActorDiedError(f"actor creation failed: {err}")
         actor.state = "DEAD"
         actor.death_cause = str(err)
+        self._release_actor_resources(actor)
         self._unregister_actor(actor)
         self._fail_task(actor.creation_spec, err)
         for spec in actor.queue:
@@ -1461,10 +1519,19 @@ class NodeService:
 
     def kill_actor(self, aid: ActorID, no_restart: bool = True):
         actor = self.actors.get(aid)
-        if actor is None or actor.state == "DEAD":
+        if actor is None:
+            # A kill can arrive while the creation is still parked on
+            # resources — drop it there so it can't spring to life later.
+            for spec in list(self._pending_actor_creations):
+                if spec.actor_id == aid:
+                    self._pending_actor_creations.remove(spec)
+                    self._fail_task(spec, ActorDiedError("actor was killed"))
+            return
+        if actor.state == "DEAD":
             return
         actor.state = "DEAD"
         actor.death_cause = "killed via kill()"
+        self._release_actor_resources(actor)
         self._unregister_actor(actor)
         for spec in actor.queue:
             self._fail_task(spec, ActorDiedError("actor was killed", task_name=spec.name))
@@ -1676,6 +1743,7 @@ class NodeService:
                 else:
                     actor.state = "DEAD"
                     actor.death_cause = "worker process died"
+                    self._release_actor_resources(actor)
                     self._unregister_actor(actor)
                     for spec in actor.queue:
                         self._fail_task(

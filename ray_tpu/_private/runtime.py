@@ -46,6 +46,12 @@ def _detect_resources(num_cpus=None, num_tpus=None, resources=None) -> dict:
     # Any local accelerator counts as the "device" lane even under the CPU
     # jax backend (tests use a virtual CPU mesh).
     out.setdefault("device", max(out["TPU"], 1.0))
+    # One TPU_HOST slot per chip-bearing node: a gang worker that claims it
+    # owns ALL the host's chips (one multi-controller SPMD process per
+    # host). Scheduling N gang workers with {"TPU_HOST": 1} each therefore
+    # lands exactly one per host. Chip-less nodes advertise 0 so spread
+    # can't put a gang member where there is nothing to own.
+    out.setdefault("TPU_HOST", 1.0 if out["TPU"] > 0 else 0.0)
     return out
 
 

@@ -68,7 +68,8 @@ class TrainWorker:
 
     def __init__(self, rank: int, world_size: int, loop_fn: Callable,
                  config: dict, experiment: str, trial: str,
-                 datasets: dict | None, resume_ckpt_path: Optional[str]):
+                 datasets: dict | None, resume_ckpt_path: Optional[str],
+                 defer_start: bool = False):
         import threading
 
         ctx = TrainContext(
@@ -109,7 +110,61 @@ class TrainWorker:
 
         self._thread = threading.Thread(target=run, daemon=True,
                                         name=f"train-loop-{rank}")
+        if not defer_start:
+            self._thread.start()
+
+    def get_rendezvous(self) -> str:
+        """Bind a free port on this worker's host for the jax.distributed
+        coordinator (called on rank 0 only; parity: the reference gets the
+        torch master addr/port from worker 0 —
+        /root/reference/python/ray/train/_internal/backend_executor.py:124,
+        train/torch/config.py:62)."""
+        import socket
+
+        # UDP connect probe (no packets sent) yields the routable interface
+        # IP; gethostbyname(hostname) maps to 127.0.1.1 on stock Debian
+        # /etc/hosts, which other hosts cannot dial.
+        host = "127.0.0.1"
+        try:
+            probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                probe.connect(("8.8.8.8", 80))
+                host = probe.getsockname()[0]
+            finally:
+                probe.close()
+        except OSError:
+            try:
+                host = socket.gethostbyname(socket.gethostname())
+            except OSError:
+                pass
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.bind(("", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return f"{host}:{port}"
+
+    def start(self, dist: Optional[dict] = None) -> bool:
+        """Start the deferred training loop. ``dist`` (multi-host gangs)
+        carries the jax.distributed rendezvous: each gang process joins the
+        coordinator before any backend use, so the mesh spans every host's
+        chips (multi-controller SPMD — no NCCL groups, the collective plane
+        is XLA/ICI)."""
+        if dist is not None:
+            try:
+                import jax
+
+                jax.distributed.initialize(
+                    coordinator_address=dist["coordinator"],
+                    num_processes=dist["num_processes"],
+                    process_id=dist["process_id"],
+                    initialization_timeout=dist.get("timeout", 60),
+                )
+            except BaseException as e:  # noqa: BLE001 - surfaced via poll()
+                self._error = f"jax.distributed rendezvous failed: {e}"
+                self._done = True
+                return False
         self._thread.start()
+        return True
 
     def poll(self, timeout: float = 0.5):
         """Drain queued reports. Returns (reports, done, error)."""
@@ -157,15 +212,25 @@ class JaxTrainer:
 
         n = self.scaling.num_workers
         use_device = self.scaling.use_tpu
-        if use_device and n > 1:
-            raise ValueError(
-                "round-1 limitation: one TPU gang worker per host — chip "
-                "parallelism happens inside the compiled step via the mesh; "
-                "set num_workers=1 (or use_tpu=False for CPU gang testing)"
-            )
         cls = ray_tpu.remote(TrainWorker)
         opts = dict(max_concurrency=4)
-        if use_device:
+        multihost = use_device and n > 1
+        if multihost:
+            # Multi-host SPMD gang: one process per host, each owning all of
+            # its host's chips (TPU_HOST slot → platform env preserved, see
+            # node_service._spawn_worker), joined into one global mesh via
+            # jax.distributed. Spread lands one worker per node.
+            total = ray_tpu.cluster_resources().get("TPU_HOST", 0)
+            if total < n:
+                raise ValueError(
+                    f"gang of {n} TPU workers needs {n} hosts but the "
+                    f"cluster has {int(total)} TPU_HOST slot(s) — add nodes "
+                    f"(ray_tpu.cluster_utils.Cluster.add_node) or reduce "
+                    f"num_workers")
+            opts["resources"] = {"TPU_HOST": 1,
+                                 **self.scaling.resources_per_worker}
+            opts["scheduling_strategy"] = "spread"
+        elif use_device:
             opts["scheduling_strategy"] = "device"
         else:
             opts["num_cpus"] = self.scaling.resources_per_worker.get("CPU", 1)
@@ -175,8 +240,17 @@ class JaxTrainer:
             w = cls.options(**opts).remote(
                 rank, n, self.loop, self.config, name, f"{name}_w{rank}",
                 datasets_per_worker[rank], resume_path,
+                defer_start=multihost,
             )
             workers.append(w)
+        if multihost:
+            coordinator = ray_tpu.get(workers[0].get_rendezvous.remote(),
+                                      timeout=120)
+            ray_tpu.get([
+                w.start.remote({"coordinator": coordinator,
+                                "num_processes": n, "process_id": rank})
+                for rank, w in enumerate(workers)
+            ], timeout=180)
         return workers
 
     def _split_datasets(self, n: int) -> list[dict]:

@@ -148,3 +148,87 @@ def test_cpu_gang_multi_worker(rt, tmp_path):
     result = trainer.fit()
     assert result.error is None
     assert result.metrics == {"rank": 0, "ws": 2}
+
+
+def test_multihost_gang_tpu(tmp_path):
+    """num_workers=2, use_tpu=True: two gang processes on two cluster nodes
+    rendezvous via jax.distributed into one global CPU mesh (16 devices =
+    2 procs x 8 local). VERDICT r1 item 3; parity target:
+    /root/reference/python/ray/train/_internal/backend_executor.py:124."""
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    # The driver node is not a TPU host (its env owns the real chip's
+    # tunnel in CI); gang workers must land on the worker nodes.
+    cluster = Cluster(init_args=dict(num_cpus=2, resources={"TPU_HOST": 0}))
+    def _multihost_loop(config):
+        """Runs inside each gang process: joins the global mesh (rendezvous
+        already done by TrainWorker.start), checks the world view, runs a
+        cross-process reduction and a tiny GPT step on per-host data shards."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ray_tpu import train
+        from ray_tpu.models import gpt
+        from ray_tpu.parallel import MeshSpec
+
+        ctx = train.get_context()
+        rank, procs = ctx.get_world_rank(), jax.process_count()
+        ndev = jax.device_count()
+        mesh = MeshSpec(dp=ndev).build()
+        dp_sharding = NamedSharding(mesh, P("dp"))
+
+        # Cross-process reduction: each process contributes rank+1 rows.
+        local = np.full((ndev // procs, 4), rank + 1.0, np.float32)
+        garr = jax.make_array_from_process_local_data(dp_sharding, local, (ndev, 4))
+        total = float(jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(garr))
+
+        # GPT step over the global mesh with per-host token shards.
+        cfg = gpt.GPTConfig(vocab_size=128, max_seq=16, d_model=32,
+                            n_layer=2, n_head=2)
+        opt = optax.adam(1e-3)
+        params = gpt.init(jax.random.PRNGKey(0), cfg)
+        state = {"params": params, "opt_state": opt.init(params), "step": 0}
+        state = gpt.shard_state(state, mesh, cfg)
+        step = gpt.make_train_step(cfg, opt, mesh)
+        rng = np.random.default_rng(rank)
+        local_tok = rng.integers(0, cfg.vocab_size,
+                                 (ndev // procs, cfg.max_seq)).astype(np.int32)
+        tokens = jax.make_array_from_process_local_data(
+            dp_sharding, local_tok, (ndev, cfg.max_seq))
+        state, metrics = step(state, tokens)
+        train.report({"sum": total, "procs": procs, "devices": ndev,
+                      "loss": float(metrics["loss"])})
+
+    try:
+        cluster.add_node(num_cpus=2, resources={"TPU_HOST": 1})
+        cluster.add_node(num_cpus=2, resources={"TPU_HOST": 1})
+        cluster.wait_for_nodes(2)
+        trainer = JaxTrainer(
+            _multihost_loop,
+            scaling_config=ScalingConfig(num_workers=2, use_tpu=True),
+            run_config=RunConfig(name="multihost", storage_path=str(tmp_path)),
+        )
+        result = trainer.fit()
+        assert result.error is None, result.error
+        assert result.metrics["procs"] == 2
+        assert result.metrics["devices"] == 16
+        # 8 rows of 1.0 from rank 0 + 8 rows of 2.0 from rank 1, 4 cols.
+        assert result.metrics["sum"] == 8 * 4 * 1.0 + 8 * 4 * 2.0
+        assert np.isfinite(result.metrics["loss"])
+    finally:
+        cluster.shutdown()
+        ray_tpu.shutdown()
+
+
+def test_multihost_gang_infeasible(rt):
+    """A gang larger than the cluster's TPU_HOST capacity fails fast with
+    a clear error instead of queueing forever."""
+    with pytest.raises(ValueError, match="TPU_HOST"):
+        JaxTrainer(
+            lambda config: None,
+            scaling_config=ScalingConfig(num_workers=3, use_tpu=True),
+        ).fit()
