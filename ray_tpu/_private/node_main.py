@@ -27,7 +27,7 @@ from .config import get_config
 from .head import RemoteHeadClient
 from .ids import NodeID
 from .node_service import NodeService
-from .object_store import SharedMemoryStore
+from .object_store import make_store
 from .rpc import async_connect
 
 
@@ -47,7 +47,7 @@ async def amain():
     # Per-node shm namespace: this node's workers mmap segments the node
     # wrote, and vice versa; other nodes exchange bytes over the peer plane.
     node_session = f"{session_id}-{node_id.hex()[:8]}"
-    shm = SharedMemoryStore(node_session)
+    shm = make_store(node_session)
     sock_dir = os.environ.get("RT_SOCK_DIR", "/tmp")
     sock_path = os.path.join(sock_dir, f"rtpu-{node_session}.sock")
 

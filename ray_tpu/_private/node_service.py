@@ -151,6 +151,8 @@ class NodeService:
         self.available = dict(resources)
         # Actor creations parked for lifetime-resource availability.
         self._pending_actor_creations: collections.deque = collections.deque()
+        # kill() that raced ahead of the creation it targets.
+        self._killed_before_create: set = set()
 
         self.objects: dict[ObjectID, ObjectState] = {}
         self.functions: dict[str, bytes] = {}  # local cache; source of truth: head
@@ -341,6 +343,10 @@ class NodeService:
         st = self._obj(oid)
         st.status, st.location, st.value = READY, "shm", None
         st.size = size
+        # Referenced objects must survive capacity eviction (native store):
+        # pinned while the node's object table holds them, unpinned on free
+        # (reference: raylet PinObjectIDs / local_object_manager.h:41).
+        self.shm.pin(oid)
         self._wake(oid, st)
 
     def mark_error(self, oid: ObjectID, err: TaskError):
@@ -385,6 +391,7 @@ class NodeService:
         if st.refcount <= 0 and st.status != PENDING and not st.waiters:
             self.objects.pop(oid, None)
             if st.location == "shm":
+                self.shm.unpin(oid)
                 self.shm.delete(oid)
 
     def materialize_for_ipc(self, oid: ObjectID) -> tuple:
@@ -1302,6 +1309,10 @@ class NodeService:
     # ------------------------------------------------------------------
     async def _create_actor(self, spec: TaskSpec):
         aid = spec.actor_id
+        if aid in self._killed_before_create:
+            self._killed_before_create.discard(aid)
+            self._fail_task(spec, ActorDiedError("actor was killed"))
+            return
         is_device = self._is_device_task(spec)
         need = {k: v for k, v in spec.resources.items() if v > 0}
         if not is_device:
@@ -1419,6 +1430,16 @@ class NodeService:
             self.loop.create_task(self._create_actor(spec))
 
     def _actor_alive(self, actor: ActorState):
+        if actor.state == "DEAD":
+            # kill() landed while the creation was in flight (its lifetime
+            # reservation is already released) — tear down what just came
+            # up instead of resurrecting a zombie.
+            if actor.worker is not None:
+                self._kill_worker(actor.worker)
+            if actor.device_pool is not None:
+                actor.device_pool.shutdown(wait=False)
+                actor.instance = None
+            return
         actor.state = "ALIVE"
         spec = actor.creation_spec
         # The creation "return" is the handle-ready signal.
@@ -1520,8 +1541,10 @@ class NodeService:
     def kill_actor(self, aid: ActorID, no_restart: bool = True):
         actor = self.actors.get(aid)
         if actor is None:
-            # A kill can arrive while the creation is still parked on
-            # resources — drop it there so it can't spring to life later.
+            # A kill can arrive while the creation is parked on resources
+            # (or mid-retry between deque and task) — record it so the
+            # creation can't spring to life later.
+            self._killed_before_create.add(aid)
             for spec in list(self._pending_actor_creations):
                 if spec.actor_id == aid:
                     self._pending_actor_creations.remove(spec)

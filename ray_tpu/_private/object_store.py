@@ -117,6 +117,13 @@ class SharedMemoryStore:
         except FileNotFoundError:
             pass
 
+    def pin(self, oid: ObjectID):
+        """No-op: the Python store has no eviction to protect against (the
+        native subclass overrides with real cross-process pin files)."""
+
+    def unpin(self, oid: ObjectID):
+        """No-op (see pin)."""
+
     def size_of(self, oid: ObjectID) -> Optional[int]:
         try:
             return os.stat(self._path(oid)).st_size

@@ -25,7 +25,7 @@ from .exceptions import GetTimeoutError
 from .ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
 from .node_service import ERROR, PENDING, NodeService
 from .object_ref import ObjectRef
-from .object_store import SharedMemoryStore
+from .object_store import make_store
 from .task_spec import TaskSpec, export_function
 
 
@@ -69,7 +69,7 @@ class Runtime:
         self._put_counter = 0
         self._put_lock = threading.Lock()
 
-        self.shm = SharedMemoryStore(self.session_id)
+        self.shm = make_store(self.session_id)
         sock_dir = os.environ.get("RT_SOCK_DIR", "/tmp")
         self.sock_path = os.path.join(sock_dir, f"rtpu-{self.session_id}.sock")
 

@@ -28,7 +28,7 @@ from .config import get_config
 from .exceptions import GetTimeoutError, TaskError
 from .ids import ActorID, JobID, ObjectID, TaskID, WorkerID
 from .object_ref import ObjectRef
-from .object_store import SharedMemoryStore
+from .object_store import make_store
 from .rpc import DuplexClient
 from .task_spec import REF, VAL, SchedulingStrategy, TaskSpec
 
@@ -48,7 +48,7 @@ class WorkerContext:
         self.worker_id = worker_id
         self.node_id = None
         self.job_id = JobID.nil()
-        self.shm = SharedMemoryStore(session_id)
+        self.shm = make_store(session_id)
         self._fn_cache: dict[str, Any] = {}
         self._exported: set[str] = set()
         self._actors: dict[ActorID, Any] = {}

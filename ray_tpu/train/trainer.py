@@ -227,6 +227,13 @@ class JaxTrainer:
                     f"cluster has {int(total)} TPU_HOST slot(s) — add nodes "
                     f"(ray_tpu.cluster_utils.Cluster.add_node) or reduce "
                     f"num_workers")
+            if self.scaling.resources_per_worker.get("TPU"):
+                raise ValueError(
+                    "multi-host gangs must not request TPU in "
+                    "resources_per_worker: a gang worker owns ALL of its "
+                    "host's chips via the TPU_HOST slot (a TPU demand would "
+                    "route it to the in-process device lane instead of a "
+                    "dedicated host process)")
             opts["resources"] = {"TPU_HOST": 1,
                                  **self.scaling.resources_per_worker}
             opts["scheduling_strategy"] = "spread"

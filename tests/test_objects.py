@@ -13,6 +13,12 @@ import numpy as np
 import ray_tpu
 from ray_tpu._private import serialization
 
+def _segments(d):
+    """Object segments in a store dir (the native store keeps a .pins
+    bookkeeping subdir that is not an object)."""
+    return [f for f in os.listdir(d) if not f.startswith(".")]
+
+
 
 def test_serialization_roundtrip_zero_copy():
     arr = np.arange(1000, dtype=np.float64)
@@ -28,14 +34,14 @@ def test_object_freed_when_refs_dropped(rt):
     ref = ray_tpu.put(big)
     shm_dir = rt.shm.prefix
     time.sleep(0.3)
-    assert len(os.listdir(shm_dir)) == 1
+    assert len(_segments(shm_dir)) == 1
 
     del ref
     gc.collect()
     deadline = time.time() + 10
-    while time.time() < deadline and os.listdir(shm_dir):
+    while time.time() < deadline and _segments(shm_dir):
         time.sleep(0.1)
-    assert os.listdir(shm_dir) == [], "shm object not freed after ref drop"
+    assert _segments(shm_dir) == [], "shm object not freed after ref drop"
 
 
 def test_chained_intermediate_freed(rt):
@@ -64,6 +70,6 @@ def test_put_many_objects_no_growth(rt):
         del r
     gc.collect()
     deadline = time.time() + 10
-    while time.time() < deadline and os.listdir(rt.shm.prefix):
+    while time.time() < deadline and _segments(rt.shm.prefix):
         time.sleep(0.1)
-    assert os.listdir(rt.shm.prefix) == []
+    assert _segments(rt.shm.prefix) == []
