@@ -63,6 +63,9 @@ class Config:
     # Max bytes of lineage (task specs kept for object reconstruction) per
     # owner (reference: max_lineage_bytes, task_manager.h).
     max_lineage_bytes: int = _cfg(100 * 1024 * 1024)
+    # How many times one task may be resubmitted to reconstruct its lost
+    # outputs (reference: max_task_retries_for_object_reconstruction).
+    max_object_reconstructions: int = _cfg(3)
 
     # --- control plane ---
     controller_port: int = _cfg(0)  # 0 = unix socket only

@@ -363,6 +363,65 @@ class NodeService:
         # A ref dropped while the object was still pending: free on arrival.
         self._maybe_free(oid, st)
 
+    def _start_reconstruction(self, oid: ObjectID) -> bool:
+        """Lineage reconstruction: resubmit the creating task of an object
+        whose bytes were lost from the store (reference:
+        src/ray/core_worker/object_recovery_manager.h:41 +
+        task_manager.h:432 resubmit-from-lineage). Loop thread only.
+
+        Actor-method results are not replayable (non-idempotent state
+        mutation) — matches the reference, which only reconstructs objects
+        from deterministic task lineage."""
+        st = self.objects.get(oid)
+        if st is None or st.creating_spec is None:
+            return False
+        if st.status == PENDING:
+            # The original task or a concurrent reconstruction is already
+            # in flight — don't double-resubmit (single loop thread makes
+            # this check atomic).
+            return True
+        spec = st.creating_spec
+        if spec.actor_id is not None:
+            return False
+        attempts = getattr(spec, "_reconstructions", 0)
+        if attempts >= self.cfg.max_object_reconstructions:
+            return False
+        # Every argument must still be resolvable; a freed dep means the
+        # lineage is broken and the object is genuinely lost.
+        for dep in spec.dependencies():
+            dst = self.objects.get(dep)
+            if dst is None or dst.status == ERROR:
+                return False
+        spec._reconstructions = attempts + 1
+        self.counters["objects_reconstructed"] += 1
+        for rid in spec.return_ids():
+            rst = self._obj(rid)
+            if rst.status != PENDING:
+                rst.status, rst.location, rst.value = PENDING, None, None
+                rst.error = None
+            self.shm.unpin(rid)
+            self.shm.delete(rid)
+        # Re-pin args for the fresh run (symmetric with submit()).
+        spec._deps_released = False
+        for dep in spec.dependencies():
+            self.incref(dep)
+        spec._remote = False
+        spec._charged = None
+        self.task_events.append(
+            {"task_id": spec.task_id.hex(), "name": spec.name,
+             "state": "RECONSTRUCTING", "ts": time.time()})
+        self._route(spec)
+        return True
+
+    async def recover_object(self, oid: ObjectID,
+                             timeout: float | None = None) -> bool:
+        """Start reconstruction of a lost object and wait for it to reach a
+        terminal state again. True = worth re-reading (READY or ERROR)."""
+        if not self._start_reconstruction(oid):
+            return False
+        st = await self.wait_object(oid, timeout)
+        return st.status != PENDING
+
     async def wait_object(self, oid: ObjectID, timeout: float | None = None) -> ObjectState:
         st = self._obj(oid)
         if st.status == PENDING:
@@ -409,6 +468,9 @@ class NodeService:
             blob = serialization.serialize(val)
         if len(blob) > self.cfg.max_inline_object_size:
             self.shm.put(oid, blob)
+            # Same invariant as mark_ready_shm: table-referenced segments
+            # are pinned against capacity eviction.
+            self.shm.pin(oid)
             st.location, st.value, st.size = "shm", None, len(blob)
             return ("shm",)
         return ("bytes", blob)
@@ -1263,7 +1325,21 @@ class NodeService:
                 return ("timeout",)
             if st.status == ERROR:
                 return ("err", st.error)
-            return ("b", self._materialize_blob(oid))
+            try:
+                return ("b", self._materialize_blob(oid))
+            except ObjectLostError as e:
+                # Serve-side loss: reconstruct from lineage, then retry once.
+                try:
+                    if await self.recover_object(oid, payload.get("timeout")):
+                        st = self.objects.get(oid)
+                        if st is None:
+                            return ("err", e)
+                        if st.status == ERROR:
+                            return ("err", st.error)
+                        return ("b", self._materialize_blob(oid))
+                except ObjectLostError as e2:
+                    e = e2
+                return ("err", e)
         if method == "incref":
             self.incref(ObjectID(payload))
             return True
@@ -1433,12 +1509,15 @@ class NodeService:
         if actor.state == "DEAD":
             # kill() landed while the creation was in flight (its lifetime
             # reservation is already released) — tear down what just came
-            # up instead of resurrecting a zombie.
+            # up instead of resurrecting a zombie, and resolve the creation
+            # return so handle waiters don't hang.
             if actor.worker is not None:
                 self._kill_worker(actor.worker)
             if actor.device_pool is not None:
                 actor.device_pool.shutdown(wait=False)
                 actor.instance = None
+            self._fail_task(actor.creation_spec,
+                            ActorDiedError("actor was killed during creation"))
             return
         actor.state = "ALIVE"
         spec = actor.creation_spec
@@ -1545,6 +1624,10 @@ class NodeService:
             # (or mid-retry between deque and task) — record it so the
             # creation can't spring to life later.
             self._killed_before_create.add(aid)
+            if len(self._killed_before_create) > 4096:
+                # Bounded: kills of never-created ids would otherwise
+                # accumulate forever on a long-lived node.
+                self._killed_before_create.pop()
             for spec in list(self._pending_actor_creations):
                 if spec.actor_id == aid:
                     self._pending_actor_creations.remove(spec)
