@@ -52,6 +52,10 @@ class AlgorithmConfig:
         self.clip_param = 0.2
         self.vf_coeff = 0.5
         self.entropy_coeff = 0.0
+        # IMPALA
+        self.broadcast_interval = 1  # updates a runner may lag before sync
+        self.rho_clip = 1.0
+        self.c_clip = 1.0
         # DQN
         self.replay_buffer_capacity = 50_000
         self.target_update_freq = 100

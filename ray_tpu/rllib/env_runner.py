@@ -77,6 +77,10 @@ class SingleAgentEnvRunner:
             "rewards": np.stack(rew_buf),    # [T, N]
             "dones": np.stack(done_buf),     # [T, N]
             "bootstrap_value": bootstrap,    # [N]
+            # Off-policy learners (IMPALA/V-trace) bootstrap with the
+            # TARGET policy's value of the final obs, not the behavior
+            # policy's value above.
+            "final_obs": self._obs.astype(np.float32),  # [N, obs_dim]
         }
 
     def rollout_transitions(self, num_steps: int, action_fn) -> dict:
@@ -141,7 +145,7 @@ def flatten_batch(batch: dict) -> dict:
     """[T, N, ...] -> [T*N, ...] for minibatch SGD."""
     out = {}
     for k, v in batch.items():
-        if k == "bootstrap_value":
+        if k in ("bootstrap_value", "final_obs"):  # [N, ...] extras
             continue
         out[k] = v.reshape((-1,) + v.shape[2:])
     return out

@@ -1,0 +1,182 @@
+"""IMPALA: asynchronous actor-learner RL with V-trace correction.
+
+Capability parity target: /root/reference/rllib/algorithms/impala/
+impala.py:126-336 (async env-runner sampling feeding the learner through
+a queue, periodic weight broadcast, off-policy V-trace correction —
+vtrace.py in the reference) — north-star #5 in SURVEY §6: CPU env-runner
+actors feed rollout fragments to a TPU learner that never waits for the
+slowest actor.
+
+TPU-native shape: the V-trace backward recursion is a `lax.scan` inside
+one jitted update (time-major [T, N] batches keep the matmuls batched on
+the MXU); the async plumbing is ray_tpu actors + `wait`-any, the in-built
+equivalent of the reference's AsyncRequestsManager.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .algorithm import Algorithm
+from .learner import Learner, LearnerGroup
+
+
+def vtrace_returns(behavior_logp, target_logp, rewards, dones, values,
+                   bootstrap_value, gamma, rho_clip=1.0, c_clip=1.0):
+    """V-trace targets and policy-gradient advantages (Espeholt et al. '18).
+
+    All inputs time-major [T, N] (values too); bootstrap_value [N] is the
+    target policy's value of the state after the last step. Returns
+    (vs [T, N], pg_advantages [T, N]).
+    """
+    rho = jnp.exp(target_logp - behavior_logp)
+    rho_bar = jnp.minimum(rho_clip, rho)
+    c_bar = jnp.minimum(c_clip, rho)
+    nonterminal = 1.0 - dones.astype(jnp.float32)
+    # values_{t+1}: next-step value, cut at episode ends, bootstrapped at T.
+    next_values = jnp.concatenate(
+        [values[1:], bootstrap_value[None]], axis=0)
+    deltas = rho_bar * (rewards + gamma * next_values * nonterminal - values)
+
+    def backward(acc, xs):
+        delta_t, c_t, nt_t = xs
+        acc = delta_t + gamma * nt_t * c_t * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        backward, jnp.zeros_like(bootstrap_value),
+        (deltas, c_bar, nonterminal), reverse=True)
+    vs = values + vs_minus_v
+    next_vs = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    pg_adv = rho_bar * (rewards + gamma * next_vs * nonterminal - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
+class IMPALALearner(Learner):
+    """V-trace actor-critic loss over time-major rollout fragments
+    (parity: /root/reference/rllib/algorithms/impala/torch/
+    impala_torch_learner.py + vtrace implementations)."""
+
+    def __init__(self, module, *, gamma: float = 0.99,
+                 vf_coeff: float = 0.5, entropy_coeff: float = 0.01,
+                 rho_clip: float = 1.0, c_clip: float = 1.0, **kw):
+        self.gamma = gamma
+        self.vf_coeff = vf_coeff
+        self.entropy_coeff = entropy_coeff
+        self.rho_clip = rho_clip
+        self.c_clip = c_clip
+        super().__init__(module, **kw)
+
+    def loss(self, params, batch):
+        T, N = batch["rewards"].shape
+        obs_flat = batch["obs"].reshape((T * N,) + batch["obs"].shape[2:])
+        act_flat = batch["actions"].reshape(T * N)
+        logp_f, entropy_f, value_f = self.module.forward_train(
+            params, obs_flat, act_flat)
+        target_logp = logp_f.reshape(T, N)
+        values = value_f.reshape(T, N)
+        bootstrap = self.module.value(params, batch["final_obs"])
+        vs, pg_adv = vtrace_returns(
+            batch["logp"], target_logp, batch["rewards"], batch["dones"],
+            values, bootstrap, self.gamma, self.rho_clip, self.c_clip)
+        pi_loss = -(target_logp * pg_adv).mean()
+        vf_loss = 0.5 * ((vs - values) ** 2).mean()
+        ent = entropy_f.mean()
+        total = pi_loss + self.vf_coeff * vf_loss - self.entropy_coeff * ent
+        rho = jnp.exp(target_logp - batch["logp"])
+        return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                       "entropy": ent, "mean_rho": rho.mean()}
+
+
+class IMPALA(Algorithm):
+    """Async actor-learner driver.
+
+    training_step: wait for ANY runner's fragment (never the slowest),
+    update on it immediately, hand the runner fresh weights if it lags
+    more than ``broadcast_interval`` updates, and resubmit its next
+    sample — the runner is always rolling out while the learner trains
+    (the queue is the in-flight ref set)."""
+
+    def setup(self, config):
+        super().setup(config)
+        self._num_updates = 0
+        self._env_steps = 0
+        # runner -> (in-flight sample ref, weight version it holds)
+        self._inflight: dict = {}
+        self._weight_version = 0
+        if self.remote_runners:
+            for r in self.remote_runners:
+                self._inflight[r] = (self._submit_sample(r),
+                                     self._weight_version)
+
+    def _submit_sample(self, runner):
+        return runner.sample.remote(self.config.rollout_fragment_length)
+
+    def training_step(self) -> dict:
+        import ray_tpu
+
+        cfg = self.config
+        interval = cfg.broadcast_interval
+        metrics: dict = {}
+        if not self.remote_runners:
+            # Degenerate sync mode (local runner) — V-trace still applies,
+            # rho == 1 since there is no lag.
+            batch = self.local_runner.sample(cfg.rollout_fragment_length)
+            self._record_episodes(self.local_runner.episode_returns())
+            metrics = self.learner_group.learner.update_from_batch(
+                self._strip(batch))
+            self._num_updates += 1
+            self._env_steps += batch["rewards"].size
+            self.local_runner.set_state(self.learner_group.get_weights())
+        else:
+            by_ref = {ref: r for r, (ref, _) in self._inflight.items()}
+            ready, _ = ray_tpu.wait(list(by_ref), num_returns=1)
+            for ref in ready:
+                runner = by_ref[ref]
+                batch = ray_tpu.get(ref)
+                _, version = self._inflight[runner]
+                # Staleness of THIS fragment: how many updates behind the
+                # learner the behavior policy was when it sampled (0 ==
+                # perfectly on-policy).
+                lag = self._weight_version - version
+                metrics = self.learner_group.learner.update_from_batch(
+                    self._strip(batch))
+                self._num_updates += 1
+                self._weight_version += 1
+                self._env_steps += batch["rewards"].size
+                metrics["policy_lag"] = lag
+                # Enqueue the (fast) episode-stats fetch and the weight
+                # sync BEFORE the next rollout so the blocking get below
+                # is not queued behind a full sample() on the serial actor.
+                ep_ref = runner.episode_returns.remote()
+                if self._weight_version - version >= interval:
+                    runner.set_state.remote(self.learner_group.get_weights())
+                    version = self._weight_version
+                self._inflight[runner] = (self._submit_sample(runner),
+                                          version)
+                self._record_episodes(ray_tpu.get(ep_ref))
+        metrics["num_env_steps_sampled"] = self._env_steps
+        metrics["num_updates"] = self._num_updates
+        return metrics
+
+    @staticmethod
+    def _strip(batch: dict) -> dict:
+        """Keep the fields the V-trace loss consumes, time-major."""
+        return {k: batch[k] for k in
+                ("obs", "actions", "logp", "rewards", "dones", "final_obs")}
+
+    def _make_learner_group(self):
+        learner = IMPALALearner(
+            self._make_module(),
+            gamma=self.config.gamma,
+            vf_coeff=self.config.vf_coeff,
+            entropy_coeff=self.config.entropy_coeff,
+            rho_clip=self.config.rho_clip,
+            c_clip=self.config.c_clip,
+            lr=self.config.lr,
+            grad_clip=self.config.grad_clip,
+            seed=self.config.seed or 0,
+        )
+        return LearnerGroup(learner)
