@@ -46,3 +46,5 @@ from ._private.exceptions import (  # noqa: F401
     WorkerCrashedError,
 )
 from ._private.task_spec import SchedulingStrategy  # noqa: F401
+from . import util  # noqa: F401
+from .util.state import timeline  # noqa: F401

@@ -381,6 +381,12 @@ class HeadService:
                 "bundles": pg.bundles,
                 "strategy": pg.strategy}
 
+    def list_pgs(self) -> list:
+        return [{"placement_group_id": pg.pg_id.hex(), "state": pg.state,
+                 "strategy": pg.strategy, "bundles": pg.bundles,
+                 "placement": {i: n.hex() for i, n in pg.placement.items()}}
+                for pg in self.placement_groups.values()]
+
     async def retry_pending_pgs(self):
         for pg in self.placement_groups.values():
             if pg.state == "PENDING":
@@ -494,6 +500,8 @@ class HeadService:
             return True
         if method == "pg_state":
             return self.pg_state(PlacementGroupID(payload))
+        if method == "list_pgs":
+            return self.list_pgs()
         raise RuntimeError(f"unknown head rpc: {method}")
 
     async def shutdown(self):
@@ -572,6 +580,9 @@ class LocalHeadClient:
     async def pg_state(self, pg_id):
         return self.head.pg_state(pg_id)
 
+    async def list_pgs(self):
+        return self.head.list_pgs()
+
 
 class RemoteHeadClient:
     """Head access for worker nodes: TCP duplex connection; the same
@@ -634,3 +645,6 @@ class RemoteHeadClient:
 
     async def pg_state(self, pg_id):
         return await self.conn.call("pg_state", pg_id.binary())
+
+    async def list_pgs(self):
+        return await self.conn.call("list_pgs", None)

@@ -190,6 +190,11 @@ class NodeService:
         self.task_events: collections.deque = collections.deque(
             maxlen=self.cfg.task_events_buffer_size
         )
+        # Latest-state row per task, bounded like the event buffer
+        # (reference: GCS task events, gcs_task_manager.h:85 — state API
+        # and timeline read these).
+        self.task_table: "collections.OrderedDict[str, dict]" = \
+            collections.OrderedDict()
 
     async def start(self):
         await self.server.start()
@@ -202,6 +207,125 @@ class NodeService:
     @property
     def peer_address(self) -> tuple:
         return self.peer_server.address
+
+    # ------------------------------------------------------------------
+    # Introspection: task events + state snapshot (reference: GCS task
+    # events / state API, python/ray/util/state/api.py,
+    # gcs_task_manager.h:85)
+    # ------------------------------------------------------------------
+    def _event(self, spec, state: str, worker: str | None = None):
+        tid = spec.task_id.hex()
+        ev = {"task_id": tid, "name": spec.name, "state": state,
+              "ts": time.time(), "node_id": self.node_id.hex()}
+        if worker is not None:
+            ev["worker"] = worker
+        if spec.actor_id is not None:
+            ev["actor_id"] = spec.actor_id.hex()
+        self.task_events.append(ev)
+        row = self.task_table.get(tid)
+        if row is None:
+            row = {"task_id": tid, "name": spec.name,
+                   "node_id": ev["node_id"],
+                   "actor_id": ev.get("actor_id"),
+                   "submitted_ts": ev["ts"]}
+            self.task_table[tid] = row
+            # Evict the oldest TERMINAL row first — a long-running task's
+            # live row must not be dropped (and later resurrected with a
+            # bogus submitted_ts) just because newer tasks streamed past.
+            scanned = 0
+            while (len(self.task_table) > self.cfg.task_events_buffer_size
+                   and scanned < 16):
+                old_tid, old = next(iter(self.task_table.items()))
+                if old.get("state") in ("FINISHED", "FAILED") or scanned == 15:
+                    self.task_table.pop(old_tid)
+                else:
+                    self.task_table.move_to_end(old_tid)
+                scanned += 1
+        else:
+            self.task_table.move_to_end(tid)
+        row["state"] = state
+        row["ts"] = ev["ts"]
+        if worker is not None:
+            row["worker"] = worker
+        if state == "RUNNING":
+            row["start_ts"] = ev["ts"]
+        if state in ("FINISHED", "FAILED"):
+            row["end_ts"] = ev["ts"]
+        else:
+            # Re-execution (retry/reconstruction): a stale end_ts older
+            # than the new start_ts would make an in-flight task look done.
+            row.pop("end_ts", None)
+
+    def state_snapshot(self, include_events: bool = False,
+                       light: bool = False, tables=None) -> dict:
+        """One node's introspection tables, plain-dict shaped for the
+        state API and the CLI (everything picklable, no live objects).
+        ``light`` ships only counters/metrics — no per-task/object rows —
+        for metrics polls that would otherwise drag whole tables over
+        the wire; ``tables`` (e.g. ["actors"]) ships just the tables a
+        list_* query actually reads."""
+        snap = {
+            "node_id": self.node_id.hex(),
+            "is_head_node": self.head is not None and self.is_head_node,
+            "address": self.peer_address,
+            "resources": dict(self.total_resources),
+            "available": dict(self.available),
+            "counters": dict(self.counters),
+            "store": self._store_stats(),
+            "num_workers": len(self.workers),
+            "num_actors": len(self.actors),
+        }
+        if light:
+            return snap
+        want = (None if tables is None
+                else {t for t in tables})
+        full = {
+            "tasks": lambda: [dict(r) for r in self.task_table.values()],
+            "actors": lambda: [
+                {"actor_id": a.actor_id.hex(),
+                 "name": getattr(a.creation_spec, "actor_name", None),
+                 "class_name": a.creation_spec.name.removesuffix(".__init__"),
+                 "state": a.state,
+                 "is_device": a.is_device,
+                 "num_restarts": a.num_restarts,
+                 "pid": (a.worker.proc.pid
+                         if a.worker is not None and a.worker.proc else None),
+                 "node_id": self.node_id.hex()}
+                for a in self.actors.values()],
+            "objects": lambda: [
+                {"object_id": o.hex(), "status": st.status,
+                 "location": st.location, "size": st.size,
+                 "refcount": st.refcount,
+                 "node_id": self.node_id.hex()}
+                for o, st in self.objects.items()],
+            "workers": lambda: [
+                {"worker_id": w.worker_id.hex(), "pid": w.proc.pid,
+                 "state": w.state,
+                 "actor_id": w.actor_id.hex() if w.actor_id else None,
+                 "node_id": self.node_id.hex()}
+                for w in self.workers.values()],
+        }
+        for key, build in full.items():
+            if want is None or key in want:
+                snap[key] = build()
+        if include_events:
+            snap["events"] = list(self.task_events)
+        return snap
+
+    def _store_stats(self) -> dict:
+        used = sum(st.size for st in self.objects.values()
+                   if st.status == READY)
+        stats = {"num_objects": len(self.objects), "used_bytes": used}
+        cap = getattr(self.shm, "capacity_bytes", None)
+        if cap is not None:
+            stats["capacity_bytes"] = cap
+        native = getattr(self.shm, "stats", None)
+        if callable(native):
+            try:
+                stats.update(native())
+            except Exception:
+                pass
+        return stats
 
     # ------------------------------------------------------------------
     # Cluster plumbing: heartbeats, peers, head pushes
@@ -407,9 +531,7 @@ class NodeService:
             self.incref(dep)
         spec._remote = False
         spec._charged = None
-        self.task_events.append(
-            {"task_id": spec.task_id.hex(), "name": spec.name,
-             "state": "RECONSTRUCTING", "ts": time.time()})
+        self._event(spec, "RECONSTRUCTING")
         self._route(spec)
         return True
 
@@ -509,10 +631,7 @@ class NodeService:
         for dep in spec.dependencies():
             self.incref(dep)
         self.counters["tasks_submitted"] += 1
-        self.task_events.append(
-            {"task_id": spec.task_id.hex(), "name": spec.name, "state": "SUBMITTED",
-             "ts": time.time()}
-        )
+        self._event(spec, "SUBMITTED")
         self._route(spec)
         return rids
 
@@ -800,6 +919,7 @@ class NodeService:
 
     async def _run_on_worker(self, worker: WorkerHandle, spec: TaskSpec):
         worker.inflight[spec.task_id] = spec
+        self._event(spec, "RUNNING", worker=f"worker:{worker.proc.pid}")
         try:
             payload = self._spec_for_ipc(spec)
             reply = await worker.conn.call("execute_task", payload)
@@ -857,9 +977,7 @@ class NodeService:
                 self.pending_cpu.append(spec)
                 self._kick()
                 return
-            for rid in rids:
-                self.mark_error(rid, err)
-            self.counters["tasks_failed"] += 1
+            self._fail_task(spec, err)
             return
         results = reply["results"]  # list[("b", blob) | ("shm", size)]
         if len(results) != len(rids):
@@ -874,10 +992,7 @@ class NodeService:
                 self.mark_ready_shm(rid, res[1])
         self._release_deps(spec)
         self.counters["tasks_finished"] += 1
-        self.task_events.append(
-            {"task_id": spec.task_id.hex(), "name": spec.name, "state": "FINISHED",
-             "ts": time.time()}
-        )
+        self._event(spec, "FINISHED")
 
     def _release_deps(self, spec: TaskSpec):
         """Unpin task args exactly once, at the task's terminal state."""
@@ -901,6 +1016,7 @@ class NodeService:
             self.mark_error(rid, err)
         self._release_deps(spec)
         self.counters["tasks_failed"] += 1
+        self._event(spec, "FAILED")
 
     # -- device lane ----------------------------------------------------
     def _resolve_args_in_process(self, spec: TaskSpec):
@@ -947,6 +1063,7 @@ class NodeService:
             finally:
                 worker_mod._running_task.reset(tok)
 
+        self._event(spec, "RUNNING", worker="device")
         fut = (pool or self.device_pool).submit(run)
 
         def done(f):
@@ -983,6 +1100,7 @@ class NodeService:
                     return
                 self._release_deps(spec)
                 self.counters["tasks_finished"] += 1
+                self._event(spec, "FINISHED", worker="device")
             self.loop.call_soon_threadsafe(finish)
 
         fut.add_done_callback(done)
@@ -1096,6 +1214,8 @@ class NodeService:
                 return
             try:
                 conn = await self._peer_conn(target, address)
+                self._event(spec, "FORWARDED",
+                            worker=f"node:{target.hex()[:8]}")
                 reply = await conn.call("remote_execute", {
                     "spec": payload_spec,
                     "owner": self.node_id.binary(),
@@ -1128,6 +1248,7 @@ class NodeService:
                                 else TaskError(str(err)))
             self._release_deps(spec)
             self.counters["tasks_failed"] += 1
+            self._event(spec, "FAILED")
             return
         results = reply["results"]
         for rid, blob in zip(rids, results):
@@ -1135,6 +1256,7 @@ class NodeService:
         self._release_deps(spec)
         self.counters["tasks_finished"] += 1
         self.counters["tasks_finished_remote"] += 1
+        self._event(spec, "FINISHED")
 
     # -- remote actors (owner side) -------------------------------------
     async def _create_actor_remotely(self, spec: TaskSpec):
@@ -1351,6 +1473,11 @@ class NodeService:
             return True
         if method == "ping":
             return "pong"
+        if method == "state":
+            return self.state_snapshot(
+                include_events=bool((payload or {}).get("events")),
+                light=bool((payload or {}).get("light")),
+                tables=(payload or {}).get("tables"))
         raise RuntimeError(f"unknown peer rpc: {method}")
 
     async def _remote_execute(self, payload: dict) -> dict:
@@ -1521,6 +1648,9 @@ class NodeService:
             return
         actor.state = "ALIVE"
         spec = actor.creation_spec
+        self._event(spec, "FINISHED",
+                    worker=("device" if actor.is_device else
+                            f"worker:{actor.worker.proc.pid}"))
         # The creation "return" is the handle-ready signal.
         self.mark_ready_value(spec.return_ids()[0], None)
         if actor.ready_fut and not actor.ready_fut.done():
@@ -1829,6 +1959,12 @@ class NodeService:
         if method == "log":
             sys.stderr.write(payload)
             return True
+
+        if method == "state":
+            return self.state_snapshot(
+                include_events=bool((payload or {}).get("events")),
+                light=bool((payload or {}).get("light")),
+                tables=(payload or {}).get("tables"))
 
         raise RuntimeError(f"unknown rpc method: {method}")
 

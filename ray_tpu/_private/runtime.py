@@ -176,6 +176,44 @@ class Runtime:
     def _state_of(self, oid: ObjectID):
         return self.node.objects.get(oid)
 
+    def cluster_state(self, include_events: bool = False,
+                      light: bool = False, tables=None,
+                      timeout: float = 10.0) -> dict:
+        """Cluster-wide introspection: every ALIVE node's state_snapshot
+        plus the head's node/PG tables (reference: the state API's GCS +
+        per-node aggregation, python/ray/util/state/api.py). ``tables``
+        restricts which per-node tables ship (e.g. ["actors"])."""
+
+        async def query_node(n):
+            if tuple(n["address"]) == tuple(self.node.peer_address):
+                return self.node.state_snapshot(include_events, light,
+                                                tables)
+            try:
+                # Per-node budget so one hung node costs O(its timeout),
+                # not the whole query: the others still answer.
+                async def ask():
+                    conn = await self.node._addr_conn(tuple(n["address"]))
+                    return await conn.call(
+                        "state", {"events": include_events, "light": light,
+                                  "tables": tables})
+                return await asyncio.wait_for(ask(),
+                                              max(1.0, timeout - 1.0))
+            except Exception:
+                return None  # node died/hung mid-query; the head will notice
+
+        async def gather():
+            nodes = await self.head_client().list_nodes()
+            pgs = await self.head_client().list_pgs()
+            snaps = await asyncio.gather(
+                *(query_node(n) for n in nodes if n["state"] == "ALIVE"))
+            return {"nodes": nodes, "placement_groups": pgs,
+                    "snapshots": [s for s in snaps if s is not None]}
+
+        return self._run(gather(), timeout)
+
+    def head_client(self):
+        return self.node.head
+
     def get(self, refs, timeout: float | None = None):
         single = isinstance(refs, ObjectRef)
         if single:
@@ -411,6 +449,9 @@ class Runtime:
 
     def list_nodes(self) -> list:
         return self._run(self.node.head.list_nodes())
+
+    def list_placement_groups(self) -> list:
+        return self._run(self.node.head.list_pgs())
 
     def shutdown(self):
         if getattr(self, "_shut", False):

@@ -1,0 +1,8 @@
+"""ray_tpu.util — cluster utilities: state introspection, timeline.
+
+Capability parity target: /root/reference/python/ray/util/ (state API,
+ActorPool, queues, metrics). The state API lives in
+``ray_tpu.util.state``; ``ray_tpu.timeline`` is re-exported at top level.
+"""
+
+from . import state  # noqa: F401

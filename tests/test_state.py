@@ -1,0 +1,150 @@
+"""State API + timeline: list tasks/actors/objects/workers/nodes/PGs
+cluster-wide, metrics snapshot, chrome-tracing dump.
+
+Parity model: /root/reference/python/ray/util/state/api.py surface and
+python/ray/tests/test_state_api.py; timeline per ray.timeline
+(python/ray/_private/state.py:434).
+"""
+
+import json
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import state
+
+
+def test_list_tasks_and_summary(rt):
+    @ray_tpu.remote
+    def work(x):
+        return x + 1
+
+    ray_tpu.get([work.remote(i) for i in range(3)])
+    rows = state.list_tasks(filters=[("name", "=", "work")])
+    assert len(rows) == 3
+    assert all(r["state"] == "FINISHED" for r in rows)
+    assert all(r["end_ts"] >= r["start_ts"] >= r["submitted_ts"]
+               for r in rows)
+    assert all(r["worker"].startswith("worker:") for r in rows)
+
+    summary = state.summarize_tasks()
+    assert summary["work"]["FINISHED"] == 3
+
+
+def test_list_tasks_failed_and_filters(rt):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("nope")
+
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(boom.remote())
+    failed = state.list_tasks(filters=[("state", "=", "FAILED")])
+    assert any(r["name"] == "boom" for r in failed)
+    # != predicate and limit
+    assert all(r["name"] != "boom"
+               for r in state.list_tasks(filters=[("name", "!=", "boom")]))
+    assert len(state.list_tasks(limit=1)) == 1
+
+
+def test_list_actors_workers_objects(rt):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.options(name="counted").remote()
+    assert ray_tpu.get(c.incr.remote()) == 1
+
+    actors = state.list_actors(filters=[("state", "=", "ALIVE")])
+    assert len(actors) == 1
+    assert actors[0]["class_name"] == "Counter"
+    assert actors[0]["name"] == "counted"
+    assert actors[0]["pid"] is not None
+
+    workers = state.list_workers(filters=[("state", "!=", "DEAD")])
+    assert len(workers) >= 1
+
+    ref = ray_tpu.put(b"x" * 2048)
+    objs = state.list_objects(filters=[("status", "=", "READY")])
+    assert any(o["object_id"] == ref.id.hex() for o in objs)
+    del ref
+
+
+def test_device_lane_tasks_in_state(rt):
+    @ray_tpu.remote(scheduling_strategy="device")
+    def on_device():
+        return 7
+
+    assert ray_tpu.get(on_device.remote()) == 7
+    rows = state.list_tasks(filters=[("name", "=", "on_device")])
+    assert rows and rows[0]["worker"] == "device"
+    assert rows[0]["state"] == "FINISHED"
+
+
+def test_cluster_metrics_and_timeline(rt, tmp_path):
+    @ray_tpu.remote
+    def step():
+        return 1
+
+    refs = [step.remote() for _ in range(2)]
+    ray_tpu.get(refs)
+
+    metrics = state.cluster_metrics()
+    assert len(metrics) == 1
+    (node_metrics,) = metrics.values()
+    assert node_metrics["counters"]["tasks_finished"] >= 2
+    # refs still live => their result objects are still in the table
+    assert node_metrics["store"]["num_objects"] >= 1
+    assert node_metrics["num_workers"] >= 1
+    del refs
+
+    path = tmp_path / "timeline.json"
+    events = ray_tpu.timeline(str(path))
+    loaded = json.loads(path.read_text())
+    assert loaded == events
+    slices = [e for e in loaded if e["ph"] == "X" and e["name"] == "step"]
+    assert len(slices) == 2
+    for ev in slices:
+        assert ev["dur"] >= 0 and ev["ts"] > 0
+        assert set(ev) >= {"pid", "tid", "ts", "dur", "name", "ph"}
+
+
+def test_state_across_nodes():
+    cluster = Cluster(init_args={"num_cpus": 1, "resources": {"y": 1}})
+    try:
+        cluster.add_node(num_cpus=1, resources={"x": 1})
+        cluster.wait_for_nodes(2)
+
+        @ray_tpu.remote(resources={"x": 1})
+        def far():
+            return "far"
+
+        @ray_tpu.remote(resources={"y": 1})
+        def near():
+            return "near"
+
+        assert ray_tpu.get([far.remote(), near.remote()], timeout=60) == \
+            ["far", "near"]
+
+        nodes = state.list_nodes(filters=[("state", "=", "ALIVE")])
+        assert len(nodes) == 2
+
+        rows = state.list_tasks(filters=[("name", "=", "far")])
+        assert rows and rows[0]["state"] == "FINISHED"
+        near_rows = state.list_tasks(filters=[("name", "=", "near")])
+        # The two tasks ran on different nodes.
+        assert rows[0]["node_id"] != near_rows[0]["node_id"]
+
+        pg = ray_tpu.placement_group([{"CPU": 1}], strategy="PACK")
+        assert pg.wait(timeout=30)
+        pgs = state.list_placement_groups(
+            filters=[("state", "=", "CREATED")])
+        assert len(pgs) == 1
+        assert pgs[0]["strategy"] == "PACK"
+    finally:
+        cluster.shutdown()
