@@ -1,30 +1,58 @@
 """serve.* public API (parity: /root/reference/python/ray/serve/api.py:
 serve.run, serve.start, serve.shutdown, serve.get_app_handle,
-serve.get_deployment_handle, serve.status)."""
+serve.get_deployment_handle, serve.status).
+
+The controller is a SUPERVISED NAMED ACTOR (reference: Serve's detached
+``SERVE_CONTROLLER_ACTOR`` created with max_restarts): clients find it by
+name from any process, and if its worker dies it restarts and recovers
+its state from the cluster-KV checkpoint while replicas keep serving.
+"""
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 from .controller import ServeController
-from .deployment import Application, DeploymentHandle
+from .deployment import (CONTROLLER_NAME, Application, DeploymentHandle,
+                         _clear_routers)
 from .http_proxy import HTTPProxy
 
-_controller: Optional[ServeController] = None
+_controller = None  # ActorHandle
 _proxy: Optional[HTTPProxy] = None
+_ingress_cache: dict[str, str] = {}  # app name -> ingress deployment
 
 
-def _get_controller(create: bool = True) -> ServeController:
+def _get_controller(create: bool = True):
+    """The controller actor handle — existing one by name, else created."""
     global _controller
-    if _controller is None and create:
-        import ray_tpu
+    import ray_tpu
 
+    if _controller is None:
+        if ray_tpu.is_initialized():
+            try:
+                _controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            except Exception:
+                _controller = None
+    if _controller is None and create:
         if not ray_tpu.is_initialized():
             ray_tpu.init()
-        _controller = ServeController()
+        _controller = ray_tpu.remote(ServeController).options(
+            name=CONTROLLER_NAME, max_restarts=100,
+            max_concurrency=16).remote()
+        # Surface construction failures eagerly.
+        ray_tpu.get(_controller.ping.remote(), timeout=60)
     if _controller is None:
         raise RuntimeError("serve is not running (call serve.run first)")
     return _controller
+
+
+class _ProxyClient:
+    """What the HTTP proxy routes through: app name -> client-side handle
+    (the proxy never talks to replicas via the controller)."""
+
+    def get_app_handle(self, app_name: str) -> DeploymentHandle:
+        return get_app_handle(app_name)
 
 
 # Route prefixes by app name, kept even when no proxy exists yet so a
@@ -36,9 +64,9 @@ def start(*, http_host: str = "127.0.0.1", http_port: int = 8000,
           detached: bool = True):
     """Start the HTTP proxy (handles work without it)."""
     global _proxy
-    controller = _get_controller()
+    _get_controller()
     if _proxy is None:
-        _proxy = HTTPProxy(controller, http_host, http_port)
+        _proxy = HTTPProxy(_ProxyClient(), http_host, http_port)
         for app_name, prefix in _routes.items():
             _proxy.add_route(prefix, app_name)
     return _proxy
@@ -46,34 +74,80 @@ def start(*, http_host: str = "127.0.0.1", http_port: int = 8000,
 
 def run(app: Application, *, name: str = "default",
         route_prefix: Optional[str] = "/") -> DeploymentHandle:
+    import ray_tpu
+
     controller = _get_controller()
-    handle = controller.deploy_application(app, name)
+    ingress = ray_tpu.get(
+        controller.deploy_application.remote(app, name), timeout=120)
+    _ingress_cache[name] = ingress
     if route_prefix is not None:
         _routes[name] = route_prefix
         if _proxy is not None:
             _proxy.add_route(route_prefix, name)
+    handle = DeploymentHandle(ingress)
+    handle._router.maybe_refresh(force=True)
     return handle
 
 
 def get_app_handle(name: str = "default") -> DeploymentHandle:
-    return _get_controller(create=False).get_app_handle(name)
+    import ray_tpu
+
+    ingress = _ingress_cache.get(name)
+    if ingress is None:
+        controller = _get_controller(create=False)
+        ingress = ray_tpu.get(controller.ingress_of.remote(name),
+                              timeout=30)
+        _ingress_cache[name] = ingress
+    return DeploymentHandle(ingress)
 
 
 def get_deployment_handle(deployment_name: str, app_name: str = "default"
                           ) -> DeploymentHandle:
-    return _get_controller(create=False).get_handle(deployment_name)
+    return DeploymentHandle(deployment_name)
 
 
-def status() -> dict:
-    return _get_controller(create=False).status()
+def status(timeout: float = 30) -> dict:
+    import ray_tpu
+
+    return ray_tpu.get(_get_controller(create=False).status.remote(),
+                       timeout=timeout)
+
+
+def _wait_controller_alive(timeout: float = 60) -> bool:
+    """Block until the (possibly restarting) controller answers a ping —
+    used by tests and callers that just killed it."""
+    import ray_tpu
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            if ray_tpu.get(controller.ping.remote(), timeout=5):
+                return True
+        except Exception:
+            time.sleep(0.2)
+    return False
 
 
 def shutdown():
     global _controller, _proxy
+    import ray_tpu
+
     _routes.clear()
+    _ingress_cache.clear()
     if _proxy is not None:
         _proxy.shutdown()
         _proxy = None
-    if _controller is not None:
-        _controller.shutdown()
-        _controller = None
+    try:
+        controller = _get_controller(create=False)
+    except RuntimeError:
+        controller = None
+    if controller is not None:
+        try:
+            ray_tpu.get(controller.shutdown_deployments.remote(),
+                        timeout=60)
+            ray_tpu.kill(controller, no_restart=True)
+        except Exception:
+            pass
+    _controller = None
+    _clear_routers()

@@ -2,31 +2,41 @@
 
 Parity target: /root/reference/python/ray/serve/_private/controller.py:89
 (run_control_loop reconciling DeploymentState, application_state.py,
-deployment_state.py) and autoscaling_policy.py. Differences: the controller
-runs in the driver process with a background reconcile thread rather than
-as a detached actor — the capability (declarative target state, replica
-actors reconciled to it, scaling on observed ongoing-request load) is the
-same shape.
+deployment_state.py) and autoscaling_policy.py.
+
+The controller runs as a SUPERVISED NAMED ACTOR (reference: the detached
+``SERVE_CONTROLLER_ACTOR`` with max_restarts): if its worker dies, the
+actor-restart FSM brings it back under the same name and ``__init__``
+rebuilds state from the checkpoint it keeps in the cluster KV — target
+deployments, per-deployment replica-actor names — then re-attaches to
+the still-running named replica actors. Apps keep serving during the
+outage because request routing is handle-side (deployment.py Router);
+the controller only manages membership.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+import uuid
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+import cloudpickle
+
 from .deployment import (Application, AutoscalingConfig, Deployment,
-                         DeploymentHandle, Router)
+                         DeploymentHandle)
 from .replica import Replica
+
+CHECKPOINT_KEY = "serve:controller_ckpt"
 
 
 @dataclass
 class DeploymentState:
     deployment: Deployment
     target_replicas: int
-    replicas: list = field(default_factory=list)  # ActorHandles
-    router: Router = field(default_factory=Router)
+    replicas: list = field(default_factory=list)   # ActorHandles
+    replica_names: list = field(default_factory=list)
     # Seeded with now so delays apply from deploy time (0.0 against
     # monotonic() would make the first scale decision bypass its delay).
     last_scale_up: float = field(default_factory=time.monotonic)
@@ -63,25 +73,94 @@ class ServeController:
         self._apps: dict[str, str] = {}  # app name -> ingress deployment
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Scale-down victims mid-drain, persisted so a controller crash
+        # during the (up to 30s) drain can't leak them.
+        self._draining: set[str] = set()
+        # Serializes snapshot+write: without it two concurrent
+        # checkpoints could persist the OLDER snapshot last.
+        self._ckpt_lock = threading.Lock()
+        self._recover()
+
+    # -- checkpoint / recovery ---------------------------------------------
+    def _checkpoint(self):
+        """Persist declarative state to the cluster KV (reference: the
+        controller checkpoints to the GCS KV so a restarted controller
+        resumes where it left off)."""
+        import ray_tpu
+
+        with self._ckpt_lock:
+            with self._lock:
+                blob = cloudpickle.dumps({
+                    "apps": dict(self._apps),
+                    "draining": sorted(self._draining),
+                    "deployments": {
+                        name: {"deployment": s.deployment,
+                               "target": s.target_replicas,
+                               "replica_names": list(s.replica_names)}
+                        for name, s in self._deployments.items()},
+                })
+            ray_tpu.kv_put(CHECKPOINT_KEY, blob)
+
+    def _recover(self):
+        """Rebuild from the KV checkpoint after a restart: re-attach to
+        live named replica actors, let reconcile replace the dead."""
+        import ray_tpu
+
+        blob = ray_tpu.kv_get(CHECKPOINT_KEY)
+        if blob is None:
+            return
+        ckpt = cloudpickle.loads(blob)
+        with self._lock:
+            self._apps = dict(ckpt["apps"])
+            for name, d in ckpt["deployments"].items():
+                state = DeploymentState(deployment=d["deployment"],
+                                        target_replicas=d["target"])
+                for rn in d["replica_names"]:
+                    handle = None
+                    try:
+                        handle = ray_tpu.get_actor(rn)
+                    except Exception:
+                        pass  # dead/unregistered — reconcile replaces it
+                    if handle is not None:
+                        state.replicas.append(handle)
+                        state.replica_names.append(rn)
+                self._deployments[name] = state
+            # Victims that were mid-drain when the old controller died:
+            # the drain was interrupted — kill them now, don't leak them.
+            for rn in ckpt.get("draining", ()):
+                try:
+                    ray_tpu.kill(ray_tpu.get_actor(rn))
+                except Exception:
+                    pass  # already gone
+            for state in self._deployments.values():
+                self._reconcile_one(state)
+            if self._deployments:
+                self._ensure_loop()
+        # Replacement replicas spawned just now must be persisted — a
+        # second crash before any later checkpoint would orphan them.
+        self._checkpoint()
 
     # -- deploy -------------------------------------------------------------
-    def deploy_application(self, app: Application, name: str
-                           ) -> DeploymentHandle:
+    def deploy_application(self, app: Application, name: str) -> str:
         """Deploy the app's deployment graph (children bound as init args
-        deploy first, parents get handles to them)."""
+        deploy first, parents get handles to them). Returns the ingress
+        deployment's name — callers build handles client-side."""
         with self._lock:
-            handle = self._deploy_node(app)
-            self._apps[name] = app.deployment.name
+            ingress = self._deploy_node(app)
+            self._apps[name] = ingress
             self._ensure_loop()
-            return handle
+        self._checkpoint()
+        return ingress
 
-    def _deploy_node(self, app: Application) -> DeploymentHandle:
+    def _deploy_node(self, app: Application) -> str:
         d = app.deployment
         init_args = tuple(
-            self._deploy_node(a) if isinstance(a, Application) else a
+            DeploymentHandle(self._deploy_node(a))
+            if isinstance(a, Application) else a
             for a in d.init_args)
         init_kwargs = {
-            k: (self._deploy_node(v) if isinstance(v, Application) else v)
+            k: (DeploymentHandle(self._deploy_node(v))
+                if isinstance(v, Application) else v)
             for k, v in d.init_kwargs.items()}
         d = Deployment(**{**d.__dict__, "init_args": init_args,
                           "init_kwargs": init_kwargs})
@@ -100,7 +179,7 @@ class ServeController:
                 ray_tpu.get([r.reconfigure.remote(d.user_config)
                              for r in state.replicas])
         self._reconcile_one(state)
-        return DeploymentHandle(d.name, state.router)
+        return d.name
 
     # -- reconcile ----------------------------------------------------------
     def _reconcile_one(self, state: DeploymentState):
@@ -111,18 +190,34 @@ class ServeController:
             opts = dict(d.ray_actor_options)
             opts.setdefault("max_concurrency", max(4, min(
                 32, d.max_ongoing_requests)))
+            # Named so a restarted controller can re-attach (reference:
+            # replica actor names in the deployment state checkpoint).
+            rname = f"SERVE:{d.name}:{uuid.uuid4().hex[:8]}"
+            opts["name"] = rname
             actor = ray_tpu.remote(Replica).options(**opts).remote(
                 d.func_or_class, d.init_args, d.init_kwargs, d.user_config)
             state.replicas.append(actor)
+            state.replica_names.append(rname)
         victims = []
         while len(state.replicas) > state.target_replicas:
-            victims.append(state.replicas.pop())
-        # Routing switches away first; victims drain in-flight work in the
-        # background before the kill (reference: graceful replica stop).
-        state.router.update_replicas(state.replicas)
+            victims.append((state.replicas.pop(),
+                            state.replica_names.pop()))
+        # Victims drain in-flight work in the background before the kill
+        # (reference: graceful replica stop). Handle-side routers pick up
+        # the membership change on their next refresh. They stay in
+        # _draining (checkpointed) until killed, so a controller crash
+        # mid-drain can't leak them.
         if victims:
-            threading.Thread(target=_drain_and_kill, args=(victims,),
-                             daemon=True).start()
+            self._draining.update(n for _, n in victims)
+
+            def drain_then_forget():
+                _drain_and_kill([h for h, _ in victims])
+                with self._lock:
+                    self._draining.difference_update(
+                        n for _, n in victims)
+                self._checkpoint()
+
+            threading.Thread(target=drain_then_forget, daemon=True).start()
 
     def _ensure_loop(self):
         if self._thread is None or not self._thread.is_alive():
@@ -132,28 +227,58 @@ class ServeController:
             self._thread.start()
 
     def _control_loop(self):
-        """Reference run_control_loop: reconcile + autoscale forever."""
+        """Reference run_control_loop: health-check + reconcile +
+        autoscale forever. A replica whose actor died is removed and
+        replaced (reference: deployment_state replica recovery)."""
         import ray_tpu
 
         while not self._stop.wait(0.25):
             # Snapshot under the lock; the blocking stats gather runs
-            # outside it so deploy/status/get_handle never stall on a slow
-            # replica.
+            # outside it so deploy/status/get_replicas never stall on a
+            # slow replica.
             with self._lock:
                 targets = [
                     (s, s.deployment.autoscaling_config, list(s.replicas))
-                    for s in self._deployments.values()
-                    if s.deployment.autoscaling_config is not None]
+                    for s in self._deployments.values()]
             for state, cfg, replicas in targets:
-                try:
-                    stats = ray_tpu.get(
-                        [r.stats.remote() for r in replicas], timeout=5)
-                except Exception:
-                    continue
+                stats, dead, slow = [], [], False
+                refs = [(r, r.stats.remote()) for r in replicas]
+                # One shared 5s budget for the whole deployment — N hung
+                # replicas must not stall the loop for N*5s.
+                ready, _ = ray_tpu.wait([ref for _, ref in refs],
+                                        num_returns=len(refs), timeout=5)
+                done = {ref.id for ref in ready}
+                for r, ref in refs:
+                    if ref.id not in done:
+                        slow = True  # alive but unresponsive
+                        continue
+                    try:
+                        stats.append(ray_tpu.get(ref, timeout=1))
+                    except (ray_tpu.ActorDiedError,
+                            ray_tpu.ActorUnavailableError,
+                            ray_tpu.WorkerCrashedError):
+                        dead.append(r)
+                    except Exception:
+                        slow = True
                 with self._lock:
                     if self._deployments.get(
-                            state.deployment.name) is state:
+                            state.deployment.name) is not state:
+                        continue
+                    if dead:
+                        for r in dead:
+                            for i, have in enumerate(state.replicas):
+                                if have is r:
+                                    state.replicas.pop(i)
+                                    state.replica_names.pop(i)
+                                    break
+                        self._reconcile_one(state)
+                    # Partial stats would under-count load (the missing
+                    # replica is usually the busy one) — never autoscale
+                    # on them.
+                    if cfg is not None and not slow and not dead:
                         self._autoscale(state, cfg, stats)
+                if dead:
+                    self._checkpoint()
 
     def _autoscale(self, state: DeploymentState, cfg: AutoscalingConfig,
                    stats: list[dict]):
@@ -168,21 +293,24 @@ class ServeController:
             state.target_replicas = desired
             state.last_scale_up = now
             self._reconcile_one(state)
+            self._checkpoint()
         elif desired < state.target_replicas and \
                 now - state.last_scale_down >= cfg.downscale_delay_s:
             state.target_replicas = desired
             state.last_scale_down = now
             self._reconcile_one(state)
+            self._checkpoint()
 
     # -- queries ------------------------------------------------------------
-    def get_handle(self, deployment_name: str) -> DeploymentHandle:
+    def get_replicas(self, deployment_name: str) -> list:
+        """Replica handles for handle-side routers (reference: the
+        controller's long-poll membership broadcast)."""
         with self._lock:
-            state = self._deployments[deployment_name]
-            return DeploymentHandle(deployment_name, state.router)
+            return list(self._deployments[deployment_name].replicas)
 
-    def get_app_handle(self, app_name: str) -> DeploymentHandle:
+    def ingress_of(self, app_name: str) -> str:
         with self._lock:
-            return self.get_handle(self._apps[app_name])
+            return self._apps[app_name]
 
     def status(self) -> dict:
         with self._lock:
@@ -196,8 +324,13 @@ class ServeController:
         with self._lock:
             return len(self._deployments[name].replicas)
 
+    def ping(self) -> bool:
+        return True
+
     # -- teardown -----------------------------------------------------------
-    def shutdown(self):
+    def shutdown_deployments(self):
+        """Kill all replicas and clear the checkpoint (full serve
+        teardown — a mere controller restart must NOT do this)."""
         import ray_tpu
 
         self._stop.set()
@@ -212,3 +345,5 @@ class ServeController:
                         pass
             self._deployments.clear()
             self._apps.clear()
+        ray_tpu.kv_del(CHECKPOINT_KEY)
+        return True

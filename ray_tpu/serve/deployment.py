@@ -100,18 +100,53 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
 
 
 class DeploymentResponse:
-    """Future-like response (reference handle.py DeploymentResponse)."""
+    """Future-like response (reference handle.py DeploymentResponse).
 
-    def __init__(self, ref, router: "Router", replica_key):
+    ``result()`` retries once through a fresh replica when the one that
+    took the request died mid-flight (reference: router failure retry —
+    a dead replica is a routing event, not a user error).
+    """
+
+    def __init__(self, ref, router: "Router", replica_key, retry=None):
         self._ref = ref
         self._router = router
         self._replica_key = replica_key
+        self._retry = retry  # (method, args, kwargs, model_id) | None
         self._done = False
 
     def result(self, timeout: Optional[float] = None):
         import ray_tpu
 
         try:
+            return ray_tpu.get(self._ref, timeout=timeout)
+        except (ray_tpu.ActorDiedError, ray_tpu.ActorUnavailableError,
+                ray_tpu.WorkerCrashedError):
+            if self._retry is None:
+                raise
+            method, args, kwargs, model_id = self._retry
+            self._settle()
+            # Drop the dead replica locally FIRST — a controller-side
+            # refresh may still list it until its health loop catches up.
+            self._router.remove_replica(self._replica_key)
+            import time as _time
+
+            deadline = _time.monotonic() + 15
+            while True:
+                try:
+                    actor, key = self._router.pick_replica(model_id)
+                    break
+                except RuntimeError:
+                    # Sole replica died: wait for the controller's health
+                    # loop to spawn a replacement.
+                    if _time.monotonic() > deadline:
+                        raise
+                    _time.sleep(0.2)
+                    self._router.maybe_refresh(force=True)
+            self._ref = actor.handle_request.remote(
+                method, args, kwargs, model_id)
+            self._replica_key = key
+            self._done = False
+            self._retry = None  # one retry only
             return ray_tpu.get(self._ref, timeout=timeout)
         finally:
             self._settle()
@@ -142,6 +177,10 @@ def _replica_key(replica):
     return aid.binary() if aid is not None else id(replica)
 
 
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+_REFRESH_INTERVAL_S = 1.0
+
+
 class Router:
     """Client-side power-of-two-choices over the replica set.
 
@@ -149,18 +188,69 @@ class Router:
     identity (actor id), not list index: update_replicas() preserves
     counts for surviving replicas, so p2c load estimates stay accurate
     across autoscaling/redeploy events.
+
+    When constructed with a deployment name, the router pulls replica
+    membership from the (named, supervised) controller actor — initially,
+    every ``_REFRESH_INTERVAL_S`` while in use, and immediately on
+    demand after a replica failure (reference: handle routers receive
+    membership via controller long-poll,
+    python/ray/serve/_private/router.py).
     """
 
-    def __init__(self):
+    def __init__(self, deployment_name: Optional[str] = None):
         self._lock = threading.Lock()
+        self._name = deployment_name
         self._replicas: list = []
         self._keys: list = []
         self._inflight: dict = {}
         self._model_affinity: dict[str, set] = {}
         self._rng = random.Random()
+        self._last_refresh = 0.0  # monotonic; 0 == never
+        # Replicas observed dead locally: a controller snapshot that still
+        # lists one (its health loop lags the observation) must not
+        # resurrect it. key -> monotonic expiry.
+        self._tombstones: dict = {}
+
+    def maybe_refresh(self, force: bool = False):
+        """Pull the replica set from the controller if stale (or forced).
+
+        Refresh failures (controller restarting, slow, or gone) fall back
+        to the current replica set — membership updates are best-effort,
+        serving traffic is not (reference: handles keep routing on their
+        last-known membership while the long-poll reconnects)."""
+        if self._name is None:
+            return
+        import time as _time
+
+        with self._lock:
+            fresh = (_time.monotonic() - self._last_refresh
+                     < _REFRESH_INTERVAL_S)
+            if fresh and not force and self._replicas:
+                return
+            have_fallback = bool(self._replicas)
+        import ray_tpu
+
+        try:
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            replicas = ray_tpu.get(
+                controller.get_replicas.remote(self._name), timeout=30)
+        except Exception:
+            if have_fallback:
+                return  # keep serving on the last-known set
+            raise
+        with self._lock:
+            self._last_refresh = _time.monotonic()
+        self.update_replicas(replicas)
 
     def update_replicas(self, replicas: list):
+        import time as _time
+
         with self._lock:
+            now = _time.monotonic()
+            self._tombstones = {k: t for k, t in self._tombstones.items()
+                                if t > now}
+            replicas = [r for r in replicas
+                        if _replica_key(r) not in self._tombstones]
             self._replicas = list(replicas)
             self._keys = [_replica_key(r) for r in self._replicas]
             live = set(self._keys)
@@ -203,26 +293,68 @@ class Router:
         with self._lock:
             return self._replicas[idx]
 
+    def remove_replica(self, key):
+        """Drop a replica observed dead so the retry (and subsequent
+        picks) can't land on it again before the controller catches up —
+        the tombstone keeps a stale controller snapshot from
+        resurrecting it for the next 10s."""
+        import time as _time
+
+        with self._lock:
+            self._tombstones[key] = _time.monotonic() + 10.0
+            for i in reversed([j for j, k in enumerate(self._keys)
+                               if k == key]):
+                del self._replicas[i]
+                del self._keys[i]
+            self._inflight.pop(key, None)
+            for mid in list(self._model_affinity):
+                self._model_affinity[mid].discard(key)
+                if not self._model_affinity[mid]:
+                    del self._model_affinity[mid]
+
     def request_done(self, key):
         with self._lock:
             if key in self._inflight:
                 self._inflight[key] = max(0, self._inflight[key] - 1)
 
 
+_process_routers: dict[str, Router] = {}
+_process_routers_lock = threading.Lock()
+
+
+def _clear_routers():
+    """Drop per-process router caches (serve.shutdown)."""
+    with _process_routers_lock:
+        _process_routers.clear()
+
+
+def _router_for(deployment_name: str) -> Router:
+    """One router per deployment per process: every handle to the same
+    deployment shares in-flight accounting, as the reference's
+    handle-shared router does."""
+    with _process_routers_lock:
+        r = _process_routers.get(deployment_name)
+        if r is None:
+            r = _process_routers[deployment_name] = Router(deployment_name)
+        return r
+
+
 class DeploymentHandle:
     """Callable handle to a running deployment (reference handle.py).
 
-    Driver-side handles share the controller's per-deployment router (so
-    autoscaling updates propagate); handles pickled into replica processes
-    (model composition) rebuild a private router over the replica set as it
-    was at serialization time.
+    A handle is just (deployment name, method, model id): the replica set
+    comes from the per-process router, which follows the controller.
+    Handles pickle to the name alone, so they survive controller
+    restarts and work from any process in the cluster (driver, replicas
+    doing model composition, the HTTP proxy).
     """
 
-    def __init__(self, deployment_name: str, router: Router,
+    def __init__(self, deployment_name: str, router: Optional[Router] = None,
                  method_name: str = "__call__",
                  multiplexed_model_id: str = ""):
         self._name = deployment_name
-        self._router = router
+        self._router = router if router is not None \
+            else _router_for(deployment_name)
         self._method = method_name
         self._model_id = multiplexed_model_id
 
@@ -241,19 +373,18 @@ class DeploymentHandle:
         return self.options(method_name=name)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
+        self._router.maybe_refresh()
         actor, key = self._router.pick_replica(self._model_id)
         ref = actor.handle_request.remote(
             self._method, args, kwargs, self._model_id)
-        return DeploymentResponse(ref, self._router, key)
+        return DeploymentResponse(
+            ref, self._router, key,
+            retry=(self._method, args, kwargs, self._model_id))
 
     def __reduce__(self):
-        with self._router._lock:
-            replicas = list(self._router._replicas)
         return (_rebuild_handle,
-                (self._name, self._method, self._model_id, replicas))
+                (self._name, self._method, self._model_id))
 
 
-def _rebuild_handle(name, method, model_id, replicas):
-    router = Router()
-    router.update_replicas(replicas)
-    return DeploymentHandle(name, router, method, model_id)
+def _rebuild_handle(name, method, model_id):
+    return DeploymentHandle(name, None, method, model_id)
