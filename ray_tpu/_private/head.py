@@ -44,10 +44,21 @@ class NodeEntry:
     available: dict  # last heartbeat snapshot
     state: str = ALIVE
     is_head_node: bool = False
+    # An attached driver (ray_tpu.init(address=...)): participates in the
+    # object/control planes but is not cluster capacity.
+    is_driver: bool = False
     conn: Optional[ServerConn] = None  # node -> head connection (push channel)
     last_heartbeat: float = field(default_factory=time.monotonic)
     # PG bundle reservations on this node: (pg_id, bundle_idx) -> resources
     reservations: dict = field(default_factory=dict)
+
+    def to_row(self) -> dict:
+        """Wire/dict shape shared by every list_nodes surface."""
+        return {"node_id": self.node_id.binary(), "address": self.address,
+                "state": self.state, "resources": self.resources,
+                "available": self.available,
+                "is_head_node": self.is_head_node,
+                "is_driver": self.is_driver}
 
 
 @dataclass
@@ -98,10 +109,12 @@ class HeadService:
     # Membership & health
     # ------------------------------------------------------------------
     def register_node(self, node_id: NodeID, address: tuple, resources: dict,
-                      conn: Optional[ServerConn]) -> dict:
+                      conn: Optional[ServerConn],
+                      is_driver: bool = False) -> dict:
         entry = NodeEntry(
             node_id=node_id, address=tuple(address),
-            resources=dict(resources), available=dict(resources), conn=conn)
+            resources=dict(resources), available=dict(resources), conn=conn,
+            is_driver=is_driver)
         self.nodes[node_id] = entry
         if conn is not None:
             conn.meta["node_id"] = node_id
@@ -437,7 +450,8 @@ class HeadService:
         if method == "register_node":
             return self.register_node(
                 NodeID(payload["node_id"]), tuple(payload["address"]),
-                payload["resources"], conn)
+                payload["resources"], conn,
+                is_driver=bool(payload.get("is_driver")))
         if method == "heartbeat":
             ok = self.heartbeat(NodeID(payload["node_id"]),
                                 payload["available"])
@@ -485,11 +499,7 @@ class HeadService:
             nid = self.actor_nodes.get(ActorID(payload))
             return nid.binary() if nid is not None else None
         if method == "list_nodes":
-            return [{"node_id": e.node_id.binary(), "address": e.address,
-                     "state": e.state, "resources": e.resources,
-                     "available": e.available,
-                     "is_head_node": e.is_head_node}
-                    for e in self.nodes.values()]
+            return [e.to_row() for e in self.nodes.values()]
         if method == "create_pg":
             pg = await self.create_placement_group(
                 PlacementGroupID(payload["pg_id"]), payload["bundles"],
@@ -564,10 +574,7 @@ class LocalHeadClient:
         return ok
 
     async def list_nodes(self):
-        return [{"node_id": e.node_id.binary(), "address": e.address,
-                 "state": e.state, "resources": e.resources,
-                 "available": e.available, "is_head_node": e.is_head_node}
-                for e in self.head.nodes.values()]
+        return [e.to_row() for e in self.head.nodes.values()]
 
     async def create_pg(self, pg_id, bundles, strategy):
         pg = await self.head.create_placement_group(pg_id, bundles, strategy)

@@ -24,11 +24,9 @@ import os
 import sys
 
 from .config import get_config
-from .head import RemoteHeadClient
 from .ids import NodeID
 from .node_service import NodeService
 from .object_store import make_store
-from .rpc import async_connect
 
 
 async def amain():
@@ -55,29 +53,16 @@ async def amain():
     node = NodeService(node_session, sock_path, resources, shm, loop,
                        node_id=node_id, head=None, is_head_node=False)
 
-    async def handle_head_push(conn, method, payload):
-        await node.on_head_push(method, payload)
-        return True
-
     async def on_head_lost(conn):
         # Head gone => cluster gone; die rather than orphan.
         sys.stderr.write(f"node {node_id.hex()[:12]}: head connection lost; "
                          f"exiting\n")
         os._exit(0)
 
-    conn = await async_connect(head_addr, handle_head_push, on_head_lost)
-    node.head = RemoteHeadClient(conn)
-    await node.start()
+    from .node_service import attach_node_to_head
 
-    async def register():
-        await conn.call("register_node", {
-            "node_id": node_id.binary(),
-            "address": node.peer_address,
-            "resources": resources,
-        })
-
-    node.register_cb = register
-    await register()
+    await attach_node_to_head(node, head_addr, resources,
+                              on_lost=on_head_lost)
     sys.stderr.write(f"node {node_id.hex()[:12]} up: peer={node.peer_address} "
                      f"resources={resources}\n")
     # Park forever; work arrives via the peer server / head pushes.

@@ -52,6 +52,45 @@ from .task_spec import REF, VAL, SchedulingStrategy, TaskSpec
 PENDING, READY, ERROR = "PENDING", "READY", "ERROR"
 
 
+async def attach_node_to_head(node: "NodeService", head_addr: tuple,
+                              resources: dict, *, is_driver: bool = False,
+                              on_lost=None):
+    """Shared node bring-up against a remote head: dial, wire head pushes,
+    start the node, register, and install the re-register callback.
+    Used by both the standalone node daemon (node_main.py) and attaching
+    drivers (runtime._attach) so the registration handshake can't drift
+    between them. ``on_lost`` (async) fires when the head connection
+    drops for any reason other than our own shutdown."""
+    from .head import RemoteHeadClient
+    from .rpc import async_connect
+
+    async def handle_head_push(conn, method, payload):
+        await node.on_head_push(method, payload)
+        return True
+
+    async def on_disconnect(conn):
+        if node._closing:
+            return
+        if on_lost is not None:
+            await on_lost(conn)
+
+    conn = await async_connect(head_addr, handle_head_push, on_disconnect)
+    node.head = RemoteHeadClient(conn)
+    await node.start()
+
+    async def register():
+        await conn.call("register_node", {
+            "node_id": node.node_id.binary(),
+            "address": node.peer_address,
+            "resources": dict(resources),
+            "is_driver": is_driver,
+        })
+
+    node.register_cb = register
+    await register()
+    return conn
+
+
 @dataclass
 class ObjectState:
     status: str = PENDING
@@ -654,8 +693,16 @@ class NodeService:
         strat = spec.strategy
         if strat.kind == "node" and strat.node_id is not None \
                 and strat.node_id != self.node_id.binary():
-            self.loop.create_task(self._execute_remotely(
-                spec, pin_node=NodeID(strat.node_id)))
+            if spec.is_actor_creation:
+                # Through the remote-actor machinery, NOT the plain
+                # remote-execute path: the owner needs a RemoteActorEntry
+                # immediately so method calls submitted right after
+                # creation queue behind the in-flight construction
+                # instead of failing as "unknown actor".
+                self.loop.create_task(self._create_actor_remotely(spec))
+            else:
+                self.loop.create_task(self._execute_remotely(
+                    spec, pin_node=NodeID(strat.node_id)))
             return
         if strat.kind == "pg" and strat.pg_id is not None:
             self.loop.create_task(self._route_pg_task(spec))
@@ -1290,18 +1337,34 @@ class NodeService:
                 await self.head.export_function(spec.func_id, blob)
             except (ConnectionLost, OSError):
                 pass
+        pin = (NodeID(spec.strategy.node_id)
+               if spec.strategy.kind == "node" and spec.strategy.node_id
+               else None)
         while True:
-            try:
-                placed = await self.head.schedule(
-                    spec.resources, spec.strategy.kind,
-                    [n.binary() for n in exclude])
-            except (ConnectionLost, OSError):
-                placed = None
-            if placed is None:
-                await asyncio.sleep(0.25)
-                if self._closing:
+            if pin is not None:
+                addr = await self._node_address(pin)
+                if addr is None:
+                    err = ActorDiedError(
+                        f"actor pinned to node {pin.hex()[:12]}, which is "
+                        f"not in the cluster", task_name=spec.name)
+                    entry.state = "DEAD"
+                    entry.death_cause = str(err)
+                    self._fail_task(spec, err)
+                    self._fail_remote_actor_queue(entry)
                     return
-                continue
+                placed = {"node_id": pin.binary(), "address": addr}
+            else:
+                try:
+                    placed = await self.head.schedule(
+                        spec.resources, spec.strategy.kind,
+                        [n.binary() for n in exclude])
+                except (ConnectionLost, OSError):
+                    placed = None
+                if placed is None:
+                    await asyncio.sleep(0.25)
+                    if self._closing:
+                        return
+                    continue
             target = NodeID(placed["node_id"])
             if target == self.node_id:
                 # Became feasible locally (e.g. the blocking resource was

@@ -59,7 +59,8 @@ class Runtime:
     """One per driver process; the execution context for the driver."""
 
     def __init__(self, num_cpus=None, num_tpus=None, resources=None,
-                 system_config: dict | None = None):
+                 system_config: dict | None = None,
+                 address: str | tuple | None = None):
         self.cfg = get_config().apply_overrides(system_config)
         self.session_id = uuid.uuid4().hex[:12]
         self.job_id = JobID.from_random()
@@ -68,6 +69,13 @@ class Runtime:
         self._driver_task = TaskID.for_task(self.job_id)
         self._put_counter = 0
         self._put_lock = threading.Lock()
+        if isinstance(address, str):
+            host, sep, port = address.rpartition(":")
+            if not sep or not host or not port.isdigit():
+                raise ValueError(
+                    f"address must be 'host:port', got {address!r}")
+            address = (host, int(port))
+        self._attach_addr = tuple(address) if address else None
 
         self.shm = make_store(self.session_id)
         sock_dir = os.environ.get("RT_SOCK_DIR", "/tmp")
@@ -79,18 +87,65 @@ class Runtime:
         )
         self._started = threading.Event()
         self.node: NodeService | None = None
-        self._resources = _detect_resources(num_cpus, num_tpus, resources)
+        self.head = None
+        self._startup_error: BaseException | None = None
+        if self._attach_addr is not None:
+            # An attaching driver contributes NO resources by default —
+            # it is a client of the cluster, not extra capacity (the
+            # reference's `ray.init(address=...)` driver likewise doesn't
+            # add a node's worth of CPUs; its host already registered
+            # them).
+            self._resources = _detect_resources(
+                num_cpus if num_cpus is not None else 0,
+                num_tpus if num_tpus is not None else 0, resources)
+            # ...but only zero what the user didn't set explicitly.
+            explicit = resources or {}
+            if num_tpus is None:
+                if "TPU_HOST" not in explicit:
+                    self._resources["TPU_HOST"] = 0.0
+                if "device" not in explicit:
+                    self._resources["device"] = 0.0
+        else:
+            self._resources = _detect_resources(num_cpus, num_tpus,
+                                                resources)
         self._loop_thread.start()
         self._started.wait()
+        if self._startup_error is not None:
+            # Failed bring-up must not leak the shm namespace or any
+            # half-started servers (atexit was never registered).
+            try:
+                self.loop.call_soon_threadsafe(self.loop.stop)
+                self._loop_thread.join(timeout=5)
+            except Exception:
+                pass
+            try:
+                self.shm.destroy()
+            except Exception:
+                pass
+            raise self._startup_error
         atexit.register(self.shutdown)
 
     def _loop_main(self):
         asyncio.set_event_loop(self.loop)
+        try:
+            if self._attach_addr is not None:
+                self.loop.run_until_complete(self._attach())
+            else:
+                self._start_head()
+        except BaseException as e:  # noqa: BLE001 - surface to __init__
+            self._startup_error = e
+            self._started.set()
+            return
+        self._started.set()
+        self.loop.run_forever()
+
+    def _start_head(self):
         from .head import HeadService, LocalHeadClient, NodeEntry
 
         # The driver process is the head node (`ray start --head` shape):
         # head control plane + its own node service share this loop.
-        self.head = HeadService(self.session_id, self.loop)
+        self.head = HeadService(self.session_id, self.loop,
+                                port=int(os.environ.get("RT_HEAD_PORT", "0")))
         self.loop.run_until_complete(self.head.start())
         self.node = NodeService(
             self.session_id, self.sock_path, self._resources, self.shm,
@@ -104,11 +159,42 @@ class Runtime:
             available=dict(self._resources),  # refreshed by heartbeats
             is_head_node=True)
         self.head.attach_local_node(self.node, entry)
-        self._started.set()
-        self.loop.run_forever()
+
+    async def _attach(self):
+        """Join an existing cluster as a driver node (reference:
+        ``ray.init(address=...)`` connecting a driver to a running GCS,
+        python/ray/_private/worker.py:1227 'connect' path; node
+        registration shares node_main.py's bring-up via
+        attach_node_to_head)."""
+        import sys
+        import threading
+
+        from .node_service import attach_node_to_head
+
+        node = NodeService(
+            self.session_id, self.sock_path, self._resources, self.shm,
+            self.loop, node_id=self.node_id, head=None, is_head_node=False)
+
+        async def on_head_lost(conn):
+            if getattr(self, "_shut", False):
+                return  # our own shutdown closed it
+            # The cluster is gone. Unlike the node daemon (which exits),
+            # a library must not kill the user's process: tear the
+            # runtime down so subsequent API calls fail fast, and leave
+            # the process alive.
+            sys.stderr.write("ray_tpu: head connection lost; shutting "
+                             "down this driver's runtime\n")
+            threading.Thread(target=self.shutdown, daemon=True).start()
+
+        self.node = node
+        await attach_node_to_head(node, self._attach_addr,
+                                  self._resources, is_driver=True,
+                                  on_lost=on_head_lost)
 
     @property
     def head_address(self) -> tuple:
+        if self._attach_addr is not None:
+            return self._attach_addr
         return self.head.address
 
     def _run(self, coro, timeout=None):
@@ -461,10 +547,11 @@ class Runtime:
             self._run(self.node.shutdown(), timeout=10)
         except Exception:
             pass
-        try:
-            self._run(self.head.shutdown(), timeout=5)
-        except Exception:
-            pass
+        if self.head is not None:
+            try:
+                self._run(self.head.shutdown(), timeout=5)
+            except Exception:
+                pass
         self.loop.call_soon_threadsafe(self.loop.stop)
         self._loop_thread.join(timeout=5)
         try:

@@ -21,15 +21,25 @@ from ._private.task_spec import SchedulingStrategy
 
 
 def init(num_cpus=None, num_tpus=None, resources=None, system_config=None,
-         ignore_reinit_error=True, **_ignored) -> Runtime:
-    """Start (or return) the runtime for this process."""
+         ignore_reinit_error=True, address=None, **_ignored) -> Runtime:
+    """Start (or return) the runtime for this process.
+
+    ``address="host:port"`` attaches this driver to an existing cluster's
+    head instead of starting one (reference: ``ray.init(address=...)``).
+    Like the reference's ``RAY_ADDRESS``, the ``RT_ADDRESS`` env var is
+    honored when ``address`` is not given — job drivers inherit it.
+    """
     ctx = context_mod.get_context()
     if ctx is not None:
         if isinstance(ctx, Runtime) and not ignore_reinit_error:
             raise RuntimeError("ray_tpu.init() called twice")
         return ctx
+    import os
+
+    if address is None:
+        address = os.environ.get("RT_ADDRESS") or None
     rt = Runtime(num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
-                 system_config=system_config)
+                 system_config=system_config, address=address)
     context_mod.set_context(rt)
     return rt
 

@@ -1,0 +1,326 @@
+"""Job submission: run driver scripts ON the cluster, track their
+lifecycle, stream their logs.
+
+Capability parity target: /root/reference/dashboard/modules/job/
+job_manager.py:525 (JobManager.submit_job: supervisor per job, entrypoint
+subprocess with RAY_ADDRESS injected, status bookkeeping in the GCS KV)
+and python/ray/dashboard/modules/job/sdk.py (JobSubmissionClient).
+
+Shape here: the ``JobManager`` is a SUPERVISED NAMED ACTOR (like the
+serve controller). Each submitted job is an entrypoint shell command run
+as its own process group with ``RT_ADDRESS`` pointing at the cluster
+head — ``ray_tpu.init()`` inside the entrypoint attaches as a driver.
+Job table lives in the cluster KV, so a restarted manager (or any other
+client) sees every job; logs go to files the manager serves on request.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import threading
+import time
+import uuid
+from dataclasses import asdict, dataclass, field
+from typing import Optional
+
+JOB_MANAGER_NAME = "JOB_MANAGER"
+_KV_PREFIX = "job:"
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    TERMINAL = (SUCCEEDED, FAILED, STOPPED)
+
+
+@dataclass
+class JobInfo:
+    submission_id: str
+    entrypoint: str
+    status: str = JobStatus.PENDING
+    message: str = ""
+    start_time: float = field(default_factory=time.time)
+    end_time: Optional[float] = None
+    metadata: dict = field(default_factory=dict)
+    runtime_env: dict = field(default_factory=dict)
+    pid: Optional[int] = None
+    log_path: str = ""
+    return_code: Optional[int] = None
+
+
+class JobManager:
+    """Named actor owning job subprocesses (reference: job supervisor
+    actors; collapsed to one manager since jobs are plain processes)."""
+
+    def __init__(self, head_address: str, log_dir: Optional[str] = None):
+        self._head_address = head_address
+        self._log_dir = log_dir or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "rtpu-jobs")
+        os.makedirs(self._log_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._jobs: dict[str, JobInfo] = {}
+        self._procs: dict[str, subprocess.Popen] = {}
+        self._recover()
+
+    # -- persistence --------------------------------------------------------
+    def _save(self, info: JobInfo):
+        import ray_tpu
+
+        ray_tpu.kv_put(_KV_PREFIX + info.submission_id,
+                       json.dumps(asdict(info)).encode())
+
+    def _recover(self):
+        """Rebuild the job table from the KV after a manager restart.
+        RUNNING jobs whose process survived keep running (re-monitored
+        by pid); dead ones are marked FAILED."""
+        import ray_tpu
+
+        for key in ray_tpu.kv_keys(_KV_PREFIX):
+            blob = ray_tpu.kv_get(key)
+            if blob is None:
+                continue
+            info = JobInfo(**json.loads(blob))
+            self._jobs[info.submission_id] = info
+            if info.status in (JobStatus.PENDING, JobStatus.RUNNING):
+                if info.pid is not None and _pid_alive(info.pid):
+                    threading.Thread(target=self._monitor_pid,
+                                     args=(info,), daemon=True).start()
+                else:
+                    info.status = JobStatus.FAILED
+                    info.message = "job process died while the manager " \
+                                   "was down"
+                    info.end_time = time.time()
+                    self._save(info)
+
+    # -- lifecycle ----------------------------------------------------------
+    def submit_job(self, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   runtime_env: Optional[dict] = None,
+                   metadata: Optional[dict] = None) -> str:
+        sid = submission_id or f"rtpu-job-{uuid.uuid4().hex[:10]}"
+        with self._lock:
+            if sid in self._jobs and \
+                    self._jobs[sid].status not in JobStatus.TERMINAL:
+                raise ValueError(f"job {sid!r} already exists and is "
+                                 f"{self._jobs[sid].status}")
+            info = JobInfo(
+                submission_id=sid, entrypoint=entrypoint,
+                metadata=dict(metadata or {}),
+                runtime_env=dict(runtime_env or {}),
+                log_path=os.path.join(self._log_dir, f"{sid}.log"))
+            self._jobs[sid] = info
+        env = dict(os.environ)
+        env["RT_ADDRESS"] = self._head_address
+        env["RT_JOB_SUBMISSION_ID"] = sid
+        # Entrypoint drivers attach to the cluster — they must not dial
+        # the TPU tunnel themselves (the node's device lane owns it).
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.update(info.runtime_env.get("env_vars", {}))
+        cwd = info.runtime_env.get("working_dir") or None
+        log = open(info.log_path, "wb")
+        try:
+            proc = subprocess.Popen(
+                entrypoint, shell=True, env=env, cwd=cwd,
+                stdout=log, stderr=subprocess.STDOUT,
+                start_new_session=True)  # own pgid: stop kills the tree
+        except OSError as e:
+            info.status = JobStatus.FAILED
+            info.message = str(e)
+            info.end_time = time.time()
+            self._save(info)
+            log.close()
+            return sid
+        finally:
+            log.close()
+        with self._lock:
+            info.status = JobStatus.RUNNING
+            info.pid = proc.pid
+            self._procs[sid] = proc
+        self._save(info)
+        threading.Thread(target=self._monitor_proc, args=(info, proc),
+                         daemon=True).start()
+        return sid
+
+    def _monitor_proc(self, info: JobInfo, proc: subprocess.Popen):
+        rc = proc.wait()
+        self._finish(info, rc)
+
+    def _monitor_pid(self, info: JobInfo):
+        """Adopted (pre-restart) job: not our child, poll liveness."""
+        while _pid_alive(info.pid):
+            time.sleep(0.5)
+        self._finish(info, None)
+
+    def _finish(self, info: JobInfo, rc: Optional[int]):
+        with self._lock:
+            if info.status == JobStatus.STOPPED:
+                return  # stop_job already settled it
+            info.return_code = rc
+            info.status = (JobStatus.SUCCEEDED if rc == 0
+                           else JobStatus.FAILED)
+            if rc != 0:
+                info.message = (f"entrypoint exited with code {rc}"
+                                if rc is not None else
+                                "job process exited (adopted; return "
+                                "code unknown)")
+            info.end_time = time.time()
+            self._procs.pop(info.submission_id, None)
+        self._save(info)
+
+    def stop_job(self, submission_id: str) -> bool:
+        with self._lock:
+            info = self._jobs.get(submission_id)
+            if info is None or info.status in JobStatus.TERMINAL:
+                return False
+            info.status = JobStatus.STOPPED
+            info.end_time = time.time()
+            pid = info.pid
+        self._save(info)
+        if pid is not None:
+            try:
+                os.killpg(pid, signal.SIGTERM)
+                time.sleep(0.5)
+                if _pid_alive(pid):
+                    os.killpg(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        return True
+
+    # -- queries ------------------------------------------------------------
+    def get_job_status(self, submission_id: str) -> str:
+        return self._job(submission_id).status
+
+    def get_job_info(self, submission_id: str) -> dict:
+        return asdict(self._job(submission_id))
+
+    def list_jobs(self) -> list[dict]:
+        with self._lock:
+            return [asdict(i) for i in self._jobs.values()]
+
+    def get_job_logs(self, submission_id: str) -> str:
+        info = self._job(submission_id)
+        try:
+            with open(info.log_path, "rb") as f:
+                return f.read().decode(errors="replace")
+        except FileNotFoundError:
+            return ""
+
+    def _job(self, submission_id: str) -> JobInfo:
+        with self._lock:
+            info = self._jobs.get(submission_id)
+        if info is None:
+            raise ValueError(f"no such job: {submission_id!r}")
+        return info
+
+    def ping(self) -> bool:
+        return True
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+class JobSubmissionClient:
+    """Client facade (reference: ray.job_submission.JobSubmissionClient).
+    Finds — or lazily creates — the JobManager actor on the cluster this
+    process is attached to."""
+
+    def __init__(self, address: Optional[str] = None):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=address)
+        self._manager = self._get_or_create_manager()
+
+    def _get_or_create_manager(self):
+        import ray_tpu
+
+        try:
+            return ray_tpu.get_actor(JOB_MANAGER_NAME)
+        except Exception:
+            pass
+        from ray_tpu._private import context as context_mod
+        from ray_tpu._private.task_spec import SchedulingStrategy
+
+        rt = context_mod.require_context()
+        if hasattr(rt, "head_address"):
+            host, port = rt.head_address
+            addr = f"{host}:{port}"
+        else:  # inside a task/actor: the worker inherited the env
+            addr = os.environ["RT_ADDRESS"]
+        # Pin the manager to the HEAD NODE (reference: the JobManager
+        # lives on the head). Without the pin, a manager created by a
+        # short-lived attached driver (e.g. `rtpu job submit`) would run
+        # on that driver's transient node and die with it.
+        head_node = next(n for n in ray_tpu.util.state.list_nodes()
+                         if n["is_head_node"])
+        strategy = SchedulingStrategy(
+            kind="node", node_id=bytes.fromhex(head_node["node_id"]))
+        manager = ray_tpu.remote(JobManager).options(
+            name=JOB_MANAGER_NAME, max_restarts=100, max_concurrency=8,
+            scheduling_strategy=strategy).remote(addr)
+        ray_tpu.get(manager.ping.remote(), timeout=60)
+        return manager
+
+    def submit_job(self, *, entrypoint: str,
+                   submission_id: Optional[str] = None,
+                   runtime_env: Optional[dict] = None,
+                   metadata: Optional[dict] = None) -> str:
+        import ray_tpu
+
+        return ray_tpu.get(self._manager.submit_job.remote(
+            entrypoint, submission_id, runtime_env, metadata), timeout=120)
+
+    def get_job_status(self, submission_id: str) -> str:
+        import ray_tpu
+
+        return ray_tpu.get(
+            self._manager.get_job_status.remote(submission_id), timeout=30)
+
+    def get_job_info(self, submission_id: str) -> dict:
+        import ray_tpu
+
+        return ray_tpu.get(
+            self._manager.get_job_info.remote(submission_id), timeout=30)
+
+    def list_jobs(self) -> list[dict]:
+        import ray_tpu
+
+        return ray_tpu.get(self._manager.list_jobs.remote(), timeout=30)
+
+    def stop_job(self, submission_id: str) -> bool:
+        import ray_tpu
+
+        return ray_tpu.get(
+            self._manager.stop_job.remote(submission_id), timeout=30)
+
+    def get_job_logs(self, submission_id: str) -> str:
+        import ray_tpu
+
+        return ray_tpu.get(
+            self._manager.get_job_logs.remote(submission_id), timeout=30)
+
+    def wait_until_finish(self, submission_id: str,
+                          timeout: float = 300) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            status = self.get_job_status(submission_id)
+            if status in JobStatus.TERMINAL:
+                return status
+            time.sleep(0.3)
+        raise TimeoutError(
+            f"job {submission_id} still "
+            f"{self.get_job_status(submission_id)} after {timeout}s")

@@ -1,0 +1,8 @@
+"""ray_tpu.scripts — the ``rtpu`` command-line interface.
+
+Capability parity target: /root/reference/python/ray/scripts/scripts.py
+(`ray start/stop/status`), python/ray/util/state CLI (`ray list ...`,
+`ray summary tasks`), and dashboard/modules/job/cli.py (`ray job ...`).
+Invoke as ``python -m ray_tpu.scripts.cli`` (or the ``rtpu`` console
+script when installed).
+"""

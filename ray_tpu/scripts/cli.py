@@ -1,0 +1,409 @@
+"""``rtpu`` CLI: cluster lifecycle, state inspection, job submission.
+
+Parity targets:
+  * ``rtpu start/stop/status`` — /root/reference/python/ray/scripts/
+    scripts.py (``ray start --head``, ``ray stop``, ``ray status``)
+  * ``rtpu list/summary/timeline`` — the state CLI
+    (python/ray/util/state/state_cli.py)
+  * ``rtpu job submit/status/stop/logs/list`` —
+    dashboard/modules/job/cli.py
+
+Cluster files (address, pids) live under ``--temp-dir`` (default
+``/tmp/rtpu``), so ``stop``/``status`` find the cluster without flags,
+like the reference's ``/tmp/ray`` session files.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+DEFAULT_TEMP_DIR = "/tmp/rtpu"
+
+
+def _temp_dir(args) -> str:
+    d = getattr(args, "temp_dir", None) or DEFAULT_TEMP_DIR
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _address_file(args) -> str:
+    return os.path.join(_temp_dir(args), "head_address")
+
+
+def _pids_file(args) -> str:
+    return os.path.join(_temp_dir(args), "pids")
+
+
+def _record_pid(args, pid: int):
+    with open(_pids_file(args), "a") as f:
+        f.write(f"{pid}\n")
+
+
+def _resolve_address(args) -> str:
+    addr = getattr(args, "address", None) or os.environ.get("RT_ADDRESS")
+    if addr:
+        return addr
+    try:
+        with open(_address_file(args)) as f:
+            return f.read().strip()
+    except FileNotFoundError:
+        sys.exit("error: no cluster address (pass --address, set "
+                 "RT_ADDRESS, or `rtpu start --head` first)")
+
+
+def _attach(args):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import ray_tpu
+
+    ray_tpu.init(address=_resolve_address(args))
+    return ray_tpu
+
+
+# ---------------------------------------------------------------------------
+# rtpu start / stop / status
+# ---------------------------------------------------------------------------
+def cmd_start(args):
+    if args.head:
+        return _start_head(args)
+    return _start_worker_node(args)
+
+
+def _start_head(args):
+    if args.block:
+        return _head_daemon(args)
+    env = dict(os.environ)
+    env["_RTPU_DAEMON"] = "head"
+    cmd = [sys.executable, "-m", "ray_tpu.scripts.cli"]
+    if args.temp_dir:
+        cmd += ["--temp-dir", args.temp_dir]  # top-level flag: before `start`
+    cmd += ["start", "--head", "--block", "--port", str(args.port),
+            "--num-cpus", str(args.num_cpus)]
+    if args.num_tpus is not None:
+        cmd += ["--num-tpus", str(args.num_tpus)]
+    if args.resources:
+        cmd += ["--resources", args.resources]
+    addr_file = _address_file(args)
+    try:
+        os.unlink(addr_file)
+    except FileNotFoundError:
+        pass
+    log = open(os.path.join(_temp_dir(args), "head.log"), "ab")
+    proc = subprocess.Popen(cmd, env=env, stdout=log, stderr=log,
+                            start_new_session=True)
+    _record_pid(args, proc.pid)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if os.path.exists(addr_file):
+            with open(addr_file) as f:
+                addr = f.read().strip()
+            if addr:
+                print(f"head started at {addr} (pid {proc.pid})")
+                print(f"attach with: ray_tpu.init(address=\"{addr}\") or "
+                      f"RT_ADDRESS={addr}")
+                return
+        if proc.poll() is not None:
+            sys.exit(f"head process exited rc={proc.returncode}; see "
+                     f"{log.name}")
+        time.sleep(0.1)
+    sys.exit("timed out waiting for the head to come up")
+
+
+def _head_daemon(args):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    os.environ["RT_HEAD_PORT"] = str(args.port)
+    import ray_tpu
+
+    resources = json.loads(args.resources) if args.resources else None
+    rt = ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+                      resources=resources)
+    host, port = rt.head_address
+    with open(_address_file(args), "w") as f:
+        f.write(f"{host}:{port}")
+    print(f"head up at {host}:{port}", flush=True)
+    stop = {"flag": False}
+
+    def bye(*_):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, bye)
+    signal.signal(signal.SIGINT, bye)
+    while not stop["flag"]:
+        time.sleep(0.2)
+    ray_tpu.shutdown()
+
+
+def _start_worker_node(args):
+    addr = _resolve_address(args)
+    resources = json.loads(args.resources) if args.resources else {}
+    resources.setdefault("CPU", args.num_cpus)
+    if args.num_tpus is not None:
+        resources.setdefault("TPU", args.num_tpus)
+    env = dict(os.environ)
+    env["RT_HEAD_ADDR"] = addr
+    env["RT_SESSION_ID"] = env.get("RT_SESSION_ID", "cli")
+    env["RT_NODE_RESOURCES"] = json.dumps(resources)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    log = open(os.path.join(_temp_dir(args), "node.log"), "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.node_main"],
+        env=env, stdout=log, stderr=log, start_new_session=True)
+    _record_pid(args, proc.pid)
+    print(f"worker node started (pid {proc.pid}) -> head {addr}")
+
+
+def cmd_stop(args):
+    try:
+        with open(_pids_file(args)) as f:
+            pids = [int(line) for line in f if line.strip()]
+    except FileNotFoundError:
+        print("nothing to stop")
+        return
+    stopped = 0
+    for pid in pids:
+        try:
+            os.killpg(pid, signal.SIGTERM)
+            stopped += 1
+        except (ProcessLookupError, PermissionError):
+            pass
+    time.sleep(0.5)
+    for pid in pids:
+        try:
+            os.killpg(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+    os.unlink(_pids_file(args))
+    try:
+        os.unlink(_address_file(args))
+    except FileNotFoundError:
+        pass
+    print(f"stopped {stopped} process group(s)")
+
+
+def cmd_status(args):
+    rt = _attach(args)
+    from ray_tpu.util import state
+
+    # Attached drivers (this CLI process included) aren't cluster capacity.
+    nodes = state.list_nodes(filters=[("is_driver", "=", False)])
+    print(f"{len(nodes)} node(s):")
+    for n in nodes:
+        role = "head" if n["is_head_node"] else "worker"
+        print(f"  {n['node_id'][:12]}  {role:6s}  {n['state']:5s}  "
+              f"{n['address'][0]}:{n['address'][1]}  "
+              f"avail={_fmt_resources(n['available'])}")
+    total = rt.cluster_resources()
+    avail = rt.available_resources()
+    print(f"resources: total={_fmt_resources(total)} "
+          f"available={_fmt_resources(avail)}")
+
+
+def _fmt_resources(res: dict) -> str:
+    return "{" + ", ".join(
+        f"{k}: {v:g}" for k, v in sorted(res.items()) if v) + "}"
+
+
+# ---------------------------------------------------------------------------
+# rtpu list / summary / timeline
+# ---------------------------------------------------------------------------
+def cmd_list(args):
+    _attach(args)
+    from ray_tpu.util import state
+
+    fn = {"tasks": state.list_tasks, "actors": state.list_actors,
+          "objects": state.list_objects, "workers": state.list_workers,
+          "nodes": state.list_nodes,
+          "placement-groups": state.list_placement_groups}[args.kind]
+    filters = []
+    for f in args.filter or []:
+        if "!=" in f:
+            k, v = f.split("!=", 1)
+            filters.append((k.strip(), "!=", _coerce(v.strip())))
+        elif "=" in f:
+            k, v = f.split("=", 1)
+            filters.append((k.strip(), "=", _coerce(v.strip())))
+        else:
+            sys.exit(f"bad --filter {f!r} (want key=value or key!=value)")
+    if args.kind == "nodes" and not any(k == "is_driver"
+                                        for k, _, _ in filters):
+        # This CLI process attaches as a driver — hide it (and any other
+        # attached drivers) unless explicitly asked for.
+        filters.append(("is_driver", "=", False))
+    rows = fn(filters=filters or None, limit=args.limit)
+    print(json.dumps(rows, indent=2, default=str))
+
+
+def _coerce(v: str):
+    if v in ("true", "True"):
+        return True
+    if v in ("false", "False"):
+        return False
+    try:
+        return int(v)
+    except ValueError:
+        return v
+
+
+def cmd_summary(args):
+    _attach(args)
+    from ray_tpu.util import state
+
+    print(json.dumps(state.summarize_tasks(), indent=2))
+
+
+def cmd_timeline(args):
+    _attach(args)
+    import ray_tpu
+
+    events = ray_tpu.timeline(args.output)
+    print(f"wrote {len(events)} events to {args.output}")
+
+
+# ---------------------------------------------------------------------------
+# rtpu job ...
+# ---------------------------------------------------------------------------
+def _job_client(args):
+    _attach(args)
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    return JobSubmissionClient()
+
+
+def cmd_job_submit(args):
+    client = _job_client(args)
+    runtime_env = {}
+    if args.working_dir:
+        runtime_env["working_dir"] = args.working_dir
+    for kv in args.env or []:
+        k, _, v = kv.partition("=")
+        runtime_env.setdefault("env_vars", {})[k] = v
+    import shlex
+
+    sid = client.submit_job(
+        entrypoint=shlex.join(args.entrypoint),
+        submission_id=args.submission_id, runtime_env=runtime_env)
+    print(f"submitted job {sid}")
+    if args.wait:
+        status = client.wait_until_finish(sid, timeout=args.timeout)
+        print(f"job {sid}: {status}")
+        print(client.get_job_logs(sid), end="")
+        sys.exit(0 if status == "SUCCEEDED" else 1)
+
+
+def cmd_job_list(args):
+    client = _job_client(args)
+    for j in client.list_jobs():
+        print(f"{j['submission_id']}  {j['status']:10s}  "
+              f"{j['entrypoint'][:60]}")
+
+
+def cmd_job_status(args):
+    print(_job_client(args).get_job_status(args.id))
+
+
+def cmd_job_stop(args):
+    ok = _job_client(args).stop_job(args.id)
+    print("stopped" if ok else "not running")
+
+
+def cmd_job_logs(args):
+    print(_job_client(args).get_job_logs(args.id), end="")
+
+
+# ---------------------------------------------------------------------------
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="rtpu", description="ray_tpu cluster CLI")
+    p.add_argument("--temp-dir", default=None,
+                   help=f"cluster files dir (default {DEFAULT_TEMP_DIR})")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a head or worker node")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", default=None,
+                    help="head address (worker nodes)")
+    sp.add_argument("--port", type=int, default=0, help="head port")
+    sp.add_argument("--num-cpus", type=int, default=os.cpu_count() or 1)
+    sp.add_argument("--num-tpus", type=int, default=None)
+    sp.add_argument("--resources", default=None, help="JSON dict")
+    sp.add_argument("--block", action="store_true",
+                    help="run in the foreground")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop everything rtpu started here")
+    sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("status", help="cluster membership + resources")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("list", help="list cluster state")
+    sp.add_argument("kind", choices=["tasks", "actors", "objects",
+                                     "workers", "nodes",
+                                     "placement-groups"])
+    sp.add_argument("--filter", action="append",
+                    help="key=value or key!=value (repeatable)")
+    sp.add_argument("--limit", type=int, default=None)
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("summary", help="task counts by name/state")
+    sp.add_argument("kind", choices=["tasks"])
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("timeline", help="dump chrome://tracing JSON")
+    sp.add_argument("--output", "-o", default="timeline.json")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_timeline)
+
+    jp = sub.add_parser("job", help="job submission")
+    jsub = jp.add_subparsers(dest="job_cmd", required=True)
+
+    sp = jsub.add_parser("submit")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--submission-id", default=None)
+    sp.add_argument("--working-dir", default=None)
+    sp.add_argument("--env", action="append", help="KEY=VALUE (repeatable)")
+    sp.add_argument("--wait", action="store_true",
+                    help="block until the job finishes; exit with its "
+                         "status")
+    sp.add_argument("--timeout", type=float, default=600)
+    sp.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                    help="-- command to run")
+    sp.set_defaults(fn=cmd_job_submit)
+
+    for name, fn in (("list", cmd_job_list), ("status", cmd_job_status),
+                     ("stop", cmd_job_stop), ("logs", cmd_job_logs)):
+        sp = jsub.add_parser(name)
+        sp.add_argument("--address", default=None)
+        if name != "list":
+            sp.add_argument("id")
+        sp.set_defaults(fn=fn)
+
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if getattr(args, "cmd", None) == "job" and \
+            getattr(args, "job_cmd", None) == "submit":
+        # strip a leading "--" separator from REMAINDER
+        if args.entrypoint and args.entrypoint[0] == "--":
+            args.entrypoint = args.entrypoint[1:]
+        if not args.entrypoint:
+            sys.exit("error: no entrypoint (rtpu job submit -- <cmd...>)")
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

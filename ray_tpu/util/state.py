@@ -108,7 +108,8 @@ def list_nodes(filters: Optional[Sequence[Filter]] = None,
              if isinstance(n["node_id"], bytes) else n["node_id"],
              "address": tuple(n["address"]), "state": n["state"],
              "resources": n["resources"], "available": n["available"],
-             "is_head_node": n["is_head_node"]}
+             "is_head_node": n["is_head_node"],
+             "is_driver": n.get("is_driver", False)}
             for n in _runtime().list_nodes()]  # head-only, no node fan-out
     return _apply_filters(rows, filters, limit)
 

@@ -1,0 +1,185 @@
+"""Driver attach, job submission, and the rtpu CLI.
+
+Parity models: ray.init(address=...) (python/ray/_private/worker.py
+connect path), JobSubmissionClient/JobManager
+(dashboard/modules/job/job_manager.py:525, tests in
+dashboard/modules/job/tests), and `ray start/stop/status`
+(python/ray/scripts/scripts.py).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _child_env(extra=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra or {})
+    return env
+
+
+def test_attach_driver(rt):
+    """A second process attaches with init(address=...): it runs tasks on
+    the cluster's nodes, reaches named actors, and shares the KV."""
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.options(name="attach_counter").remote()
+    assert ray_tpu.get(c.incr.remote()) == 1
+    host, port = rt.head_address
+    script = (
+        "import ray_tpu\n"
+        "ray_tpu.init()\n"  # RT_ADDRESS from env
+        "c = ray_tpu.get_actor('attach_counter')\n"
+        "print('GOT', ray_tpu.get(c.incr.remote(), timeout=60))\n"
+        "@ray_tpu.remote\n"
+        "def f(x): return x + 1\n"
+        "print('TASK', ray_tpu.get(f.remote(41), timeout=60))\n"
+        "ray_tpu.kv_put('attach_key', b'v')\n"
+        "ray_tpu.shutdown()\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", script],
+        env=_child_env({"RT_ADDRESS": f"{host}:{port}"}),
+        capture_output=True, text=True, timeout=120)
+    assert "GOT 2" in out.stdout, out.stderr[-2000:]
+    assert "TASK 42" in out.stdout
+    assert ray_tpu.kv_get("attach_key") == b"v"
+    assert ray_tpu.get(c.incr.remote()) == 3
+
+
+def test_job_submit_success_logs_and_list(rt):
+    client = JobSubmissionClient()
+    sid = client.submit_job(
+        entrypoint=(
+            f"{sys.executable} -c \"import ray_tpu; ray_tpu.init();\n"
+            "import ray_tpu\n"
+            "f = ray_tpu.remote(lambda x: x * 2)\n"
+            "print('job result:', ray_tpu.get(f.remote(21), timeout=60))\n"
+            "ray_tpu.shutdown()\""),
+        metadata={"owner": "test"})
+    assert client.wait_until_finish(sid, timeout=180) == JobStatus.SUCCEEDED
+    logs = client.get_job_logs(sid)
+    assert "job result: 42" in logs
+    info = client.get_job_info(sid)
+    assert info["metadata"] == {"owner": "test"}
+    assert info["return_code"] == 0
+    assert any(j["submission_id"] == sid for j in client.list_jobs())
+
+
+def test_job_failure_and_stop(rt):
+    client = JobSubmissionClient()
+    bad = client.submit_job(entrypoint=f"{sys.executable} -c 'exit(3)'")
+    assert client.wait_until_finish(bad, timeout=120) == JobStatus.FAILED
+    assert client.get_job_info(bad)["return_code"] == 3
+
+    slow = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(600)'")
+    deadline = time.monotonic() + 60
+    while client.get_job_status(slow) == JobStatus.PENDING and \
+            time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert client.stop_job(slow)
+    assert client.wait_until_finish(slow, timeout=60) == JobStatus.STOPPED
+    pid = client.get_job_info(slow)["pid"]
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            os.kill(pid, 0)
+            time.sleep(0.1)
+        except ProcessLookupError:
+            break
+    else:
+        pytest.fail("stopped job's process still alive")
+
+
+def test_job_manager_restart_recovers_table(rt):
+    """Kill the JobManager's worker: the supervised actor restarts and
+    rebuilds the job table from the KV; a running job is adopted."""
+    from ray_tpu.util import state as state_api
+
+    client = JobSubmissionClient()
+    done = client.submit_job(entrypoint=f"{sys.executable} -c 'print(1)'")
+    client.wait_until_finish(done, timeout=120)
+    running = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(30)'")
+    deadline = time.monotonic() + 60
+    while client.get_job_status(running) != JobStatus.RUNNING and \
+            time.monotonic() < deadline:
+        time.sleep(0.1)
+
+    (mgr,) = state_api.list_actors(
+        filters=[("class_name", "=", "JobManager")])
+    os.kill(mgr["pid"], signal.SIGKILL)
+
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            statuses = {j["submission_id"]: j["status"]
+                        for j in client.list_jobs()}
+            break
+        except Exception:
+            time.sleep(0.3)
+    else:
+        pytest.fail("job manager never came back")
+    assert statuses[done] == JobStatus.SUCCEEDED
+    assert statuses[running] == JobStatus.RUNNING  # adopted, not lost
+    assert client.stop_job(running)
+
+
+def test_cli_end_to_end(tmp_path):
+    """rtpu start --head -> status/list/job submit --wait/stop, all
+    against a daemonized head from a clean process."""
+    temp_dir = str(tmp_path / "rtpu")
+    base = [sys.executable, "-m", "ray_tpu.scripts.cli",
+            "--temp-dir", temp_dir]
+    env = _child_env()
+
+    def run(*extra, timeout=180):
+        return subprocess.run(base + list(extra), env=env,
+                              capture_output=True, text=True,
+                              timeout=timeout)
+
+    out = run("start", "--head", "--num-cpus", "2")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "head started at" in out.stdout
+    try:
+        out = run("status")
+        assert out.returncode == 0, out.stderr[-2000:]
+        assert "1 node(s):" in out.stdout
+        assert "head" in out.stdout
+
+        out = run("list", "nodes")
+        rows = json.loads(out.stdout)
+        assert len(rows) == 1 and rows[0]["is_head_node"]
+
+        out = run("job", "submit", "--wait", "--",
+                  sys.executable, "-c", "print(7 * 6)")
+        assert out.returncode == 0, out.stdout + out.stderr[-2000:]
+        assert "SUCCEEDED" in out.stdout
+        assert "42" in out.stdout
+
+        out = run("list", "actors", "--filter", "class_name=JobManager")
+        assert len(json.loads(out.stdout)) == 1
+    finally:
+        out = run("stop")
+    assert "stopped" in out.stdout
