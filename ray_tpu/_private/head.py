@@ -51,6 +51,10 @@ class NodeEntry:
     last_heartbeat: float = field(default_factory=time.monotonic)
     # PG bundle reservations on this node: (pg_id, bundle_idx) -> resources
     reservations: dict = field(default_factory=dict)
+    # Autoscaler metadata: launch template name + pending resource shapes
+    # from the node's last heartbeat (reference: LoadMetrics).
+    node_type: Optional[str] = None
+    load: list = field(default_factory=list)
 
     def to_row(self) -> dict:
         """Wire/dict shape shared by every list_nodes surface."""
@@ -110,11 +114,12 @@ class HeadService:
     # ------------------------------------------------------------------
     def register_node(self, node_id: NodeID, address: tuple, resources: dict,
                       conn: Optional[ServerConn],
-                      is_driver: bool = False) -> dict:
+                      is_driver: bool = False,
+                      node_type: Optional[str] = None) -> dict:
         entry = NodeEntry(
             node_id=node_id, address=tuple(address),
             resources=dict(resources), available=dict(resources), conn=conn,
-            is_driver=is_driver)
+            is_driver=is_driver, node_type=node_type)
         self.nodes[node_id] = entry
         if conn is not None:
             conn.meta["node_id"] = node_id
@@ -122,11 +127,13 @@ class HeadService:
         return {"session_id": self.session_id,
                 "head_address": self.address}
 
-    def heartbeat(self, node_id: NodeID, available: dict):
+    def heartbeat(self, node_id: NodeID, available: dict, load=None):
         entry = self.nodes.get(node_id)
         if entry is None or entry.state == DEAD:
             return False  # node should re-register (head restarted / expired)
         entry.available = dict(available)
+        if load is not None:
+            entry.load = list(load)
         entry.last_heartbeat = time.monotonic()
         return True
 
@@ -405,6 +412,33 @@ class HeadService:
             if pg.state == "PENDING":
                 await self._try_place_pg(pg)
 
+    def autoscaler_snapshot(self) -> dict:
+        """Cluster view consumed by the autoscaler (reference: LoadMetrics
+        assembled from GCS resource/load state, autoscaler.py:373):
+        per-node totals/availability/type plus aggregate pending demand
+        (parked task/actor shapes from heartbeats + unplaced PG bundles)."""
+        nodes = []
+        demand = []
+        for e in self.nodes.values():
+            nodes.append({
+                "node_id": e.node_id.hex(),
+                "node_type": e.node_type,
+                "state": e.state,
+                "is_head_node": e.is_head_node,
+                "is_driver": e.is_driver,
+                "resources": dict(e.resources),
+                "available": dict(e.available),
+                "reservations": len(e.reservations),
+            })
+            if e.state == ALIVE:
+                demand.extend(dict(s) for s in e.load)
+        pending_bundles = []
+        for pg in self.placement_groups.values():
+            if pg.state == "PENDING":
+                pending_bundles.extend(dict(b) for b in pg.bundles)
+        return {"nodes": nodes, "demand": demand,
+                "pending_pg_bundles": pending_bundles}
+
     # ------------------------------------------------------------------
     # KV / functions / named actors
     # ------------------------------------------------------------------
@@ -451,10 +485,12 @@ class HeadService:
             return self.register_node(
                 NodeID(payload["node_id"]), tuple(payload["address"]),
                 payload["resources"], conn,
-                is_driver=bool(payload.get("is_driver")))
+                is_driver=bool(payload.get("is_driver")),
+                node_type=payload.get("node_type"))
         if method == "heartbeat":
             ok = self.heartbeat(NodeID(payload["node_id"]),
-                                payload["available"])
+                                payload["available"],
+                                payload.get("load"))
             # Heartbeats double as the resource-view sync (reference:
             # ray_syncer) — piggyback pending-PG retries on fresh info.
             await self.retry_pending_pgs()
@@ -568,8 +604,8 @@ class LocalHeadClient:
         nid = self.head.actor_nodes.get(actor_id)
         return nid.binary() if nid is not None else None
 
-    async def heartbeat(self, node_id, available):
-        ok = self.head.heartbeat(node_id, available)
+    async def heartbeat(self, node_id, available, load=None):
+        ok = self.head.heartbeat(node_id, available, load)
         await self.head.retry_pending_pgs()
         return ok
 
@@ -634,10 +670,10 @@ class RemoteHeadClient:
     async def actor_node(self, actor_id):
         return await self.conn.call("actor_node", actor_id.binary())
 
-    async def heartbeat(self, node_id, available):
+    async def heartbeat(self, node_id, available, load=None):
         return await self.conn.call(
             "heartbeat", {"node_id": node_id.binary(),
-                          "available": available})
+                          "available": available, "load": load})
 
     async def list_nodes(self):
         return await self.conn.call("list_nodes", None)

@@ -62,6 +62,7 @@ async def amain():
     from .node_service import attach_node_to_head
 
     await attach_node_to_head(node, head_addr, resources,
+                              node_type=os.environ.get("RT_NODE_TYPE"),
                               on_lost=on_head_lost)
     sys.stderr.write(f"node {node_id.hex()[:12]} up: peer={node.peer_address} "
                      f"resources={resources}\n")

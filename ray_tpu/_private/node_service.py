@@ -54,7 +54,7 @@ PENDING, READY, ERROR = "PENDING", "READY", "ERROR"
 
 async def attach_node_to_head(node: "NodeService", head_addr: tuple,
                               resources: dict, *, is_driver: bool = False,
-                              on_lost=None):
+                              node_type: str = None, on_lost=None):
     """Shared node bring-up against a remote head: dial, wire head pushes,
     start the node, register, and install the re-register callback.
     Used by both the standalone node daemon (node_main.py) and attaching
@@ -84,6 +84,7 @@ async def attach_node_to_head(node: "NodeService", head_addr: tuple,
             "address": node.peer_address,
             "resources": dict(resources),
             "is_driver": is_driver,
+            "node_type": node_type,
         })
 
     node.register_cb = register
@@ -372,13 +373,28 @@ class NodeService:
     async def _heartbeat_loop(self):
         while not self._closing:
             try:
-                ok = await self.head.heartbeat(self.node_id, dict(self.available))
+                ok = await self.head.heartbeat(self.node_id,
+                                               dict(self.available),
+                                               self._demand_shapes())
                 if ok is False:
                     # Head lost track of us (restart/expiry): re-register.
                     await self._register_with_head()
             except (ConnectionLost, OSError):
                 pass
             await asyncio.sleep(self.cfg.heartbeat_interval_s)
+
+    def _demand_shapes(self, cap: int = 100) -> list:
+        """Resource shapes of work parked on this node — the per-node
+        resource load the autoscaler bin-packs against (reference:
+        LoadMetrics fed from raylet resource_load, autoscaler.py:171)."""
+        shapes = []
+        for spec in self.pending_cpu:
+            shapes.append(spec.resources)
+        for spec, _exclude in self._pending_remote:
+            shapes.append(spec.resources)
+        for spec in self._pending_actor_creations:
+            shapes.append(spec.resources)
+        return [dict(s) for s in shapes[:cap]]
 
     async def _register_with_head(self):
         cb = getattr(self, "register_cb", None)

@@ -1,0 +1,80 @@
+"""Slice-aware autoscaling (reference: python/ray/autoscaler/).
+
+Public surface:
+- `NodeTypeConfig` / `AutoscalingConfig` — launch templates + policy knobs
+  (reference: cluster YAML `available_node_types`).
+- `NodeProvider` / `LocalNodeProvider` — provisioning plugin interface and
+  the one-machine gang-subprocess implementation.
+- `StandardAutoscaler` / `ResourceDemandScheduler` — the decision core.
+- `AutoscalingCluster` — test/dev harness: a live cluster whose worker
+  slices appear and disappear with load (reference:
+  python/ray/cluster_utils.py:25 AutoscalingCluster + fake_multinode).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from .autoscaler import (AutoscalerMonitor, AutoscalingConfig,
+                         NodeTypeConfig, ResourceDemandScheduler,
+                         ScalingActions, StandardAutoscaler)
+from .node_provider import LocalNodeProvider, NodeProvider, SliceHandle
+
+__all__ = [
+    "AutoscalerMonitor", "AutoscalingCluster", "AutoscalingConfig",
+    "LocalNodeProvider", "NodeProvider", "NodeTypeConfig",
+    "ResourceDemandScheduler", "ScalingActions", "SliceHandle",
+    "StandardAutoscaler",
+]
+
+
+class AutoscalingCluster:
+    """A live local cluster managed by the real autoscaler: the driver is
+    the head node; worker slices are provisioned/terminated on demand by
+    `StandardAutoscaler` through `LocalNodeProvider`."""
+
+    def __init__(self, config: AutoscalingConfig, init_args: dict = None):
+        import ray_tpu
+        from ray_tpu._private import context
+
+        ray_tpu.init(**(init_args or {}))
+        self.runtime = context.get_context()
+        if self.runtime.head is None:
+            raise RuntimeError(
+                "AutoscalingCluster must run on the head (not an attached "
+                "driver)")
+        self.provider = LocalNodeProvider(self.runtime.head_address,
+                                          self.runtime.session_id)
+        self.monitor = AutoscalerMonitor(self.runtime.head, config,
+                                         self.provider)
+        self.monitor.start(self.runtime.loop)
+
+    @property
+    def autoscaler(self) -> StandardAutoscaler:
+        return self.monitor.autoscaler
+
+    def alive_worker_nodes(self) -> list:
+        return [n for n in self.runtime.list_nodes()
+                if n["state"] == "ALIVE" and not n["is_head_node"]
+                and not n["is_driver"]]
+
+    def shutdown(self):
+        import glob
+        import os
+        import shutil
+
+        import ray_tpu
+
+        session = self.runtime.session_id
+        asyncio.run_coroutine_threadsafe(
+            self.monitor.stop(), self.runtime.loop).result(timeout=10)
+        self.provider.shutdown()
+        ray_tpu.shutdown()
+        # SIGKILLed slice hosts can't clean their shm/socket namespaces.
+        for path in glob.glob(f"/dev/shm/rtpu-{session}-*"):
+            shutil.rmtree(path, ignore_errors=True)
+        for path in glob.glob(f"/tmp/rtpu-{session}-*.sock"):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass

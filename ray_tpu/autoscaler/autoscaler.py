@@ -1,0 +1,265 @@
+"""Slice-aware autoscaler: demand bin-packing + reconcile loop.
+
+Capability parity target: the reference's StandardAutoscaler
+(/root/reference/python/ray/autoscaler/_private/autoscaler.py:171,
+update:373) and ResourceDemandScheduler.get_nodes_to_launch
+(resource_demand_scheduler.py:102,170): read pending demand + min/max
+workers from cluster load, bin-pack onto configured node types, launch
+through a NodeProvider plugin, terminate idle nodes after a timeout.
+
+TPU-native differences:
+- the provisioning unit is a *slice* (gang of hosts) — a slice launches,
+  counts, and terminates as one unit; it is only "idle" when every member
+  host is idle (a half-busy slice is busy);
+- demand arrives from node heartbeats (parked task/actor shapes) plus
+  unplaced placement-group bundles from the head's PG table, mirroring
+  how gang demand should drive slice provisioning (SURVEY §7 stage 11).
+
+The decision core (`ResourceDemandScheduler`, `StandardAutoscaler.plan`)
+is pure — snapshot in, actions out — so it unit-tests without processes,
+matching the reference's scheduler tests.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .node_provider import NodeProvider, SliceHandle
+
+
+@dataclass
+class NodeTypeConfig:
+    """One launch template (reference: `available_node_types` entries in
+    the cluster YAML, ray-schema.json)."""
+    name: str
+    resources: dict  # per-host resources
+    min_workers: int = 0  # in slices
+    max_workers: int = 1  # in slices
+    hosts: int = 1  # hosts per slice (TPU pod slice = N hosts)
+
+
+@dataclass
+class AutoscalingConfig:
+    node_types: List[NodeTypeConfig]
+    idle_timeout_s: float = 5.0
+    max_workers: Optional[int] = None  # global cap, in slices
+    update_interval_s: float = 0.5
+
+    def type_map(self) -> Dict[str, NodeTypeConfig]:
+        return {t.name: t for t in self.node_types}
+
+
+@dataclass
+class ScalingActions:
+    launch: Dict[str, int] = field(default_factory=dict)  # type -> slices
+    terminate: List[str] = field(default_factory=list)  # slice ids
+
+    @property
+    def empty(self) -> bool:
+        return not self.launch and not self.terminate
+
+
+def _fits(capacity: dict, shape: dict) -> bool:
+    return all(capacity.get(k, 0) >= v for k, v in shape.items() if v)
+
+
+def _take(capacity: dict, shape: dict) -> None:
+    for k, v in shape.items():
+        if v:
+            capacity[k] = capacity.get(k, 0) - v
+
+
+class ResourceDemandScheduler:
+    """Pure bin-packing: which new slices does unmet demand require?
+    (reference: resource_demand_scheduler.py:170 get_nodes_to_launch)"""
+
+    def __init__(self, config: AutoscalingConfig):
+        self.config = config
+
+    def get_slices_to_launch(
+        self,
+        demand: List[dict],
+        free_capacity: List[dict],
+        slice_counts: Dict[str, int],
+    ) -> Dict[str, int]:
+        """demand: pending resource shapes; free_capacity: available dict
+        per alive/launching host; slice_counts: current slices per type
+        (alive + launching). Greedy first-fit-decreasing: pack each shape
+        into existing free capacity, else open the smallest feasible node
+        type under its max_workers."""
+        types = self.config.node_types
+        counts = dict(slice_counts)
+        bins = [dict(c) for c in free_capacity]
+        launch: Dict[str, int] = {}
+        total = sum(counts.values())
+        cap = self.config.max_workers
+
+        def size(shape):
+            return sum(shape.values())
+
+        for shape in sorted(demand, key=size, reverse=True):
+            if not shape or not any(shape.values()):
+                continue
+            placed = False
+            for b in bins:
+                if _fits(b, shape):
+                    _take(b, shape)
+                    placed = True
+                    break
+            if placed:
+                continue
+            for t in types:
+                if counts.get(t.name, 0) >= t.max_workers:
+                    continue
+                if cap is not None and total >= cap:
+                    break
+                if _fits(t.resources, shape):
+                    # Open a new slice of this type: its hosts become
+                    # fresh bins for the remaining demand.
+                    new_bins = [dict(t.resources) for _ in range(t.hosts)]
+                    _take(new_bins[0], shape)
+                    bins.extend(new_bins)
+                    counts[t.name] = counts.get(t.name, 0) + 1
+                    total += 1
+                    launch[t.name] = launch.get(t.name, 0) + 1
+                    break
+            # else: no feasible type — shape is infeasible; skip (the
+            # reference logs and drops these the same way).
+        return launch
+
+
+class StandardAutoscaler:
+    """Reconciles desired slice set against the provider: min_workers,
+    demand-driven launches, idle termination."""
+
+    def __init__(self, config: AutoscalingConfig, provider: NodeProvider):
+        self.config = config
+        self.provider = provider
+        self.scheduler = ResourceDemandScheduler(config)
+        self._idle_since: Dict[str, float] = {}  # slice_id -> t
+
+    # -- pure decision core -------------------------------------------------
+    def plan(self, snapshot: dict, slices: List[SliceHandle],
+             now: Optional[float] = None) -> ScalingActions:
+        """snapshot: HeadService.autoscaler_snapshot(); slices: provider
+        non_terminated_slices()."""
+        now = time.monotonic() if now is None else now
+        types = self.config.type_map()
+        actions = ScalingActions()
+
+        node_rows = {n["node_id"]: n for n in snapshot["nodes"]}
+        alive = {nid: n for nid, n in node_rows.items()
+                 if n["state"] == "ALIVE"}
+
+        # Slice accounting: a slice is ALIVE when every member host is
+        # registered-alive; LAUNCHING while any member is still absent.
+        slice_counts: Dict[str, int] = {}
+        launching_hosts: List[dict] = []
+        for h in slices:
+            slice_counts[h.node_type] = slice_counts.get(h.node_type, 0) + 1
+            t = types.get(h.node_type)
+            for nid in h.node_ids:
+                if nid not in alive and t is not None:
+                    launching_hosts.append(dict(t.resources))
+
+        # Demand = parked shapes + unplaced PG bundles.
+        demand = list(snapshot["demand"]) + list(
+            snapshot.get("pending_pg_bundles", []))
+
+        # Free capacity: available on alive hosts + full capacity of
+        # hosts still launching (they'll absorb demand when up).
+        free = [dict(n["available"]) for n in alive.values()] \
+            + launching_hosts
+
+        launch = self.scheduler.get_slices_to_launch(
+            demand, free, slice_counts)
+
+        # Enforce min_workers per type (on top of demand launches).
+        for t in self.config.node_types:
+            have = slice_counts.get(t.name, 0) + launch.get(t.name, 0)
+            if have < t.min_workers:
+                launch[t.name] = launch.get(t.name, 0) + (t.min_workers - have)
+        actions.launch = {k: v for k, v in launch.items() if v > 0}
+
+        # Idle termination: every member host fully free, nothing
+        # reserved, no pending demand anywhere that the slice could
+        # absorb, for longer than idle_timeout_s.
+        if not demand:
+            for h in slices:
+                t = types.get(h.node_type)
+                if t is None:
+                    continue
+                member_rows = [alive.get(nid) for nid in h.node_ids]
+                idle = all(
+                    r is not None and r["reservations"] == 0
+                    and r["available"] == r["resources"]
+                    for r in member_rows)
+                if not idle:
+                    self._idle_since.pop(h.slice_id, None)
+                    continue
+                since = self._idle_since.setdefault(h.slice_id, now)
+                current = slice_counts.get(h.node_type, 0)
+                scheduled_kills = sum(
+                    1 for s in actions.terminate
+                    for hh in slices
+                    if hh.slice_id == s and hh.node_type == h.node_type)
+                if (now - since >= self.config.idle_timeout_s
+                        and current - scheduled_kills > t.min_workers):
+                    actions.terminate.append(h.slice_id)
+        else:
+            self._idle_since.clear()
+        return actions
+
+    # -- side-effecting reconcile ------------------------------------------
+    def update(self, snapshot: dict) -> ScalingActions:
+        slices = self.provider.non_terminated_slices()
+        actions = self.plan(snapshot, slices)
+        for type_name, count in actions.launch.items():
+            t = self.config.type_map()[type_name]
+            for _ in range(count):
+                self.provider.create_slice(t.name, t.resources, t.hosts)
+        for slice_id in actions.terminate:
+            self.provider.terminate_slice(slice_id)
+            self._idle_since.pop(slice_id, None)
+        return actions
+
+
+class AutoscalerMonitor:
+    """Async reconcile loop on the head's event loop (reference: the
+    `monitor.py` process started by the head; here a task on the driver's
+    runtime loop since the driver is the head)."""
+
+    def __init__(self, head_service, config: AutoscalingConfig,
+                 provider: NodeProvider):
+        self.head = head_service
+        self.autoscaler = StandardAutoscaler(config, provider)
+        self._task: Optional[asyncio.Task] = None
+        self._stopped = asyncio.Event()
+
+    async def _run(self):
+        interval = self.autoscaler.config.update_interval_s
+        while not self._stopped.is_set():
+            try:
+                snap = self.head.autoscaler_snapshot()
+                # Provider calls fork subprocesses — cheap, but keep the
+                # loop healthy by yielding around them.
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self.autoscaler.update, snap)
+            except Exception as e:  # noqa: BLE001 - monitor must survive
+                import sys
+                sys.stderr.write(f"autoscaler update failed: {e}\n")
+            try:
+                await asyncio.wait_for(self._stopped.wait(), interval)
+            except asyncio.TimeoutError:
+                pass
+
+    def start(self, loop: asyncio.AbstractEventLoop):
+        self._task = loop.create_task(self._run())
+
+    async def stop(self):
+        self._stopped.set()
+        if self._task is not None:
+            await asyncio.wait([self._task], timeout=5)

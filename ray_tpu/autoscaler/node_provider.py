@@ -1,0 +1,124 @@
+"""Node providers — pluggable cloud/provisioning backends for the autoscaler.
+
+Capability parity target: the reference's NodeProvider plugin interface
+(/root/reference/python/ray/autoscaler/node_provider.py) with its
+aws/gcp/fake_multinode implementations. TPU-native difference: the unit
+of provisioning is a *slice* — a gang of host processes that joins and
+leaves the cluster atomically (SURVEY §7 stage 11: "autoscaler that
+scales slices via a NodeProvider-style plugin").
+
+`LocalNodeProvider` is the in-process implementation (reference analogue:
+`fake_multi_node.FakeMultiNodeProvider`): each slice is `hosts` extra
+node daemons (`ray_tpu._private.node_main`) on this machine, used by the
+autoscaler tests and by `AutoscalingCluster`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ray_tpu._private.ids import NodeID
+
+
+@dataclass
+class SliceHandle:
+    """One provisioned slice: provider-level id + its cluster node ids."""
+    slice_id: str
+    node_type: str
+    node_ids: List[str]  # hex NodeIDs of the member hosts
+    meta: dict = field(default_factory=dict)
+
+
+class NodeProvider:
+    """Interface the autoscaler drives. Implementations provision whole
+    slices (1 host for CPU node types, N hosts for TPU pod slices)."""
+
+    def create_slice(self, node_type: str, resources: dict,
+                     hosts: int = 1) -> SliceHandle:
+        raise NotImplementedError
+
+    def terminate_slice(self, slice_id: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_slices(self) -> List[SliceHandle]:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        for h in list(self.non_terminated_slices()):
+            self.terminate_slice(h.slice_id)
+
+
+class LocalNodeProvider(NodeProvider):
+    """Slices are gangs of local `node_main` subprocesses attached to the
+    driver's head — the fake_multinode-equivalent test/one-machine
+    provider."""
+
+    def __init__(self, head_address: tuple, session_id: str):
+        self.head_address = tuple(head_address)
+        self.session_id = session_id
+        self._slices: Dict[str, SliceHandle] = {}
+        self._procs: Dict[str, List[subprocess.Popen]] = {}
+        self._counter = 0
+
+    def _spawn_host(self, node_type: str, resources: dict,
+                    node_id: NodeID) -> subprocess.Popen:
+        env = dict(os.environ)
+        host, port = self.head_address
+        env.update({
+            "RT_HEAD_ADDR": f"{host}:{port}",
+            "RT_SESSION_ID": self.session_id,
+            "RT_NODE_ID": node_id.hex(),
+            "RT_NODE_TYPE": node_type,
+            "RT_NODE_RESOURCES": json.dumps(resources),
+            # Provisioned hosts must not dial the TPU tunnel (the chip is
+            # owned by the head's device lane in the one-machine setup).
+            "JAX_PLATFORMS": "cpu",
+        })
+        for var in ("PALLAS_AXON_POOL_IPS", "TPU_VISIBLE_CHIPS",
+                    "TPU_WORKER_HOSTNAMES"):
+            env.pop(var, None)
+        return subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.node_main"], env=env)
+
+    def create_slice(self, node_type: str, resources: dict,
+                     hosts: int = 1) -> SliceHandle:
+        self._counter += 1
+        slice_id = f"{node_type}-{self._counter}"
+        node_ids, procs = [], []
+        for _ in range(hosts):
+            nid = NodeID.from_random()
+            procs.append(self._spawn_host(node_type, resources, nid))
+            node_ids.append(nid.hex())
+        handle = SliceHandle(slice_id=slice_id, node_type=node_type,
+                             node_ids=node_ids)
+        self._slices[slice_id] = handle
+        self._procs[slice_id] = procs
+        return handle
+
+    def terminate_slice(self, slice_id: str) -> None:
+        handle = self._slices.pop(slice_id, None)
+        if handle is None:
+            return
+        for proc in self._procs.pop(slice_id, []):
+            try:
+                proc.kill()
+                proc.wait(timeout=5)
+            except Exception:
+                pass
+
+    def non_terminated_slices(self) -> List[SliceHandle]:
+        live = []
+        for sid, handle in list(self._slices.items()):
+            procs = self._procs.get(sid, [])
+            if procs and all(p.poll() is None for p in procs):
+                live.append(handle)
+            elif any(p.poll() is not None for p in procs):
+                # A host died => the slice is gone as a unit (gang
+                # semantics); reap the rest.
+                self.terminate_slice(sid)
+        return live

@@ -1,0 +1,225 @@
+"""Autoscaler: pure demand bin-packing decisions + a live autoscaling
+cluster that launches slices for pending work and reaps idle ones.
+
+Parity model: /root/reference/python/ray/autoscaler/_private/
+autoscaler.py (StandardAutoscaler.update) and
+resource_demand_scheduler.py tests; the live test mirrors
+ray.cluster_utils.AutoscalingCluster + fake_multinode.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (AutoscalingCluster, AutoscalingConfig,
+                                NodeTypeConfig, ScalingActions,
+                                StandardAutoscaler)
+from ray_tpu.autoscaler.node_provider import SliceHandle
+
+
+class _NullProvider:
+    def non_terminated_slices(self):
+        return []
+
+
+def _snap(nodes=(), demand=(), pending_pg_bundles=()):
+    return {"nodes": list(nodes), "demand": list(demand),
+            "pending_pg_bundles": list(pending_pg_bundles)}
+
+
+def _node(node_id, resources, available=None, state="ALIVE",
+          node_type=None, reservations=0, head=False):
+    return {"node_id": node_id, "node_type": node_type, "state": state,
+            "is_head_node": head, "is_driver": False,
+            "resources": dict(resources),
+            "available": dict(resources if available is None else available),
+            "reservations": reservations}
+
+
+def _mk(types, **kw):
+    cfg = AutoscalingConfig(node_types=types, **kw)
+    return StandardAutoscaler(cfg, _NullProvider())
+
+
+class TestPlan:
+    def test_no_demand_no_actions(self):
+        a = _mk([NodeTypeConfig("cpu", {"CPU": 2}, max_workers=4)])
+        actions = a.plan(_snap([_node("h", {"CPU": 2}, head=True)]), [])
+        assert actions.empty
+
+    def test_demand_fitting_existing_capacity_no_launch(self):
+        a = _mk([NodeTypeConfig("cpu", {"CPU": 2}, max_workers=4)])
+        snap = _snap([_node("h", {"CPU": 4}, available={"CPU": 3}, head=True)],
+                     demand=[{"CPU": 1}, {"CPU": 2}])
+        assert a.plan(snap, []).empty
+
+    def test_unmet_demand_launches(self):
+        a = _mk([NodeTypeConfig("cpu", {"CPU": 2}, max_workers=4)])
+        snap = _snap([_node("h", {"CPU": 1}, available={"CPU": 0}, head=True)],
+                     demand=[{"CPU": 2}, {"CPU": 2}, {"CPU": 2}])
+        actions = a.plan(snap, [])
+        assert actions.launch == {"cpu": 3}
+
+    def test_bin_packs_multiple_shapes_per_slice(self):
+        a = _mk([NodeTypeConfig("cpu", {"CPU": 4}, max_workers=4)])
+        snap = _snap([_node("h", {"CPU": 0}, head=True)],
+                     demand=[{"CPU": 1}] * 4)
+        assert a.plan(snap, []).launch == {"cpu": 1}
+
+    def test_max_workers_cap(self):
+        a = _mk([NodeTypeConfig("cpu", {"CPU": 1}, max_workers=2)])
+        snap = _snap([_node("h", {"CPU": 0}, head=True)],
+                     demand=[{"CPU": 1}] * 10)
+        assert a.plan(snap, []).launch == {"cpu": 2}
+
+    def test_global_max_workers(self):
+        a = _mk([NodeTypeConfig("cpu", {"CPU": 1}, max_workers=10)],
+                max_workers=3)
+        snap = _snap([_node("h", {"CPU": 0}, head=True)],
+                     demand=[{"CPU": 1}] * 10)
+        assert a.plan(snap, []).launch == {"cpu": 3}
+
+    def test_custom_resource_selects_type(self):
+        a = _mk([NodeTypeConfig("cpu", {"CPU": 8}, max_workers=4),
+                 NodeTypeConfig("tpu", {"CPU": 1, "TPU": 4}, max_workers=2)])
+        snap = _snap([_node("h", {"CPU": 8}, head=True)],
+                     demand=[{"TPU": 4}])
+        assert a.plan(snap, []).launch == {"tpu": 1}
+
+    def test_infeasible_shape_ignored(self):
+        a = _mk([NodeTypeConfig("cpu", {"CPU": 2}, max_workers=4)])
+        snap = _snap([_node("h", {"CPU": 2}, head=True)],
+                     demand=[{"GPU": 1}])
+        assert a.plan(snap, []).empty
+
+    def test_min_workers_enforced(self):
+        a = _mk([NodeTypeConfig("cpu", {"CPU": 1}, min_workers=2,
+                                max_workers=4)])
+        actions = a.plan(_snap([_node("h", {"CPU": 1}, head=True)]), [])
+        assert actions.launch == {"cpu": 2}
+
+    def test_pending_pg_bundles_drive_launch(self):
+        a = _mk([NodeTypeConfig("cpu", {"CPU": 2}, max_workers=4)])
+        snap = _snap([_node("h", {"CPU": 1}, available={"CPU": 1}, head=True)],
+                     pending_pg_bundles=[{"CPU": 2}, {"CPU": 2}])
+        assert a.plan(snap, []).launch == {"cpu": 2}
+
+    def test_launching_slice_absorbs_demand(self):
+        a = _mk([NodeTypeConfig("cpu", {"CPU": 2}, max_workers=4)])
+        # One slice already launching (hosts not yet registered).
+        slices = [SliceHandle("cpu-1", "cpu", ["not-yet-alive"])]
+        snap = _snap([_node("h", {"CPU": 0}, head=True)],
+                     demand=[{"CPU": 2}])
+        assert a.plan(snap, slices).empty
+
+    def test_multihost_slice_counts_all_hosts_capacity(self):
+        a = _mk([NodeTypeConfig("pod", {"CPU": 1, "TPU": 4}, max_workers=2,
+                                hosts=4)])
+        snap = _snap([_node("h", {"CPU": 1}, head=True)],
+                     demand=[{"TPU": 4}] * 4)
+        # All four shapes fit in ONE 4-host slice.
+        assert a.plan(snap, []).launch == {"pod": 1}
+
+    def test_idle_termination_after_timeout(self):
+        a = _mk([NodeTypeConfig("cpu", {"CPU": 2}, max_workers=4)],
+                idle_timeout_s=1.0)
+        slices = [SliceHandle("cpu-1", "cpu", ["w1"])]
+        snap = _snap([_node("h", {"CPU": 1}, head=True),
+                      _node("w1", {"CPU": 2}, node_type="cpu")])
+        t0 = 100.0
+        assert a.plan(snap, slices, now=t0).empty  # starts the idle clock
+        assert a.plan(snap, slices, now=t0 + 0.5).empty
+        actions = a.plan(snap, slices, now=t0 + 1.5)
+        assert actions.terminate == ["cpu-1"]
+
+    def test_busy_slice_not_terminated(self):
+        a = _mk([NodeTypeConfig("cpu", {"CPU": 2}, max_workers=4)],
+                idle_timeout_s=0.5)
+        slices = [SliceHandle("cpu-1", "cpu", ["w1"])]
+        busy = _snap([_node("h", {"CPU": 1}, head=True),
+                      _node("w1", {"CPU": 2}, available={"CPU": 1},
+                            node_type="cpu")])
+        t0 = 10.0
+        assert a.plan(busy, slices, now=t0).empty
+        assert a.plan(busy, slices, now=t0 + 5).empty
+
+    def test_reserved_slice_not_terminated(self):
+        # A PG reservation holds the slice even with full availability...
+        a = _mk([NodeTypeConfig("cpu", {"CPU": 2}, max_workers=4)],
+                idle_timeout_s=0.1)
+        slices = [SliceHandle("cpu-1", "cpu", ["w1"])]
+        snap = _snap([_node("h", {"CPU": 1}, head=True),
+                      _node("w1", {"CPU": 2}, available={"CPU": 0},
+                            node_type="cpu", reservations=1)])
+        assert a.plan(snap, slices, now=1.0).empty
+        assert a.plan(snap, slices, now=99.0).empty
+
+    def test_idle_termination_respects_min_workers(self):
+        a = _mk([NodeTypeConfig("cpu", {"CPU": 2}, min_workers=1,
+                                max_workers=4)], idle_timeout_s=0.1)
+        slices = [SliceHandle("cpu-1", "cpu", ["w1"]),
+                  SliceHandle("cpu-2", "cpu", ["w2"])]
+        snap = _snap([_node("h", {"CPU": 1}, head=True),
+                      _node("w1", {"CPU": 2}, node_type="cpu"),
+                      _node("w2", {"CPU": 2}, node_type="cpu")])
+        a.plan(snap, slices, now=0.0)
+        actions = a.plan(snap, slices, now=10.0)
+        assert len(actions.terminate) == 1  # one kept for min_workers
+
+    def test_partial_slice_death_not_idle(self):
+        # A multi-host slice with a dead member is not "idle" (it is
+        # broken — the provider reaps it as a gang); plan must not
+        # terminate-by-idleness nor count it as capacity.
+        a = _mk([NodeTypeConfig("pod", {"CPU": 2}, max_workers=2, hosts=2)],
+                idle_timeout_s=0.1)
+        slices = [SliceHandle("pod-1", "pod", ["w1", "wdead"])]
+        snap = _snap([_node("h", {"CPU": 1}, head=True),
+                      _node("w1", {"CPU": 2}, node_type="pod"),
+                      _node("wdead", {"CPU": 2}, state="DEAD",
+                            node_type="pod")])
+        a.plan(snap, slices, now=0.0)
+        assert a.plan(snap, slices, now=10.0).terminate == []
+
+
+@pytest.fixture
+def autoscaling_cluster():
+    ray_tpu.shutdown()
+    cfg = AutoscalingConfig(
+        node_types=[NodeTypeConfig("worker", {"CPU": 1, "scale": 1},
+                                   min_workers=0, max_workers=2)],
+        idle_timeout_s=2.0, update_interval_s=0.25)
+    c = AutoscalingCluster(cfg, init_args={"num_cpus": 1})
+    try:
+        yield c
+    finally:
+        c.shutdown()
+
+
+def _wait(pred, timeout, msg):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise TimeoutError(msg)
+
+
+def test_autoscaling_cluster_scales_up_and_down(autoscaling_cluster):
+    c = autoscaling_cluster
+    assert c.alive_worker_nodes() == []
+
+    @ray_tpu.remote(resources={"scale": 1})
+    def on_worker():
+        import os as _os
+        return _os.environ.get("RT_NODE_TYPE", "")
+
+    # Demand for a resource only the worker type has -> scale up.
+    refs = [on_worker.remote() for _ in range(2)]
+    out = ray_tpu.get(refs, timeout=90)
+    assert out == ["worker", "worker"]
+    assert len(c.alive_worker_nodes()) >= 1
+
+    # Demand gone -> idle slices reaped back to min_workers=0.
+    _wait(lambda: len(c.alive_worker_nodes()) == 0, 45,
+          "idle workers were not terminated")
