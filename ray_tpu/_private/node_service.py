@@ -1394,6 +1394,12 @@ class NodeService:
                     "spec": payload_spec, "owner": self.node_id.binary()})
             except (ConnectionLost, OSError):
                 exclude.add(target)
+                # A pinned target stays the same next iteration (it is
+                # ALIVE at the head until the heartbeat monitor rules);
+                # back off instead of hammering the head's directory.
+                await asyncio.sleep(0.25)
+                if self._closing:
+                    return
                 continue
             err = reply.get("error")
             if err is not None:

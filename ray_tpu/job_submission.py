@@ -140,9 +140,21 @@ class JobManager:
         finally:
             log.close()
         with self._lock:
-            info.status = JobStatus.RUNNING
-            info.pid = proc.pid
-            self._procs[sid] = proc
+            if info.status == JobStatus.STOPPED:
+                # stop_job raced the spawn: it had no pid to kill, so the
+                # kill is ours to deliver.
+                stopped = True
+            else:
+                stopped = False
+                info.status = JobStatus.RUNNING
+                info.pid = proc.pid
+                self._procs[sid] = proc
+        if stopped:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            return sid
         self._save(info)
         threading.Thread(target=self._monitor_proc, args=(info, proc),
                          daemon=True).start()
@@ -182,6 +194,7 @@ class JobManager:
             info.status = JobStatus.STOPPED
             info.end_time = time.time()
             pid = info.pid
+            self._procs.pop(submission_id, None)
         self._save(info)
         if pid is not None:
             try:
@@ -269,11 +282,16 @@ class JobSubmissionClient:
                          if n["is_head_node"])
         strategy = SchedulingStrategy(
             kind="node", node_id=bytes.fromhex(head_node["node_id"]))
-        manager = ray_tpu.remote(JobManager).options(
-            name=JOB_MANAGER_NAME, max_restarts=100, max_concurrency=8,
-            scheduling_strategy=strategy).remote(addr)
-        ray_tpu.get(manager.ping.remote(), timeout=60)
-        return manager
+        try:
+            manager = ray_tpu.remote(JobManager).options(
+                name=JOB_MANAGER_NAME, max_restarts=100, max_concurrency=8,
+                scheduling_strategy=strategy).remote(addr)
+            ray_tpu.get(manager.ping.remote(), timeout=60)
+            return manager
+        except Exception:
+            # Get-or-create race: a concurrent client won the name
+            # registration; adopt the winner's manager.
+            return ray_tpu.get_actor(JOB_MANAGER_NAME)
 
     def submit_job(self, *, entrypoint: str,
                    submission_id: Optional[str] = None,

@@ -80,7 +80,6 @@ def _start_head(args):
     if args.block:
         return _head_daemon(args)
     env = dict(os.environ)
-    env["_RTPU_DAEMON"] = "head"
     cmd = [sys.executable, "-m", "ray_tpu.scripts.cli"]
     if args.temp_dir:
         cmd += ["--temp-dir", args.temp_dir]  # top-level flag: before `start`
@@ -175,12 +174,27 @@ def cmd_stop(args):
             stopped += 1
         except (ProcessLookupError, PermissionError):
             pass
-    time.sleep(0.5)
-    for pid in pids:
+    # Give the head time to run its full shutdown (worker joins, shm
+    # teardown) before escalating; SIGKILL only what remains.
+    deadline = time.monotonic() + 15.0
+
+    def _alive(pid):
         try:
-            os.killpg(pid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            pass
+            os.kill(pid, 0)
+            return True
+        except ProcessLookupError:
+            return False
+        except PermissionError:
+            return True
+
+    while time.monotonic() < deadline and any(_alive(p) for p in pids):
+        time.sleep(0.2)
+    for pid in pids:
+        if _alive(pid):
+            try:
+                os.killpg(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
     os.unlink(_pids_file(args))
     try:
         os.unlink(_address_file(args))
