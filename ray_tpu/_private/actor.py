@@ -116,7 +116,7 @@ def method(num_returns=1):
 class ActorClass:
     def __init__(self, cls, *, num_cpus=None, num_tpus=None, resources=None,
                  max_restarts=0, max_concurrency=1, scheduling_strategy=None,
-                 name=None, lifetime=None):
+                 name=None, lifetime=None, runtime_env=None):
         self._cls = cls
         self._class_name = cls.__name__
         res = dict(resources or {})
@@ -136,6 +136,7 @@ class ActorClass:
             scheduling_strategy = SchedulingStrategy(kind=scheduling_strategy)
         self._strategy = scheduling_strategy or SchedulingStrategy()
         self._name = name
+        self._runtime_env = runtime_env
         self._export_cache: tuple | None = None
         for mname in self._method_names():
             m = getattr(cls, mname)
@@ -157,6 +158,7 @@ class ActorClass:
             max_concurrency=self._max_concurrency,
             scheduling_strategy=self._strategy,
             name=self._name,
+            runtime_env=self._runtime_env,
         )
         if "num_cpus" in overrides:
             merged["resources"]["CPU"] = float(overrides.pop("num_cpus"))
@@ -214,7 +216,9 @@ class ActorClass:
             max_concurrency=self._max_concurrency,
             max_restarts=self._max_restarts,
             actor_name=self._name,
-            runtime_env={"methods": method_names},
+            actor_methods=method_names,
+            runtime_env=ctx.resolve_runtime_env(self._runtime_env,
+                                                device_lane=device),
         )
         refs = ctx.submit_spec(spec)
         return ActorHandle(actor_id, method_names, self._class_name, device,

@@ -54,6 +54,9 @@ class Config:
     worker_startup_timeout_s: float = _cfg(60.0)
     idle_worker_kill_timeout_s: float = _cfg(300.0)
     max_cpu_workers: int = _cfg(64)
+    # A failed runtime_env setup poisons that env on the node for this
+    # long (fail-fast) before the next task retries it from scratch.
+    runtime_env_retry_s: float = _cfg(30.0)
 
     # --- fault tolerance ---
     task_max_retries: int = _cfg(3)

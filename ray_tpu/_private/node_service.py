@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import json
 import os
 import subprocess
 import sys
@@ -40,6 +41,7 @@ from .config import get_config
 from .exceptions import (
     ActorDiedError,
     ObjectLostError,
+    RuntimeEnvSetupError,
     TaskCancelledError,
     TaskError,
     WorkerCrashedError,
@@ -115,6 +117,9 @@ class WorkerHandle:
     actor_id: Optional[ActorID] = None
     last_idle: float = field(default_factory=time.monotonic)
     registered: Optional[asyncio.Future] = None
+    # Runtime-env identity this worker wears; leases only match tasks
+    # with the same env (reference: worker_pool.h pools by env hash).
+    env_id: str = ""
 
 
 @dataclass
@@ -200,6 +205,9 @@ class NodeService:
 
         self.workers: dict[WorkerID, WorkerHandle] = {}
         self.idle_workers: collections.deque[WorkerHandle] = collections.deque()
+        # Runtime envs whose setup recently failed on this node:
+        # env_id -> (error, monotonic time); entries expire (_bad_env_error).
+        self._bad_envs: dict[str, tuple] = {}
         self.pending_cpu: collections.deque[TaskSpec] = collections.deque()
         self.cancelled: set[TaskID] = set()
 
@@ -867,6 +875,13 @@ class NodeService:
             if self._is_device_task(spec):
                 self._run_on_device(spec)
                 continue
+            bad = self._bad_env_error(spec.env_id)
+            if bad is not None:
+                msg = f"runtime_env setup failed on this node: {bad}"
+                self._fail_task(spec, TaskError(
+                    msg, cause=RuntimeEnvSetupError(msg),
+                    task_name=spec.name))
+                continue
             worker = self._acquire_worker(spec)
             if worker is None:
                 if self._should_spill(spec):
@@ -924,32 +939,74 @@ class NodeService:
                 return pool.available
         return self.available
 
+    def _bad_env_error(self, env_id: str) -> Optional[str]:
+        """Recent setup failure for this env on this node, if any. Entries
+        expire so transient causes (KV blip, disk pressure) retry instead
+        of poisoning the node forever."""
+        hit = self._bad_envs.get(env_id)
+        if hit is None:
+            return None
+        msg, t = hit
+        if time.monotonic() - t > self.cfg.runtime_env_retry_s:
+            del self._bad_envs[env_id]
+            return None
+        return msg
+
     def _acquire_worker(self, spec: TaskSpec) -> Optional[WorkerHandle]:
         need = spec.resources.get("CPU", 1.0)
+        env_id = spec.env_id
         pool = self._charge_pool(spec)
         if pool.get("CPU", 0) < need:
             return None
+        skipped = []
+        found = None
         while self.idle_workers:
             w = self.idle_workers.popleft()
-            if w.state == "IDLE" and w.conn is not None and w.conn.alive:
-                w.state = "BUSY"
-                pool["CPU"] = pool.get("CPU", 0) - need
-                spec._charged = pool
-                return w
-        # No idle worker: fork one, but never more STARTING workers than CPU
-        # slots could run concurrently (forks cost ~2.5s on small hosts).
+            if not (w.state == "IDLE" and w.conn is not None
+                    and w.conn.alive):
+                continue  # dead/stale handle: drop it
+            if w.env_id != env_id:
+                skipped.append(w)  # wears a different env; keep for others
+                continue
+            found = w
+            break
+        self.idle_workers.extend(skipped)
+        if found is not None:
+            found.state = "BUSY"
+            pool["CPU"] = pool.get("CPU", 0) - need
+            spec._charged = pool
+            return found
+        # No idle worker with this env: fork one, but never more STARTING
+        # workers than CPU slots could run concurrently (forks cost ~2.5s
+        # on small hosts).
         live = [w for w in self.workers.values()
                 if w.state != "DEAD" and w.actor_id is None]
         starting = sum(1 for w in live if w.state == "STARTING")
+        if (len(live) >= self.cfg.max_cpu_workers and skipped
+                and starting == 0):
+            # Pool is full of idle workers wearing OTHER envs: evict the
+            # longest-idle mismatch to make room (reference: worker_pool
+            # kills idle workers for a different runtime env).
+            victim = min(skipped, key=lambda w: w.last_idle)
+            try:
+                self.idle_workers.remove(victim)
+            except ValueError:
+                pass
+            self._kill_worker(victim)
+            live = [w for w in self.workers.values()
+                    if w.state != "DEAD" and w.actor_id is None]
         if (len(live) < self.cfg.max_cpu_workers
                 and starting < max(1, int(self.available.get("CPU", 1)))):
-            self._spawn_worker()
+            self._spawn_worker(runtime_env=spec.runtime_env)
         return None
 
     def _spawn_worker(self, actor_id: ActorID | None = None,
-                      preserve_platform_env: bool = False) -> WorkerHandle:
+                      preserve_platform_env: bool = False,
+                      runtime_env: dict | None = None) -> WorkerHandle:
         wid = WorkerID.from_random()
         env = dict(os.environ)
+        if runtime_env:
+            env["RT_RUNTIME_ENV"] = json.dumps(runtime_env)
         # CPU-lane workers must never touch the TPU: the device lane owns
         # the chips. Force the cpu backend (setdefault is not enough — the
         # ambient env pins the TPU platform) and drop the TPU-plugin
@@ -974,7 +1031,10 @@ class NodeService:
             stdout=None,
             stderr=None,
         )
-        w = WorkerHandle(worker_id=wid, proc=proc, actor_id=actor_id)
+        from ray_tpu import runtime_env as _re
+
+        w = WorkerHandle(worker_id=wid, proc=proc, actor_id=actor_id,
+                         env_id=_re.env_id(runtime_env))
         w.registered = self.loop.create_future()
         self.workers[wid] = w
         self.counters["workers_started"] += 1
@@ -1622,7 +1682,7 @@ class NodeService:
         actor.ready_fut = self.loop.create_future()
         self.actors[aid] = actor
         if spec.actor_name and self.head is not None:
-            meths = (spec.runtime_env or {}).get("methods", [])
+            meths = spec.actor_methods or []
             try:
                 ok = await self.head.register_named_actor(
                     spec.actor_name, aid, self.node_id, meths)
@@ -1671,6 +1731,7 @@ class NodeService:
             worker = self._spawn_worker(
                 actor_id=actor.actor_id,
                 preserve_platform_env=spec.resources.get("TPU_HOST", 0) > 0,
+                runtime_env=spec.runtime_env,
             )
             actor.worker = worker
             try:
@@ -1681,6 +1742,13 @@ class NodeService:
                 self._actor_creation_failed(
                     actor, ActorDiedError("actor worker failed to start")
                 )
+                return
+            if worker.state == "DEAD":  # runtime_env setup failed
+                bad = self._bad_envs.get(worker.env_id)
+                self._actor_creation_failed(
+                    actor, ActorDiedError(
+                        f"runtime_env setup failed: "
+                        f"{bad[0] if bad else 'unknown'}"))
                 return
             try:
                 reply = await worker.conn.call(
@@ -1930,6 +1998,33 @@ class NodeService:
                 raise RuntimeError(f"unknown worker {payload['worker_id']}")
             w.conn = conn
             conn.meta["worker"] = w
+            setup_error = payload.get("setup_error")
+            if setup_error is not None:
+                # The worker could not wear its runtime env; it exits after
+                # this reply. Poison the env so queued tasks fail fast with
+                # a typed error instead of respawning forever (reference:
+                # RuntimeEnvSetupError surfaced to the submitter).
+                self._bad_envs[w.env_id] = (setup_error, time.monotonic())
+                w.state = "DEAD"
+                if w.registered and not w.registered.done():
+                    w.registered.set_result(None)  # waiters check state
+                # Fail the tasks that wanted this env NOW (they triggered
+                # the spawn); the timed poison only fail-fasts future
+                # submissions, so a permanent failure can't respawn-loop.
+                msg = f"runtime_env setup failed on this node: {setup_error}"
+                err = TaskError(msg, cause=RuntimeEnvSetupError(msg))
+                keep = collections.deque()
+                while self.pending_cpu:
+                    spec = self.pending_cpu.popleft()
+                    if spec.env_id == w.env_id:
+                        err.task_name = spec.name
+                        self._fail_task(spec, err)
+                    else:
+                        keep.append(spec)
+                self.pending_cpu = keep
+                self._kick()
+                return {"session_id": self.session_id,
+                        "peer_address": self.peer_address}
             if w.actor_id is None:
                 w.state = "IDLE"
                 w.last_idle = time.monotonic()

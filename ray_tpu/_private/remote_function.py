@@ -46,7 +46,7 @@ def encode_args(args, kwargs, device_lane: bool):
 class RemoteFunction:
     def __init__(self, function, *, num_cpus=None, num_tpus=None, num_returns=1,
                  max_retries=3, retry_exceptions=False, resources=None,
-                 scheduling_strategy=None, name=None):
+                 scheduling_strategy=None, name=None, runtime_env=None):
         self._function = function
         self._name = name or getattr(function, "__name__", "anonymous")
         self._num_returns = num_returns
@@ -62,6 +62,7 @@ class RemoteFunction:
         if isinstance(scheduling_strategy, str):
             scheduling_strategy = SchedulingStrategy(kind=scheduling_strategy)
         self._strategy = scheduling_strategy or SchedulingStrategy()
+        self._runtime_env = runtime_env
         self._export_cache: tuple | None = None  # (ctx, fid)
         functools.update_wrapper(self, function)
 
@@ -73,6 +74,7 @@ class RemoteFunction:
             resources=dict(self._resources),
             scheduling_strategy=self._strategy,
             name=self._name,
+            runtime_env=self._runtime_env,
         )
         if "num_cpus" in overrides:
             merged["resources"]["CPU"] = float(overrides.pop("num_cpus"))
@@ -124,6 +126,8 @@ class RemoteFunction:
             max_retries=self._max_retries,
             retry_exceptions=self._retry_exceptions,
             strategy=self._strategy,
+            runtime_env=ctx.resolve_runtime_env(self._runtime_env,
+                                                device_lane=device),
         )
         refs = ctx.submit_spec(spec)
         return refs[0] if self._num_returns == 1 else refs

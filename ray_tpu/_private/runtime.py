@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import atexit
+import json
 import os
 import threading
 import uuid
@@ -60,8 +61,15 @@ class Runtime:
 
     def __init__(self, num_cpus=None, num_tpus=None, resources=None,
                  system_config: dict | None = None,
-                 address: str | tuple | None = None):
+                 address: str | tuple | None = None,
+                 runtime_env: dict | None = None):
+        from ray_tpu import runtime_env as _re
+
         self.cfg = get_config().apply_overrides(system_config)
+        # Job-level default environment (reference: ray.init(runtime_env=)
+        # applied to every task/actor of the job, merged task-side).
+        self.default_runtime_env = _re.validate(runtime_env)
+        self._env_resolve_cache: dict = {}
         self.session_id = uuid.uuid4().hex[:12]
         self.job_id = JobID.from_random()
         self.node_id = NodeID.from_random()
@@ -473,6 +481,34 @@ class Runtime:
 
     def kv_op(self, op, key, val=None):
         return self._run(self.node.head.kv_op(op, key, val))
+
+    def resolve_runtime_env(self, env: dict | None,
+                            device_lane: bool = False):
+        """Merge the job default with a per-task env and upload any local
+        packages (ray_tpu.runtime_env.resolve_for_upload), cached by env
+        content. Returns the resolved env for the TaskSpec, or None."""
+        from ray_tpu import runtime_env as _re
+
+        if device_lane:
+            # The device lane runs in the node-owner process, which cannot
+            # wear a per-task environment. An explicit per-task env is a
+            # user error; the job-level default is simply skipped (it
+            # already applies to the driver process the lane lives in).
+            if _re.validate(env):
+                raise ValueError(
+                    "runtime_env is not supported on device-lane "
+                    "tasks/actors: the device lane runs in the node-owner "
+                    "process. Drop the runtime_env or target the CPU lane.")
+            return None
+        merged = _re.merge(self.default_runtime_env, env)
+        if not merged:
+            return None
+        key = json.dumps(merged, sort_keys=True)
+        hit = self._env_resolve_cache.get(key)
+        if hit is None:
+            hit = _re.resolve_for_upload(merged, self.kv_op)
+            self._env_resolve_cache[key] = hit
+        return dict(hit)
 
     # -- placement groups --------------------------------------------------
     def create_placement_group(self, bundles, strategy):

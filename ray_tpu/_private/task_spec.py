@@ -76,7 +76,16 @@ class TaskSpec:
     max_concurrency: int = 1
     max_restarts: int = 0
     actor_name: Optional[str] = None
+    actor_methods: Optional[list] = None
+    # Resolved runtime environment (env_vars + kv:// package URIs —
+    # see ray_tpu.runtime_env); workers are pooled by its hash.
     runtime_env: Optional[dict] = None
+
+    @property
+    def env_id(self) -> str:
+        from ray_tpu import runtime_env as _re
+
+        return _re.env_id(self.runtime_env)
 
     def return_ids(self) -> list[ObjectID]:
         return [ObjectID.for_return(self.task_id, i) for i in range(self.num_returns)]

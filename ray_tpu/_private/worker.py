@@ -58,7 +58,30 @@ class WorkerContext:
         self._decref_lock = threading.Lock()
         # Connect last: the node service may push tasks the moment we register.
         self.client = DuplexClient(sock_path, self._handle, handler_threads=32)
-        reply = self.client.call("register", {"worker_id": worker_id.hex()})
+        # Wear the runtime environment BEFORE registering — tasks are only
+        # pushed to registered workers, so setup can't race execution
+        # (reference: the runtime-env agent prepares the env before the
+        # worker is handed a lease, runtime_env_agent.py:289).
+        setup_error = None
+        self._worker_env = {}  # resolved env this worker wears (inherited
+        # by nested submissions, see resolve_runtime_env)
+        env_json = os.environ.get("RT_RUNTIME_ENV")
+        if env_json:
+            from ray_tpu import runtime_env as _re
+
+            try:
+                self._worker_env = __import__("json").loads(env_json)
+                _re.apply(self._worker_env,
+                          kv_get=lambda k: self.kv_op("get", k))
+            except BaseException as e:  # noqa: BLE001 - report, then die
+                setup_error = f"{type(e).__name__}: {e}"
+        reply = self.client.call(
+            "register", {"worker_id": worker_id.hex(),
+                         "setup_error": setup_error})
+        if setup_error is not None:
+            self.client.close()
+            sys.stderr.write(f"runtime_env setup failed: {setup_error}\n")
+            os._exit(1)
         # Our node's peer address: stamped into refs we create so they stay
         # resolvable when they travel to other nodes.
         self.node_addr = tuple(reply["peer_address"]) \
@@ -180,6 +203,24 @@ class WorkerContext:
 
     def kv_op(self, op, key, val=None):
         return self.client.call("kv", (op, key, val))
+
+    def resolve_runtime_env(self, env, device_lane: bool = False):
+        """Nested submissions from inside a worker: children inherit this
+        worker's (already-resolved) environment by default, with the
+        explicit per-call env merged on top (reference semantics: child
+        tasks inherit the parent's runtime_env unless overridden)."""
+        from ray_tpu import runtime_env as _re
+
+        if device_lane:
+            if _re.validate(env):
+                raise ValueError(
+                    "runtime_env is not supported on device-lane "
+                    "tasks/actors")
+            return None
+        merged = _re.merge(self._worker_env, env)
+        if not merged:
+            return None
+        return _re.resolve_for_upload(merged, self.kv_op)
 
     # -- task execution ----------------------------------------------------
     def _get_callable(self, func_id: str):

@@ -21,7 +21,8 @@ from ._private.task_spec import SchedulingStrategy
 
 
 def init(num_cpus=None, num_tpus=None, resources=None, system_config=None,
-         ignore_reinit_error=True, address=None, **_ignored) -> Runtime:
+         ignore_reinit_error=True, address=None, runtime_env=None,
+         **_ignored) -> Runtime:
     """Start (or return) the runtime for this process.
 
     ``address="host:port"`` attaches this driver to an existing cluster's
@@ -39,7 +40,8 @@ def init(num_cpus=None, num_tpus=None, resources=None, system_config=None,
     if address is None:
         address = os.environ.get("RT_ADDRESS") or None
     rt = Runtime(num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
-                 system_config=system_config, address=address)
+                 system_config=system_config, address=address,
+                 runtime_env=runtime_env)
     context_mod.set_context(rt)
     return rt
 

@@ -154,6 +154,9 @@ class JobManager:
                 os.killpg(proc.pid, signal.SIGKILL)
             except (ProcessLookupError, PermissionError):
                 pass
+            # Reap the killed child so it doesn't linger as a zombie in
+            # this long-lived manager actor.
+            threading.Thread(target=proc.wait, daemon=True).start()
             return sid
         self._save(info)
         threading.Thread(target=self._monitor_proc, args=(info, proc),

@@ -1,0 +1,251 @@
+"""Runtime environments: validation/merging, packaging, worker-pool
+isolation, env_vars / working_dir / py_modules / pip, setup failure
+surfacing.
+
+Parity model: /root/reference/python/ray/_private/runtime_env/ and
+python/ray/tests/test_runtime_env*.py.
+"""
+
+import os
+import sys
+
+import pytest
+
+import ray_tpu
+from ray_tpu import runtime_env as re_mod
+
+
+# ---------------------------------------------------------------------------
+# Pure unit tests
+# ---------------------------------------------------------------------------
+class TestValidateMerge:
+    def test_empty(self):
+        assert re_mod.validate(None) == {}
+        assert re_mod.validate({}) == {}
+        assert re_mod.env_id({}) == ""
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(ValueError):
+            re_mod.validate({"bogus": 1})
+
+    def test_env_vars_typed(self):
+        with pytest.raises(TypeError):
+            re_mod.validate({"env_vars": {"A": 1}})
+
+    def test_merge_env_vars_task_wins(self):
+        base = {"env_vars": {"A": "1", "B": "2"}}
+        override = {"env_vars": {"B": "3"}, "pip": ["numpy"]}
+        merged = re_mod.merge(base, override)
+        assert merged["env_vars"] == {"A": "1", "B": "3"}
+        assert merged["pip"] == ["numpy"]
+
+    def test_env_id_stable_and_distinct(self):
+        a = {"env_vars": {"X": "1"}}
+        b = {"env_vars": {"X": "2"}}
+        assert re_mod.env_id(a) == re_mod.env_id(dict(a))
+        assert re_mod.env_id(a) != re_mod.env_id(b)
+
+
+class TestPackaging:
+    def test_upload_and_apply_roundtrip(self, tmp_path, monkeypatch):
+        pkg = tmp_path / "proj"
+        pkg.mkdir()
+        (pkg / "mymod_rt_test.py").write_text("VALUE = 41\n")
+        (pkg / "data.txt").write_text("hello")
+        kv = {}
+
+        def kv_op(op, key, val=None):
+            if op == "put":
+                kv[key] = val
+                return True
+            if op == "get":
+                return kv.get(key)
+            if op == "exists":
+                return key in kv
+            raise AssertionError(op)
+
+        resolved = re_mod.resolve_for_upload(
+            {"working_dir": str(pkg)}, kv_op)
+        uri = resolved["working_dir"]
+        assert uri.startswith("kv://rtpkg/")
+        # Deterministic: same dir -> same uri.
+        assert re_mod.resolve_for_upload(
+            {"working_dir": str(pkg)}, kv_op)["working_dir"] == uri
+
+        cwd, path = os.getcwd(), list(sys.path)
+        try:
+            re_mod.apply(resolved, kv_get=lambda k: kv.get(k),
+                         cache_dir=str(tmp_path / "cache"))
+            assert open("data.txt").read() == "hello"
+            import mymod_rt_test
+            assert mymod_rt_test.VALUE == 41
+        finally:
+            os.chdir(cwd)
+            sys.path[:] = path
+            sys.modules.pop("mymod_rt_test", None)
+
+    def test_missing_path_raises(self):
+        with pytest.raises(ray_tpu.RuntimeEnvSetupError):
+            re_mod.resolve_for_upload(
+                {"working_dir": "/no/such/dir"}, lambda *a: None)
+
+    def test_pip_check(self):
+        re_mod._check_pip(["numpy", "jax>=0.4"])  # baked in: passes
+        with pytest.raises(ray_tpu.RuntimeEnvSetupError):
+            re_mod._check_pip(["definitely-not-a-real-package-xyz"])
+
+
+# ---------------------------------------------------------------------------
+# Live-cluster tests
+# ---------------------------------------------------------------------------
+def test_env_vars_apply_to_task(rt):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RT_TEST_FLAG": "on"}})
+    def read_env():
+        import os as _os
+        return _os.environ.get("RT_TEST_FLAG")
+
+    assert ray_tpu.get(read_env.remote(), timeout=60) == "on"
+
+
+def test_workers_pooled_by_env(rt):
+    @ray_tpu.remote
+    def plain():
+        import os as _os
+        return _os.environ.get("RT_TEST_FLAG", "unset"), _os.getpid()
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"RT_TEST_FLAG": "on"}})
+    def tagged():
+        import os as _os
+        return _os.environ.get("RT_TEST_FLAG", "unset"), _os.getpid()
+
+    flag_a, pid_a = ray_tpu.get(tagged.remote(), timeout=60)
+    flag_b, pid_b = ray_tpu.get(plain.remote(), timeout=60)
+    assert flag_a == "on"
+    # The plain task must NOT run in the env-wearing worker.
+    assert flag_b == "unset"
+    assert pid_a != pid_b
+
+
+def test_working_dir_ships_to_worker(rt, tmp_path):
+    pkg = tmp_path / "wd"
+    pkg.mkdir()
+    (pkg / "shipped_cfg.txt").write_text("42")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(pkg)})
+    def read_file():
+        return open("shipped_cfg.txt").read()
+
+    assert ray_tpu.get(read_file.remote(), timeout=60) == "42"
+
+
+def test_py_modules_importable(rt, tmp_path):
+    mod_dir = tmp_path / "mods"
+    mod_dir.mkdir()
+    (mod_dir / "shipped_mod_rt.py").write_text("def f():\n    return 7\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod_dir)]})
+    def use_mod():
+        import shipped_mod_rt
+        return shipped_mod_rt.f()
+
+    assert ray_tpu.get(use_mod.remote(), timeout=60) == 7
+
+
+def test_actor_runtime_env(rt):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RT_ACTOR_FLAG": "yes"}})
+    class EnvActor:
+        def flag(self):
+            import os as _os
+            return _os.environ.get("RT_ACTOR_FLAG")
+
+    a = EnvActor.remote()
+    assert ray_tpu.get(a.flag.remote(), timeout=60) == "yes"
+
+
+def test_bad_pip_requirement_fails_typed(rt):
+    @ray_tpu.remote(max_retries=0,
+                    runtime_env={"pip": ["not-a-real-pkg-abcxyz"]})
+    def never_runs():
+        return 1
+
+    with pytest.raises(ray_tpu.TaskError) as ei:
+        ray_tpu.get(never_runs.remote(), timeout=90)
+    assert "runtime_env" in str(ei.value)
+
+
+def test_device_lane_rejects_runtime_env(rt):
+    @ray_tpu.remote(scheduling_strategy="device",
+                    runtime_env={"env_vars": {"A": "1"}})
+    def dev():
+        return 1
+
+    with pytest.raises(ValueError):
+        dev.remote()
+
+
+def test_nested_task_inherits_parent_env(rt):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RT_NEST_FLAG": "deep"}})
+    def parent():
+        import ray_tpu as _rt
+
+        @_rt.remote
+        def child():
+            import os as _os
+            return _os.environ.get("RT_NEST_FLAG")
+
+        return _rt.get(child.remote(), timeout=60)
+
+    assert ray_tpu.get(parent.remote(), timeout=90) == "deep"
+
+
+def test_device_lane_allowed_with_job_default_env():
+    ray_tpu.shutdown()
+    try:
+        ray_tpu.init(num_cpus=2,
+                     runtime_env={"env_vars": {"RT_JOB_FLAG": "j"}})
+
+        @ray_tpu.remote(scheduling_strategy="device")
+        def dev():
+            return 5
+
+        # The job default is skipped for the device lane (it already
+        # applies to the driver process), not an error.
+        assert ray_tpu.get(dev.remote(), timeout=60) == 5
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_bad_env_poison_expires(rt):
+    rt.cfg.runtime_env_retry_s = 0.0  # expire immediately -> retried
+
+    @ray_tpu.remote(max_retries=0,
+                    runtime_env={"pip": ["still-not-a-real-pkg"]})
+    def never_runs():
+        return 1
+
+    for _ in range(2):  # second submit retries setup, same typed error
+        with pytest.raises(ray_tpu.TaskError) as ei:
+            ray_tpu.get(never_runs.remote(), timeout=90)
+        assert "runtime_env" in str(ei.value)
+
+
+def test_job_level_default_env(tmp_path):
+    ray_tpu.shutdown()
+    try:
+        ray_tpu.init(num_cpus=2,
+                     runtime_env={"env_vars": {"RT_JOB_FLAG": "j1"}})
+
+        @ray_tpu.remote
+        def read_env():
+            import os as _os
+            return _os.environ.get("RT_JOB_FLAG")
+
+        @ray_tpu.remote(runtime_env={"env_vars": {"RT_JOB_FLAG": "t1"}})
+        def override():
+            import os as _os
+            return _os.environ.get("RT_JOB_FLAG")
+
+        assert ray_tpu.get(read_env.remote(), timeout=60) == "j1"
+        assert ray_tpu.get(override.remote(), timeout=60) == "t1"
+    finally:
+        ray_tpu.shutdown()
