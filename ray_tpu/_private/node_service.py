@@ -208,6 +208,9 @@ class NodeService:
         # Runtime envs whose setup recently failed on this node:
         # env_id -> (error, monotonic time); entries expire (_bad_env_error).
         self._bad_envs: dict[str, tuple] = {}
+        # User metrics: cumulative snapshots pushed by worker processes,
+        # keyed by source worker id (in-process code is read directly).
+        self.user_metrics: dict[str, dict] = {}
         self.pending_cpu: collections.deque[TaskSpec] = collections.deque()
         self.cancelled: set[TaskID] = set()
 
@@ -322,6 +325,7 @@ class NodeService:
             "store": self._store_stats(),
             "num_workers": len(self.workers),
             "num_actors": len(self.actors),
+            "metrics": self._metrics_rows(),
         }
         if light:
             return snap
@@ -359,6 +363,32 @@ class NodeService:
         if include_events:
             snap["events"] = list(self.task_events)
         return snap
+
+    def _metrics_rows(self) -> list:
+        """User metrics visible on this node: the in-process registry
+        (driver / device lane) plus worker pushes, stamped with source +
+        node for cross-node aggregation (ray_tpu.util.prometheus_text)."""
+        rows = []
+        try:
+            from ray_tpu.util.metrics import _registry
+
+            local = _registry.snapshot()
+            for r in local["rows"]:
+                r = dict(r)
+                r["source"] = f"node:{self.node_id.hex()[:8]}"
+                r["node_id"] = self.node_id.hex()
+                r["ts"] = local["ts"]
+                rows.append(r)
+        except Exception:
+            pass
+        for source, snap in self.user_metrics.items():
+            for r in snap.get("rows", []):
+                r = dict(r)
+                r["source"] = source
+                r["node_id"] = self.node_id.hex()
+                r["ts"] = snap.get("ts", 0.0)
+                rows.append(r)
+        return rows
 
     def _store_stats(self) -> dict:
         used = sum(st.size for st in self.objects.values()
@@ -2012,13 +2042,13 @@ class NodeService:
                 # the spawn); the timed poison only fail-fasts future
                 # submissions, so a permanent failure can't respawn-loop.
                 msg = f"runtime_env setup failed on this node: {setup_error}"
-                err = TaskError(msg, cause=RuntimeEnvSetupError(msg))
                 keep = collections.deque()
                 while self.pending_cpu:
                     spec = self.pending_cpu.popleft()
                     if spec.env_id == w.env_id:
-                        err.task_name = spec.name
-                        self._fail_task(spec, err)
+                        self._fail_task(spec, TaskError(
+                            msg, cause=RuntimeEnvSetupError(msg),
+                            task_name=spec.name))
                     else:
                         keep.append(spec)
                 self.pending_cpu = keep
@@ -2057,6 +2087,12 @@ class NodeService:
             spec: TaskSpec = payload
             rids = self.submit(spec)
             return [r.binary() for r in rids]
+
+        if method == "metrics_push":
+            # Cumulative user-metric snapshot from a worker process
+            # (reference: worker -> per-node metrics agent, reporter.proto).
+            self.user_metrics[payload["source"]] = payload["snapshot"]
+            return True
 
         if method == "fetch_object":
             oid = ObjectID(payload["oid"])

@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import asyncio
 import atexit
-import json
 import os
 import threading
 import uuid
@@ -69,7 +68,6 @@ class Runtime:
         # Job-level default environment (reference: ray.init(runtime_env=)
         # applied to every task/actor of the job, merged task-side).
         self.default_runtime_env = _re.validate(runtime_env)
-        self._env_resolve_cache: dict = {}
         self.session_id = uuid.uuid4().hex[:12]
         self.job_id = JobID.from_random()
         self.node_id = NodeID.from_random()
@@ -503,12 +501,10 @@ class Runtime:
         merged = _re.merge(self.default_runtime_env, env)
         if not merged:
             return None
-        key = json.dumps(merged, sort_keys=True)
-        hit = self._env_resolve_cache.get(key)
-        if hit is None:
-            hit = _re.resolve_for_upload(merged, self.kv_op)
-            self._env_resolve_cache[key] = hit
-        return dict(hit)
+        # No spec-keyed cache: local paths are re-zipped every submit so
+        # edits ship immediately; the deterministic zip's content hash
+        # dedupes the KV upload, which keeps this cheap.
+        return _re.resolve_for_upload(merged, self.kv_op)
 
     # -- placement groups --------------------------------------------------
     def create_placement_group(self, bundles, strategy):

@@ -282,6 +282,13 @@ def cmd_timeline(args):
     print(f"wrote {len(events)} events to {args.output}")
 
 
+def cmd_metrics(args):
+    _attach(args)
+    from ray_tpu.util import prometheus_text
+
+    sys.stdout.write(prometheus_text())
+
+
 # ---------------------------------------------------------------------------
 # rtpu job ...
 # ---------------------------------------------------------------------------
@@ -374,6 +381,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("kind", choices=["tasks"])
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("metrics",
+                        help="print cluster metrics (Prometheus format)")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_metrics)
 
     sp = sub.add_parser("timeline", help="dump chrome://tracing JSON")
     sp.add_argument("--output", "-o", default="timeline.json")

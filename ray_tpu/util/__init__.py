@@ -1,8 +1,13 @@
-"""ray_tpu.util — cluster utilities: state introspection, timeline.
+"""ray_tpu.util — cluster utilities: state introspection, timeline,
+actor pools, distributed queues, user metrics + Prometheus export.
 
 Capability parity target: /root/reference/python/ray/util/ (state API,
-ActorPool, queues, metrics). The state API lives in
+actor_pool.py, queue.py, metrics.py). The state API lives in
 ``ray_tpu.util.state``; ``ray_tpu.timeline`` is re-exported at top level.
 """
 
+from . import metrics  # noqa: F401
+from . import queue  # noqa: F401
 from . import state  # noqa: F401
+from .actor_pool import ActorPool  # noqa: F401
+from .prometheus import list_metrics, prometheus_text, serve_metrics  # noqa: F401

@@ -1,0 +1,147 @@
+"""Actor pool: round-robin work distribution over a fixed set of actors.
+
+Capability parity target: /root/reference/python/ray/util/actor_pool.py
+(ActorPool: map:87, map_unordered:120, submit:150, get_next:183,
+get_next_unordered:226, has_next, has_free, push, pop_idle).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+
+class ActorPool:
+    """Distribute work over a set of (interchangeable) actor handles.
+
+    Example:
+        pool = ActorPool([Worker.remote() for _ in range(4)])
+        for out in pool.map(lambda a, v: a.step.remote(v), items):
+            ...
+    """
+
+    def __init__(self, actors: List[Any]):
+        import ray_tpu
+
+        self._ray = ray_tpu
+        self._idle: List[Any] = list(actors)
+        # ref -> (actor, submission index)
+        self._inflight: dict = {}
+        # Completed (actor already re-idled) but not yet returned: ref -> idx
+        self._ready: dict = {}
+        self._index_to_ref: dict = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+
+    # -- submission ---------------------------------------------------------
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        """Schedule fn(actor, value) on an idle actor (blocks via wait if
+        none is idle)."""
+        if not self._idle:
+            # Wait for any in-flight call to finish, freeing its actor.
+            self._absorb_one(block=True)
+        actor = self._idle.pop()
+        ref = fn(actor, value)
+        self._inflight[ref] = (actor, self._next_task_index)
+        self._index_to_ref[self._next_task_index] = ref
+        self._next_task_index += 1
+
+    def _absorb_one(self, block: bool) -> Any:
+        """Wait for one in-flight ref; re-idle its actor; park the ref in
+        the ready set until a get_next* returns it."""
+        refs = list(self._inflight.keys())
+        done, _ = self._ray.wait(refs, num_returns=1,
+                                 timeout=None if block else 0)
+        if not done:
+            return None
+        ref = done[0]
+        actor, idx = self._inflight.pop(ref)
+        self._idle.append(actor)
+        self._ready[ref] = idx
+        return ref
+
+    # -- retrieval ----------------------------------------------------------
+    def has_next(self) -> bool:
+        return bool(self._inflight) or bool(self._ready)
+
+    def has_free(self) -> bool:
+        return bool(self._idle)
+
+    def get_next(self, timeout: float | None = None) -> Any:
+        """Next result in SUBMISSION order."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        # Skip indices already consumed by get_next_unordered.
+        while self._next_return_index not in self._index_to_ref:
+            self._next_return_index += 1
+        idx = self._next_return_index
+        ref = self._index_to_ref[idx]
+        # A timeout must keep the entry (the caller retries); any other
+        # outcome — value or task exception — consumes it, so iteration
+        # continues and the actor returns to the pool (reference: the
+        # future is popped before ray.get).
+        try:
+            value = self._ray.get(ref, timeout=timeout)
+        except BaseException as e:
+            from ray_tpu import GetTimeoutError
+
+            if isinstance(e, GetTimeoutError):
+                raise
+            self._consume(ref, idx)
+            raise
+        self._consume(ref, idx)
+        return value
+
+    def _consume(self, ref, idx):
+        self._index_to_ref.pop(idx, None)
+        if idx == self._next_return_index:
+            self._next_return_index += 1
+        entry = self._inflight.pop(ref, None)
+        if entry is not None:
+            self._idle.append(entry[0])
+        self._ready.pop(ref, None)
+
+    def get_next_unordered(self, timeout: float | None = None) -> Any:
+        """Next result in COMPLETION order."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        if self._ready:
+            ref = next(iter(self._ready))
+            idx = self._ready.pop(ref)
+            self._index_to_ref.pop(idx, None)
+            return self._ray.get(ref)
+        done, _ = self._ray.wait(list(self._inflight.keys()), num_returns=1,
+                                 timeout=timeout)
+        if not done:
+            from ray_tpu import GetTimeoutError
+
+            raise GetTimeoutError("get_next_unordered timed out")
+        ref = done[0]
+        actor, idx = self._inflight.pop(ref)
+        self._idle.append(actor)
+        self._index_to_ref.pop(idx, None)
+        return self._ray.get(ref)
+
+    # -- bulk ---------------------------------------------------------------
+    def map(self, fn: Callable[[Any, Any], Any],
+            values: Iterable[Any]) -> Iterable[Any]:
+        """Ordered streaming map (generator)."""
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable[[Any, Any], Any],
+                      values: Iterable[Any]) -> Iterable[Any]:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    # -- membership ---------------------------------------------------------
+    def push(self, actor: Any) -> None:
+        """Add an idle actor to the pool."""
+        self._idle.append(actor)
+
+    def pop_idle(self) -> Any | None:
+        """Remove and return an idle actor (None if none idle)."""
+        return self._idle.pop() if self._idle else None

@@ -1,0 +1,208 @@
+"""User-defined metrics: Counter / Gauge / Histogram.
+
+Capability parity target: /root/reference/python/ray/util/metrics.py
+(Counter:129, Gauge:197, Histogram:263 with tag_keys/default_tags) and
+the export pipeline (C++ stats -> per-node metrics agent ->
+prometheus_exporter.py). Here every process keeps a local registry;
+worker processes push cumulative snapshots to their node (piggybacked on
+a 1s daemon flusher), nodes expose a ``metrics`` state table, and the
+driver renders the Prometheus text format (ray_tpu.util.prometheus_text
+/ the ``rtpu metrics`` CLI) — same observable surface, no separate
+agent process.
+
+Aggregation semantics across processes: counters and histogram buckets
+SUM over sources; gauges take the most recent write per tag set.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_HISTOGRAM_BOUNDARIES = [
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 60.0]
+
+
+class _Registry:
+    """Per-process metric store. Cumulative, so pushes are idempotent:
+    the node keeps the latest snapshot per (source, metric, tags)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        # (name, sorted-tags) -> value for counters/gauges,
+        #                        [counts-per-bucket, sum] for histograms
+        self.meta: Dict[str, dict] = {}  # name -> {type, description, ...}
+        self.data: Dict[Tuple[str, tuple], object] = {}
+        self._flusher_started = False
+
+    def register(self, name: str, kind: str, description: str,
+                 boundaries: Optional[List[float]] = None):
+        with self.lock:
+            old = self.meta.get(name)
+            if old is not None and old["type"] != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {old['type']}")
+            self.meta[name] = {"type": kind, "description": description,
+                               "boundaries": boundaries}
+        self._ensure_flusher()
+
+    def record(self, name: str, tags: tuple, op: str, value: float):
+        with self.lock:
+            key = (name, tags)
+            if op == "inc":
+                self.data[key] = float(self.data.get(key, 0.0)) + value
+            elif op == "set":
+                self.data[key] = float(value)
+            elif op == "observe":
+                bounds = self.meta[name]["boundaries"]
+                cell = self.data.get(key)
+                if cell is None:
+                    cell = [[0] * (len(bounds) + 1), 0.0, 0]
+                    self.data[key] = cell
+                counts, total, n = cell
+                idx = len(bounds)
+                for i, b in enumerate(bounds):
+                    if value <= b:
+                        idx = i
+                        break
+                counts[idx] += 1
+                cell[1] = total + value
+                cell[2] = n + 1
+
+    def snapshot(self) -> dict:
+        with self.lock:
+            rows = []
+            for (name, tags), val in self.data.items():
+                meta = self.meta[name]
+                row = {"name": name, "type": meta["type"],
+                       "description": meta["description"],
+                       "tags": dict(tags)}
+                if meta["type"] == "histogram":
+                    row["boundaries"] = meta["boundaries"]
+                    row["bucket_counts"] = list(val[0])
+                    row["sum"] = val[1]
+                    row["count"] = val[2]
+                else:
+                    row["value"] = val
+                rows.append(row)
+            return {"ts": time.time(), "rows": rows}
+
+    def _ensure_flusher(self):
+        """Inside a worker process, push snapshots to the node every
+        second (the driver's registry is read in-process)."""
+        if self._flusher_started:
+            return
+        from .._private import context as context_mod
+
+        ctx = context_mod.get_context()
+        if ctx is None or not hasattr(ctx, "client"):
+            return  # driver/device-lane: node_service reads us directly
+        self._flusher_started = True
+        client = ctx.client
+        source = ctx.worker_id.hex()
+
+        def flush_loop():
+            from .._private.rpc import ConnectionLost
+
+            while True:
+                time.sleep(1.0)
+                try:
+                    snap = self.snapshot()
+                    if snap["rows"]:
+                        client.call("metrics_push",
+                                    {"source": source, "snapshot": snap})
+                except (ConnectionLost, OSError):
+                    return  # node gone; worker is dying anyway
+                except Exception:
+                    continue  # transient (e.g. saturated node): retry next tick
+
+        threading.Thread(target=flush_loop, daemon=True,
+                         name="rt-metrics-flush").start()
+
+    def flush_now(self):
+        """Synchronous push (workers call this implicitly via the flusher;
+        tests can force it)."""
+        from .._private import context as context_mod
+
+        ctx = context_mod.get_context()
+        if ctx is None or not hasattr(ctx, "client"):
+            return
+        snap = self.snapshot()
+        if snap["rows"]:
+            ctx.client.call("metrics_push",
+                            {"source": ctx.worker_id.hex(),
+                             "snapshot": snap})
+
+
+_registry = _Registry()
+
+
+def _norm_tags(tag_keys: tuple, default_tags: dict,
+               tags: Optional[dict]) -> tuple:
+    merged = dict(default_tags)
+    if tags:
+        unknown = set(tags) - set(tag_keys)
+        if unknown:
+            raise ValueError(f"unknown tag keys {unknown}; declared "
+                             f"tag_keys={tag_keys}")
+        merged.update(tags)
+    return tuple(sorted(merged.items()))
+
+
+class _Metric:
+    _kind = ""
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[tuple] = None, **kw):
+        if not name:
+            raise ValueError("metric name required")
+        self._name = name
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: dict = {}
+        _registry.register(name, self._kind, description,
+                           kw.get("boundaries"))
+
+    def set_default_tags(self, tags: dict):
+        unknown = set(tags) - set(self._tag_keys)
+        if unknown:
+            raise ValueError(f"unknown tag keys {unknown}")
+        self._default_tags = dict(tags)
+        return self
+
+
+class Counter(_Metric):
+    _kind = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[dict] = None):
+        if value < 0:
+            raise ValueError("Counter.inc requires value >= 0")
+        _registry.record(self._name,
+                         _norm_tags(self._tag_keys, self._default_tags, tags),
+                         "inc", value)
+
+
+class Gauge(_Metric):
+    _kind = "gauge"
+
+    def set(self, value: float, tags: Optional[dict] = None):
+        _registry.record(self._name,
+                         _norm_tags(self._tag_keys, self._default_tags, tags),
+                         "set", value)
+
+
+class Histogram(_Metric):
+    _kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[List[float]] = None,
+                 tag_keys: Optional[tuple] = None):
+        boundaries = sorted(boundaries or DEFAULT_HISTOGRAM_BOUNDARIES)
+        super().__init__(name, description, tag_keys,
+                         boundaries=boundaries)
+        self._boundaries = boundaries
+
+    def observe(self, value: float, tags: Optional[dict] = None):
+        _registry.record(self._name,
+                         _norm_tags(self._tag_keys, self._default_tags, tags),
+                         "observe", value)

@@ -1,0 +1,165 @@
+"""Prometheus text-format export of system + user metrics.
+
+Capability parity target: the reference's metrics pipeline
+(/root/reference/src/ray/stats/metric_defs.cc -> per-node metrics agent,
+python/ray/_private/metrics_agent.py -> prometheus_exporter.py, plus
+dashboard/modules/metrics). Here the driver aggregates every node's
+``metrics`` state table and renders the exposition format directly;
+``serve_metrics()`` exposes it over HTTP for a real Prometheus scraper,
+``rtpu metrics`` prints it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from .._private import context as context_mod
+
+_SYSTEM_HELP = {
+    "tasks_finished": "Tasks that finished successfully on the node",
+    "tasks_failed": "Tasks that failed on the node",
+    "workers_started": "Worker processes forked by the node",
+    "workers_died": "Worker processes that died",
+}
+
+
+def _fmt_tags(tags: dict) -> str:
+    if not tags:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(tags.items()))
+    return "{" + inner + "}"
+
+
+def _sanitize(name: str) -> str:
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+
+
+def list_metrics() -> list:
+    """All user-metric rows cluster-wide (one row per source process +
+    tag set; see ray_tpu.util.metrics for aggregation semantics)."""
+    rt = context_mod.require_context()
+    snap = rt.cluster_state(light=True)
+    rows = []
+    for s in snap["snapshots"]:
+        rows.extend(s.get("metrics", []))
+    return rows
+
+
+def prometheus_text() -> str:
+    """Render cluster metrics in the Prometheus exposition format:
+    system counters/gauges per node (rtpu_node_*) plus user metrics
+    aggregated across processes (counters/histograms sum; gauges take
+    the latest write per tag set)."""
+    rt = context_mod.require_context()
+    snap = rt.cluster_state(light=True)
+    out = []
+
+    # -- system metrics, one series per node -------------------------------
+    emitted_meta = set()
+
+    def emit_meta(name, kind, help_text=""):
+        if name not in emitted_meta:
+            emitted_meta.add(name)
+            if help_text:
+                out.append(f"# HELP {name} {help_text}")
+            out.append(f"# TYPE {name} {kind}")
+
+    for s in snap["snapshots"]:
+        node = s["node_id"][:12]
+        tags = {"node_id": node}
+        for cname, val in s.get("counters", {}).items():
+            mname = f"rtpu_node_{_sanitize(cname)}"
+            emit_meta(mname, "counter", _SYSTEM_HELP.get(cname, ""))
+            out.append(f"{mname}{_fmt_tags(tags)} {val}")
+        store = s.get("store", {})
+        for k in ("bytes_used", "capacity_bytes", "num_objects"):
+            if k in store:
+                mname = f"rtpu_store_{_sanitize(k)}"
+                emit_meta(mname, "gauge")
+                out.append(f"{mname}{_fmt_tags(tags)} {store[k]}")
+        for k in ("num_workers", "num_actors"):
+            mname = f"rtpu_node_{k}"
+            emit_meta(mname, "gauge")
+            out.append(f"{mname}{_fmt_tags(tags)} {s.get(k, 0)}")
+
+    # -- user metrics, aggregated across sources ---------------------------
+    rows = []
+    for s in snap["snapshots"]:
+        rows.extend(s.get("metrics", []))
+
+    by_metric: dict = {}
+    for r in rows:
+        by_metric.setdefault(r["name"], []).append(r)
+
+    for name, group in sorted(by_metric.items()):
+        kind = group[0]["type"]
+        mname = _sanitize(name)
+        emit_meta(mname, kind, group[0].get("description", ""))
+        by_tags: dict = {}
+        for r in group:
+            key = tuple(sorted(r.get("tags", {}).items()))
+            by_tags.setdefault(key, []).append(r)
+        for key, series in sorted(by_tags.items()):
+            tags = dict(key)
+            if kind == "counter":
+                out.append(f"{mname}{_fmt_tags(tags)} "
+                           f"{sum(r['value'] for r in series)}")
+            elif kind == "gauge":
+                latest = max(series, key=lambda r: r.get("ts", 0.0))
+                out.append(f"{mname}{_fmt_tags(tags)} {latest['value']}")
+            else:  # histogram: sum buckets, cumulative le-labels
+                bounds = series[0]["boundaries"]
+                counts = [0] * (len(bounds) + 1)
+                total, n = 0.0, 0
+                for r in series:
+                    if r.get("boundaries") != bounds:
+                        # Processes registered the same histogram with
+                        # different boundaries; skip the mismatched series
+                        # rather than corrupting (or 500ing) the export.
+                        continue
+                    for i, c in enumerate(r["bucket_counts"]):
+                        counts[i] += c
+                    total += r["sum"]
+                    n += r["count"]
+                cum = 0
+                for b, c in zip(bounds, counts):
+                    cum += c
+                    bt = dict(tags, le=repr(float(b)))
+                    out.append(f"{mname}_bucket{_fmt_tags(bt)} {cum}")
+                bt = dict(tags, le="+Inf")
+                out.append(f"{mname}_bucket{_fmt_tags(bt)} {n}")
+                out.append(f"{mname}_sum{_fmt_tags(tags)} {total}")
+                out.append(f"{mname}_count{_fmt_tags(tags)} {n}")
+    return "\n".join(out) + "\n"
+
+
+def serve_metrics(port: int = 0, host: str = "127.0.0.1"):
+    """Start a /metrics HTTP endpoint on a daemon thread; returns the
+    bound (host, port). Point a Prometheus scraper at it."""
+    import http.server
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - stdlib API
+            if self.path.rstrip("/") not in ("", "/metrics"):
+                self.send_error(404)
+                return
+            try:
+                body = prometheus_text().encode()
+            except Exception as e:  # noqa: BLE001
+                self.send_error(500, str(e))
+                return
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    server = http.server.ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="rt-metrics-http").start()
+    return server.server_address

@@ -1,0 +1,129 @@
+"""Distributed FIFO queue backed by an actor.
+
+Capability parity target: /root/reference/python/ray/util/queue.py
+(Queue on a _QueueActor, Empty/Full, put/get with block+timeout,
+put_nowait/get_nowait, *_nowait_batch).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, List, Optional
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    """Holds the items. Single actor => linearized operations; blocking
+    semantics are implemented client-side by polling with deadlines so a
+    blocked consumer never wedges the actor's call queue."""
+
+    def __init__(self, maxsize: int = 0):
+        self.maxsize = maxsize
+        self.items: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+
+    def qsize(self) -> int:
+        return len(self.items)
+
+    def put(self, item) -> bool:
+        with self._lock:
+            if self.maxsize > 0 and len(self.items) >= self.maxsize:
+                return False
+            self.items.append(item)
+            return True
+
+    def put_batch(self, items: List[Any]) -> bool:
+        with self._lock:
+            if self.maxsize > 0 and \
+                    len(self.items) + len(items) > self.maxsize:
+                return False
+            self.items.extend(items)
+            return True
+
+    def get(self, n: int = 1) -> Optional[List[Any]]:
+        with self._lock:
+            if len(self.items) < n:
+                return None
+            return [self.items.popleft() for _ in range(n)]
+
+
+class Queue:
+    """Client facade; cheap to serialize (workers sharing the handle share
+    the queue)."""
+
+    def __init__(self, maxsize: int = 0, actor_options: dict | None = None):
+        import ray_tpu
+
+        self._ray = ray_tpu
+        self.maxsize = maxsize
+        opts = dict(actor_options or {})
+        opts.setdefault("max_concurrency", 8)
+        self.actor = ray_tpu.remote(_QueueActor).options(**opts).remote(
+            maxsize)
+
+    def qsize(self) -> int:
+        return self._ray.get(self.actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def full(self) -> bool:
+        return self.maxsize > 0 and self.qsize() >= self.maxsize
+
+    def put(self, item: Any, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        if not block:
+            if not self._ray.get(self.actor.put.remote(item)):
+                raise Full
+            return
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self._ray.get(self.actor.put.remote(item)):
+                return
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Full
+            time.sleep(0.01)
+
+    def put_nowait(self, item: Any) -> None:
+        self.put(item, block=False)
+
+    def put_nowait_batch(self, items: List[Any]) -> None:
+        if not self._ray.get(self.actor.put_batch.remote(list(items))):
+            raise Full
+
+    def get(self, block: bool = True,
+            timeout: Optional[float] = None) -> Any:
+        if not block:
+            got = self._ray.get(self.actor.get.remote(1))
+            if got is None:
+                raise Empty
+            return got[0]
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            got = self._ray.get(self.actor.get.remote(1))
+            if got is not None:
+                return got[0]
+            if deadline is not None and time.monotonic() >= deadline:
+                raise Empty
+            time.sleep(0.01)
+
+    def get_nowait(self) -> Any:
+        return self.get(block=False)
+
+    def get_nowait_batch(self, num_items: int) -> List[Any]:
+        got = self._ray.get(self.actor.get.remote(num_items))
+        if got is None:
+            raise Empty(f"queue has fewer than {num_items} items")
+        return got
+
+    def shutdown(self) -> None:
+        self._ray.kill(self.actor)

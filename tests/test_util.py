@@ -1,0 +1,174 @@
+"""ray_tpu.util: ActorPool, distributed Queue, user metrics + Prometheus
+export.
+
+Parity model: /root/reference/python/ray/util/actor_pool.py, queue.py,
+metrics.py and python/ray/tests/test_actor_pool.py / test_queue.py /
+test_metrics_agent.py.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool, prometheus_text
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@ray_tpu.remote
+class _Doubler:
+    def double(self, v):
+        return 2 * v
+
+    def slow_double(self, v):
+        import time as _t
+        _t.sleep(0.2 if v == 0 else 0.0)
+        return 2 * v
+
+
+class TestActorPool:
+    def test_map_ordered(self, rt):
+        pool = ActorPool([_Doubler.remote() for _ in range(2)])
+        assert list(pool.map(lambda a, v: a.double.remote(v),
+                             range(6))) == [0, 2, 4, 6, 8, 10]
+
+    def test_map_unordered_completes(self, rt):
+        pool = ActorPool([_Doubler.remote() for _ in range(2)])
+        out = list(pool.map_unordered(
+            lambda a, v: a.slow_double.remote(v), range(4)))
+        assert sorted(out) == [0, 2, 4, 6]
+
+    def test_submit_get_next(self, rt):
+        pool = ActorPool([_Doubler.remote()])
+        pool.submit(lambda a, v: a.double.remote(v), 10)
+        pool.submit(lambda a, v: a.double.remote(v), 11)
+        assert pool.has_next()
+        assert pool.get_next(timeout=30) == 20
+        assert pool.get_next(timeout=30) == 22
+        assert not pool.has_next()
+        with pytest.raises(StopIteration):
+            pool.get_next()
+
+    def test_push_pop_idle(self, rt):
+        a = _Doubler.remote()
+        pool = ActorPool([a])
+        popped = pool.pop_idle()
+        assert popped is a
+        assert pool.pop_idle() is None
+        pool.push(a)
+        assert pool.has_free()
+
+
+class TestQueue:
+    def test_fifo_roundtrip(self, rt):
+        q = Queue()
+        for i in range(5):
+            q.put(i)
+        assert q.qsize() == 5
+        assert [q.get(timeout=10) for _ in range(5)] == list(range(5))
+        assert q.empty()
+
+    def test_nowait_and_exceptions(self, rt):
+        q = Queue(maxsize=2)
+        q.put_nowait(1)
+        q.put_nowait(2)
+        assert q.full()
+        with pytest.raises(Full):
+            q.put_nowait(3)
+        assert q.get_nowait() == 1
+        assert q.get_nowait() == 2
+        with pytest.raises(Empty):
+            q.get_nowait()
+
+    def test_batch_ops(self, rt):
+        q = Queue()
+        q.put_nowait_batch([1, 2, 3])
+        assert q.get_nowait_batch(3) == [1, 2, 3]
+        with pytest.raises(Empty):
+            q.get_nowait_batch(1)
+
+    def test_get_timeout(self, rt):
+        q = Queue()
+        t0 = time.monotonic()
+        with pytest.raises(Empty):
+            q.get(timeout=0.3)
+        assert time.monotonic() - t0 >= 0.25
+
+    def test_shared_between_tasks(self, rt):
+        q = Queue()
+
+        @ray_tpu.remote
+        def producer(queue, n):
+            for i in range(n):
+                queue.put(i)
+            return n
+
+        assert ray_tpu.get(producer.remote(q, 3), timeout=60) == 3
+        assert sorted(q.get(timeout=10) for _ in range(3)) == [0, 1, 2]
+
+
+class TestMetrics:
+    def test_counter_gauge_histogram_in_driver(self, rt):
+        from ray_tpu.util import metrics
+
+        c = metrics.Counter("t_requests_total", "reqs",
+                            tag_keys=("route",))
+        c.inc(1, tags={"route": "a"})
+        c.inc(2, tags={"route": "a"})
+        c.inc(5, tags={"route": "b"})
+        g = metrics.Gauge("t_inflight", "inflight")
+        g.set(7)
+        h = metrics.Histogram("t_latency_s", "lat", boundaries=[0.1, 1.0])
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(3.0)
+
+        text = prometheus_text()
+        assert 't_requests_total{route="a"} 3.0' in text
+        assert 't_requests_total{route="b"} 5.0' in text
+        assert "t_inflight 7.0" in text
+        assert 't_latency_s_bucket{le="0.1"} 1' in text
+        assert 't_latency_s_bucket{le="1.0"} 2' in text
+        assert 't_latency_s_bucket{le="+Inf"} 3' in text
+        assert "t_latency_s_count 3" in text
+
+    def test_unknown_tag_rejected(self, rt):
+        from ray_tpu.util import metrics
+
+        c = metrics.Counter("t_tagcheck", tag_keys=("a",))
+        with pytest.raises(ValueError):
+            c.inc(1, tags={"b": "x"})
+
+    def test_worker_metrics_flow_to_node(self, rt):
+        @ray_tpu.remote
+        def record():
+            from ray_tpu.util import metrics
+
+            c = metrics.Counter("t_worker_events", "from a worker")
+            c.inc(4)
+            metrics._registry.flush_now()
+            return True
+
+        assert ray_tpu.get(record.remote(), timeout=60)
+        text = prometheus_text()
+        assert "t_worker_events 4.0" in text
+
+    def test_system_metrics_present(self, rt):
+        @ray_tpu.remote
+        def one():
+            return 1
+
+        ray_tpu.get(one.remote(), timeout=60)
+        text = prometheus_text()
+        assert "rtpu_node_tasks_finished" in text
+        assert "rtpu_node_num_workers" in text
+
+    def test_http_endpoint(self, rt):
+        import urllib.request
+
+        from ray_tpu.util import serve_metrics
+
+        host, port = serve_metrics()
+        body = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=10).read().decode()
+        assert "rtpu_node_num_workers" in body
