@@ -79,6 +79,9 @@ class Config:
     heartbeat_interval_s: float = _cfg(0.25)
     node_death_timeout_s: float = _cfg(3.0)
     node_register_timeout_s: float = _cfg(30.0)
+    # A worker node whose head connection drops keeps retrying the dial
+    # for this long (head restart window) before giving up and exiting.
+    head_reconnect_grace_s: float = _cfg(30.0)
     # A locally-feasible task waiting longer than this with zero local
     # capacity is offered to the head for spillback to another node.
     spillback_delay_s: float = _cfg(0.2)

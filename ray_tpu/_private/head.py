@@ -25,7 +25,9 @@ rather than an add-on (SURVEY §7 stage 3).
 from __future__ import annotations
 
 import asyncio
+import os
 import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -80,7 +82,9 @@ class HeadService:
     """Cluster tables + policy. All state owned by one asyncio loop."""
 
     def __init__(self, session_id: str, loop: asyncio.AbstractEventLoop,
-                 port: int = 0):
+                 port: int = 0, store=None):
+        from .head_store import FileHeadStore, InMemoryHeadStore
+
         self.cfg = get_config()
         self.session_id = session_id
         self.loop = loop
@@ -91,10 +95,62 @@ class HeadService:
         self.actor_nodes: dict[ActorID, NodeID] = {}
         self.placement_groups: dict[PlacementGroupID, PGEntry] = {}
         self._local_node_service = None  # driver node (in-process)
+        if store is None:
+            path = os.environ.get("RT_HEAD_PERSIST")
+            store = FileHeadStore(path) if path else InMemoryHeadStore()
+        self.store = store
+        # Snapshot writes happen off the event loop; one thread keeps
+        # them ordered (last save wins on disk as it does in memory).
+        self._persist_pool = (
+            None if isinstance(store, InMemoryHeadStore)
+            else ThreadPoolExecutor(max_workers=1,
+                                    thread_name_prefix="rt-head-persist"))
+        self._replay()
         self.server = DuplexServer(
             (self.cfg.head_host, port), self._handle_rpc, self._on_disconnect)
         self._monitor_task: Optional[asyncio.Task] = None
         self._closing = False
+
+    # ------------------------------------------------------------------
+    # Persistence (reference: GcsInitData replay + raylet resync via
+    # NotifyGCSRestart, node_manager.proto:361)
+    # ------------------------------------------------------------------
+    def _replay(self):
+        """Load durable tables from the store. Node membership and the
+        actor directory are NOT persisted — surviving nodes re-register
+        (heartbeat gets False -> re-register) and re-announce their
+        actors and bundle reservations; placement groups reload as
+        definitions and are reconciled against what nodes still hold."""
+        data = self.store.load()
+        if not data:
+            return
+        self.kv = dict(data.get("kv", {}))
+        self.functions = dict(data.get("functions", {}))
+        for row in data.get("placement_groups", []):
+            pg = PGEntry(
+                pg_id=PlacementGroupID(row["pg_id"]),
+                bundles=[dict(b) for b in row["bundles"]],
+                strategy=row["strategy"], state="PENDING",
+                ready_event=asyncio.Event())
+            self.placement_groups[pg.pg_id] = pg
+
+    def _persist(self):
+        if self._closing or self._persist_pool is None:
+            return
+        # Shallow copies on-loop (values are immutable bytes/dicts the
+        # head never mutates in place); pickle+fsync off-loop so a
+        # multi-MB package upload can't stall scheduling RPCs.
+        tables = {
+            "kv": dict(self.kv),
+            "functions": dict(self.functions),
+            "placement_groups": [
+                {"pg_id": pg.pg_id.binary(),
+                 "bundles": [dict(b) for b in pg.bundles],
+                 "strategy": pg.strategy}
+                for pg in self.placement_groups.values()
+                if pg.state != "REMOVED"],
+        }
+        self._persist_pool.submit(self.store.save, tables)
 
     async def start(self):
         await self.server.start()
@@ -115,7 +171,8 @@ class HeadService:
     def register_node(self, node_id: NodeID, address: tuple, resources: dict,
                       conn: Optional[ServerConn],
                       is_driver: bool = False,
-                      node_type: Optional[str] = None) -> dict:
+                      node_type: Optional[str] = None,
+                      sync: Optional[dict] = None) -> dict:
         entry = NodeEntry(
             node_id=node_id, address=tuple(address),
             resources=dict(resources), available=dict(resources), conn=conn,
@@ -123,9 +180,54 @@ class HeadService:
         self.nodes[node_id] = entry
         if conn is not None:
             conn.meta["node_id"] = node_id
+        release = self._reconcile_node_sync(entry, sync or {})
         self._notify_membership()
         return {"session_id": self.session_id,
-                "head_address": self.address}
+                "head_address": self.address,
+                "release_bundles": release}
+
+    def _reconcile_node_sync(self, entry: NodeEntry, sync: dict) -> list:
+        """Adopt a (re-)registering node's live state — named actors,
+        actor homes, and bundle reservations it still holds — into the
+        directory tables (reference: raylet resync after NotifyGCSRestart
+        + GCS releasing leaked bundles, ReleaseUnusedBundles). Returns
+        the reservations the node should release (their PG no longer
+        exists here)."""
+        for name, info in (sync.get("named_actors") or {}).items():
+            self.named_actors.setdefault(name, {
+                "actor_id": info["actor_id"], "node_id": entry.node_id.binary(),
+                "methods": info.get("methods", [])})
+        for aid_bin in (sync.get("actor_ids") or []):
+            self.actor_nodes[ActorID(aid_bin)] = entry.node_id
+        release = []
+        for row in (sync.get("reservations") or []):
+            pg_id = PlacementGroupID(row["pg_id"])
+            idx = row["bundle_index"]
+            res = dict(row["resources"])
+            pg = self.placement_groups.get(pg_id)
+            if pg is None or pg.state == "REMOVED" \
+                    or idx >= len(pg.bundles):
+                release.append({"pg_id": pg_id.binary(),
+                                "bundle_index": idx})
+                continue
+            holder = pg.placement.get(idx)
+            if holder is not None and holder != entry.node_id:
+                # The head already (re-)placed this bundle elsewhere while
+                # the node was partitioned: the node's copy is stale —
+                # release it rather than double-booking the bundle.
+                release.append({"pg_id": pg_id.binary(),
+                                "bundle_index": idx})
+                continue
+            pg.placement[idx] = entry.node_id
+            entry.reservations[(pg_id, idx)] = res
+            for k, v in res.items():
+                entry.available[k] = entry.available.get(k, 0) - v
+            if pg.state == "PENDING" \
+                    and len(pg.placement) == len(pg.bundles):
+                pg.state = "CREATED"
+                if pg.ready_event is not None:
+                    pg.ready_event.set()
+        return release
 
     def heartbeat(self, node_id: NodeID, available: dict, load=None):
         entry = self.nodes.get(node_id)
@@ -155,6 +257,10 @@ class HeadService:
         if node_id is None or self._closing:
             return
         entry = self.nodes.get(node_id)
+        if entry is not None and entry.conn is not conn:
+            # A stale half-open socket finally erroring after the node
+            # already re-registered over a fresh connection: ignore.
+            return
         if entry is not None and entry.state == ALIVE:
             await self._mark_node_dead(entry, "connection lost")
 
@@ -290,21 +396,26 @@ class HeadService:
         pg = PGEntry(pg_id=pg_id, bundles=[dict(b) for b in bundles],
                      strategy=strategy, ready_event=asyncio.Event())
         self.placement_groups[pg_id] = pg
+        self._persist()
         await self._try_place_pg(pg)
         return pg
 
     async def _try_place_pg(self, pg: PGEntry):
-        """Reserve every bundle or nothing (prepare/commit in one pass —
-        single-loop head owns all reservation state, so prepare==commit;
-        the reference needs true 2PC because raylets own their resources:
-        node_manager.proto Prepare/CommitBundleResources)."""
+        """Reserve every not-yet-placed bundle or nothing (prepare/commit
+        in one pass — single-loop head owns all reservation state, so
+        prepare==commit; the reference needs true 2PC because raylets own
+        their resources: node_manager.proto Prepare/CommitBundleResources).
+        Bundles already in pg.placement (adopted from re-registering nodes
+        after a head restart) are kept as-is: only the missing ones are
+        placed, so reconciliation can't double-reserve."""
         if pg.state != "PENDING":
             return
         # Work on a scratch copy of availability so a failed attempt
-        # leaves nothing reserved.
+        # leaves nothing reserved. Adopted bundles already subtracted
+        # their resources from entry.available at reconcile time.
         avail = {e.node_id: dict(e.available) for e in self.nodes.values()
                  if e.state == ALIVE}
-        placement: dict[int, NodeID] = {}
+        placement: dict[int, NodeID] = dict(pg.placement)
 
         def fits(nid, res):
             a = avail[nid]
@@ -318,6 +429,8 @@ class HeadService:
         node_ids = list(avail.keys())
         ok = True
         for idx, res in enumerate(pg.bundles):
+            if idx in placement:
+                continue  # adopted reservation, keep it
             if pg.strategy in ("PACK", "STRICT_PACK"):
                 order = sorted(
                     node_ids,
@@ -344,10 +457,14 @@ class HeadService:
                 break
         if not ok:
             return  # stays PENDING; retried on membership/resource change
-        # Commit: record reservations and subtract from live availability.
+        # Commit NEW bundles only: record reservations and subtract from
+        # live availability (adopted bundles did both at reconcile time
+        # and their nodes already hold the reservation).
+        fresh = {i: n for i, n in placement.items()
+                 if i not in pg.placement}
         pg.placement = placement
         pg.state = "CREATED"
-        for idx, nid in placement.items():
+        for idx, nid in fresh.items():
             entry = self.nodes[nid]
             res = pg.bundles[idx]
             entry.reservations[(pg.pg_id, idx)] = dict(res)
@@ -374,6 +491,7 @@ class HeadService:
         if pg is None:
             return
         pg.state = "REMOVED"
+        self._persist()
         for idx, nid in pg.placement.items():
             entry = self.nodes.get(nid)
             if entry is None:
@@ -445,16 +563,26 @@ class HeadService:
     def kv_op(self, op: str, key: str, val=None):
         if op == "put":
             self.kv[key] = val
+            self._persist()
             return True
         if op == "get":
             return self.kv.get(key)
         if op == "del":
-            return self.kv.pop(key, None) is not None
+            existed = self.kv.pop(key, None) is not None
+            if existed:
+                self._persist()
+            return existed
         if op == "exists":
             return key in self.kv
         if op == "keys":
             return [k for k in self.kv if k.startswith(key)]
         raise ValueError(f"bad kv op {op}")
+
+    def put_function(self, fid: str, blob) -> bool:
+        if blob is not None and fid not in self.functions:
+            self.functions[fid] = blob
+            self._persist()
+        return fid in self.functions
 
     def register_named_actor(self, name: str, actor_id: ActorID,
                              node_id: NodeID, methods: list) -> bool:
@@ -486,7 +614,8 @@ class HeadService:
                 NodeID(payload["node_id"]), tuple(payload["address"]),
                 payload["resources"], conn,
                 is_driver=bool(payload.get("is_driver")),
-                node_type=payload.get("node_type"))
+                node_type=payload.get("node_type"),
+                sync=payload.get("sync"))
         if method == "heartbeat":
             ok = self.heartbeat(NodeID(payload["node_id"]),
                                 payload["available"],
@@ -500,9 +629,7 @@ class HeadService:
             return self.kv_op(op, key, val)
         if method == "export_function":
             fid, blob = payload
-            if blob is not None and fid not in self.functions:
-                self.functions[fid] = blob
-            return fid in self.functions
+            return self.put_function(fid, blob)
         if method == "fetch_function":
             return self.functions.get(payload)
         if method == "schedule":
@@ -554,6 +681,10 @@ class HeadService:
         self._closing = True
         if self._monitor_task is not None:
             self._monitor_task.cancel()
+        if self._persist_pool is not None:
+            # Let the queued (ordered) snapshot writes land.
+            await self.loop.run_in_executor(
+                None, self._persist_pool.shutdown, True)
         await self.server.stop()
 
 
@@ -568,9 +699,7 @@ class LocalHeadClient:
         return self.head.kv_op(op, key, val)
 
     async def export_function(self, fid, blob):
-        if blob is not None and fid not in self.head.functions:
-            self.head.functions[fid] = blob
-        return True
+        return self.head.put_function(fid, blob)
 
     async def fetch_function(self, fid):
         return self.head.functions.get(fid)

@@ -1,0 +1,69 @@
+"""Durable backing store for the head's cluster tables.
+
+Capability parity target: the reference's pluggable GCS storage
+(/root/reference/src/ray/gcs/store_client/store_client.h with
+InMemoryStoreClient / RedisStoreClient, replayed through GcsInitData on
+restart, gcs_server/gcs_init_data.h). This deployment has no Redis;
+the HA analogue is an atomic-rename snapshot file on local disk —
+same recovery contract (head restart replays tables, nodes re-register
+and reconcile) with a file instead of a Redis endpoint.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import Any, Dict, Optional
+
+
+class HeadStore:
+    """Interface: load() -> dict of tables; save(tables)."""
+
+    def load(self) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def save(self, tables: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+
+class InMemoryHeadStore(HeadStore):
+    """Default: nothing survives the head process (reference default:
+    InMemoryStoreClient)."""
+
+    def load(self):
+        return None
+
+    def save(self, tables):
+        pass
+
+
+class FileHeadStore(HeadStore):
+    """Write-through snapshot with atomic replace; mutations on the head
+    are low-rate control-plane ops, so full-snapshot writes are cheap and
+    keep recovery trivial (read one file)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def load(self):
+        try:
+            with open(self.path, "rb") as f:
+                return pickle.load(f)
+        except FileNotFoundError:
+            return None
+        except Exception:
+            # Torn/corrupt snapshot (crash mid-rename cannot cause this,
+            # but disk issues can): start fresh rather than refuse to boot.
+            return None
+
+    def save(self, tables):
+        tmp = f"{self.path}.tmp-{os.getpid()}"
+        with self._lock:
+            with open(tmp, "wb") as f:
+                pickle.dump(tables, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)

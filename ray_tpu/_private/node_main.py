@@ -53,16 +53,48 @@ async def amain():
     node = NodeService(node_session, sock_path, resources, shm, loop,
                        node_id=node_id, head=None, is_head_node=False)
 
-    async def on_head_lost(conn):
-        # Head gone => cluster gone; die rather than orphan.
-        sys.stderr.write(f"node {node_id.hex()[:12]}: head connection lost; "
-                         f"exiting\n")
-        os._exit(0)
-
     from .node_service import attach_node_to_head
 
+    node_type = os.environ.get("RT_NODE_TYPE")
+    reconnecting = {"active": False}
+
+    async def on_head_lost(conn):
+        # Head gone. It may be restarting (reference: raylets survive a
+        # GCS restart and resync via NotifyGCSRestart): retry the dial
+        # for a grace period, re-registering with our live directory
+        # state; only then conclude the cluster is gone and exit.
+        if reconnecting["active"]:
+            return
+        reconnecting["active"] = True
+        try:
+            from .rpc import ConnectionLost
+
+            cfg = get_config()
+            deadline = asyncio.get_running_loop().time() \
+                + cfg.head_reconnect_grace_s
+            sys.stderr.write(f"node {node_id.hex()[:12]}: head connection "
+                             f"lost; retrying for "
+                             f"{cfg.head_reconnect_grace_s:.0f}s\n")
+            while asyncio.get_running_loop().time() < deadline:
+                try:
+                    await attach_node_to_head(
+                        node, head_addr, resources, node_type=node_type,
+                        on_lost=on_head_lost, start=False)
+                    sys.stderr.write(f"node {node_id.hex()[:12]}: "
+                                     f"re-registered with head\n")
+                    return
+                except (OSError, ConnectionLost):
+                    # Dial refused, or the head died mid-handshake: both
+                    # mean "not back yet".
+                    await asyncio.sleep(1.0)
+            sys.stderr.write(f"node {node_id.hex()[:12]}: head did not come "
+                             f"back; exiting\n")
+            os._exit(0)
+        finally:
+            reconnecting["active"] = False
+
     await attach_node_to_head(node, head_addr, resources,
-                              node_type=os.environ.get("RT_NODE_TYPE"),
+                              node_type=node_type,
                               on_lost=on_head_lost)
     sys.stderr.write(f"node {node_id.hex()[:12]} up: peer={node.peer_address} "
                      f"resources={resources}\n")

@@ -56,13 +56,16 @@ PENDING, READY, ERROR = "PENDING", "READY", "ERROR"
 
 async def attach_node_to_head(node: "NodeService", head_addr: tuple,
                               resources: dict, *, is_driver: bool = False,
-                              node_type: str = None, on_lost=None):
+                              node_type: str = None, on_lost=None,
+                              start: bool = True):
     """Shared node bring-up against a remote head: dial, wire head pushes,
     start the node, register, and install the re-register callback.
     Used by both the standalone node daemon (node_main.py) and attaching
     drivers (runtime._attach) so the registration handshake can't drift
     between them. ``on_lost`` (async) fires when the head connection
-    drops for any reason other than our own shutdown."""
+    drops for any reason other than our own shutdown. ``start=False``
+    re-attaches an already-running node after a head restart (same
+    handshake, node services untouched)."""
     from .head import RemoteHeadClient
     from .rpc import async_connect
 
@@ -78,16 +81,25 @@ async def attach_node_to_head(node: "NodeService", head_addr: tuple,
 
     conn = await async_connect(head_addr, handle_head_push, on_disconnect)
     node.head = RemoteHeadClient(conn)
-    await node.start()
+    if start:
+        await node.start()
 
     async def register():
-        await conn.call("register_node", {
+        reply = await conn.call("register_node", {
             "node_id": node.node_id.binary(),
             "address": node.peer_address,
             "resources": dict(resources),
             "is_driver": is_driver,
             "node_type": node_type,
+            # Live state for head-restart reconciliation (reference:
+            # raylet resync after NotifyGCSRestart).
+            "sync": node.directory_sync(),
         })
+        for row in (reply or {}).get("release_bundles", []):
+            # The head no longer knows this PG (removed while we were
+            # partitioned / before its restart): free the reservation.
+            node.release_bundle(PlacementGroupID(row["pg_id"]),
+                                row["bundle_index"])
 
     node.register_cb = register
     await register()
@@ -209,8 +221,10 @@ class NodeService:
         # env_id -> (error, monotonic time); entries expire (_bad_env_error).
         self._bad_envs: dict[str, tuple] = {}
         # User metrics: cumulative snapshots pushed by worker processes,
-        # keyed by source worker id (in-process code is read directly).
+        # keyed by source worker id (in-process code is read directly);
+        # dead workers' counters fold into the retired accumulator.
         self.user_metrics: dict[str, dict] = {}
+        self._retired_metrics: dict[tuple, dict] = {}
         self.pending_cpu: collections.deque[TaskSpec] = collections.deque()
         self.cancelled: set[TaskID] = set()
 
@@ -364,6 +378,33 @@ class NodeService:
             snap["events"] = list(self.task_events)
         return snap
 
+    def _retire_worker_metrics(self, source: str):
+        """Fold a dead worker's last counter/histogram snapshot into the
+        node-level retired accumulator (so totals don't regress) and drop
+        its gauges; the per-worker entry is pruned so user_metrics and
+        the export payload stay bounded under worker churn."""
+        snap = self.user_metrics.pop(source, None)
+        if snap is None:
+            return
+        acc = self._retired_metrics
+        for r in snap.get("rows", []):
+            kind = r.get("type")
+            if kind == "gauge":
+                continue
+            key = (r["name"], tuple(sorted(r.get("tags", {}).items())))
+            cur = acc.get(key)
+            if cur is None:
+                acc[key] = dict(r)
+            elif kind == "counter":
+                cur["value"] += r["value"]
+            elif kind == "histogram" \
+                    and cur.get("boundaries") == r.get("boundaries"):
+                cur["bucket_counts"] = [
+                    a + b for a, b in zip(cur["bucket_counts"],
+                                          r["bucket_counts"])]
+                cur["sum"] += r["sum"]
+                cur["count"] += r["count"]
+
     def _metrics_rows(self) -> list:
         """User metrics visible on this node: the in-process registry
         (driver / device lane) plus worker pushes, stamped with source +
@@ -388,6 +429,12 @@ class NodeService:
                 r["node_id"] = self.node_id.hex()
                 r["ts"] = snap.get("ts", 0.0)
                 rows.append(r)
+        for r in self._retired_metrics.values():
+            r = dict(r)
+            r["source"] = f"retired:{self.node_id.hex()[:8]}"
+            r["node_id"] = self.node_id.hex()
+            r["ts"] = 0.0
+            rows.append(r)
         return rows
 
     def _store_stats(self) -> dict:
@@ -2001,6 +2048,30 @@ class NodeService:
     # placement decision lives in the head, gcs_placement_group_scheduler
     # equivalent; this node just sets resources aside)
     # ------------------------------------------------------------------
+    def directory_sync(self) -> dict:
+        """What this node contributes to the head's directory tables on
+        (re-)registration: live named actors, homes of actors it hosts,
+        and placement-group bundles it still has reserved."""
+        named = {}
+        actor_ids = []
+        for a in self.actors.values():
+            if a.state not in ("ALIVE", "PENDING", "RESTARTING"):
+                continue
+            actor_ids.append(a.actor_id.binary())
+            name = getattr(a.creation_spec, "actor_name", None)
+            if name:
+                named[name] = {
+                    "actor_id": a.actor_id.binary(),
+                    "methods": a.creation_spec.actor_methods or []}
+        return {
+            "named_actors": named,
+            "actor_ids": actor_ids,
+            "reservations": [
+                {"pg_id": pg_id.binary(), "bundle_index": idx,
+                 "resources": dict(pool.total)}
+                for (pg_id, idx), pool in self.bundles.items()],
+        }
+
     def reserve_bundle(self, pg_id: PlacementGroupID, bundle_index: int,
                        resources: dict):
         self.bundles[(pg_id, bundle_index)] = BundlePool(
@@ -2191,6 +2262,7 @@ class NodeService:
         was = w.state
         w.state = "DEAD"
         self.counters["workers_died"] += 1
+        self._retire_worker_metrics(w.worker_id.hex())
         # Plain task workers: inflight tasks handled by ConnectionLost in
         # _run_on_worker (retry path). Actor workers: restart FSM.
         if w.actor_id is not None:

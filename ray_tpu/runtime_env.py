@@ -117,6 +117,26 @@ def _zip_dir(path: str) -> bytes:
     return blob
 
 
+# path -> (stat fingerprint, uri): skip the O(dir bytes) re-zip on the
+# submit hot path when nothing under the directory changed; any edit
+# (mtime/size/name) misses and re-uploads, so fresh code still ships.
+_upload_cache: Dict[str, tuple] = {}
+
+
+def _dir_fingerprint(path: str):
+    if os.path.isfile(path):
+        st = os.stat(path)
+        return (st.st_mtime_ns, st.st_size)
+    entries = []
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
+        for fname in sorted(files):
+            st = os.stat(os.path.join(root, fname))
+            entries.append((os.path.relpath(os.path.join(root, fname), path),
+                            st.st_mtime_ns, st.st_size))
+    return tuple(entries)
+
+
 def _upload_path(path: str, kv_op: Callable) -> str:
     """Zip a local directory (or take a single .py file) into the KV,
     returning its kv:// URI."""
@@ -124,6 +144,10 @@ def _upload_path(path: str, kv_op: Callable) -> str:
         return path
     if not os.path.exists(path):
         raise RuntimeEnvSetupError(f"runtime_env path {path!r} not found")
+    fp = _dir_fingerprint(path)
+    hit = _upload_cache.get(os.path.abspath(path))
+    if hit is not None and hit[0] == fp:
+        return hit[1]
     if os.path.isfile(path):
         # A single module file: wrap it in a one-file package.
         with open(path, "rb") as f:
@@ -140,7 +164,9 @@ def _upload_path(path: str, kv_op: Callable) -> str:
     key = KV_PACKAGE_PREFIX + sha
     if not kv_op("exists", key, None):
         kv_op("put", key, blob)
-    return URI_SCHEME + key
+    uri = URI_SCHEME + key
+    _upload_cache[os.path.abspath(path)] = (fp, uri)
+    return uri
 
 
 def resolve_for_upload(env: Optional[dict], kv_op: Callable) -> dict:

@@ -120,6 +120,11 @@ def _head_daemon(args):
 
     jax.config.update("jax_platforms", "cpu")
     os.environ["RT_HEAD_PORT"] = str(args.port)
+    # Durable head tables (KV, functions, PG definitions): a head
+    # restarted on the same port replays them and worker nodes resync
+    # (reference: Redis-backed GCS fault tolerance).
+    os.environ.setdefault(
+        "RT_HEAD_PERSIST", os.path.join(_temp_dir(args), "head_state.bin"))
     import ray_tpu
 
     resources = json.loads(args.resources) if args.resources else None

@@ -40,11 +40,21 @@ class _Registry:
                  boundaries: Optional[List[float]] = None):
         with self.lock:
             old = self.meta.get(name)
-            if old is not None and old["type"] != kind:
-                raise ValueError(
-                    f"metric {name!r} already registered as {old['type']}")
-            self.meta[name] = {"type": kind, "description": description,
-                               "boundaries": boundaries}
+            if old is not None:
+                if old["type"] != kind:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{old['type']}")
+                if kind == "histogram" \
+                        and old["boundaries"] != boundaries:
+                    # Existing cells are sized for the old boundaries;
+                    # silently swapping them would corrupt recording.
+                    raise ValueError(
+                        f"histogram {name!r} already registered with "
+                        f"boundaries {old['boundaries']}")
+            else:
+                self.meta[name] = {"type": kind, "description": description,
+                                   "boundaries": boundaries}
         self._ensure_flusher()
 
     def record(self, name: str, tags: tuple, op: str, value: float):

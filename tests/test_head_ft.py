@@ -1,0 +1,213 @@
+"""Head (GCS-equivalent) persistence and restart fault tolerance.
+
+Parity model: /root/reference/src/ray/gcs/store_client/ (Redis-backed
+GCS state), gcs_server/gcs_init_data.h (replay on restart), and
+python/ray/tests/test_gcs_fault_tolerance.py: kill the head, bring it
+back on the same address, and the surviving nodes re-register, KV
+survives, named actors are re-announced, and PG reservations reconcile.
+"""
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ray_tpu._private.head import HeadService
+from ray_tpu._private.head_store import FileHeadStore
+from ray_tpu._private.ids import NodeID, PlacementGroupID
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Unit: store + replay
+# ---------------------------------------------------------------------------
+def test_file_store_roundtrip(tmp_path):
+    store = FileHeadStore(str(tmp_path / "head.bin"))
+    assert store.load() is None
+    store.save({"kv": {"a": b"1"}, "functions": {}, "placement_groups": []})
+    assert store.load()["kv"] == {"a": b"1"}
+
+
+def _run_head(coro_fn, store):
+    """Drive a HeadService on a private loop without sockets."""
+    loop = asyncio.new_event_loop()
+    try:
+        head = HeadService("testsess", loop, store=store)
+        result = loop.run_until_complete(coro_fn(head))
+        if head._persist_pool is not None:
+            # Snapshot writes are off-loop; barrier so the store is
+            # current before the next head instance replays it.
+            head._persist_pool.submit(lambda: None).result()
+        return result, head
+    finally:
+        loop.close()
+
+
+def test_head_replays_kv_functions_and_pgs(tmp_path):
+    store = FileHeadStore(str(tmp_path / "head.bin"))
+
+    async def fill(head):
+        head.kv_op("put", "k1", b"v1")
+        head.put_function("fid1", b"blob")
+        pg_id = PlacementGroupID.from_random()
+        node = NodeID.from_random()
+        head.register_node(node, ("127.0.0.1", 1), {"CPU": 4}, None)
+        await head.create_placement_group(pg_id, [{"CPU": 1}], "PACK")
+        return pg_id
+
+    pg_id, head1 = _run_head(fill, store)
+    assert head1.placement_groups[pg_id].state == "CREATED"
+
+    async def check(head):
+        return None
+
+    _, head2 = _run_head(check, store)
+    assert head2.kv_op("get", "k1") == b"v1"
+    assert head2.functions["fid1"] == b"blob"
+    # PG definition survives; placement is PENDING until nodes resync.
+    pg = head2.placement_groups[pg_id]
+    assert pg.state == "PENDING" and pg.placement == {}
+
+
+def test_head_reconciles_node_reservations(tmp_path):
+    store = FileHeadStore(str(tmp_path / "head.bin"))
+
+    async def fill(head):
+        pg_id = PlacementGroupID.from_random()
+        node = NodeID.from_random()
+        head.register_node(node, ("127.0.0.1", 1), {"CPU": 4}, None)
+        await head.create_placement_group(pg_id, [{"CPU": 2}], "PACK")
+        return pg_id, node
+
+    (pg_id, node), _ = _run_head(fill, store)
+
+    async def resync(head):
+        # The surviving node re-registers carrying its reservation.
+        reply = head.register_node(
+            node, ("127.0.0.1", 1), {"CPU": 4}, None,
+            sync={"reservations": [
+                {"pg_id": pg_id.binary(), "bundle_index": 0,
+                 "resources": {"CPU": 2}}]})
+        return reply
+
+    reply, head2 = _run_head(resync, store)
+    assert reply["release_bundles"] == []
+    pg = head2.placement_groups[pg_id]
+    assert pg.state == "CREATED"
+    assert pg.placement == {0: node}
+    assert head2.nodes[node].available["CPU"] == 2
+
+    # A reservation for a PG the head no longer knows is released.
+    async def resync_stale(head):
+        ghost = PlacementGroupID.from_random()
+        return head.register_node(
+            node, ("127.0.0.1", 1), {"CPU": 4}, None,
+            sync={"reservations": [
+                {"pg_id": ghost.binary(), "bundle_index": 0,
+                 "resources": {"CPU": 1}}]})
+
+    reply, _ = _run_head(resync_stale, store)
+    assert len(reply["release_bundles"]) == 1
+
+
+def test_named_actor_sync_on_register(tmp_path):
+    store = FileHeadStore(str(tmp_path / "head.bin"))
+
+    async def resync(head):
+        node = NodeID.from_random()
+        aid = os.urandom(12)
+        head.register_node(
+            node, ("127.0.0.1", 1), {"CPU": 1}, None,
+            sync={"named_actors": {
+                "survivor": {"actor_id": aid, "methods": ["ping"]}},
+                "actor_ids": [aid]})
+        return head.named_actors.get("survivor")
+
+    info, _ = _run_head(resync, store)
+    assert info is not None and info["methods"] == ["ping"]
+
+
+# ---------------------------------------------------------------------------
+# Live: CLI head restart with a surviving worker node
+# ---------------------------------------------------------------------------
+def test_head_restart_cluster_survives(tmp_path):
+    """rtpu start --head; add a worker node; kill the head daemon; start
+    a new head on the same port + persist file -> the node re-registers
+    and KV written before the restart is still there."""
+    temp = str(tmp_path / "rtpu")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    port = 40000 + (os.getpid() % 20000)
+    cli = [sys.executable, "-m", "ray_tpu.scripts.cli", "--temp-dir", temp]
+
+    def start_head():
+        subprocess.run(cli + ["start", "--head", "--port", str(port),
+                              "--num-cpus", "1"],
+                       env=env, check=True, timeout=90)
+
+    def script(code):
+        e = dict(env, RT_ADDRESS=f"127.0.0.1:{port}")
+        return subprocess.run([sys.executable, "-c", code], env=e,
+                              capture_output=True, text=True, timeout=90)
+
+    start_head()
+    try:
+        # A worker node that must survive the head restart.
+        node_env = dict(env, RT_HEAD_ADDR=f"127.0.0.1:{port}",
+                        RT_SESSION_ID="headft", RT_NODE_RESOURCES='{"CPU": 1, "x": 1}')
+        node = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.node_main"],
+            env=node_env)
+        out = script(
+            "import ray_tpu, time\n"
+            "ray_tpu.init()\n"
+            "ray_tpu.kv_put('ft_key', b'survives')\n"
+            "for _ in range(100):\n"
+            "    if any(n.get('resources', {}).get('x')\n"
+            "           for n in ray_tpu.util.state.list_nodes()):\n"
+            "        break\n"
+            "    time.sleep(0.2)\n"
+            "else:\n"
+            "    raise SystemExit('node never joined')\n"
+            "print('PHASE1 OK')\n"
+            "ray_tpu.shutdown()\n")
+        assert "PHASE1 OK" in out.stdout, (out.stdout, out.stderr)
+
+        # Kill ONLY the head daemon (not the worker node).
+        with open(os.path.join(temp, "pids")) as f:
+            head_pid = int(f.read().split()[0])
+        os.kill(head_pid, 9)
+        time.sleep(1.0)
+        os.unlink(os.path.join(temp, "pids"))
+        start_head()
+
+        # Node re-registers within its grace window; KV survived.
+        out = script(
+            "import ray_tpu, time\n"
+            "ray_tpu.init()\n"
+            "assert ray_tpu.kv_get('ft_key') == b'survives', 'kv lost'\n"
+            "for _ in range(150):\n"
+            "    if any(n.get('resources', {}).get('x')\n"
+            "           for n in ray_tpu.util.state.list_nodes()\n"
+            "           if n['state'] == 'ALIVE'):\n"
+            "        break\n"
+            "    time.sleep(0.2)\n"
+            "else:\n"
+            "    raise SystemExit('node never re-registered')\n"
+            "@ray_tpu.remote(resources={'x': 1})\n"
+            "def on_node():\n"
+            "    return 'ran'\n"
+            "print('TASK', ray_tpu.get(on_node.remote(), timeout=60))\n"
+            "print('PHASE2 OK')\n"
+            "ray_tpu.shutdown()\n")
+        assert "PHASE2 OK" in out.stdout, (out.stdout, out.stderr)
+        assert "TASK ran" in out.stdout
+        node.kill()
+        node.wait(timeout=10)
+    finally:
+        subprocess.run(cli + ["stop"], env=env, timeout=60)
