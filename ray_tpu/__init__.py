@@ -49,4 +49,5 @@ from ._private.exceptions import (  # noqa: F401
 from ._private.task_spec import SchedulingStrategy  # noqa: F401
 from . import runtime_env  # noqa: F401
 from . import util  # noqa: F401
+from . import workflow  # noqa: F401
 from .util.state import timeline  # noqa: F401

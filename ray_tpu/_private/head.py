@@ -105,6 +105,11 @@ class HeadService:
             None if isinstance(store, InMemoryHeadStore)
             else ThreadPoolExecutor(max_workers=1,
                                     thread_name_prefix="rt-head-persist"))
+        import threading
+
+        self._persist_lock = threading.Lock()
+        self._persist_pending = None
+        self._persist_inflight = False
         self._replay()
         self.server = DuplexServer(
             (self.cfg.head_host, port), self._handle_rpc, self._on_disconnect)
@@ -139,7 +144,11 @@ class HeadService:
             return
         # Shallow copies on-loop (values are immutable bytes/dicts the
         # head never mutates in place); pickle+fsync off-loop so a
-        # multi-MB package upload can't stall scheduling RPCs.
+        # multi-MB package upload can't stall scheduling RPCs. Bursts
+        # COALESCE: while a write is in flight, later snapshots replace
+        # the pending one instead of queueing — latest wins on disk as
+        # it does in memory, and N package uploads cost O(N) writes,
+        # not one full-store write per mutation.
         tables = {
             "kv": dict(self.kv),
             "functions": dict(self.functions),
@@ -150,7 +159,22 @@ class HeadService:
                 for pg in self.placement_groups.values()
                 if pg.state != "REMOVED"],
         }
-        self._persist_pool.submit(self.store.save, tables)
+        with self._persist_lock:
+            self._persist_pending = tables
+            if self._persist_inflight:
+                return
+            self._persist_inflight = True
+        self._persist_pool.submit(self._write_pending)
+
+    def _write_pending(self):
+        while True:
+            with self._persist_lock:
+                tables = self._persist_pending
+                self._persist_pending = None
+                if tables is None:
+                    self._persist_inflight = False
+                    return
+            self.store.save(tables)
 
     async def start(self):
         await self.server.start()
@@ -267,9 +291,10 @@ class HeadService:
     async def _mark_node_dead(self, entry: NodeEntry, cause: str):
         entry.state = DEAD
         entry.available = {}
-        # Drop directory entries that pointed at the dead node.
+        # Drop directory entries that pointed at the dead node (the table
+        # stores raw bytes; compare bytes, not NodeID objects).
         for name in [n for n, info in self.named_actors.items()
-                     if info["node_id"] == entry.node_id]:
+                     if info["node_id"] == entry.node_id.binary()]:
             del self.named_actors[name]
         for aid in [a for a, n in self.actor_nodes.items()
                     if n == entry.node_id]:

@@ -147,7 +147,12 @@ def _upload_path(path: str, kv_op: Callable) -> str:
     fp = _dir_fingerprint(path)
     hit = _upload_cache.get(os.path.abspath(path))
     if hit is not None and hit[0] == fp:
-        return hit[1]
+        uri = hit[1]
+        # The cache only skips the zip; the KV is re-checked so a URI
+        # cached against a previous cluster (shutdown/init, head restart
+        # without persistence) can't go stale.
+        if kv_op("exists", uri[len(URI_SCHEME):], None):
+            return uri
     if os.path.isfile(path):
         # A single module file: wrap it in a one-file package.
         with open(path, "rb") as f:

@@ -1,0 +1,327 @@
+"""Durable workflows: checkpointed multi-step execution on tasks.
+
+Capability parity target: /root/reference/python/ray/workflow/
+(workflow_executor.py, workflow_state_from_dag.py, checkpointed step
+outputs in workflow_storage.py, resume_all/get_output api.py). The
+step-graph API mirrors the reference's step surface: build a lazy DAG
+with ``fn.step(...)``, execute with ``workflow.run(node, workflow_id=)``;
+every finished step checkpoints its output, so a crashed or killed run
+resumes from the last completed step (``workflow.resume``). A step that
+returns another step node is a continuation (the reference's dynamic
+workflows).
+
+Not carried over: virtual actors and HTTP event providers (the
+reference marks both experimental); our steps are plain ``ray_tpu``
+remote functions, so TPU device-lane steps work unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+__all__ = ["init", "step", "run", "resume", "resume_all", "get_status",
+           "get_output", "list_all", "delete", "WorkflowStep",
+           "StepNode", "WorkflowError"]
+
+# Statuses (reference: WorkflowStatus in common.py)
+RUNNING = "RUNNING"
+SUCCESSFUL = "SUCCESSFUL"
+FAILED = "FAILED"
+RESUMABLE = "RESUMABLE"
+
+
+class WorkflowError(RuntimeError):
+    pass
+
+
+_storage_root: Optional[str] = None
+
+
+def init(storage: Optional[str] = None) -> None:
+    """Set the workflow storage root (a directory; survives the driver)."""
+    global _storage_root
+    _storage_root = storage or os.environ.get(
+        "RT_WORKFLOW_STORAGE", "/tmp/rtpu-workflows")
+    os.makedirs(_storage_root, exist_ok=True)
+
+
+def _root() -> str:
+    if _storage_root is None:
+        init()
+    return _storage_root
+
+
+# ---------------------------------------------------------------------------
+# Step graph
+# ---------------------------------------------------------------------------
+@dataclass
+class StepNode:
+    """A lazy, picklable invocation in the workflow DAG."""
+
+    fn: Any  # the plain function (pickled into storage with the DAG)
+    args: tuple
+    kwargs: dict
+    name: str
+    max_retries: int = 3
+    resources: Optional[dict] = None
+    step_id: str = field(
+        default_factory=lambda: uuid.uuid4().hex[:12])
+
+    def deps(self) -> List["StepNode"]:
+        out = [a for a in self.args if isinstance(a, StepNode)]
+        out += [v for v in self.kwargs.values() if isinstance(v, StepNode)]
+        return out
+
+
+class WorkflowStep:
+    """``step(fn)`` wrapper; ``.step(*args)`` builds a StepNode
+    (reference: the classic ``@workflow.step`` decorator surface)."""
+
+    def __init__(self, fn, *, name: Optional[str] = None,
+                 max_retries: int = 3, resources: Optional[dict] = None):
+        self._fn = fn
+        self._name = name or getattr(fn, "__name__", "step")
+        self._max_retries = max_retries
+        self._resources = resources
+
+    def options(self, *, name: Optional[str] = None,
+                max_retries: Optional[int] = None,
+                resources: Optional[dict] = None) -> "WorkflowStep":
+        return WorkflowStep(
+            self._fn,
+            name=name or self._name,
+            max_retries=self._max_retries if max_retries is None
+            else max_retries,
+            resources=resources or self._resources)
+
+    def step(self, *args, **kwargs) -> StepNode:
+        return StepNode(fn=self._fn, args=args, kwargs=kwargs,
+                        name=self._name, max_retries=self._max_retries,
+                        resources=self._resources)
+
+
+def step(fn=None, **options):
+    """Decorator/wrapper: ``@workflow.step`` or ``workflow.step(fn)``."""
+    if fn is None:
+        return lambda f: WorkflowStep(f, **options)
+    return WorkflowStep(fn, **options)
+
+
+# ---------------------------------------------------------------------------
+# Storage layout: <root>/<workflow_id>/
+#   workflow.pkl          the entry StepNode (whole DAG pickles with it)
+#   status.json           {status, ts, error?}
+#   steps/<step_id>.pkl   checkpointed step output
+# ---------------------------------------------------------------------------
+class _Storage:
+    def __init__(self, workflow_id: str):
+        self.dir = os.path.join(_root(), workflow_id)
+        self.steps_dir = os.path.join(self.dir, "steps")
+
+    def create(self, entry: StepNode):
+        os.makedirs(self.steps_dir, exist_ok=True)
+        with open(os.path.join(self.dir, "workflow.pkl"), "wb") as f:
+            import cloudpickle
+
+            cloudpickle.dump(entry, f)
+
+    def load_entry(self) -> StepNode:
+        with open(os.path.join(self.dir, "workflow.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def set_status(self, status: str, error: Optional[str] = None):
+        blob = json.dumps({"status": status, "ts": time.time(),
+                           "error": error})
+        tmp = os.path.join(self.dir, f".status-{os.getpid()}")
+        with open(tmp, "w") as f:
+            f.write(blob)
+        os.replace(tmp, os.path.join(self.dir, "status.json"))
+
+    def get_status(self) -> Optional[dict]:
+        try:
+            with open(os.path.join(self.dir, "status.json")) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+
+    def checkpoint(self, step_id: str, value: Any):
+        tmp = os.path.join(self.steps_dir, f".{step_id}-{os.getpid()}")
+        with open(tmp, "wb") as f:
+            import cloudpickle
+
+            cloudpickle.dump(value, f)
+        os.replace(tmp, os.path.join(self.steps_dir, f"{step_id}.pkl"))
+
+    def restore(self, step_id: str):
+        """(hit, value)"""
+        try:
+            with open(os.path.join(self.steps_dir, f"{step_id}.pkl"),
+                      "rb") as f:
+                return True, pickle.load(f)
+        except FileNotFoundError:
+            return False, None
+
+    def exists(self) -> bool:
+        return os.path.isfile(os.path.join(self.dir, "workflow.pkl"))
+
+
+# ---------------------------------------------------------------------------
+# Execution
+# ---------------------------------------------------------------------------
+def _execute_node(node: StepNode, storage: _Storage) -> Any:
+    """Depth-first checkpointed execution. Completed steps restore from
+    their checkpoint instead of re-running (reference:
+    workflow_storage's step-output recovery)."""
+    import ray_tpu
+
+    hit, value = storage.restore(node.step_id)
+    if hit:
+        # A checkpointed continuation re-enters execution (its own steps
+        # may or may not be checkpointed yet).
+        if isinstance(value, StepNode):
+            return _execute_node(value, storage)
+        return value
+
+    # Sibling dependencies run CONCURRENTLY (each on its own thread, the
+    # underlying scheduler fans the tasks across the cluster); threads
+    # recurse, so parallelism holds at every DAG level. Checkpoint
+    # dedup means a node shared by two branches still executes once —
+    # whichever thread loses the os.replace race just re-reads.
+    step_deps = node.deps()
+    resolved: dict = {}
+    if len(step_deps) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=len(step_deps)) as pool:
+            futs = {d.step_id: pool.submit(_execute_node, d, storage)
+                    for d in step_deps}
+            resolved = {sid: f.result() for sid, f in futs.items()}
+
+    def resolve(v):
+        if not isinstance(v, StepNode):
+            return v
+        if v.step_id in resolved:
+            return resolved[v.step_id]
+        return _execute_node(v, storage)
+
+    args = [resolve(a) for a in node.args]
+    kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
+
+    # Retries are owned HERE (one layer): each attempt is submitted with
+    # max_retries=0 so the task layer can't multiply the count — a
+    # non-idempotent step body runs at most max_retries+1 times.
+    opts = {"max_retries": 0}
+    if node.resources:
+        opts["resources"] = node.resources
+    remote_fn = ray_tpu.remote(node.fn).options(**opts)
+
+    last_err = None
+    for _attempt in range(node.max_retries + 1):
+        try:
+            value = ray_tpu.get(remote_fn.remote(*args, **kwargs))
+            break
+        except ray_tpu.TaskError as e:
+            last_err = e
+    else:
+        raise WorkflowError(
+            f"step {node.name!r} failed after {node.max_retries + 1} "
+            f"attempts: {last_err}") from last_err
+
+    storage.checkpoint(node.step_id, value)
+    if isinstance(value, StepNode):
+        # Continuation: the step dynamically returned more work.
+        return _execute_node(value, storage)
+    return value
+
+
+def run(entry: Optional[StepNode], workflow_id: Optional[str] = None) -> Any:
+    """Execute a step DAG durably; returns the terminal value.
+    Re-running an existing workflow_id resumes it (the stored DAG is the
+    source of truth); ``entry=None`` is resume-only."""
+    if entry is not None and not isinstance(entry, StepNode):
+        raise TypeError("workflow.run expects a StepNode "
+                        "(build one with step(fn).step(...))")
+    workflow_id = workflow_id or f"wf-{uuid.uuid4().hex[:10]}"
+    storage = _Storage(workflow_id)
+    if storage.exists():
+        entry = storage.load_entry()
+    elif entry is None:
+        raise WorkflowError(f"no workflow {workflow_id!r} in storage")
+    else:
+        storage.create(entry)
+    storage.set_status(RUNNING)
+    try:
+        value = _execute_node(entry, storage)
+    except BaseException as e:
+        storage.set_status(
+            RESUMABLE if not isinstance(e, WorkflowError) else FAILED,
+            error=str(e))
+        raise
+    storage.set_status(SUCCESSFUL)
+    return value
+
+
+def resume(workflow_id: str) -> Any:
+    """Resume a crashed/failed workflow from its checkpoints."""
+    return run(None, workflow_id=workflow_id)
+
+
+def resume_all() -> Dict[str, Any]:
+    """Resume every non-successful workflow; returns id -> result/error
+    (reference: workflow.resume_all on startup)."""
+    out = {}
+    for wid, meta in list_all():
+        if meta in (SUCCESSFUL,):
+            continue
+        try:
+            out[wid] = resume(wid)
+        except BaseException as e:  # noqa: BLE001 - caller inspects
+            out[wid] = e
+    return out
+
+
+def get_status(workflow_id: str) -> Optional[str]:
+    meta = _Storage(workflow_id).get_status()
+    return meta["status"] if meta else None
+
+
+def get_output(workflow_id: str) -> Any:
+    """Terminal value of a SUCCESSFUL workflow (from its checkpoint)."""
+    storage = _Storage(workflow_id)
+    status = get_status(workflow_id)
+    if status != SUCCESSFUL:
+        raise WorkflowError(
+            f"workflow {workflow_id!r} is {status}, not SUCCESSFUL")
+    node = storage.load_entry()
+    while True:
+        hit, value = storage.restore(node.step_id)
+        if not hit:
+            raise WorkflowError(f"missing checkpoint for {node.step_id}")
+        if isinstance(value, StepNode):
+            node = value
+            continue
+        return value
+
+
+def list_all() -> List[tuple]:
+    """[(workflow_id, status)] for everything in storage."""
+    root = _root()
+    out = []
+    for wid in sorted(os.listdir(root)):
+        storage = _Storage(wid)
+        if storage.exists():
+            meta = storage.get_status()
+            out.append((wid, meta["status"] if meta else RESUMABLE))
+    return out
+
+
+def delete(workflow_id: str) -> None:
+    import shutil
+
+    shutil.rmtree(os.path.join(_root(), workflow_id), ignore_errors=True)

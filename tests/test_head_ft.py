@@ -131,6 +131,25 @@ def test_named_actor_sync_on_register(tmp_path):
     assert info is not None and info["methods"] == ["ping"]
 
 
+def test_named_actor_dropped_when_node_dies(tmp_path):
+    store = FileHeadStore(str(tmp_path / "head.bin"))
+
+    async def scenario(head):
+        node = NodeID.from_random()
+        aid = os.urandom(12)
+        head.register_node(
+            node, ("127.0.0.1", 1), {"CPU": 1}, None,
+            sync={"named_actors": {
+                "doomed": {"actor_id": aid, "methods": []}},
+                "actor_ids": [aid]})
+        assert "doomed" in head.named_actors
+        await head._mark_node_dead(head.nodes[node], "test")
+        return "doomed" in head.named_actors
+
+    still_there, _ = _run_head(scenario, store)
+    assert not still_there  # the dead node's named actors are dropped
+
+
 # ---------------------------------------------------------------------------
 # Live: CLI head restart with a surviving worker node
 # ---------------------------------------------------------------------------

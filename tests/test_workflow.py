@@ -1,0 +1,173 @@
+"""Durable workflows: checkpointing, resume, continuations.
+
+Parity model: /root/reference/python/ray/workflow/tests
+(test_basic_workflows.py, test_recovery.py).
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+
+
+@pytest.fixture
+def wf(rt, tmp_path):
+    workflow.init(str(tmp_path / "wf-store"))
+    yield workflow
+
+
+def _mark(path):
+    with open(path, "a") as f:
+        f.write("x")
+
+
+def _count(path):
+    try:
+        with open(path) as f:
+            return len(f.read())
+    except FileNotFoundError:
+        return 0
+
+
+def test_linear_workflow(wf):
+    @wf.step
+    def add(a, b):
+        return a + b
+
+    @wf.step
+    def double(x):
+        return 2 * x
+
+    node = double.step(add.step(2, 3))
+    assert wf.run(node, workflow_id="lin") == 10
+    assert wf.get_status("lin") == workflow.SUCCESSFUL
+    assert wf.get_output("lin") == 10
+
+
+def test_diamond_dag(wf):
+    @wf.step
+    def src():
+        return 3
+
+    @wf.step
+    def left(x):
+        return x + 1
+
+    @wf.step
+    def right(x):
+        return x * 10
+
+    @wf.step
+    def join(a, b):
+        return (a, b)
+
+    s = src.step()
+    assert wf.run(join.step(left.step(s), right.step(s)),
+                  workflow_id="dia") == (4, 30)
+
+
+def test_checkpoints_skip_completed_steps(wf, tmp_path):
+    marker = str(tmp_path / "ran")
+
+    @wf.step
+    def counted(m):
+        with open(m, "a") as f:
+            f.write("x")
+        return "done"
+
+    node = counted.step(marker)
+    assert wf.run(node, workflow_id="ck") == "done"
+    assert _count(marker) == 1
+    # Re-running the same workflow id restores from checkpoint: the step
+    # body must NOT run again.
+    assert wf.run(node, workflow_id="ck") == "done"
+    assert _count(marker) == 1
+
+
+def test_failed_step_then_resume(wf, tmp_path):
+    """A step that fails exhausts retries -> workflow FAILED; fixing the
+    precondition and resuming completes WITHOUT re-running the steps
+    that already checkpointed."""
+    before_marker = str(tmp_path / "before")
+    gate = str(tmp_path / "gate")
+
+    @wf.step
+    def before(m):
+        with open(m, "a") as f:
+            f.write("x")
+        return 7
+
+    @wf.step(max_retries=0)
+    def fragile(x, gate_path):
+        if not os.path.exists(gate_path):
+            raise RuntimeError("gate closed")
+        return x + 1
+
+    node = fragile.step(before.step(before_marker), gate)
+    with pytest.raises(workflow.WorkflowError):
+        wf.run(node, workflow_id="rec")
+    assert wf.get_status("rec") == workflow.FAILED
+    assert _count(before_marker) == 1
+
+    _mark(gate)  # open the gate
+    assert wf.resume("rec") == 8
+    assert wf.get_status("rec") == workflow.SUCCESSFUL
+    assert _count(before_marker) == 1  # checkpointed: not re-run
+
+
+def test_continuation(wf):
+    @wf.step
+    def final(x):
+        return x * 100
+
+    @wf.step
+    def decide(x):
+        if x > 0:
+            return final.step(x)
+        return 0
+
+    assert wf.run(decide.step(5), workflow_id="cont") == 500
+    assert wf.get_output("cont") == 500
+    assert wf.run(decide.step(-1), workflow_id="cont2") == 0
+
+
+def test_list_resume_all_delete(wf, tmp_path):
+    gate = str(tmp_path / "g2")
+
+    @wf.step
+    def ok():
+        return 1
+
+    @wf.step(max_retries=0)
+    def needs_gate(g):
+        if not os.path.exists(g):
+            raise RuntimeError("no gate")
+        return 2
+
+    wf.run(ok.step(), workflow_id="good")
+    with pytest.raises(workflow.WorkflowError):
+        wf.run(needs_gate.step(gate), workflow_id="bad")
+
+    statuses = dict(wf.list_all())
+    assert statuses["good"] == workflow.SUCCESSFUL
+    assert statuses["bad"] == workflow.FAILED
+
+    _mark(gate)
+    results = wf.resume_all()
+    assert results == {"bad": 2}
+
+    wf.delete("good")
+    assert "good" not in dict(wf.list_all())
+
+
+def test_get_output_on_unfinished_raises(wf, tmp_path):
+    @wf.step(max_retries=0)
+    def boom():
+        raise RuntimeError("nope")
+
+    with pytest.raises(workflow.WorkflowError):
+        wf.run(boom.step(), workflow_id="unf")
+    with pytest.raises(workflow.WorkflowError):
+        wf.get_output("unf")
