@@ -174,7 +174,14 @@ class HeadService:
                 if tables is None:
                     self._persist_inflight = False
                     return
-            self.store.save(tables)
+            try:
+                self.store.save(tables)
+            except Exception as e:  # noqa: BLE001 - one bad write must
+                # not wedge persistence forever: log, keep draining (the
+                # next mutation re-snapshots the full state anyway).
+                import sys
+
+                sys.stderr.write(f"head persistence write failed: {e}\n")
 
     async def start(self):
         await self.server.start()

@@ -9,8 +9,15 @@ DDP), and env runners are CPU actors feeding the TPU learner.
 """
 
 from .algorithm import DQN, PPO, Algorithm, AlgorithmConfig  # noqa: F401
+from .appo import APPO, APPOLearner  # noqa: F401
 from .impala import IMPALA, IMPALALearner, vtrace_returns  # noqa: F401
 from .env import SyncVectorEnv, make_env  # noqa: F401
+from .multi_agent import (  # noqa: F401
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+)
+from .sac import SAC, SACLearner  # noqa: F401
 from .env_runner import (  # noqa: F401
     SingleAgentEnvRunner,
     compute_gae,

@@ -61,6 +61,15 @@ class AlgorithmConfig:
         self.target_update_freq = 100
         self.epsilon = (1.0, 0.05, 10_000)  # start, end, decay steps
         self.learning_starts = 1_000
+        # SAC
+        self.tau = 0.005  # polyak coefficient for the target critic
+        self.target_entropy = None  # None => -act_dim (the SAC default)
+        # APPO
+        self.use_kl_loss = False
+        self.kl_coeff = 0.2
+        # multi-agent
+        self.policies: Optional[dict] = None
+        self.policy_mapping_fn: Callable = lambda agent_id: "default"
 
     # -- builder steps ------------------------------------------------------
     def environment(self, env=None, *, env_config: Optional[dict] = None,
@@ -89,6 +98,17 @@ class AlgorithmConfig:
             if not hasattr(self, k):
                 raise AttributeError(f"unknown training option {k!r}")
             setattr(self, k, v)
+        return self
+
+    def multi_agent(self, *, policies: Optional[dict] = None,
+                    policy_mapping_fn: Optional[Callable] = None, **_):
+        """Reference: algorithm_config.multi_agent(policies=...,
+        policy_mapping_fn=...). ``policy_mapping_fn(agent_id)`` routes
+        each agent to a policy id; agents sharing an id share weights."""
+        if policies is not None:
+            self.policies = policies
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
         return self
 
     def debugging(self, *, seed: Optional[int] = None, **_):
@@ -151,8 +171,12 @@ class Algorithm:
 
     def _make_module(self):
         vec = self.local_runner.vec
-        obs_dim, n_act = space_dims(vec.single_observation_space,
-                                    vec.single_action_space)
+        act_space = vec.single_action_space
+        if not hasattr(act_space, "n"):
+            raise ValueError(
+                f"{type(self).__name__} needs a Discrete action space, "
+                f"got {act_space}; use SAC for continuous control")
+        obs_dim, n_act = space_dims(vec.single_observation_space, act_space)
         return DiscreteActorCritic(obs_dim, n_act, self.config.model_config)
 
     def _make_learner_group(self) -> LearnerGroup:

@@ -1,0 +1,75 @@
+"""APPO: asynchronous PPO (IMPALA plumbing + clipped-surrogate loss).
+
+Capability parity target: /root/reference/rllib/algorithms/appo/
+(appo.py — "IMPALA with a PPO surrogate loss", V-trace-corrected
+advantages, optional KL penalty against the behavior policy). The async
+actor-learner loop, weight broadcast, and staleness accounting are
+inherited unchanged from our IMPALA; only the loss differs.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .impala import IMPALA, IMPALALearner, vtrace_returns
+from .learner import LearnerGroup
+
+
+class APPOLearner(IMPALALearner):
+    """Clipped PPO surrogate over V-trace advantages (parity:
+    appo_torch_learner / appo_tf_learner loss)."""
+
+    def __init__(self, module, *, clip_param: float = 0.2,
+                 use_kl_loss: bool = False, kl_coeff: float = 0.2, **kw):
+        self.clip_param = clip_param
+        self.use_kl_loss = use_kl_loss
+        self.kl_coeff = kl_coeff
+        super().__init__(module, **kw)
+
+    def loss(self, params, batch):
+        T, N = batch["rewards"].shape
+        obs_flat = batch["obs"].reshape((T * N,) + batch["obs"].shape[2:])
+        act_flat = batch["actions"].reshape(T * N)
+        logp_f, entropy_f, value_f = self.module.forward_train(
+            params, obs_flat, act_flat)
+        target_logp = logp_f.reshape(T, N)
+        values = value_f.reshape(T, N)
+        bootstrap = self.module.value(params, batch["final_obs"])
+        vs, pg_adv = vtrace_returns(
+            batch["logp"], target_logp, batch["rewards"], batch["dones"],
+            values, bootstrap, self.gamma, self.rho_clip, self.c_clip)
+        adv = (pg_adv - pg_adv.mean()) / jnp.maximum(pg_adv.std(), 1e-6)
+        ratio = jnp.exp(target_logp - batch["logp"])
+        surr = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - self.clip_param,
+                     1 + self.clip_param) * adv)
+        pi_loss = -surr.mean()
+        vf_loss = 0.5 * ((vs - values) ** 2).mean()
+        ent = entropy_f.mean()
+        kl = (batch["logp"] - target_logp).mean()
+        total = pi_loss + self.vf_coeff * vf_loss - self.entropy_coeff * ent
+        if self.use_kl_loss:
+            total = total + self.kl_coeff * kl
+        return total, {"policy_loss": pi_loss, "vf_loss": vf_loss,
+                       "entropy": ent, "kl": kl,
+                       "mean_ratio": ratio.mean()}
+
+
+class APPO(IMPALA):
+    def _make_learner_group(self):
+        learner = APPOLearner(
+            self._make_module(),
+            clip_param=self.config.clip_param,
+            use_kl_loss=self.config.use_kl_loss,
+            kl_coeff=self.config.kl_coeff,
+            gamma=self.config.gamma,
+            vf_coeff=self.config.vf_coeff,
+            entropy_coeff=self.config.entropy_coeff,
+            rho_clip=self.config.rho_clip,
+            c_clip=self.config.c_clip,
+            lr=self.config.lr,
+            grad_clip=self.config.grad_clip,
+            seed=self.config.seed or 0,
+        )
+        return LearnerGroup(learner)

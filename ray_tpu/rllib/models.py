@@ -98,9 +98,77 @@ class DiscreteActorCritic:
         return logp, entropy, self.value(params, obs)
 
 
+class SquashedGaussianActorTwinQ:
+    """Continuous-control SAC module: tanh-squashed Gaussian policy and
+    twin Q critics (parity: the reference's SAC default models,
+    rllib/algorithms/sac/sac_catalog + sac_torch_model — policy net with
+    state-dependent log-std, two independent Q(s, a) nets)."""
+
+    LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+    def __init__(self, obs_dim: int, act_dim: int, act_low, act_high,
+                 config: Optional[ModelConfig] = None):
+        self.obs_dim = obs_dim
+        self.act_dim = act_dim
+        self.config = config or ModelConfig(hidden=(256, 256),
+                                            activation="relu")
+        low = np.asarray(act_low, np.float32).reshape(act_dim)
+        high = np.asarray(act_high, np.float32).reshape(act_dim)
+        self.act_scale = (high - low) / 2.0
+        self.act_mid = (high + low) / 2.0
+
+    def init(self, key) -> dict:
+        kp, k1, k2 = jax.random.split(key, 3)
+        h = self.config.hidden
+        return {
+            "pi": _mlp_init(kp, (self.obs_dim, *h, 2 * self.act_dim),
+                            scale_last=0.01),
+            "q1": _mlp_init(k1, (self.obs_dim + self.act_dim, *h, 1),
+                            scale_last=1.0),
+            "q2": _mlp_init(k2, (self.obs_dim + self.act_dim, *h, 1),
+                            scale_last=1.0),
+        }
+
+    def _dist(self, params, obs):
+        obs = obs.reshape(obs.shape[0], -1)
+        out = _mlp_apply(params["pi"], obs, _act(self.config.activation))
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, self.LOG_STD_MIN, self.LOG_STD_MAX)
+        return mean, log_std
+
+    def sample_action(self, params, obs, key):
+        """Reparameterized squashed sample -> (env action, logp)."""
+        mean, log_std = self._dist(params, obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(key, mean.shape)
+        pre_tanh = mean + std * eps
+        squashed = jnp.tanh(pre_tanh)
+        # log prob with tanh change-of-variables (stable form).
+        logp = (-0.5 * (eps ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))).sum(-1)
+        logp -= (2.0 * (jnp.log(2.0) - pre_tanh
+                        - jax.nn.softplus(-2.0 * pre_tanh))).sum(-1)
+        action = squashed * self.act_scale + self.act_mid
+        return action, logp
+
+    def deterministic_action(self, params, obs):
+        mean, _ = self._dist(params, obs)
+        return jnp.tanh(mean) * self.act_scale + self.act_mid
+
+    def q_values(self, params, obs, action):
+        obs = obs.reshape(obs.shape[0], -1)
+        # Critics see normalized actions so scales don't skew the MLP.
+        norm_act = (action - self.act_mid) / self.act_scale
+        x = jnp.concatenate([obs, norm_act], axis=-1)
+        act = _act(self.config.activation)
+        q1 = _mlp_apply(params["q1"], x, act)[..., 0]
+        q2 = _mlp_apply(params["q2"], x, act)[..., 0]
+        return q1, q2
+
+
 def space_dims(obs_space, act_space) -> tuple[int, int]:
     obs_dim = int(np.prod(obs_space.shape))
     if hasattr(act_space, "n"):
         return obs_dim, int(act_space.n)
-    raise NotImplementedError(
-        f"only discrete action spaces in round 1, got {act_space}")
+    if hasattr(act_space, "shape"):  # Box: continuous dims
+        return obs_dim, int(np.prod(act_space.shape))
+    raise NotImplementedError(f"unsupported action space {act_space}")

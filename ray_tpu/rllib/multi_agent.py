@@ -1,0 +1,296 @@
+"""Multi-agent RL: MultiAgentEnv, per-policy runners, multi-policy PPO.
+
+Capability parity target: /root/reference/rllib/env/multi_agent_env.py
+(dict-keyed obs/action/reward spaces, "__all__" termination) and the
+multi-agent training path (policy_map + policy_mapping_fn in
+rllib/policy/policy_map.py and algorithm_config.multi_agent()): each
+agent is mapped to a policy; rollouts are bucketed per policy and each
+policy's learner updates on its own batch. Shared policies (many agents
+-> one policy_id) train on the union of their agents' experience.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from .env import make_env
+from .env_runner import compute_gae
+from .learner import LearnerGroup, PPOLearner
+from .models import DiscreteActorCritic, ModelConfig, space_dims
+
+
+class MultiAgentEnv:
+    """Base class (reference: rllib/env/multi_agent_env.py).
+
+    Contract:
+      - ``possible_agents``: list of agent ids.
+      - ``reset(seed=None) -> (obs_dict, info_dict)``
+      - ``step(action_dict) -> (obs, rewards, terminateds, truncateds,
+        infos)`` — all dicts keyed by agent id; ``terminateds["__all__"]``
+        ends the episode. Only agents present in ``obs`` act next step.
+      - ``observation_space(agent_id)`` / ``action_space(agent_id)``.
+    """
+
+    possible_agents: list = []
+
+    def reset(self, seed: Optional[int] = None):
+        raise NotImplementedError
+
+    def step(self, action_dict: dict):
+        raise NotImplementedError
+
+    def observation_space(self, agent_id):
+        raise NotImplementedError
+
+    def action_space(self, agent_id):
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class MultiAgentEnvRunner:
+    """Rollout collection over one MultiAgentEnv, bucketing per-agent
+    trajectories by policy (reference: env_runner sampling +
+    policy_mapping_fn routing)."""
+
+    def __init__(self, config: dict):
+        self.config = config
+        self.env: MultiAgentEnv = make_env(config["env"],
+                                           config.get("env_config"))
+        self.mapping: Callable = config["policy_mapping_fn"]
+        model_config = config.get("model_config") or ModelConfig()
+        seed = config.get("seed", 0) or 0
+        # One module per policy; dims from any agent mapped to it.
+        self.modules: Dict[str, DiscreteActorCritic] = {}
+        self.params: Dict[str, Any] = {}
+        for agent in self.env.possible_agents:
+            pid = self.mapping(agent)
+            if pid in self.modules:
+                continue
+            obs_dim, n_act = space_dims(self.env.observation_space(agent),
+                                        self.env.action_space(agent))
+            self.modules[pid] = DiscreteActorCritic(obs_dim, n_act,
+                                                    model_config)
+            self.params[pid] = self.modules[pid].init(
+                jax.random.key(seed + len(self.modules)))
+        self._key = jax.random.key(seed + 101)
+        self._obs, _ = self.env.reset(seed=seed)
+        self._episode_return = 0.0
+        self._completed: list = []
+
+    def set_state(self, params: dict):
+        self.params.update(params)
+        return True
+
+    def policy_specs(self) -> dict:
+        """policy_id -> (obs_dim, n_actions) for learner construction."""
+        return {pid: (m.obs_dim, m.n_actions)
+                for pid, m in self.modules.items()}
+
+    def sample(self, num_steps: int, gamma: float, lam: float) -> dict:
+        """Collect ``num_steps`` env steps; returns
+        {policy_id: flat train batch with advantages/value_targets}."""
+        # Per-agent open trajectory: lists of (obs, act, logp, value, rew).
+        traj: Dict[Any, dict] = {}
+
+        def open_traj(agent):
+            return {"obs": [], "actions": [], "logp": [], "values": [],
+                    "rewards": [], "dones": []}
+
+        finished: Dict[str, list] = {pid: [] for pid in self.modules}
+
+        def close_traj(agent, tr, bootstrap):
+            """Fragment/episode end: per-agent GAE over its own steps."""
+            if not tr["obs"]:
+                return
+            batch = {
+                "obs": np.asarray(tr["obs"], np.float32)[:, None],
+                "actions": np.asarray(tr["actions"])[:, None],
+                "logp": np.asarray(tr["logp"], np.float32)[:, None],
+                "values": np.asarray(tr["values"], np.float32)[:, None],
+                "rewards": np.asarray(tr["rewards"], np.float32)[:, None],
+                "dones": np.asarray(tr["dones"])[:, None],
+                "bootstrap_value": np.asarray([bootstrap], np.float32),
+            }
+            out = compute_gae(batch, gamma, lam)
+            flat = {k: v[:, 0] for k, v in out.items()
+                    if k != "bootstrap_value"}
+            finished[self.mapping(agent)].append(flat)
+
+        for _ in range(num_steps):
+            actions = {}
+            for agent, obs in self._obs.items():
+                pid = self.mapping(agent)
+                module = self.modules[pid]
+                self._key, k = jax.random.split(self._key)
+                a, logp, value = module.forward_exploration(
+                    self.params[pid],
+                    np.asarray(obs, np.float32)[None], k)
+                actions[agent] = int(a[0])
+                tr = traj.setdefault(agent, open_traj(agent))
+                tr["obs"].append(np.asarray(obs, np.float32))
+                tr["actions"].append(int(a[0]))
+                tr["logp"].append(float(logp[0]))
+                tr["values"].append(float(value[0]))
+            obs, rewards, terms, truncs, _ = self.env.step(actions)
+            # Every agent that ACTED gets a (possibly zero) reward entry —
+            # the reference contract allows envs to omit agents from the
+            # rewards dict, and a missing entry would misalign the
+            # trajectory arrays.
+            for agent in actions:
+                r = float(rewards.get(agent, 0.0))
+                done = bool(terms.get(agent) or truncs.get(agent)
+                            or terms.get("__all__")
+                            or truncs.get("__all__"))
+                traj[agent]["rewards"].append(r)
+                traj[agent]["dones"].append(done)
+                self._episode_return += r
+            if terms.get("__all__") or truncs.get("__all__"):
+                for agent, tr in traj.items():
+                    close_traj(agent, tr, 0.0)
+                traj.clear()
+                self._completed.append(self._episode_return)
+                self._episode_return = 0.0
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = obs
+        # Fragment end: bootstrap open trajectories with the current value.
+        for agent, tr in traj.items():
+            pid = self.mapping(agent)
+            if agent in self._obs:
+                v = float(self.modules[pid].value(
+                    self.params[pid],
+                    np.asarray(self._obs[agent], np.float32)[None])[0])
+            else:
+                v = 0.0
+            close_traj(agent, tr, v)
+
+        out = {}
+        for pid, parts in finished.items():
+            if parts:
+                out[pid] = {k: np.concatenate([p[k] for p in parts])
+                            for k in parts[0]}
+        return out
+
+    def episode_returns(self, clear: bool = True) -> list:
+        out = list(self._completed)
+        if clear:
+            self._completed.clear()
+        return out
+
+    def stop(self):
+        self.env.close()
+        return True
+
+
+class MultiAgentPPO:
+    """Multi-policy PPO driver (reference: PPO with
+    config.multi_agent(policies=..., policy_mapping_fn=...)): one
+    PPOLearner per policy, each updating on its agents' experience."""
+
+    def __init__(self, config):
+        import collections
+
+        self.config = config
+        self.iteration = 0
+        self._episode_returns = collections.deque(maxlen=100)
+        self._num_episodes = 0
+        runner_cfg = {
+            "env": config.env,
+            "env_config": config.env_config,
+            "policy_mapping_fn": config.policy_mapping_fn,
+            "model_config": config.model_config,
+            "seed": config.seed,
+        }
+        self.local_runner = MultiAgentEnvRunner(runner_cfg)
+        self.remote_runners = []
+        if config.num_env_runners > 0:
+            import ray_tpu
+
+            cls = ray_tpu.remote(MultiAgentEnvRunner)
+            self.remote_runners = [
+                cls.options(num_cpus=1).remote(
+                    {**runner_cfg, "seed": (config.seed or 0) + 1000 * (i + 1)})
+                for i in range(config.num_env_runners)]
+        self.learners: Dict[str, LearnerGroup] = {}
+        for idx, (pid, (obs_dim, n_act)) in enumerate(
+                self.local_runner.policy_specs().items()):
+            module = DiscreteActorCritic(obs_dim, n_act,
+                                         config.model_config)
+            # Per-policy seed offset: same-shaped policies must NOT start
+            # from identical weights (self-play symmetry lock-in).
+            self.learners[pid] = LearnerGroup(PPOLearner(
+                module, clip_param=config.clip_param,
+                vf_coeff=config.vf_coeff,
+                entropy_coeff=config.entropy_coeff,
+                lr=config.lr, grad_clip=config.grad_clip,
+                seed=(config.seed or 0) + 13 * idx))
+        self._sync_weights()
+
+    def _sync_weights(self):
+        weights = {pid: lg.get_weights()
+                   for pid, lg in self.learners.items()}
+        self.local_runner.set_state(weights)
+        if self.remote_runners:
+            import ray_tpu
+
+            ray_tpu.get([r.set_state.remote(weights)
+                         for r in self.remote_runners])
+
+    def train(self) -> dict:
+        cfg = self.config
+        steps = max(1, cfg.train_batch_size)
+        if self.remote_runners:
+            import ray_tpu
+
+            per = max(1, steps // len(self.remote_runners))
+            batches = ray_tpu.get(
+                [r.sample.remote(per, cfg.gamma, cfg.lambda_)
+                 for r in self.remote_runners])
+            for rets in ray_tpu.get([r.episode_returns.remote()
+                                     for r in self.remote_runners]):
+                self._episode_returns.extend(rets)
+                self._num_episodes += len(rets)
+        else:
+            batches = [self.local_runner.sample(steps, cfg.gamma,
+                                                cfg.lambda_)]
+            rets = self.local_runner.episode_returns()
+            self._episode_returns.extend(rets)
+            self._num_episodes += len(rets)
+
+        metrics: dict = {}
+        for pid, lg in self.learners.items():
+            parts = [b[pid] for b in batches if pid in b]
+            if not parts:
+                continue
+            batch = {k: np.concatenate([p[k] for p in parts])
+                     for k in parts[0]}
+            m = lg.update_from_batch(
+                batch, minibatch_size=cfg.minibatch_size,
+                num_epochs=cfg.num_epochs,
+                shuffle_key=(cfg.seed or 0) + self.iteration)
+            metrics.update({f"{pid}/{k}": v for k, v in m.items()})
+        self._sync_weights()
+        self.iteration += 1
+        window = list(self._episode_returns)
+        metrics["training_iteration"] = self.iteration
+        metrics["episode_return_mean"] = (
+            float(np.mean(window)) if window else float("nan"))
+        metrics["num_episodes"] = self._num_episodes
+        return metrics
+
+    def stop(self):
+        self.local_runner.stop()
+        if self.remote_runners:
+            import ray_tpu
+
+            for r in self.remote_runners:
+                try:
+                    r.stop.remote()
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass

@@ -1,0 +1,230 @@
+"""SAC: soft actor-critic for continuous control.
+
+Capability parity target: /root/reference/rllib/algorithms/sac/
+(sac.py config surface, sac_torch_policy.py losses: twin-Q TD with a
+polyak-averaged target critic, reparameterized squashed-Gaussian actor,
+automatic entropy-temperature tuning against a target entropy).
+
+TPU-native shape: all three updates (critic, actor, alpha) and the
+polyak target move are ONE jitted function — no per-net Python steps;
+replay batches are the only host<->device traffic.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .algorithm import Algorithm
+from .learner import LearnerGroup
+from .models import SquashedGaussianActorTwinQ, space_dims
+from .replay import ReplayBuffer
+
+
+class SACLearner:
+    """Owns actor/critic/log-alpha params, their optimizers, and the
+    target critic. The Learner base class assumes one loss over one
+    params tree; SAC's three coupled objectives get their own update."""
+
+    def __init__(self, module: SquashedGaussianActorTwinQ, *,
+                 gamma: float = 0.99, tau: float = 0.005,
+                 lr: float = 3e-4, target_entropy=None, seed: int = 0):
+        self.module = module
+        self.gamma = gamma
+        self.tau = tau
+        self.target_entropy = (-float(module.act_dim)
+                               if target_entropy is None
+                               else float(target_entropy))
+        params = module.init(jax.random.key(seed))
+        self.state = {
+            "actor": {"pi": params["pi"]},
+            "critic": {"q1": params["q1"], "q2": params["q2"]},
+            "target_critic": jax.tree_util.tree_map(
+                jnp.copy, {"q1": params["q1"], "q2": params["q2"]}),
+            "log_alpha": jnp.zeros(()),
+        }
+        self.tx_actor = optax.adam(lr)
+        self.tx_critic = optax.adam(lr)
+        self.tx_alpha = optax.adam(lr)
+        self.opt = {
+            "actor": self.tx_actor.init(self.state["actor"]),
+            "critic": self.tx_critic.init(self.state["critic"]),
+            "alpha": self.tx_alpha.init(self.state["log_alpha"]),
+        }
+        self._update_fn = jax.jit(self._update)
+        self._key = jax.random.key(seed + 1)
+
+    # -- one fused update ---------------------------------------------------
+    def _q_params(self, critic):
+        return {"pi": self.state["actor"]["pi"], **critic}
+
+    def _update(self, state, opt, batch, key):
+        m = self.module
+        k_next, k_pi = jax.random.split(key)
+
+        def full(actor, critic):
+            return {**actor, **critic}
+
+        # Critic: soft Bellman target from the frozen target twin-Q.
+        def critic_loss(critic):
+            next_act, next_logp = m.sample_action(
+                full(state["actor"], critic), batch["next_obs"], k_next)
+            tq1, tq2 = m.q_values(
+                full(state["actor"], state["target_critic"]),
+                batch["next_obs"], next_act)
+            alpha = jnp.exp(state["log_alpha"])
+            next_q = jnp.minimum(tq1, tq2) - alpha * next_logp
+            nonterminal = 1.0 - batch["dones"].astype(jnp.float32)
+            target = jax.lax.stop_gradient(
+                batch["rewards"] + self.gamma * nonterminal * next_q)
+            q1, q2 = m.q_values(full(state["actor"], critic),
+                                batch["obs"], batch["actions"])
+            loss = ((q1 - target) ** 2).mean() + ((q2 - target) ** 2).mean()
+            return loss, (q1.mean(),)
+
+        (c_loss, (q_mean,)), c_grads = jax.value_and_grad(
+            critic_loss, has_aux=True)(state["critic"])
+        c_updates, opt_critic = self.tx_critic.update(
+            c_grads, opt["critic"], state["critic"])
+        critic = optax.apply_updates(state["critic"], c_updates)
+
+        # Actor: maximize min-Q of reparameterized actions minus entropy
+        # cost (fresh critic, frozen for the actor step).
+        def actor_loss(actor):
+            act, logp = m.sample_action(full(actor, critic),
+                                        batch["obs"], k_pi)
+            q1, q2 = m.q_values(full(actor, critic), batch["obs"], act)
+            alpha = jax.lax.stop_gradient(jnp.exp(state["log_alpha"]))
+            return (alpha * logp - jnp.minimum(q1, q2)).mean(), logp.mean()
+
+        (a_loss, logp_mean), a_grads = jax.value_and_grad(
+            actor_loss, has_aux=True)(state["actor"])
+        a_updates, opt_actor = self.tx_actor.update(
+            a_grads, opt["actor"], state["actor"])
+        actor = optax.apply_updates(state["actor"], a_updates)
+
+        # Temperature: drive policy entropy toward the target.
+        def alpha_loss(log_alpha):
+            return -(log_alpha * jax.lax.stop_gradient(
+                logp_mean + self.target_entropy))
+
+        al_loss, al_grad = jax.value_and_grad(alpha_loss)(
+            state["log_alpha"])
+        al_updates, opt_alpha = self.tx_alpha.update(
+            al_grad, opt["alpha"], state["log_alpha"])
+        log_alpha = optax.apply_updates(state["log_alpha"], al_updates)
+
+        # Polyak target move.
+        target_critic = jax.tree_util.tree_map(
+            lambda t, o: (1 - self.tau) * t + self.tau * o,
+            state["target_critic"], critic)
+
+        new_state = {"actor": actor, "critic": critic,
+                     "target_critic": target_critic,
+                     "log_alpha": log_alpha}
+        new_opt = {"actor": opt_actor, "critic": opt_critic,
+                   "alpha": opt_alpha}
+        metrics = {"critic_loss": c_loss, "actor_loss": a_loss,
+                   "alpha_loss": al_loss,
+                   "alpha": jnp.exp(log_alpha),
+                   "q_mean": q_mean, "logp_mean": logp_mean}
+        return new_state, new_opt, metrics
+
+    def update_from_batch(self, batch: dict) -> dict:
+        batch = {k: jnp.asarray(v) for k, v in batch.items()
+                 if k in ("obs", "actions", "rewards", "next_obs", "dones")}
+        self._key, sub = jax.random.split(self._key)
+        self.state, self.opt, metrics = self._update_fn(
+            self.state, self.opt, batch, sub)
+        return {k: float(v) for k, v in metrics.items()}
+
+    # -- weight/checkpoint surface (Algorithm parity) -----------------------
+    def get_state(self):
+        return {**self.state["actor"], **self.state["critic"]}
+
+    def set_state(self, params):
+        self.state["actor"] = {"pi": params["pi"]}
+        self.state["critic"] = {"q1": params["q1"], "q2": params["q2"]}
+
+    def get_full_state(self) -> dict:
+        return {"state": self.state, "opt": self.opt}
+
+    def set_full_state(self, full: dict):
+        self.state = full["state"]
+        self.opt = full["opt"]
+
+
+class SAC(Algorithm):
+    """Replay-driven continuous control (reference: sac.py's
+    training_step — sample env, store, train on replay)."""
+
+    def _make_module(self):
+        vec = self.local_runner.vec
+        obs_space = vec.single_observation_space
+        act_space = vec.single_action_space
+        if hasattr(act_space, "n"):
+            raise ValueError("SAC needs a continuous (Box) action space")
+        obs_dim, act_dim = space_dims(obs_space, act_space)
+        return SquashedGaussianActorTwinQ(
+            obs_dim, act_dim, act_space.low, act_space.high)
+
+    def _make_learner_group(self):
+        learner = SACLearner(
+            self._make_module(),
+            gamma=self.config.gamma,
+            tau=self.config.tau,
+            lr=self.config.lr,
+            target_entropy=self.config.target_entropy,
+            seed=self.config.seed or 0,
+        )
+        return LearnerGroup(learner)
+
+    def setup(self, config):
+        if config.num_env_runners > 0:
+            raise ValueError(
+                "SAC samples from its local runner (replay dominates) — "
+                "set num_env_runners=0")
+        super().setup(config)
+        self.buffer = ReplayBuffer(config.replay_buffer_capacity,
+                                   seed=config.seed)
+        self._env_steps = 0
+        self._act_key = jax.random.key((config.seed or 0) + 7)
+        self._warmup_rng = np.random.default_rng((config.seed or 0) + 11)
+
+    def _sync_weights(self):
+        pass  # the local runner's discrete-policy params are unused
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        runner = self.local_runner
+        learner = self.learner_group.learner
+        module = learner.module
+
+        def policy(obs):
+            if self._env_steps < cfg.learning_starts:
+                # Uniform warmup (reference: initial random exploration).
+                return self._warmup_rng.uniform(
+                    module.act_mid - module.act_scale,
+                    module.act_mid + module.act_scale,
+                    (len(obs), module.act_dim)).astype(np.float32)
+            self._act_key, sub = jax.random.split(self._act_key)
+            act, _ = module.sample_action(
+                {**learner.state["actor"], **learner.state["critic"]},
+                jnp.asarray(obs), sub)
+            return np.asarray(act)
+
+        transitions = runner.rollout_transitions(
+            cfg.rollout_fragment_length, policy)
+        self.buffer.add_batch(**transitions)
+        self._env_steps += len(transitions["obs"])
+        self._record_episodes(runner.episode_returns())
+
+        metrics = {"buffer_size": len(self.buffer)}
+        if self._env_steps >= cfg.learning_starts:
+            for _ in range(cfg.num_epochs):
+                metrics.update(learner.update_from_batch(
+                    self.buffer.sample(cfg.train_batch_size)))
+        metrics["num_env_steps_sampled"] = self._env_steps
+        return metrics

@@ -174,10 +174,41 @@ class _Storage:
 # ---------------------------------------------------------------------------
 # Execution
 # ---------------------------------------------------------------------------
-def _execute_node(node: StepNode, storage: _Storage) -> Any:
+def _execute_node(node: StepNode, storage: _Storage,
+                  inflight: Optional[dict] = None) -> Any:
     """Depth-first checkpointed execution. Completed steps restore from
-    their checkpoint instead of re-running (reference:
-    workflow_storage's step-output recovery)."""
+    their checkpoint instead of re-running (reference: workflow_storage's
+    step-output recovery). ``inflight`` (step_id -> Future, shared across
+    this run's branch threads) dedups a node referenced by several
+    branches: exactly one thread executes it, the others wait on its
+    future — without it a shared non-idempotent step would run once per
+    branch."""
+    import ray_tpu
+
+    if inflight is not None:
+        from concurrent.futures import Future
+
+        with _INFLIGHT_LOCK:
+            existing = inflight.get(node.step_id)
+            if existing is None:
+                inflight[node.step_id] = Future()
+        if existing is not None:
+            return existing.result()
+        try:
+            value = _execute_node_inner(node, storage, inflight)
+            inflight[node.step_id].set_result(value)
+            return value
+        except BaseException as e:
+            inflight[node.step_id].set_exception(e)
+            raise
+    return _execute_node_inner(node, storage, inflight)
+
+
+_INFLIGHT_LOCK = __import__("threading").Lock()
+
+
+def _execute_node_inner(node: StepNode, storage: _Storage,
+                        inflight: Optional[dict]) -> Any:
     import ray_tpu
 
     hit, value = storage.restore(node.step_id)
@@ -185,7 +216,7 @@ def _execute_node(node: StepNode, storage: _Storage) -> Any:
         # A checkpointed continuation re-enters execution (its own steps
         # may or may not be checkpointed yet).
         if isinstance(value, StepNode):
-            return _execute_node(value, storage)
+            return _execute_node(value, storage, inflight)
         return value
 
     # Sibling dependencies run CONCURRENTLY (each on its own thread, the
@@ -199,7 +230,8 @@ def _execute_node(node: StepNode, storage: _Storage) -> Any:
         from concurrent.futures import ThreadPoolExecutor
 
         with ThreadPoolExecutor(max_workers=len(step_deps)) as pool:
-            futs = {d.step_id: pool.submit(_execute_node, d, storage)
+            futs = {d.step_id: pool.submit(_execute_node, d, storage,
+                                           inflight)
                     for d in step_deps}
             resolved = {sid: f.result() for sid, f in futs.items()}
 
@@ -208,7 +240,7 @@ def _execute_node(node: StepNode, storage: _Storage) -> Any:
             return v
         if v.step_id in resolved:
             return resolved[v.step_id]
-        return _execute_node(v, storage)
+        return _execute_node(v, storage, inflight)
 
     args = [resolve(a) for a in node.args]
     kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
@@ -236,7 +268,7 @@ def _execute_node(node: StepNode, storage: _Storage) -> Any:
     storage.checkpoint(node.step_id, value)
     if isinstance(value, StepNode):
         # Continuation: the step dynamically returned more work.
-        return _execute_node(value, storage)
+        return _execute_node(value, storage, inflight)
     return value
 
 
@@ -257,7 +289,7 @@ def run(entry: Optional[StepNode], workflow_id: Optional[str] = None) -> Any:
         storage.create(entry)
     storage.set_status(RUNNING)
     try:
-        value = _execute_node(entry, storage)
+        value = _execute_node(entry, storage, inflight={})
     except BaseException as e:
         storage.set_status(
             RESUMABLE if not isinstance(e, WorkflowError) else FAILED,

@@ -68,6 +68,37 @@ def test_diamond_dag(wf):
                   workflow_id="dia") == (4, 30)
 
 
+def test_shared_step_executes_once(wf, tmp_path):
+    """A node referenced by two branches runs exactly once even though
+    the branches execute concurrently (in-flight dedup)."""
+    marker = str(tmp_path / "shared_ran")
+
+    @wf.step
+    def shared(m):
+        import time as _t
+        with open(m, "a") as f:
+            f.write("x")
+        _t.sleep(0.3)  # widen the race window
+        return 5
+
+    @wf.step
+    def left(x):
+        return x + 1
+
+    @wf.step
+    def right(x):
+        return x + 2
+
+    @wf.step
+    def join(a, b):
+        return a * b
+
+    s = shared.step(marker)
+    assert wf.run(join.step(left.step(s), right.step(s)),
+                  workflow_id="shared") == 42
+    assert _count(marker) == 1
+
+
 def test_checkpoints_skip_completed_steps(wf, tmp_path):
     marker = str(tmp_path / "ran")
 
