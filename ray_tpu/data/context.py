@@ -17,6 +17,9 @@ class DataContext:
     # "cpu" -> subprocess workers (production); "device" -> in-process
     # threads (tests / small data: avoids ~2.5s worker forks).
     execution_lane: str = "cpu"
+    # Reduce-partition count for random_shuffle (None => one per input
+    # block; reference: push-based shuffle's reducer parallelism knob).
+    shuffle_num_partitions: int | None = None
 
     _current = None
 

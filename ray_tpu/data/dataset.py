@@ -78,6 +78,74 @@ def _fuse(stages: list[_Stage]) -> Callable[[B.Block], B.Block]:
     return apply
 
 
+# ---------------------------------------------------------------------------
+# Exchange task bodies (run as remote tasks; refs resolve to block values)
+# ---------------------------------------------------------------------------
+def _gather_spans(spans, *blocks):
+    """Assemble one output block from (lo, hi) row spans of the inputs."""
+    import ray_tpu.data.block as B
+
+    return B.concat_blocks(
+        [B.slice_block(blk, lo, hi) for (lo, hi), blk in zip(spans, blocks)])
+
+
+def _shuffle_map(blk, P, seed, block_index):
+    """Randomly scatter a block's rows into P partitions."""
+    import numpy as np
+
+    import ray_tpu.data.block as B
+
+    n = B.block_len(blk)
+    rng = np.random.default_rng((seed, block_index))
+    assign = rng.integers(0, P, n)
+    parts = tuple({k: v[assign == r] for k, v in blk.items()}
+                  for r in range(P))
+    return parts[0] if P == 1 else parts
+
+
+def _shuffle_reduce(seed, r, *parts):
+    """Concat one partition column and locally permute it."""
+    import numpy as np
+
+    import ray_tpu.data.block as B
+
+    blk = B.concat_blocks(list(parts))
+    n = B.block_len(blk)
+    if n == 0:
+        return {}
+    perm = np.random.default_rng((seed, 1_000_003, r)).permutation(n)
+    return {k: v[perm] for k, v in blk.items()}
+
+
+def _sort_map(blk, key, splitters):
+    """Range-partition a block by key against the splitters."""
+    import numpy as np
+
+    import ray_tpu.data.block as B
+
+    P = len(splitters) + 1
+    if P == 1:
+        return blk
+    bucket = np.searchsorted(splitters, blk[key], side="right")
+    return tuple({k: v[bucket == r] for k, v in blk.items()}
+                 for r in range(P))
+
+
+def _sort_reduce(key, descending, *parts):
+    """Sort one key range locally."""
+    import numpy as np
+
+    import ray_tpu.data.block as B
+
+    blk = B.concat_blocks(list(parts))
+    if not B.block_len(blk):
+        return {}
+    order = np.argsort(blk[key], kind="stable")
+    if descending:
+        order = order[::-1]
+    return {k: v[order] for k, v in blk.items()}
+
+
 def _remote_opts():
     ctx = DataContext.get_current()
     if ctx.execution_lane == "device":
@@ -134,63 +202,149 @@ class Dataset:
         return Dataset(source)
 
     # -- all-to-all (materializing) ---------------------------------------
+    def _stage_refs(self, sample_key: Optional[str] = None,
+                    samples_per_block: int = 64):
+        """Stage this dataset's blocks into the object store one at a
+        time (the driver never holds more than one block), returning
+        (refs, lens[, key samples]) — the input side of every exchange."""
+        import ray_tpu
+
+        refs, lens, samples = [], [], []
+        rng = np.random.default_rng(0)
+        for blk in self.iter_blocks():
+            refs.append(ray_tpu.put(blk))
+            lens.append(B.block_len(blk))
+            if sample_key is not None:
+                col = blk[sample_key]
+                take = min(len(col), samples_per_block)
+                samples.append(rng.choice(col, take, replace=False))
+        if sample_key is not None:
+            return refs, lens, samples
+        return refs, lens
+
     def repartition(self, num_blocks: int) -> "Dataset":
+        """Distributed: inputs are staged as object refs and each output
+        block is assembled by a remote gather task over the refs spanning
+        its row range — nothing concatenates in the driver (reference:
+        the all-to-all repartition exchange under
+        _internal/planner/exchange/)."""
         parent = self
 
         def source():
-            full = B.concat_blocks(list(parent.iter_blocks()))
-            n = B.block_len(full)
-            if n == 0:
+            import ray_tpu
+
+            refs, lens = parent._stage_refs()
+            total = sum(lens)
+            if total == 0:
                 return
-            # Balanced sizes: first (n % num_blocks) blocks get one extra
-            # row, so exactly num_blocks blocks whenever n >= num_blocks.
-            base, extra = divmod(n, num_blocks)
-            start = 0
+            offsets = np.cumsum([0] + lens)
+            gather = ray_tpu.remote(**_remote_opts())(_gather_spans)
+            base, extra = divmod(total, num_blocks)
+            pending, start = [], 0
             for i in builtins.range(num_blocks):
                 size = base + (1 if i < extra else 0)
                 if size == 0:
                     continue
-                yield B.slice_block(full, start, start + size)
-                start += size
+                stop = start + size
+                spans = []
+                for j in builtins.range(len(refs)):
+                    lo, hi = int(offsets[j]), int(offsets[j + 1])
+                    if hi <= start or lo >= stop:
+                        continue
+                    spans.append((j, max(start, lo) - lo,
+                                  min(stop, hi) - lo))
+                pending.append(gather.remote(
+                    [(s[1], s[2]) for s in spans],
+                    *[refs[s[0]] for s in spans]))
+                start = stop
+            for ref in pending:
+                yield ray_tpu.get(ref)
 
         return Dataset(source)
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        """Distributed map/reduce shuffle (reference: push_based_shuffle,
+        python/ray/data/_internal/planner/exchange/push_based_shuffle...):
+        map tasks split each input block into P random partitions
+        (num_returns=P refs), reduce tasks concat their column of parts
+        and locally permute — peak memory per task is O(rows/P), and the
+        exchange rides the object plane, not the driver."""
         parent = self
         # Pin the seed at graph-construction time: shards from
-        # streaming_split re-execute the pipeline independently, and they
-        # must all observe the SAME permutation.
+        # streaming_split and re-executions must all observe the SAME
+        # permutation.
         if seed is None:
             seed = int(np.random.default_rng().integers(2 ** 31))
 
         def source():
-            blocks = list(parent.iter_blocks())
-            full = B.concat_blocks(blocks)
-            n = B.block_len(full)
-            if n == 0:
+            import ray_tpu
+
+            refs, _lens = parent._stage_refs()
+            if not refs:
                 return
-            rng = np.random.default_rng(seed)
-            perm = rng.permutation(n)
-            full = {k: v[perm] for k, v in full.items()}
-            nblocks = max(1, len(blocks))
-            per = -(-n // nblocks)
-            for i in builtins.range(nblocks):
-                yield B.slice_block(full, i * per, min((i + 1) * per, n))
+            ctx = DataContext.get_current()
+            P = max(1, ctx.shuffle_num_partitions or len(refs))
+            opts = _remote_opts()
+            mapper = ray_tpu.remote(num_returns=P, **opts)(_shuffle_map)
+            cols = [[] for _ in builtins.range(P)]
+            for m, ref in enumerate(refs):
+                out = mapper.remote(ref, P, seed, m)
+                if P == 1:
+                    out = [out]
+                for r in builtins.range(P):
+                    cols[r].append(out[r])
+            reducer = ray_tpu.remote(**opts)(_shuffle_reduce)
+            pending = [reducer.remote(seed, r, *cols[r])
+                       for r in builtins.range(P)]
+            for ref in pending:
+                blk = ray_tpu.get(ref)
+                if B.block_len(blk):
+                    yield blk
 
         return Dataset(source)
 
     def sort(self, key: str, *, descending: bool = False) -> "Dataset":
+        """Distributed sample-partitioned sort (reference: the sort
+        exchange, _internal/planner/exchange/sort_task_spec.py): the
+        driver picks range splitters from per-block samples, map tasks
+        range-partition each block, reduce tasks sort their range —
+        outputs stream back in global key order."""
         parent = self
 
         def source():
-            blocks = list(parent.iter_blocks())
-            full = B.concat_blocks(blocks)
-            if not B.block_len(full):
+            import ray_tpu
+
+            refs, _lens, samples = parent._stage_refs(sample_key=key)
+            if not refs:
                 return
-            order = np.argsort(full[key], kind="stable")
+            sample = np.concatenate(samples) if samples else np.array([])
+            P = max(1, len(refs))
+            if P > 1 and len(sample):
+                qs = np.linspace(0, 100, P + 1)[1:-1]
+                splitters = np.percentile(np.sort(sample), qs,
+                                          method="nearest")
+                splitters = np.unique(splitters)
+            else:
+                splitters = np.array([])
+            P = len(splitters) + 1  # degenerate key ranges collapse
+            opts = _remote_opts()
+            mapper = ray_tpu.remote(num_returns=P, **opts)(_sort_map)
+            cols = [[] for _ in builtins.range(P)]
+            for ref in refs:
+                out = mapper.remote(ref, key, splitters)
+                if P == 1:
+                    out = [out]
+                for r in builtins.range(P):
+                    cols[r].append(out[r])
+            reducer = ray_tpu.remote(**opts)(_sort_reduce)
+            pending = [reducer.remote(key, descending, *cols[r])
+                       for r in builtins.range(P)]
             if descending:
-                order = order[::-1]
-            yield {k: v[order] for k, v in full.items()}
+                pending.reverse()
+            for ref in pending:
+                blk = ray_tpu.get(ref)
+                if B.block_len(blk):
+                    yield blk
 
         return Dataset(source)
 
@@ -320,10 +474,20 @@ class Dataset:
         return [Dataset(lambda bs=bs: iter(bs)) for bs in out]
 
     def streaming_split(self, n: int) -> list["DatasetShard"]:
-        """Per-worker shards that stream round-robin slices of this dataset
-        (parity: /root/reference/python/ray/data/dataset.py streaming_split
-        feeding train workers)."""
-        return [DatasetShard(self, rank, n) for rank in builtins.range(n)]
+        """Per-worker shards fed by ONE shared pipeline execution: a
+        coordinator actor runs the dataset once per epoch and routes
+        blocks round-robin to the shards (parity:
+        /root/reference/python/ray/data/dataset.py streaming_split with
+        its SplitCoordinator — the shards observe disjoint slices of one
+        pass, instead of N shards re-executing the pipeline N times).
+        Epochs are coordinated: when every shard has drained the current
+        pass, the next iteration restarts the pipeline."""
+        import ray_tpu
+
+        coord = ray_tpu.remote(_SplitCoordinator).options(
+            num_cpus=0, max_concurrency=2 * n + 2).remote(self, n)
+        return [DatasetShard(self, rank, n, coordinator=coord)
+                for rank in builtins.range(n)]
 
     # -- IO ----------------------------------------------------------------
     def write_parquet(self, path: str):
@@ -340,15 +504,79 @@ class Dataset:
         return f"Dataset(stages={len(self._stages)})"
 
 
-class DatasetShard:
-    """A rank's view of a dataset: streams every n-th block."""
+class _SplitCoordinator:
+    """Owns one execution of the pipeline per epoch and hands its blocks
+    to whichever consumer asks next (reference: the streaming_split
+    coordinator actor / output splitter). Direct hand-off — no per-rank
+    buffering — so coordinator memory is O(1 block) regardless of
+    consumption skew; block distribution follows consumption rate while
+    shards always observe DISJOINT slices of one pass. Consumers that
+    finish an epoch early wait until every rank drains (or abandons)
+    before the next epoch starts; a rank that abandons a partially
+    consumed iterator and re-iterates implicitly finishes its old epoch
+    instead of deadlocking the barrier."""
 
-    def __init__(self, parent: Dataset, rank: int, world: int):
+    def __init__(self, dataset, n: int):
+        import threading
+
+        self._dataset = dataset
+        self._n = n
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._finished: set = set()  # ranks that saw this pass's end
+        self._it = None
+        self._done = False
+
+    def next_block(self, rank: int):
+        """Next block for `rank`, or None when the current pass ends for
+        it. A rank that already saw the end waits at the barrier until
+        every other rank drains, then joins the next pass; a rank that
+        abandoned a partial iterator simply rejoins the current pass."""
+        with self._cond:
+            while rank in self._finished:
+                # Wants the next pass; barrier until all ranks drain.
+                if len(self._finished) == self._n:
+                    self._finished.clear()
+                    self._it = None
+                    self._done = False
+                    self._cond.notify_all()
+                    break
+                self._cond.wait(timeout=5.0)
+            if self._it is None and not self._done:
+                self._it = self._dataset.iter_blocks()
+            if not self._done:
+                try:
+                    return next(self._it)
+                except StopIteration:
+                    self._done = True
+            self._finished.add(rank)
+            if len(self._finished) == self._n:
+                self._cond.notify_all()
+            return None
+
+
+class DatasetShard:
+    """A rank's view of a dataset. Coordinator-backed shards (from
+    streaming_split) consume disjoint slices of one shared execution;
+    the plain form streams every n-th block of its own execution."""
+
+    def __init__(self, parent: Dataset, rank: int, world: int,
+                 coordinator=None):
         self._parent = parent
         self._rank = rank
         self._world = world
+        self._coordinator = coordinator
 
     def iter_blocks(self):
+        if self._coordinator is not None:
+            import ray_tpu
+
+            while True:
+                blk = ray_tpu.get(self._coordinator.next_block.remote(
+                    self._rank))
+                if blk is None:
+                    return
+                yield blk
         for i, blk in enumerate(self._parent.iter_blocks()):
             if i % self._world == self._rank:
                 yield blk

@@ -57,9 +57,6 @@ class SACLearner:
         self._key = jax.random.key(seed + 1)
 
     # -- one fused update ---------------------------------------------------
-    def _q_params(self, critic):
-        return {"pi": self.state["actor"]["pi"], **critic}
-
     def _update(self, state, opt, batch, key):
         m = self.module
         k_next, k_pi = jax.random.split(key)

@@ -183,8 +183,6 @@ def _execute_node(node: StepNode, storage: _Storage,
     branches: exactly one thread executes it, the others wait on its
     future — without it a shared non-idempotent step would run once per
     branch."""
-    import ray_tpu
-
     if inflight is not None:
         from concurrent.futures import Future
 
