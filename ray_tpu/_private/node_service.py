@@ -225,6 +225,8 @@ class NodeService:
         # dead workers' counters fold into the retired accumulator.
         self.user_metrics: dict[str, dict] = {}
         self._retired_metrics: dict[tuple, dict] = {}
+        # Trace spans pushed by workers (bounded; tracing is opt-in).
+        self.trace_spans: collections.deque = collections.deque(maxlen=10_000)
         self.pending_cpu: collections.deque[TaskSpec] = collections.deque()
         self.cancelled: set[TaskID] = set()
 
@@ -370,6 +372,7 @@ class NodeService:
                  "actor_id": w.actor_id.hex() if w.actor_id else None,
                  "node_id": self.node_id.hex()}
                 for w in self.workers.values()],
+            "spans": lambda: list(self.trace_spans),
         }
         for key, build in full.items():
             if want is None or key in want:
@@ -1166,6 +1169,7 @@ class NodeService:
             "method_name": spec.method_name,
             "actor_id": spec.actor_id.binary() if spec.actor_id else None,
             "is_actor_creation": spec.is_actor_creation,
+            "trace_ctx": spec.trace_ctx,
         }
 
     def _handle_task_reply(self, spec: TaskSpec, reply: dict):
@@ -1253,15 +1257,33 @@ class NodeService:
             from . import worker as worker_mod
 
             tok = worker_mod._running_task.set(spec.task_id)
+            tracer = None
+            if spec.trace_ctx is not None:
+                from ray_tpu.util import tracing
+
+                tracer = tracing.span(f"task::{spec.name}::execute",
+                                      attributes={"lane": "device"},
+                                      ctx=spec.trace_ctx)
+                tracer.__enter__()
             try:
                 if instance is not None:
                     method = getattr(instance, spec.method_name)
                     return (True, method(*args, **kwargs))
                 return (True, fn(*args, **kwargs))
             except BaseException as e:  # noqa: BLE001
+                if tracer is not None:
+                    tracer.attributes["error"] = f"{type(e).__name__}: {e}"
                 return (False, TaskError.from_exception(e, spec.name))
             finally:
                 worker_mod._running_task.reset(tok)
+                if tracer is not None:
+                    tracer.__exit__(None, None, None)
+                    # The node process is not a worker: route its spans
+                    # into the node table itself so multi-node traces
+                    # include device-lane work.
+                    from ray_tpu.util import tracing
+
+                    self.trace_spans.extend(tracing.drain_local_spans())
 
         self._event(spec, "RUNNING", worker="device")
         fut = (pool or self.device_pool).submit(run)
@@ -1662,6 +1684,8 @@ class NodeService:
                                payload: Any):
         if method == "remote_execute":
             return await self._remote_execute(payload)
+        if method == "stacks":
+            return await self.collect_stacks()
         if method == "fetch_object":
             oid = ObjectID(payload["oid"])
             st = await self.wait_object(oid, payload.get("timeout"))
@@ -2048,6 +2072,30 @@ class NodeService:
     # placement decision lives in the head, gcs_placement_group_scheduler
     # equivalent; this node just sets resources aside)
     # ------------------------------------------------------------------
+    async def collect_stacks(self) -> dict:
+        """Stacks of this node's process and its live workers, keyed by
+        'node:<id>' / 'worker:<pid>' (reference: `ray stack`). Worker
+        queries run CONCURRENTLY so N hung workers cost one 5s timeout,
+        not N."""
+        from .stack_dump import format_stacks
+
+        out = {f"node:{self.node_id.hex()[:12]}": format_stacks()}
+        targets = [w for w in self.workers.values()
+                   if w.state in ("IDLE", "BUSY") and w.conn is not None
+                   and w.conn.alive]
+
+        async def ask(w):
+            try:
+                return await asyncio.wait_for(
+                    w.conn.call("stack_dump", None), timeout=5)
+            except Exception as e:  # noqa: BLE001 - best effort
+                return f"<unavailable: {e}>"
+
+        dumps = await asyncio.gather(*(ask(w) for w in targets))
+        for w, text in zip(targets, dumps):
+            out[f"worker:{w.proc.pid}"] = text
+        return out
+
     def directory_sync(self) -> dict:
         """What this node contributes to the head's directory tables on
         (re-)registration: live named actors, homes of actors it hosts,
@@ -2163,6 +2211,10 @@ class NodeService:
             # Cumulative user-metric snapshot from a worker process
             # (reference: worker -> per-node metrics agent, reporter.proto).
             self.user_metrics[payload["source"]] = payload["snapshot"]
+            return True
+
+        if method == "spans_push":
+            self.trace_spans.extend(payload)
             return True
 
         if method == "fetch_object":

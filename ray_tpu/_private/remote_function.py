@@ -129,7 +129,14 @@ class RemoteFunction:
             runtime_env=ctx.resolve_runtime_env(self._runtime_env,
                                                 device_lane=device),
         )
-        refs = ctx.submit_spec(spec)
+        from ray_tpu.util import tracing
+
+        if tracing.tracing_enabled():
+            with tracing.span(f"task::{self._name}::submit") as sp:
+                spec.trace_ctx = sp.context()
+                refs = ctx.submit_spec(spec)
+        else:
+            refs = ctx.submit_spec(spec)
         return refs[0] if self._num_returns == 1 else refs
 
     def __call__(self, *args, **kwargs):

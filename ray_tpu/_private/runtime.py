@@ -480,6 +480,32 @@ class Runtime:
     def kv_op(self, op, key, val=None):
         return self._run(self.node.head.kv_op(op, key, val))
 
+    def cluster_stacks(self, timeout: float = 15.0) -> dict:
+        """Thread stacks of every node + worker process cluster-wide
+        (reference: `ray stack`)."""
+
+        async def query(n):
+            if tuple(n["address"]) == tuple(self.node.peer_address):
+                return await self.node.collect_stacks()
+            try:
+                conn = await self.node._addr_conn(tuple(n["address"]))
+                return await asyncio.wait_for(
+                    conn.call("stacks", None), timeout)
+            except Exception as e:  # noqa: BLE001 - best effort
+                return {f"node:{n['node_id'].hex()[:12]}":
+                        f"<unreachable: {e}>"}
+
+        async def gather():
+            nodes = await self.head_client().list_nodes()
+            outs = await asyncio.gather(
+                *(query(n) for n in nodes if n["state"] == "ALIVE"))
+            merged = {}
+            for o in outs:
+                merged.update(o)
+            return merged
+
+        return self._run(gather(), timeout=timeout + 5)
+
     def resolve_runtime_env(self, env: dict | None,
                             device_lane: bool = False):
         """Merge the job default with a per-task env and upload any local

@@ -1,0 +1,25 @@
+"""Thread stack dumps for live debugging.
+
+Capability parity target: `ray stack` (py-spy dump of every worker,
+/root/reference/python/ray/scripts/scripts.py `def stack`) — py-spy is
+not baked into this image, so processes self-report via
+sys._current_frames (the faulthandler view), which needs no ptrace and
+covers the common "where is it stuck" question.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import traceback
+
+
+def format_stacks() -> str:
+    """All of THIS process's thread stacks, ray-stack-shaped."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = [f"process {os.getpid()} ({len(names)} threads)"]
+    for tid, frame in sys._current_frames().items():
+        out.append(f"\n--- thread {names.get(tid, '?')} ({tid}) ---")
+        out.append("".join(traceback.format_stack(frame)).rstrip())
+    return "\n".join(out)

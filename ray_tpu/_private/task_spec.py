@@ -77,6 +77,10 @@ class TaskSpec:
     max_restarts: int = 0
     actor_name: Optional[str] = None
     actor_methods: Optional[list] = None
+    # Tracing context ({"trace_id", "span_id"}) propagated from the
+    # submitter's active span (reference: span context inside the task
+    # spec, tracing_helper.py).
+    trace_ctx: Optional[dict] = None
     # Resolved runtime environment (env_vars + kv:// package URIs —
     # see ray_tpu.runtime_env); workers are pooled by its hash.
     runtime_env: Optional[dict] = None

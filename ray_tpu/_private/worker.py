@@ -267,6 +267,10 @@ class WorkerContext:
             return self._create_actor(payload)
         if method == "ping":
             return "pong"
+        if method == "stack_dump":
+            from .stack_dump import format_stacks
+
+            return format_stacks()
         if method == "shutdown":
             threading.Thread(target=lambda: os._exit(0), daemon=True).start()
             return True
@@ -275,6 +279,19 @@ class WorkerContext:
     def _execute(self, p: dict):
         task_id = TaskID(p["task_id"])
         tok = _running_task.set(task_id)
+        trace_ctx = p.get("trace_ctx")
+        tracer = None
+        if trace_ctx is not None:
+            from ray_tpu.util import tracing
+
+            # Receiving a traced task implies tracing is on in this
+            # process too, so nested submissions keep the chain even on
+            # nodes whose fork env lacked RT_TRACING.
+            tracing.enable_tracing()
+            tracer = tracing.span(f"task::{p['name']}::execute",
+                                  attributes={"worker_pid": os.getpid()},
+                                  ctx=trace_ctx)
+            tracer.__enter__()
         try:
             args = [self._decode_arg(a) for a in p["args"]]
             kwargs = {k: self._decode_arg(v) for k, v in p["kwargs"].items()}
@@ -287,9 +304,24 @@ class WorkerContext:
             return {"results": self._encode_results(task_id, p["num_returns"], value),
                     "error": None}
         except BaseException as e:  # noqa: BLE001
+            if tracer is not None:
+                tracer.attributes["error"] = f"{type(e).__name__}: {e}"
             return {"results": None, "error": TaskError.from_exception(e, p["name"])}
         finally:
             _running_task.reset(tok)
+            if tracer is not None:
+                tracer.__exit__(None, None, None)
+                self._flush_spans()
+
+    def _flush_spans(self):
+        from ray_tpu.util import tracing
+
+        spans = tracing.drain_local_spans()
+        if spans:
+            try:
+                self.client.call("spans_push", spans)
+            except Exception:
+                pass
 
     def _create_actor(self, p: dict):
         task_id = TaskID(p["task_id"])

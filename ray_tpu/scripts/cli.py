@@ -63,7 +63,8 @@ def _attach(args):
     jax.config.update("jax_platforms", "cpu")
     import ray_tpu
 
-    ray_tpu.init(address=_resolve_address(args))
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(address=_resolve_address(args))
     return ray_tpu
 
 
@@ -294,6 +295,39 @@ def cmd_metrics(args):
     sys.stdout.write(prometheus_text())
 
 
+def cmd_stack(args):
+    _attach(args)
+    from ray_tpu._private import context as context_mod
+
+    rt = context_mod.require_context()
+    for name, text in sorted(rt.cluster_stacks().items()):
+        print(f"===== {name} =====")
+        print(text)
+        print()
+
+
+def cmd_memory(args):
+    _attach(args)
+    from collections import defaultdict
+
+    from ray_tpu.util import state
+
+    rows = state.list_objects()
+    by_node = defaultdict(lambda: [0, 0])
+    for r in rows:
+        by_node[r["node_id"][:12]][0] += 1
+        by_node[r["node_id"][:12]][1] += r.get("size") or 0
+    print(f"{len(rows)} object(s) cluster-wide")
+    for node, (count, nbytes) in sorted(by_node.items()):
+        print(f"  node {node}: {count} objects, {nbytes / 1e6:.2f} MB")
+    top = sorted(rows, key=lambda r: r.get("size") or 0, reverse=True)[:20]
+    if top:
+        print("top objects by size:")
+        for r in top:
+            print(f"  {r['object_id'][:16]}  {r.get('size') or 0:>12}  "
+                  f"{r['status']:<8} refs={r.get('refcount', '?')}")
+
+
 # ---------------------------------------------------------------------------
 # rtpu job ...
 # ---------------------------------------------------------------------------
@@ -391,6 +425,15 @@ def build_parser() -> argparse.ArgumentParser:
                         help="print cluster metrics (Prometheus format)")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_metrics)
+
+    sp = sub.add_parser("stack",
+                        help="thread stacks of every node/worker process")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_stack)
+
+    sp = sub.add_parser("memory", help="object store usage summary")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_memory)
 
     sp = sub.add_parser("timeline", help="dump chrome://tracing JSON")
     sp.add_argument("--output", "-o", default="timeline.json")

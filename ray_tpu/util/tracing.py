@@ -1,0 +1,149 @@
+"""Opt-in distributed tracing: spans around task submit/execute with
+context propagated through the task spec.
+
+Capability parity target: the reference's OpenTelemetry task tracing
+(/root/reference/python/ray/util/tracing/tracing_helper.py — spans
+injected around submit and execute, context carried inside the task
+spec; enabled via ray.init(_tracing_startup_hook)). This deployment has
+no OTel SDK baked in, so spans use the OTel data shape (trace_id,
+span_id, parent_id, name, start/end, attributes) in a process-local
+recorder; worker processes piggyback their spans to the node with the
+metrics flusher plane, and `get_spans()` / `export_chrome_trace()`
+aggregate cluster-wide. `register_exporter` is the hook where a real
+OTLP exporter would plug in.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+_enabled = False
+_lock = threading.Lock()
+_spans: List[dict] = []
+_MAX_SPANS = 10_000
+_exporters: List[Callable[[dict], None]] = []
+
+# The active span context in this thread/task ({"trace_id", "span_id"}).
+current_context: contextvars.ContextVar = contextvars.ContextVar(
+    "rt_trace_ctx", default=None)
+
+
+def enable_tracing() -> None:
+    """Turn span recording on in THIS process (driver: call before
+    submitting; workers inherit via the RT_TRACING env var)."""
+    global _enabled
+    _enabled = True
+    os.environ["RT_TRACING"] = "1"
+
+
+def tracing_enabled() -> bool:
+    return _enabled or os.environ.get("RT_TRACING") == "1"
+
+
+def register_exporter(fn: Callable[[dict], None]) -> None:
+    """fn(span) is called for every finished span (OTLP bridge point)."""
+    _exporters.append(fn)
+
+
+def _record(span: dict) -> None:
+    with _lock:
+        if len(_spans) < _MAX_SPANS:
+            _spans.append(span)
+    for fn in _exporters:
+        try:
+            fn(span)
+        except Exception:
+            pass
+
+
+class span:
+    """Context manager recording one span; nests under the thread's
+    current context and becomes the context inside the block."""
+
+    def __init__(self, name: str, attributes: Optional[dict] = None,
+                 ctx: Optional[dict] = None):
+        self.name = name
+        self.attributes = dict(attributes or {})
+        self._ctx_in = ctx
+
+    def __enter__(self):
+        parent = self._ctx_in or current_context.get()
+        self.trace_id = (parent or {}).get("trace_id") or uuid.uuid4().hex
+        self.span_id = uuid.uuid4().hex[:16]
+        self.parent_id = (parent or {}).get("span_id")
+        self.start = time.time()
+        self._token = current_context.set(
+            {"trace_id": self.trace_id, "span_id": self.span_id})
+        return self
+
+    def context(self) -> dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    def __exit__(self, exc_type, exc, tb):
+        current_context.reset(self._token)
+        if exc_type is not None:
+            self.attributes["error"] = f"{exc_type.__name__}: {exc}"
+        _record({
+            "name": self.name, "trace_id": self.trace_id,
+            "span_id": self.span_id, "parent_id": self.parent_id,
+            "start": self.start, "end": time.time(),
+            "pid": os.getpid(), "attributes": self.attributes,
+        })
+        return False
+
+
+def local_spans() -> List[dict]:
+    with _lock:
+        return list(_spans)
+
+
+def drain_local_spans() -> List[dict]:
+    with _lock:
+        out = list(_spans)
+        _spans.clear()
+    return out
+
+
+def get_spans() -> List[dict]:
+    """Cluster-wide spans: this process's plus every node's collected
+    worker spans (the ``spans`` state table)."""
+    from .._private import context as context_mod
+
+    rt = context_mod.get_context()
+    rows = local_spans()
+    if rt is not None and hasattr(rt, "cluster_state"):
+        snap = rt.cluster_state(tables=["spans"])
+        for s in snap["snapshots"]:
+            rows.extend(s.get("spans", []))
+    # Dedup (driver-local spans also reach the head node's table).
+    seen = set()
+    out = []
+    for r in rows:
+        if r["span_id"] in seen:
+            continue
+        seen.add(r["span_id"])
+        out.append(r)
+    return sorted(out, key=lambda r: r["start"])
+
+
+def export_chrome_trace(filename: str) -> int:
+    """Spans as chrome://tracing 'X' events (complements the task-event
+    timeline; reference: ray timeline)."""
+    import json
+
+    spans = get_spans()
+    events = [{
+        "name": s["name"], "cat": "span", "ph": "X",
+        "ts": s["start"] * 1e6, "dur": max(0.0, s["end"] - s["start"]) * 1e6,
+        "pid": s.get("pid", 0), "tid": s["trace_id"][:8],
+        "args": {**s.get("attributes", {}), "trace_id": s["trace_id"],
+                 "span_id": s["span_id"], "parent_id": s.get("parent_id")},
+    } for s in spans]
+    with open(filename, "w") as f:
+        json.dump(events, f)
+    return len(events)
