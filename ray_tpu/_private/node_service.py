@@ -1256,15 +1256,13 @@ class NodeService:
         def run():
             from . import worker as worker_mod
 
-            tok = worker_mod._running_task.set(spec.task_id)
-            tracer = None
-            if spec.trace_ctx is not None:
-                from ray_tpu.util import tracing
+            from ray_tpu.util import tracing
 
-                tracer = tracing.span(f"task::{spec.name}::execute",
-                                      attributes={"lane": "device"},
-                                      ctx=spec.trace_ctx)
-                tracer.__enter__()
+            tok = worker_mod._running_task.set(spec.task_id)
+            tracer = (tracing.task_span(f"task::{spec.name}::execute",
+                                        spec.trace_ctx,
+                                        attributes={"lane": "device"})
+                      if spec.trace_ctx is not None else None)
             try:
                 if instance is not None:
                     method = getattr(instance, spec.method_name)
@@ -1272,17 +1270,15 @@ class NodeService:
                 return (True, fn(*args, **kwargs))
             except BaseException as e:  # noqa: BLE001
                 if tracer is not None:
-                    tracer.attributes["error"] = f"{type(e).__name__}: {e}"
+                    tracer.error(e)
                 return (False, TaskError.from_exception(e, spec.name))
             finally:
                 worker_mod._running_task.reset(tok)
                 if tracer is not None:
-                    tracer.__exit__(None, None, None)
+                    tracer.finish()
                     # The node process is not a worker: route its spans
                     # into the node table itself so multi-node traces
                     # include device-lane work.
-                    from ray_tpu.util import tracing
-
                     self.trace_spans.extend(tracing.drain_local_spans())
 
         self._event(spec, "RUNNING", worker="device")
@@ -2092,8 +2088,11 @@ class NodeService:
                 return f"<unavailable: {e}>"
 
         dumps = await asyncio.gather(*(ask(w) for w in targets))
+        node = self.node_id.hex()[:8]
         for w, text in zip(targets, dumps):
-            out[f"worker:{w.proc.pid}"] = text
+            # Node-qualified keys: pids are per-host, so bare pids from
+            # different machines would collide in the merged view.
+            out[f"worker:{node}:{w.proc.pid}"] = text
         return out
 
     def directory_sync(self) -> dict:

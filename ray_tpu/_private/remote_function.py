@@ -131,7 +131,7 @@ class RemoteFunction:
         )
         from ray_tpu.util import tracing
 
-        if tracing.tracing_enabled():
+        if tracing.should_trace():
             with tracing.span(f"task::{self._name}::submit") as sp:
                 spec.trace_ctx = sp.context()
                 refs = ctx.submit_spec(spec)

@@ -279,19 +279,17 @@ class WorkerContext:
     def _execute(self, p: dict):
         task_id = TaskID(p["task_id"])
         tok = _running_task.set(task_id)
-        trace_ctx = p.get("trace_ctx")
-        tracer = None
-        if trace_ctx is not None:
-            from ray_tpu.util import tracing
+        from ray_tpu.util import tracing
 
-            # Receiving a traced task implies tracing is on in this
-            # process too, so nested submissions keep the chain even on
-            # nodes whose fork env lacked RT_TRACING.
-            tracing.enable_tracing()
-            tracer = tracing.span(f"task::{p['name']}::execute",
-                                  attributes={"worker_pid": os.getpid()},
-                                  ctx=trace_ctx)
-            tracer.__enter__()
+        trace_ctx = p.get("trace_ctx")
+        # Nested submissions during a traced task follow the thread's
+        # active context (tracing.should_trace), so the chain survives
+        # any number of hops WITHOUT flipping tracing on permanently for
+        # this worker's later, untraced work.
+        tracer = (tracing.task_span(f"task::{p['name']}::execute",
+                                    trace_ctx,
+                                    attributes={"worker_pid": os.getpid()})
+                  if trace_ctx is not None else None)
         try:
             args = [self._decode_arg(a) for a in p["args"]]
             kwargs = {k: self._decode_arg(v) for k, v in p["kwargs"].items()}
@@ -305,12 +303,12 @@ class WorkerContext:
                     "error": None}
         except BaseException as e:  # noqa: BLE001
             if tracer is not None:
-                tracer.attributes["error"] = f"{type(e).__name__}: {e}"
+                tracer.error(e)
             return {"results": None, "error": TaskError.from_exception(e, p["name"])}
         finally:
             _running_task.reset(tok)
             if tracer is not None:
-                tracer.__exit__(None, None, None)
+                tracer.finish()
                 self._flush_spans()
 
     def _flush_spans(self):

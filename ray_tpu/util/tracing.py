@@ -22,10 +22,14 @@ import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional
 
+import collections
+
 _enabled = False
 _lock = threading.Lock()
-_spans: List[dict] = []
 _MAX_SPANS = 10_000
+# Drop-OLDEST on overflow (a long-lived traced driver keeps recording;
+# matching the node table's deque semantics).
+_spans: collections.deque = collections.deque(maxlen=_MAX_SPANS)
 _exporters: List[Callable[[dict], None]] = []
 
 # The active span context in this thread/task ({"trace_id", "span_id"}).
@@ -50,15 +54,48 @@ def register_exporter(fn: Callable[[dict], None]) -> None:
     _exporters.append(fn)
 
 
+def should_trace() -> bool:
+    """Record spans when tracing is enabled in this process OR a trace
+    context is already active on this thread (a traced task executing
+    here) — so nested submissions keep the chain without permanently
+    flipping tracing on for unrelated later work."""
+    return tracing_enabled() or current_context.get() is not None
+
+
 def _record(span: dict) -> None:
     with _lock:
-        if len(_spans) < _MAX_SPANS:
-            _spans.append(span)
+        _spans.append(span)
     for fn in _exporters:
         try:
             fn(span)
         except Exception:
             pass
+
+
+class task_span:
+    """The submit/execute span protocol shared by the worker and the
+    device lane: enter on start, `error(e)` on failure, `finish()` in
+    the finally. No-op when ctx is None and tracing is off."""
+
+    def __init__(self, name: str, ctx: Optional[dict],
+                 attributes: Optional[dict] = None):
+        self._span = None
+        if ctx is not None or should_trace():
+            self._span = span(name, attributes=attributes, ctx=ctx)
+            self._span.__enter__()
+
+    @property
+    def active(self) -> bool:
+        return self._span is not None
+
+    def error(self, e: BaseException) -> None:
+        if self._span is not None:
+            self._span.attributes["error"] = f"{type(e).__name__}: {e}"
+
+    def finish(self) -> None:
+        if self._span is not None:
+            self._span.__exit__(None, None, None)
+            self._span = None
 
 
 class span:

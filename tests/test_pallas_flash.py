@@ -80,6 +80,70 @@ def test_pallas_flash_grad():
                                    atol=1e-3, rtol=1e-3)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_pallas_flash_grad_ragged_seq(causal):
+    """Gradients with a seq length that does NOT divide the block size:
+    the padded-row/padded-key masking in the backward kernels must zero
+    contributions from padding."""
+    q, k, v = _rand_qkv(jax.random.key(14), 2, 41, 2, 16)
+
+    def loss_pl(q, k, v):
+        return (flash_attention_pallas(
+            q, k, v, causal=causal, block_q=16, block_k=16,
+            interpret=True) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (causal_attention(q, k, v, causal=causal) ** 2).sum()
+
+    g_pl = jax.grad(loss_pl, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_pl, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-3, rtol=2e-3)
+
+
+def test_pallas_flash_grad_bf16():
+    q, k, v = _rand_qkv(jax.random.key(15), 1, 64, 2, 16)
+    q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
+
+    def loss_pl(q, k, v):
+        return (flash_attention_pallas(
+            q, k, v, block_q=32, block_k=32,
+            interpret=True).astype(jnp.float32) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (causal_attention(q, k, v).astype(jnp.float32) ** 2).sum()
+
+    g_pl = jax.grad(loss_pl, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_pl, g_ref):
+        scale = float(jnp.max(jnp.abs(b.astype(jnp.float32)))) + 1e-9
+        err = float(jnp.max(jnp.abs(
+            a.astype(jnp.float32) - b.astype(jnp.float32)))) / scale
+        assert err < 6e-2, err  # bf16 tolerance
+
+
+def test_pallas_flash_grad_gqa():
+    """GQA gradients: dk/dv must sum over the query-head groups (the
+    jnp.repeat expansion's transpose)."""
+    q, _, _ = _rand_qkv(jax.random.key(16), 1, 32, 4, 16)
+    _, k, v = _rand_qkv(jax.random.key(17), 1, 32, 2, 16)
+
+    def loss_pl(q, k, v):
+        return (flash_attention_pallas(
+            q, k, v, block_q=16, block_k=16, interpret=True) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (causal_attention(q, k, v) ** 2).sum()
+
+    g_pl = jax.grad(loss_pl, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    assert g_pl[1].shape == k.shape and g_pl[2].shape == v.shape
+    for a, b in zip(g_pl, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-3, rtol=2e-3)
+
+
 def test_pallas_flash_under_jit_and_scan():
     # The kernel must be jittable and usable inside lax.scan (the model
     # calls it from a scanned block).
