@@ -1,0 +1,52 @@
+"""Best-effort interruption of task threads (the cancel mechanism).
+
+One registry per process maps running task ids to the thread executing
+them; ``interrupt`` injects an exception into that thread via
+``PyThreadState_SetAsyncExc``. The registry lock is held across both
+the lookup and the injection, and the executing thread unregisters
+under the same lock FIRST in its finally — so once a task has
+unregistered, no injection can target its (soon to be reused) thread.
+The remaining window — the exception detonating inside the tail of the
+task's own finally — is inherent to async exceptions and bounded to
+that task.
+
+Used by both cancel lanes: the CPU worker process
+(worker._cancel_running) and the node's device lane
+(node_service.cancel_task).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Dict, Optional
+
+
+class TaskInterruptRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._threads: Dict[bytes, int] = {}
+
+    def register(self, key: bytes, ident: Optional[int] = None) -> None:
+        with self._lock:
+            self._threads[key] = (threading.get_ident()
+                                  if ident is None else ident)
+
+    def unregister(self, key: bytes) -> None:
+        with self._lock:
+            self._threads.pop(key, None)
+
+    def interrupt(self, key: bytes, exc_type: type) -> bool:
+        """Raise exc_type in the thread running task `key`; False if the
+        task is no longer running here (finished — nothing to do)."""
+        with self._lock:
+            ident = self._threads.get(key)
+            if ident is None:
+                return False
+            n = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(ident), ctypes.py_object(exc_type))
+            if n > 1:  # invalid ident hit >1 states: revoke, never spray
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_ulong(ident), None)
+                return False
+            return n == 1

@@ -28,6 +28,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
@@ -227,6 +228,10 @@ class NodeService:
         self._retired_metrics: dict[tuple, dict] = {}
         # Trace spans pushed by workers (bounded; tracing is opt-in).
         self.trace_spans: collections.deque = collections.deque(maxlen=10_000)
+        # Device-lane tasks currently executing (best-effort cancel).
+        from .interrupt import TaskInterruptRegistry
+
+        self._device_interrupts = TaskInterruptRegistry()
         self.pending_cpu: collections.deque[TaskSpec] = collections.deque()
         self.cancelled: set[TaskID] = set()
 
@@ -1195,6 +1200,7 @@ class NodeService:
             else:
                 self.mark_ready_shm(rid, res[1])
         self._release_deps(spec)
+        self.cancelled.discard(spec.task_id)  # cancel raced completion
         self.counters["tasks_finished"] += 1
         self._event(spec, "FINISHED")
 
@@ -1206,7 +1212,40 @@ class NodeService:
         for dep in spec.dependencies():
             self.decref(dep)
 
+    def cancel_task(self, task_id: TaskID, force: bool = False):
+        """Cancel a task wherever it is: queued specs are dropped at
+        dispatch; a task RUNNING on a CPU worker gets a best-effort
+        async interrupt (force=True kills the worker process instead);
+        a running device-lane task gets the same thread interrupt in
+        this process. Reference: ray.cancel semantics
+        (core_worker CancelTask + force kill)."""
+        self.cancelled.add(task_id)
+        for w in self.workers.values():
+            spec = w.inflight.get(task_id)
+            if spec is None:
+                continue
+            spec._cancel_requested = True
+            if force:
+                # ConnectionLost surfaces in _run_on_worker; the
+                # _cancel_requested flag turns the retry path into a
+                # TaskCancelledError failure.
+                self._kill_worker(w, force=True)
+            elif w.conn is not None and w.conn.alive:
+                self.loop.create_task(self._send_cancel(w, task_id))
+        self._device_interrupts.interrupt(task_id.binary(),
+                                          TaskCancelledError)
+        self._kick()
+
+    async def _send_cancel(self, w: WorkerHandle, task_id: TaskID):
+        try:
+            await w.conn.call("cancel_task", task_id.binary())
+        except (ConnectionLost, OSError):
+            pass
+
     def _retry_or_fail(self, spec: TaskSpec, err: TaskError):
+        if getattr(spec, "_cancel_requested", False):
+            self._fail_task(spec, TaskCancelledError(task_name=spec.name))
+            return
         if spec.max_retries > 0 and not spec.is_actor_creation and spec.actor_id is None:
             spec.max_retries -= 1
             self.counters["tasks_retried"] += 1
@@ -1219,6 +1258,7 @@ class NodeService:
         for rid in spec.return_ids():
             self.mark_error(rid, err)
         self._release_deps(spec)
+        self.cancelled.discard(spec.task_id)  # terminal: no leak
         self.counters["tasks_failed"] += 1
         self._event(spec, "FAILED")
 
@@ -1259,6 +1299,7 @@ class NodeService:
             from ray_tpu.util import tracing
 
             tok = worker_mod._running_task.set(spec.task_id)
+            self._device_interrupts.register(spec.task_id.binary())
             tracer = (tracing.task_span(f"task::{spec.name}::execute",
                                         spec.trace_ctx,
                                         attributes={"lane": "device"})
@@ -1273,6 +1314,7 @@ class NodeService:
                     tracer.error(e)
                 return (False, TaskError.from_exception(e, spec.name))
             finally:
+                self._device_interrupts.unregister(spec.task_id.binary())
                 worker_mod._running_task.reset(tok)
                 if tracer is not None:
                     tracer.finish()
@@ -1285,7 +1327,15 @@ class NodeService:
         fut = (pool or self.device_pool).submit(run)
 
         def done(f):
-            ok, value = f.result()
+            try:
+                ok, value = f.result()
+            except BaseException as e:  # noqa: BLE001 - an injected cancel
+                # can land OUTSIDE run()'s try (e.g. in its finally); the
+                # return objects must still resolve or the caller's get
+                # blocks forever and actor slots leak.
+                ok = False
+                value = (e if isinstance(e, TaskError)
+                         else TaskError.from_exception(e, spec.name))
             def finish():
                 if actor is not None:
                     actor.inflight -= 1
@@ -2056,10 +2106,12 @@ class NodeService:
                     except (ConnectionLost, OSError):
                         pass
 
-    def _kill_worker(self, worker: WorkerHandle):
+    def _kill_worker(self, worker: WorkerHandle, force: bool = False):
         worker.state = "DEAD"
         try:
-            worker.proc.terminate()
+            # force => SIGKILL: the ray force-cancel contract must hold
+            # even for workers that ignore/block SIGTERM.
+            (worker.proc.kill if force else worker.proc.terminate)()
         except ProcessLookupError:
             pass
 

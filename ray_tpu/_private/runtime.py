@@ -394,34 +394,42 @@ class Runtime:
                         self.node.ensure_object(r.id, r.owner_addr))
             oids = [r.id for r in refs]
             deadline = None if timeout is None else self.loop.time() + timeout
-            while True:
-                ready = [o for o in oids
-                         if self.node.objects.get(o)
-                         and self.node.objects[o].status != PENDING]
-                if len(ready) >= num_returns:
-                    return ready
-                remaining = (None if deadline is None
-                             else max(0.0, deadline - self.loop.time()))
-                if remaining == 0.0:
-                    return ready
-                futs = []
-                for o in oids:
-                    st = self.node._obj(o)
-                    if st.status == PENDING:
-                        f = self.loop.create_future()
-                        st.waiters.append(f)
-                        futs.append(f)
-                if not futs:
-                    return ready
-                await asyncio.wait(futs, timeout=remaining,
-                                   return_when=asyncio.FIRST_COMPLETED)
-                for f in futs:
+            # ONE waiter per still-pending object for the whole call —
+            # re-registering every wakeup is O(n·wakeups) churn that
+            # fan-in workloads (1k-ref waits, BASELINE.md) punish.
+            waiters: dict = {}
+            try:
+                while True:
+                    ready = [o for o in oids
+                             if self.node.objects.get(o)
+                             and self.node.objects[o].status != PENDING]
+                    if len(ready) >= num_returns:
+                        return ready
+                    remaining = (None if deadline is None
+                                 else max(0.0, deadline - self.loop.time()))
+                    if remaining == 0.0:
+                        return ready
+                    for o in oids:
+                        if o in waiters:
+                            continue
+                        st = self.node._obj(o)
+                        if st.status == PENDING:
+                            f = self.loop.create_future()
+                            st.waiters.append(f)
+                            waiters[o] = f
+                    futs = [f for f in waiters.values() if not f.done()]
+                    if not futs:
+                        return ready
+                    await asyncio.wait(futs, timeout=remaining,
+                                       return_when=asyncio.FIRST_COMPLETED)
+            finally:
+                for o, f in waiters.items():
                     if not f.done():
                         f.cancel()
-                for o in oids:
-                    st = self.node.objects.get(o)
-                    if st and st.waiters:
-                        st.waiters[:] = [x for x in st.waiters if not x.cancelled()]
+                        st = self.node.objects.get(o)
+                        if st and st.waiters:
+                            st.waiters[:] = [x for x in st.waiters
+                                             if x is not f]
 
         ready_ids = set(o.binary() for o in self._run(do()))
         ready = [r for r in refs if r.id.binary() in ready_ids]
@@ -465,8 +473,7 @@ class Runtime:
             return
 
         def do():
-            self.node.cancelled.add(st.creating_spec.task_id)
-            self.node._kick()
+            self.node.cancel_task(st.creating_spec.task_id, force=force)
 
         self._call_soon(do)
 

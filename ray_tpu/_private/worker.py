@@ -56,6 +56,9 @@ class WorkerContext:
         self._put_lock = threading.Lock()
         self._decref_buf: list[bytes] = []
         self._decref_lock = threading.Lock()
+        from .interrupt import TaskInterruptRegistry
+
+        self._interrupts = TaskInterruptRegistry()
         # Connect last: the node service may push tasks the moment we register.
         self.client = DuplexClient(sock_path, self._handle, handler_threads=32)
         # Wear the runtime environment BEFORE registering — tasks are only
@@ -271,13 +274,29 @@ class WorkerContext:
             from .stack_dump import format_stacks
 
             return format_stacks()
+        if method == "cancel_task":
+            return self._cancel_running(TaskID(payload))
         if method == "shutdown":
             threading.Thread(target=lambda: os._exit(0), daemon=True).start()
             return True
         raise RuntimeError(f"unknown worker rpc: {method}")
 
+    def _cancel_running(self, task_id: TaskID) -> bool:
+        """Best-effort interrupt of a RUNNING task: raise
+        TaskCancelledError in the thread executing it (reference:
+        non-force ray.cancel delivers KeyboardInterrupt to the worker).
+        Pure-Python code is interrupted at the next bytecode boundary;
+        a task blocked in a C call keeps running until it returns. A
+        task that already finished is a no-op (the registry lock rules
+        out injecting into a reused thread)."""
+        from .exceptions import TaskCancelledError
+
+        return self._interrupts.interrupt(task_id.binary(),
+                                          TaskCancelledError)
+
     def _execute(self, p: dict):
         task_id = TaskID(p["task_id"])
+        self._interrupts.register(task_id.binary())
         tok = _running_task.set(task_id)
         from ray_tpu.util import tracing
 
@@ -304,8 +323,17 @@ class WorkerContext:
         except BaseException as e:  # noqa: BLE001
             if tracer is not None:
                 tracer.error(e)
-            return {"results": None, "error": TaskError.from_exception(e, p["name"])}
+            from .exceptions import TaskCancelledError
+
+            if isinstance(e, TaskCancelledError):
+                err = TaskCancelledError(task_name=p["name"])
+            else:
+                err = TaskError.from_exception(e, p["name"])
+            return {"results": None, "error": err}
         finally:
+            # Unregister FIRST (under the registry lock): after this, a
+            # racing cancel can no longer target this pool thread.
+            self._interrupts.unregister(task_id.binary())
             _running_task.reset(tok)
             if tracer is not None:
                 tracer.finish()

@@ -46,9 +46,11 @@ DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, blk_q: int,
-                blk_k: int, nk: int, orig_sk: int, causal: bool,
-                scale: float):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, blk_q: int, blk_k: int,
+                nk: int, orig_sk: int, causal: bool, scale: float,
+                lse_ref=None):
+    """Primal-only variant reuses this with lse_ref=None, so inference
+    calls skip the LSE side-output entirely (no wasted HBM writes)."""
     qi = pl.program_id(2)
     q = q_ref[0, 0, :, :]                      # (blk_q, d), input dtype
     d = q.shape[-1]
@@ -91,12 +93,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, blk_q: int,
         upper = nk
     m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
     o_ref[0, 0, :, :] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-    # Row logsumexp, saved for the backward's softmax recompute. Finite
-    # even for rows whose keys were all masked (m is then NEG_INF, not
-    # -inf, so exp(s - lse) recomputes to a harmless uniform p that the
-    # zero upstream gradient kills).
-    lse = m + jnp.log(jnp.maximum(l, 1e-30))          # (blk_q, 1)
-    lse_ref[0, 0, :, :] = jnp.broadcast_to(lse, lse_ref.shape[2:])
+    if lse_ref is not None:
+        # Row logsumexp, saved for the backward's softmax recompute.
+        # Finite even for rows whose keys were all masked (m is then
+        # NEG_INF, not -inf, so exp(s - lse) recomputes to a harmless
+        # uniform p that the zero upstream gradient kills).
+        lse = m + jnp.log(jnp.maximum(l, 1e-30))      # (blk_q, 1)
+        lse_ref[0, 0, :, :] = jnp.broadcast_to(lse, lse_ref.shape[2:])
+
+
+def _fwd_kernel_with_lse(q_ref, k_ref, v_ref, o_ref, lse_ref, **kw):
+    _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=lse_ref, **kw)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -202,9 +209,12 @@ def _pad_seq(x, blk):
     return x
 
 
-def _fwd(q, k, v, *, causal: bool, blk_q: int, blk_k: int, interpret: bool):
+def _fwd(q, k, v, *, causal: bool, blk_q: int, blk_k: int, interpret: bool,
+         with_lse: bool = True):
     """Returns (out [b,s,h,d], residuals) — residuals are the padded
-    heads-major tensors + LSE the backward kernels consume."""
+    heads-major tensors + LSE the backward kernels consume. The primal
+    (inference) path calls with with_lse=False and skips the LSE
+    side-output entirely (residuals None)."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
     blk_q = min(blk_q, max(sq, 8))
@@ -217,19 +227,28 @@ def _fwd(q, k, v, *, causal: bool, blk_q: int, blk_k: int, interpret: bool):
     nq, nk = sq_p // blk_q, sk_p // blk_k
     scale = d ** -0.5
 
-    kernel = functools.partial(
-        _fwd_kernel, blk_q=blk_q, blk_k=blk_k, nk=nk, orig_sk=sk,
-        causal=causal, scale=scale)
+    opts = dict(blk_q=blk_q, blk_k=blk_k, nk=nk, orig_sk=sk,
+                causal=causal, scale=scale)
+    in_specs = [
+        pl.BlockSpec((1, 1, blk_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, sk_p, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+        pl.BlockSpec((1, 1, sk_p, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+    ]
+    o_spec = pl.BlockSpec((1, 1, blk_q, d), lambda bi, hi, qi: (bi, hi, qi, 0))
+    if not with_lse:
+        out = pl.pallas_call(
+            functools.partial(_fwd_kernel, **opts),
+            grid=(b, h, nq), in_specs=in_specs, out_specs=o_spec,
+            out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+            interpret=interpret,
+        )(qp, kp, vp)
+        return out[:, :, :sq].transpose(0, 2, 1, 3), None
     out, lse = pl.pallas_call(
-        kernel,
+        functools.partial(_fwd_kernel_with_lse, **opts),
         grid=(b, h, nq),
-        in_specs=[
-            pl.BlockSpec((1, 1, blk_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, sk_p, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, sk_p, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, 1, blk_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            o_spec,
             pl.BlockSpec((1, 1, blk_q, LANES),
                          lambda bi, hi, qi: (bi, hi, qi, 0)),
         ],
@@ -303,8 +322,9 @@ def _bwd(res, g, *, causal: bool, blk_q: int, blk_k: int, interpret: bool):
 def _make_op(causal: bool, blk_q: int, blk_k: int, interpret: bool):
     @jax.custom_vjp
     def op(q, k, v):
+        # Primal (inference) path: no LSE side-output.
         out, _res = _fwd(q, k, v, causal=causal, blk_q=blk_q, blk_k=blk_k,
-                         interpret=interpret)
+                         interpret=interpret, with_lse=False)
         return out
 
     def fwd(q, k, v):

@@ -1,0 +1,98 @@
+"""Fault-injection utilities for chaos testing.
+
+Capability parity target: the reference's killer actors
+(/root/reference/python/ray/_private/test_utils.py — ResourceKillerActor
+:1396, NodeKillerActor:1464, WorkerKillerActor:1527): background actors
+that kill random workers/nodes under load, used by the FT test suites
+(test_actor_failures.py, test_gcs_fault_tolerance.py, chaos release
+tests).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import threading
+import time
+from typing import Optional
+
+
+class WorkerKiller:
+    """Driver-side chaos thread: SIGKILLs random live CPU workers at an
+    interval while running. Worker pids come from the state API, so only
+    cluster-managed processes are ever touched."""
+
+    def __init__(self, interval_s: float = 0.5, seed: Optional[int] = None):
+        self.interval_s = interval_s
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.kills = 0
+
+    def _loop(self):
+        from ray_tpu.util import state
+
+        while not self._stop.wait(self.interval_s):
+            try:
+                rows = [w for w in state.list_workers()
+                        if w["state"] in ("IDLE", "BUSY")
+                        and not w.get("actor_id")]
+            except Exception:
+                continue
+            if not rows:
+                continue
+            victim = self._rng.choice(rows)
+            try:
+                os.kill(victim["pid"], signal.SIGKILL)
+                self.kills += 1
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def __enter__(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="rt-worker-killer")
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        return False
+
+
+class NodeKiller:
+    """Chaos for multi-node tests: SIGKILLs random worker NODES of a
+    cluster_utils.Cluster at an interval (never the head)."""
+
+    def __init__(self, cluster, interval_s: float = 1.0,
+                 max_kills: int = 1, seed: Optional[int] = None):
+        self.cluster = cluster
+        self.interval_s = interval_s
+        self.max_kills = max_kills
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.kills = 0
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            if self.kills >= self.max_kills or not self.cluster.nodes:
+                return
+            node = self._rng.choice(self.cluster.nodes)
+            try:
+                self.cluster.remove_node(node, force=True)
+                self.kills += 1
+            except Exception:
+                pass
+
+    def __enter__(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="rt-node-killer")
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc):
+        self._stop.set()
+        self._thread.join(timeout=30)
+        return False

@@ -1,0 +1,202 @@
+"""Chaos / hardening: cancel of RUNNING tasks, fault injection under
+load, wait() fan-in.
+
+Parity models: ray.cancel semantics (core_worker CancelTask + force
+kill), the reference's WorkerKillerActor/NodeKillerActor chaos suites
+(python/ray/_private/test_utils.py:1396,1464,1527), and the 1k-ref
+ray.wait microbenchmark shape (BASELINE.md).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.test_utils import NodeKiller, WorkerKiller
+
+
+# ---------------------------------------------------------------------------
+# Cancel of running tasks (VERDICT r1 weak item 7)
+# ---------------------------------------------------------------------------
+def test_cancel_running_cpu_task(rt):
+    @ray_tpu.remote
+    def spin(path):
+        # Pure-Python loop: interruptible at bytecode boundaries.
+        import os as _os
+        import time as _t
+
+        open(path, "w").close()
+        t0 = _t.monotonic()
+        while _t.monotonic() - t0 < 60:
+            _ = sum(range(1000))
+        return "finished"
+
+    import tempfile
+
+    started = tempfile.mktemp()
+    ref = spin.remote(started)
+    deadline = time.monotonic() + 60
+    import os
+
+    while not os.path.exists(started):  # task is RUNNING
+        assert time.monotonic() < deadline
+        time.sleep(0.05)
+    ray_tpu.cancel(ref)
+    with pytest.raises(ray_tpu.TaskError) as ei:
+        ray_tpu.get(ref, timeout=30)
+    assert "cancel" in str(ei.value).lower()
+
+    # The worker survived a non-force cancel and is reusable.
+    @ray_tpu.remote
+    def ok():
+        return 42
+
+    assert ray_tpu.get(ok.remote(), timeout=60) == 42
+
+
+def test_cancel_force_kills_worker(rt):
+    @ray_tpu.remote
+    def block(path):
+        import time as _t
+
+        open(path, "w").close()
+        _t.sleep(120)  # blocking C call: only force can stop it promptly
+        return "finished"
+
+    import os
+    import tempfile
+
+    started = tempfile.mktemp()
+    ref = block.remote(started)
+    deadline = time.monotonic() + 60
+    while not os.path.exists(started):
+        assert time.monotonic() < deadline
+        time.sleep(0.05)
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises(ray_tpu.TaskError) as ei:
+        ray_tpu.get(ref, timeout=30)
+    assert "cancel" in str(ei.value).lower()
+
+    @ray_tpu.remote
+    def ok():
+        return 1
+
+    assert ray_tpu.get(ok.remote(), timeout=60) == 1
+
+
+def test_cancel_running_device_task(rt):
+    @ray_tpu.remote(scheduling_strategy="device")
+    def dev_spin(path):
+        import time as _t
+
+        open(path, "w").close()
+        t0 = _t.monotonic()
+        while _t.monotonic() - t0 < 60:
+            _ = sum(range(1000))
+        return "finished"
+
+    import os
+    import tempfile
+
+    started = tempfile.mktemp()
+    ref = dev_spin.remote(started)
+    deadline = time.monotonic() + 60
+    while not os.path.exists(started):
+        assert time.monotonic() < deadline
+        time.sleep(0.05)
+    ray_tpu.cancel(ref)
+    with pytest.raises(ray_tpu.TaskError) as ei:
+        ray_tpu.get(ref, timeout=30)
+    assert "cancel" in str(ei.value).lower()
+
+
+def test_cancel_queued_task_still_works(rt):
+    @ray_tpu.remote(num_cpus=4)  # hogs the node
+    def hog():
+        import time as _t
+
+        _t.sleep(2.0)
+        return "hog"
+
+    @ray_tpu.remote
+    def queued():
+        return "ran"
+
+    h = hog.remote()
+    q = queued.remote()  # parked behind the hog
+    ray_tpu.cancel(q)
+    with pytest.raises(ray_tpu.TaskError):
+        ray_tpu.get(q, timeout=30)
+    assert ray_tpu.get(h, timeout=30) == "hog"
+
+
+# ---------------------------------------------------------------------------
+# Chaos under load
+# ---------------------------------------------------------------------------
+def test_worker_killer_tasks_survive(rt):
+    """Random worker SIGKILLs under a task load: every task completes
+    correctly via retries."""
+
+    @ray_tpu.remote(max_retries=20)
+    def work(i):
+        import time as _t
+
+        _t.sleep(0.15)
+        return i * i
+
+    with WorkerKiller(interval_s=0.4, seed=1) as killer:
+        refs = [work.remote(i) for i in range(40)]
+        out = ray_tpu.get(refs, timeout=300)
+    assert out == [i * i for i in range(40)]
+    assert killer.kills >= 1  # the chaos actually fired
+
+
+def test_node_killer_cluster_survives():
+    """Kill a worker NODE mid-load: tasks retried/spilled elsewhere."""
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    cluster = Cluster(init_args={"num_cpus": 2})
+    try:
+        cluster.add_node(num_cpus=2)
+        cluster.wait_for_nodes(2)
+
+        @ray_tpu.remote(max_retries=20)
+        def work(i):
+            import time as _t
+
+            _t.sleep(0.2)
+            return i + 100
+
+        with NodeKiller(cluster, interval_s=1.5, max_kills=1, seed=0) as nk:
+            refs = [work.remote(i) for i in range(30)]
+            out = ray_tpu.get(refs, timeout=300)
+        assert out == [i + 100 for i in range(30)]
+        assert nk.kills == 1
+    finally:
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# wait() fan-in (VERDICT r1 weak item 11)
+# ---------------------------------------------------------------------------
+def test_wait_large_fanin(rt):
+    @ray_tpu.remote
+    def unit(i):
+        return i
+
+    refs = [unit.remote(i) for i in range(300)]
+    t0 = time.monotonic()
+    remaining = list(refs)
+    done_count = 0
+    while remaining:
+        done, remaining = ray_tpu.wait(remaining, num_returns=1,
+                                       timeout=120)
+        done_count += len(done)
+    assert done_count == 300
+    assert time.monotonic() - t0 < 120
+
+    # And a single big wait for everything at once.
+    refs = [unit.remote(i) for i in range(500)]
+    done, not_done = ray_tpu.wait(refs, num_returns=500, timeout=120)
+    assert len(done) == 500 and not not_done
