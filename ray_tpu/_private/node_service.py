@@ -1299,12 +1299,15 @@ class NodeService:
             from ray_tpu.util import tracing
 
             tok = worker_mod._running_task.set(spec.task_id)
+            tracer = None
+            # register() immediately precedes the try whose finally
+            # unregisters (see worker._execute): no stale-mapping window.
             self._device_interrupts.register(spec.task_id.binary())
-            tracer = (tracing.task_span(f"task::{spec.name}::execute",
-                                        spec.trace_ctx,
-                                        attributes={"lane": "device"})
-                      if spec.trace_ctx is not None else None)
             try:
+                tracer = (tracing.task_span(f"task::{spec.name}::execute",
+                                            spec.trace_ctx,
+                                            attributes={"lane": "device"})
+                          if spec.trace_ctx is not None else None)
                 if instance is not None:
                     method = getattr(instance, spec.method_name)
                     return (True, method(*args, **kwargs))
@@ -1340,6 +1343,7 @@ class NodeService:
                 if actor is not None:
                     actor.inflight -= 1
                     self._pump_actor(actor)
+                self.cancelled.discard(spec.task_id)  # cancel raced done
                 rids = spec.return_ids()
                 if not ok:
                     # Same retry semantics as the CPU lane.

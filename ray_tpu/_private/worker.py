@@ -296,20 +296,25 @@ class WorkerContext:
 
     def _execute(self, p: dict):
         task_id = TaskID(p["task_id"])
-        self._interrupts.register(task_id.binary())
         tok = _running_task.set(task_id)
-        from ray_tpu.util import tracing
-
-        trace_ctx = p.get("trace_ctx")
-        # Nested submissions during a traced task follow the thread's
-        # active context (tracing.should_trace), so the chain survives
-        # any number of hops WITHOUT flipping tracing on permanently for
-        # this worker's later, untraced work.
-        tracer = (tracing.task_span(f"task::{p['name']}::execute",
-                                    trace_ctx,
-                                    attributes={"worker_pid": os.getpid()})
-                  if trace_ctx is not None else None)
+        tracer = None
+        # register() is IMMEDIATELY followed by the try whose finally
+        # unregisters — any injected cancel landing after registration
+        # reaches that finally, so a stale mapping can never target this
+        # (reused) pool thread.
+        self._interrupts.register(task_id.binary())
         try:
+            from ray_tpu.util import tracing
+
+            trace_ctx = p.get("trace_ctx")
+            # Nested submissions during a traced task follow the thread's
+            # active context (tracing.should_trace), so the chain survives
+            # any number of hops WITHOUT flipping tracing on permanently
+            # for this worker's later, untraced work.
+            tracer = (tracing.task_span(
+                f"task::{p['name']}::execute", trace_ctx,
+                attributes={"worker_pid": os.getpid()})
+                if trace_ctx is not None else None)
             args = [self._decode_arg(a) for a in p["args"]]
             kwargs = {k: self._decode_arg(v) for k, v in p["kwargs"].items()}
             if p.get("actor_id") is not None:

@@ -60,6 +60,9 @@ class Result:
     error: Optional[BaseException] = None
     path: str = ""
     metrics_history: list = field(default_factory=list)
+    # The trial's hyperparameter config (reference: Result.config) —
+    # populated by Tune; empty for direct Trainer.fit results.
+    config: dict = field(default_factory=dict)
 
 
 class TrainWorker:

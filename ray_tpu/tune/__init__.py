@@ -10,7 +10,10 @@ single slice; everything else matches the reference's API shape.
 
 from .search import (  # noqa: F401
     BasicVariantGenerator,
+    ConcurrencyLimiter,
+    Repeater,
     Searcher,
+    TPESearcher,
     choice,
     grid_search,
     lograndint,
@@ -25,6 +28,7 @@ from .schedulers import (  # noqa: F401
     ASHAScheduler,
     AsyncHyperBandScheduler,
     FIFOScheduler,
+    HyperBandScheduler,
     MedianStoppingRule,
     PopulationBasedTraining,
     TrialScheduler,

@@ -94,13 +94,43 @@ class TuneController:
                 return True
         return False
 
-    def _next_config(self) -> Optional[dict]:
-        return self.searcher.suggest(f"t{len(self.trials)}")
+    def _next_trial(self) -> Optional[Trial]:
+        """Suggest under the trial's REAL id so searcher feedback
+        (on_trial_result/complete) matches what suggest() was told —
+        stateful searchers (ConcurrencyLimiter, TPE, Repeater) depend on
+        the ids lining up."""
+        trial = Trial(config={})
+        cfg = self.searcher.suggest(trial.trial_id)
+        if cfg is None:
+            return None
+        trial.config = cfg
+        return trial
 
     # -- the loop -----------------------------------------------------------
     def step(self) -> bool:
         """One controller step. Returns False when the experiment is done."""
         import ray_tpu
+
+        # Wake PAUSED trials whose scheduler later granted a resume plan
+        # (barrier schedulers like HyperBand promote a cohort only when
+        # its LAST member parks — after the earlier members' pause-time
+        # exploit already returned None).
+        for t in self.trials:
+            if t.status == PAUSED:
+                if getattr(self.scheduler, "paused_is_stopped",
+                           lambda _t: False)(t):
+                    t.status = TERMINATED
+                    self.scheduler.on_trial_complete(t, t.last_result)
+                    self.searcher.on_trial_complete(t.trial_id,
+                                                    t.last_result)
+                    continue
+                plan = self.scheduler.exploit(t)
+                if plan is not None:
+                    ckpt, new_config = plan
+                    if ckpt is not None:
+                        t.resume_ckpt_path = getattr(ckpt, "path", ckpt)
+                    t.config = new_config
+                    t.status = PENDING
 
         # Refill: new trials from the searcher, resumed PENDING trials first.
         running = [t for t in self.trials if t.status == RUNNING]
@@ -109,15 +139,22 @@ class TuneController:
             if pending:
                 trial = pending.pop(0)
             else:
-                cfg = self._next_config()
-                if cfg is None:
+                trial = self._next_trial()
+                if trial is None:
                     break
-                trial = Trial(config=cfg)
                 self.trials.append(trial)
             self._launch(trial)
             running.append(trial)
 
         if not running:
+            # Before declaring the experiment done, let a barrier
+            # scheduler resolve partial cohorts (trials PAUSED at a rung
+            # whose peers can never arrive) — if it changes anything the
+            # next step's wake pass resumes/terminates them.
+            drain = getattr(self.scheduler, "drain", None)
+            if drain is not None and any(
+                    t.status == PAUSED for t in self.trials) and drain():
+                return True
             return False
 
         polls = [(t, t.actor.poll.remote(timeout=POLL_INTERVAL))

@@ -42,6 +42,11 @@ class TrialScheduler:
     def exploit(self, trial):
         return None
 
+    # Barrier-scheduler hook: a PAUSED trial whose cohort eliminated it
+    # should be terminated by the controller's wake pass. Default: no.
+    def paused_is_stopped(self, trial) -> bool:
+        return False
+
 
 class FIFOScheduler(TrialScheduler):
     """Run every trial to completion in submission order."""
@@ -195,3 +200,98 @@ class PopulationBasedTraining(TrialScheduler):
 
 # Reference exposes ASHAScheduler as the recommended alias.
 ASHAScheduler = AsyncHyperBandScheduler
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Synchronous HyperBand-style successive halving (parity:
+    /root/reference/python/ray/tune/schedulers/hyperband.py, reduced to
+    one bracket): trials run to the current rung's budget and PAUSE;
+    when a full cohort is parked at a rung, the top 1/eta CONTINUE to
+    the next rung (the controller resumes paused trials from their
+    checkpoints) and the rest stop. Compared to ASHA's asynchronous
+    promotions this wastes some wall-clock at rung barriers but never
+    promotes on a partial cohort."""
+
+    def __init__(self, *, time_attr: str = "training_iteration",
+                 max_t: int = 81, eta: int = 3, cohort: int = None):
+        self.time_attr = time_attr
+        self.max_t = max_t
+        self.eta = eta
+        self.cohort = cohort  # trials per rung barrier (None: set on 1st rung)
+        self.rungs: list[int] = []
+        r = max_t
+        while r >= 1:
+            self.rungs.append(r)
+            r //= eta
+        self.rungs = sorted(set(self.rungs))  # ascending budgets
+        # trial_id -> index of the rung it is working toward
+        self._target: dict[str, int] = {}
+        # rung idx -> list[(score, trial_id)] parked at the barrier
+        self._parked: dict[int, list] = {}
+        self._advance: set = set()  # trial_ids allowed to continue
+        self._stopped: set = set()
+
+    def _rung_budget(self, idx: int) -> int:
+        return self.rungs[idx]
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        tid = trial.trial_id
+        if tid in self._stopped:
+            return STOP
+        idx = self._target.setdefault(tid, 0)
+        t = result.get(self.time_attr, 0)
+        if t >= self.max_t:
+            return STOP
+        if t < self._rung_budget(idx):
+            return CONTINUE
+        # Reached the rung: park at the barrier.
+        score = self._score(result)
+        parked = self._parked.setdefault(idx, [])
+        parked.append((score, tid))
+        if self.cohort is None:
+            self.cohort = max(self.eta, 1)
+        if len(parked) >= self.cohort:
+            self._resolve_cohort(idx)
+        if tid in self._advance:
+            self._advance.discard(tid)
+            return CONTINUE
+        if tid in self._stopped:
+            return STOP
+        return PAUSE
+
+    def _resolve_cohort(self, idx: int) -> bool:
+        """Rank a rung's parked trials; top 1/eta advance, rest stop."""
+        parked = self._parked.get(idx) or []
+        if not parked:
+            return False
+        parked.sort(reverse=True)
+        keep = max(1, len(parked) // self.eta)
+        for rank, (_s, pid) in enumerate(parked):
+            if rank < keep:
+                self._advance.add(pid)
+                self._target[pid] = min(idx + 1, len(self.rungs) - 1)
+            else:
+                self._stopped.add(pid)
+        self._parked[idx] = []
+        return True
+
+    def drain(self, trials=None) -> bool:
+        """No more trials are coming (searcher exhausted, nothing
+        running): resolve every PARTIAL cohort so stranded-at-a-barrier
+        trials — including the tournament leader waiting for peers that
+        can never arrive — either advance or terminate. Returns True if
+        anything changed (the controller re-runs its wake pass)."""
+        changed = False
+        for idx in sorted(self._parked):
+            changed |= self._resolve_cohort(idx)
+        return changed
+
+    def exploit(self, trial):
+        # A paused trial later promoted by its cohort resumes unchanged.
+        if trial.trial_id in self._advance:
+            self._advance.discard(trial.trial_id)
+            return (trial.resume_ckpt_path, trial.config)
+        return None
+
+    def paused_is_stopped(self, trial) -> bool:
+        return trial.trial_id in self._stopped

@@ -191,3 +191,230 @@ class BasicVariantGenerator(Searcher):
             self._queue.extend(resolve(self._space or {}, self.rng))
             self._draws += 1
         return self._queue.pop(0)
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps live suggestions from a wrapped searcher (parity:
+    /root/reference/python/ray/tune/search/concurrency_limiter.py):
+    suggest() returns None while ``max_concurrent`` suggested trials
+    have not completed — sequential model-based searchers (TPE) need
+    this to learn from results before suggesting more."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        if max_concurrent < 1:
+            raise ValueError("max_concurrent must be >= 1")
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    def set_search_properties(self, metric, mode, space):
+        super().set_search_properties(metric, mode, space)
+        self.searcher.set_search_properties(metric, mode, space)
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        if len(self._live) >= self.max_concurrent:
+            return None
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None:
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_result(self, trial_id, result):
+        self.searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)
+
+
+class Repeater(Searcher):
+    """Repeats each underlying suggestion ``repeat`` times and reports
+    the MEAN metric back to the wrapped searcher (parity:
+    /root/reference/python/ray/tune/search/repeater.py) — for noisy
+    objectives (RL, dropout) where single evaluations mislead."""
+
+    def __init__(self, searcher: Searcher, repeat: int = 3):
+        if repeat < 1:
+            raise ValueError("repeat must be >= 1")
+        self.searcher = searcher
+        self.repeat = repeat
+        self._group_of: dict = {}    # trial_id -> group key
+        self._groups: dict = {}      # group key -> {config, scores, lead}
+        self._pending: list = []     # (group, config) clones to hand out
+        self._counter = 0
+
+    def set_search_properties(self, metric, mode, space):
+        super().set_search_properties(metric, mode, space)
+        self.searcher.set_search_properties(metric, mode, space)
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        if self._pending:
+            group, cfg = self._pending.pop(0)
+            self._group_of[trial_id] = group
+            return dict(cfg)
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is None:
+            return None
+        group = f"rep{self._counter}"
+        self._counter += 1
+        self._groups[group] = {"config": cfg, "scores": [],
+                               "lead": trial_id,
+                               "remaining": self.repeat}
+        self._group_of[trial_id] = group
+        self._pending.extend((group, cfg) for _ in range(self.repeat - 1))
+        return dict(cfg)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        group = self._group_of.pop(trial_id, None)
+        if group is None:
+            return
+        g = self._groups.get(group)
+        if g is None:
+            return
+        if not error and result and self.metric in result:
+            g["scores"].append(float(result[self.metric]))
+        # Count DOWN from repeat: clones still waiting in self._pending
+        # (not yet suggested) must keep the group open — a live-trial
+        # scan alone closes it early under tight concurrency limits.
+        g["remaining"] -= 1
+        if g["remaining"] == 0:
+            mean = (sum(g["scores"]) / len(g["scores"])
+                    if g["scores"] else None)
+            agg = dict(result or {})
+            if mean is not None:
+                agg[self.metric] = mean
+            self.searcher.on_trial_complete(
+                g["lead"], agg if g["scores"] else None,
+                error=not g["scores"])
+            del self._groups[group]
+
+
+class TPESearcher(Searcher):
+    """Native tree-structured-Parzen-estimator-style searcher (the
+    reference reaches TPE through the Optuna/HyperOpt integrations,
+    tune/search/optuna — no external SDK is baked into this image, so
+    this is a self-contained implementation of the same idea): after
+    ``n_initial`` random trials, split observations at the ``gamma``
+    quantile into good/bad, model each numeric dimension with Gaussian
+    kernels around observed points (log-space where the domain is log),
+    and suggest the candidate maximizing the good/bad density ratio;
+    categoricals sample from smoothed good-set counts."""
+
+    def __init__(self, *, n_initial: int = 10, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None,
+                 num_samples: int = 100):
+        self.n_initial = n_initial
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+        self._space: Optional[dict] = None
+        self._obs: list = []  # (config, score) — score already sign-fixed
+        self._live_cfg: dict = {}
+        self._suggested = 0
+
+    def set_search_properties(self, metric, mode, space):
+        super().set_search_properties(metric, mode, space)
+        self._space = space
+
+    # -- internals ----------------------------------------------------------
+    def _leaves(self):
+        return list(_walk(self._space or {}))
+
+    def _sample_random(self) -> dict:
+        return resolve(self._space or {}, self.rng)[0]
+
+    def _kde_logpdf(self, x, points, bw):
+        # Mixture of Gaussians around each observed point.
+        if not points:
+            return 0.0
+        total = 0.0
+        for p in points:
+            total += math.exp(-0.5 * ((x - p) / bw) ** 2)
+        return math.log(max(total / len(points), 1e-300))
+
+    def _suggest_model(self) -> dict:
+        n_good = max(1, int(len(self._obs) * self.gamma))
+        ranked = sorted(self._obs, key=lambda cs: -cs[1])
+        good = [c for c, _ in ranked[:n_good]]
+        bad = [c for c, _ in ranked[n_good:]] or good
+
+        def get(cfg, path):
+            cur = cfg
+            for k in path:
+                cur = cur[k]
+            return cur
+
+        best_cfg, best_score = None, -math.inf
+        for _ in range(self.n_candidates):
+            cand = {}
+            score = 0.0
+            for path, dom in self._leaves():
+                if isinstance(dom, (Float, Integer)):
+                    is_log = getattr(dom, "log", False)
+                    tx = (lambda v: math.log(max(v, 1e-300))) if is_log \
+                        else (lambda v: float(v))
+                    gv = [tx(get(c, path)) for c in good]
+                    bv = [tx(get(c, path)) for c in bad]
+                    lo, hi = tx(dom.lower), tx(max(dom.upper, dom.lower + 1e-12))
+                    bw = max((hi - lo) / 5.0, 1e-12)
+                    # Sample from the good KDE, clipped into the domain.
+                    center = self.rng.choice(gv)
+                    x = min(max(self.rng.gauss(center, bw), lo), hi)
+                    score += self._kde_logpdf(x, gv, bw) - \
+                        self._kde_logpdf(x, bv, bw)
+                    v = math.exp(x) if is_log else x
+                    if isinstance(dom, Integer):
+                        v = min(int(round(v)), dom.upper - 1)
+                        v = max(v, dom.lower)
+                    elif getattr(dom, "q", None):
+                        v = round(v / dom.q) * dom.q
+                    _set(cand, path, v)
+                elif isinstance(dom, Categorical):
+                    counts = {c: 1.0 for c in map(repr, dom.categories)}
+                    for c in good:
+                        counts[repr(get(c, path))] = \
+                            counts.get(repr(get(c, path)), 1.0) + 1.0
+                    cats, weights = zip(*[(cat, counts[repr(cat)])
+                                          for cat in dom.categories])
+                    v = self.rng.choices(cats, weights=weights)[0]
+                    _set(cand, path, v)
+                else:  # Function/grid leaves: sample fresh
+                    _set(cand, path, dom.sample(self.rng)
+                         if isinstance(dom, Domain)
+                         else self.rng.choice(dom.values))
+            if score >= best_score:
+                best_cfg, best_score = cand, score
+        # Constants (non-domain leaves) come from a random resolve base.
+        base = self._sample_random()
+
+        def merge(dst, src):
+            for k, v in src.items():
+                if isinstance(v, dict) and isinstance(dst.get(k), dict):
+                    merge(dst[k], v)
+                else:
+                    dst[k] = v
+
+        merge(base, best_cfg)
+        return base
+
+    # -- Searcher API -------------------------------------------------------
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        if self._suggested >= self.num_samples:
+            return None
+        self._suggested += 1
+        if len(self._obs) < self.n_initial:
+            cfg = self._sample_random()
+        else:
+            cfg = self._suggest_model()
+        self._live_cfg[trial_id] = cfg
+        return cfg
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        cfg = self._live_cfg.pop(trial_id, None)
+        if cfg is None or error or not result or self.metric not in result:
+            return
+        score = float(result[self.metric])
+        if self.mode == "min":
+            score = -score
+        self._obs.append((cfg, score))

@@ -149,6 +149,7 @@ class Tuner:
                        else None),
                 path=os.path.join(exp_dir, t.name),
                 metrics_history=t.history,
+                config=dict(t.config),
             ))
         return ResultGrid(results, controller.trials, tc.metric, tc.mode)
 
