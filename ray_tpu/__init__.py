@@ -47,6 +47,7 @@ from ._private.exceptions import (  # noqa: F401
     WorkerCrashedError,
 )
 from ._private.task_spec import SchedulingStrategy  # noqa: F401
+from . import dashboard  # noqa: F401
 from . import runtime_env  # noqa: F401
 from . import util  # noqa: F401
 from . import workflow  # noqa: F401

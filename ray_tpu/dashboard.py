@@ -1,0 +1,233 @@
+"""Cluster dashboard: a single-page web UI over the state API.
+
+Capability parity target: the reference dashboard
+(/root/reference/dashboard/ — head web server + per-node agents feeding
+node/actor/job/metrics views). Here the driver already aggregates
+everything through the state API and metrics tables, so the dashboard
+is one HTTP server on the head: an HTML page that polls the JSON
+endpoints below. No build step, no React bundle — the data surface
+matches the reference's Overview/Cluster/Actors/Jobs/Metrics tabs.
+
+Endpoints:
+  /                    the page
+  /api/overview        nodes, resources, task summary, store usage
+  /api/actors          actor table
+  /api/jobs            job table (if a JobManager exists)
+  /api/tasks           task summary by name/state
+  /metrics             Prometheus text (same as util.serve_metrics)
+
+Start with ``ray_tpu.dashboard.start_dashboard(port)`` or
+``rtpu dashboard``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional
+
+_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:1.5rem;background:#fafafa;color:#222}
+ h1{font-size:1.3rem} h2{font-size:1.05rem;margin-top:1.4rem}
+ table{border-collapse:collapse;width:100%;background:#fff;box-shadow:0 1px 2px #0002}
+ th,td{padding:.35rem .6rem;border-bottom:1px solid #eee;text-align:left;font-size:.85rem}
+ th{background:#f0f0f0} .num{text-align:right}
+ .pill{padding:.1rem .5rem;border-radius:1rem;font-size:.75rem}
+ .ALIVE,.RUNNING,.SUCCEEDED{background:#d6f5d6}.DEAD,.FAILED,.ERROR{background:#fdd}
+ .PENDING,.STOPPED{background:#eee}
+ #updated{color:#888;font-size:.8rem}
+</style></head><body>
+<h1>ray_tpu dashboard <span id="updated"></span></h1>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Resources</h2><table id="resources"></table>
+<h2>Tasks</h2><table id="tasks"></table>
+<h2>Actors</h2><table id="actors"></table>
+<h2>Jobs</h2><table id="jobs"></table>
+<h2>Object store</h2><table id="store"></table>
+<script>
+function esc(v){return String(v).replace(/[&<>"']/g,
+  c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));}
+function row(cells, tag){return '<tr>'+cells.map(c=>'<'+(tag||'td')+'>'+c+'</'+(tag||'td')+'>').join('')+'</tr>';}
+function pill(s){s=esc(s);return '<span class="pill '+s+'">'+s+'</span>';}
+async function refresh(){
+  try{
+    const o = await (await fetch('api/overview')).json();
+    document.getElementById('nodes').innerHTML =
+      row(['node','state','role','CPU avail/total','other resources'],'th') +
+      o.nodes.map(n=>row([n.node_id.slice(0,12), pill(n.state),
+        n.is_head_node?'head':(n.is_driver?'driver':'worker'),
+        (n.available.CPU??0)+' / '+(n.resources.CPU??0),
+        Object.entries(n.resources).filter(([k])=>k!=='CPU')
+          .map(([k,v])=>esc(k)+'='+esc(v)).join(' ')||'-'])).join('');
+    document.getElementById('resources').innerHTML =
+      row(['resource','available','total'],'th') +
+      Object.entries(o.resources_total).map(([k,v])=>
+        row([esc(k), o.resources_available[k]??0, v])).join('');
+    const t = await (await fetch('api/tasks')).json();
+    document.getElementById('tasks').innerHTML =
+      row(['task','SUBMITTED','RUNNING','FINISHED','FAILED'],'th') +
+      Object.entries(t.by_name).map(([name,states])=>row([esc(name),
+        states.SUBMITTED||0, states.RUNNING||0, states.FINISHED||0,
+        states.FAILED||0])).join('');
+    const a = await (await fetch('api/actors')).json();
+    document.getElementById('actors').innerHTML =
+      row(['actor','class','state','restarts','node','pid'],'th') +
+      a.actors.map(x=>row([esc(x.name||x.actor_id.slice(0,12)), esc(x.class_name),
+        pill(x.state), x.num_restarts, x.node_id.slice(0,12),
+        x.pid??'-'])).join('');
+    const j = await (await fetch('api/jobs')).json();
+    document.getElementById('jobs').innerHTML =
+      row(['job','status','entrypoint','runtime (s)'],'th') +
+      j.jobs.map(x=>row([esc(x.submission_id), pill(x.status),
+        esc(x.entrypoint), x.runtime_s??'-'])).join('');
+    document.getElementById('store').innerHTML =
+      row(['node','objects','bytes used','capacity'],'th') +
+      o.store.map(s=>row([s.node_id.slice(0,12), s.num_objects??'-',
+        s.bytes_used??'-', s.capacity_bytes??'-'])).join('');
+    document.getElementById('updated').textContent =
+      'updated ' + new Date().toLocaleTimeString();
+  }catch(e){document.getElementById('updated').textContent='refresh failed: '+e;}
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>
+"""
+
+
+# One cluster snapshot shared by every endpoint for ~1s: N open tabs
+# polling 3 endpoints each must not multiply cluster-wide RPC fan-outs
+# (each of which pays the per-node timeout for any hung node).
+_snap_cache = {"t": 0.0, "snap": None}
+_snap_lock = threading.Lock()
+
+
+def _snapshot(ttl: float = 1.0) -> dict:
+    import time as _t
+
+    from ._private import context as context_mod
+
+    with _snap_lock:
+        now = _t.monotonic()
+        if _snap_cache["snap"] is None or now - _snap_cache["t"] > ttl:
+            rt = context_mod.require_context()
+            _snap_cache["snap"] = rt.cluster_state(
+                tables=["tasks", "actors"])
+            _snap_cache["t"] = now
+        return _snap_cache["snap"]
+
+
+def _overview() -> dict:
+    snap = _snapshot()
+    nodes = []
+    total: dict = {}
+    avail: dict = {}
+    store = []
+    for n in snap["nodes"]:
+        nodes.append({
+            "node_id": (n["node_id"].hex()
+                        if isinstance(n["node_id"], bytes)
+                        else str(n["node_id"])),
+            "state": n["state"],
+            "is_head_node": n.get("is_head_node", False),
+            "is_driver": n.get("is_driver", False),
+            "resources": n["resources"],
+            "available": n["available"],
+        })
+        if n["state"] == "ALIVE" and not n.get("is_driver"):
+            for k, v in n["resources"].items():
+                total[k] = total.get(k, 0) + v
+            for k, v in n["available"].items():
+                avail[k] = avail.get(k, 0) + v
+    for s in snap["snapshots"]:
+        store.append({"node_id": s["node_id"], **s.get("store", {})})
+    return {"nodes": nodes, "resources_total": total,
+            "resources_available": avail, "store": store}
+
+
+def _tasks() -> dict:
+    snap = _snapshot()
+    best: dict = {}
+    for s in snap["snapshots"]:
+        for r in s.get("tasks", []):
+            cur = best.get(r["task_id"])
+            if cur is None or ("start_ts" in r, r.get("ts", 0.0)) > \
+                    ("start_ts" in cur, cur.get("ts", 0.0)):
+                best[r["task_id"]] = r
+    by_name: dict = {}
+    for r in best.values():
+        states = by_name.setdefault(r["name"], {})
+        states[r["state"]] = states.get(r["state"], 0) + 1
+    return {"by_name": by_name}
+
+
+def _actors() -> dict:
+    snap = _snapshot()
+    actors = []
+    for s in snap["snapshots"]:
+        actors.extend(s.get("actors", []))
+    return {"actors": actors}
+
+
+def _jobs() -> dict:
+    try:
+        from .job_submission import JOB_MANAGER_NAME
+        import ray_tpu
+
+        mgr = ray_tpu.get_actor(JOB_MANAGER_NAME)
+        jobs = ray_tpu.get(mgr.list_jobs.remote(), timeout=10)
+        import time as _t
+
+        for j in jobs:
+            if j.get("start_time"):
+                end = j.get("end_time") or _t.time()
+                j["runtime_s"] = round(end - j["start_time"], 1)
+        return {"jobs": jobs}
+    except Exception:
+        return {"jobs": []}
+
+
+def start_dashboard(port: int = 0, host: str = "127.0.0.1"):
+    """Serve the dashboard on a daemon thread; returns (host, port)."""
+    import http.server
+
+    from .util.prometheus import prometheus_text
+
+    routes = {
+        "/api/overview": _overview,
+        "/api/tasks": _tasks,
+        "/api/actors": _actors,
+        "/api/jobs": _jobs,
+    }
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - stdlib API
+            path = self.path.split("?")[0].rstrip("/") or "/"
+            try:
+                if path == "/":
+                    body, ctype = _PAGE.encode(), "text/html"
+                elif path == "/metrics":
+                    body, ctype = (prometheus_text().encode(),
+                                   "text/plain; version=0.0.4")
+                elif path in routes:
+                    body = json.dumps(routes[path]()).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+            except Exception as e:  # noqa: BLE001
+                self.send_error(500, str(e))
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    server = http.server.ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="rt-dashboard").start()
+    return server.server_address

@@ -295,6 +295,19 @@ def cmd_metrics(args):
     sys.stdout.write(prometheus_text())
 
 
+def cmd_dashboard(args):
+    _attach(args)
+    from ray_tpu.dashboard import start_dashboard
+
+    host, port = start_dashboard(port=args.port)
+    print(f"dashboard at http://{host}:{port}/ (ctrl-c to stop)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+
+
 def cmd_stack(args):
     _attach(args)
     from ray_tpu._private import context as context_mod
@@ -425,6 +438,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="print cluster metrics (Prometheus format)")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_metrics)
+
+    sp = sub.add_parser("dashboard", help="serve the cluster web UI")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--port", type=int, default=8265)
+    sp.set_defaults(fn=cmd_dashboard)
 
     sp = sub.add_parser("stack",
                         help="thread stacks of every node/worker process")

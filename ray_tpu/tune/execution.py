@@ -161,7 +161,7 @@ class TuneController:
                  for t in running]
         for trial, ref in polls:
             try:
-                reports, done, err = ray_tpu.get(ref, timeout=120)
+                reports, done, err, _beat = ray_tpu.get(ref, timeout=120)
             except Exception as e:  # actor died (crash/kill)
                 self._on_trial_error(trial, str(e))
                 continue

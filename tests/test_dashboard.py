@@ -1,0 +1,65 @@
+"""Dashboard: JSON endpoints + page over the state API.
+
+Parity model: /root/reference/dashboard/ (head web server views:
+overview/nodes/actors/jobs/metrics)."""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dashboard import start_dashboard
+
+
+@pytest.fixture
+def dash(rt):
+    host, port = start_dashboard()
+    yield f"http://{host}:{port}"
+
+
+def _get(url):
+    return urllib.request.urlopen(url, timeout=15).read().decode()
+
+
+def test_page_serves(dash):
+    html = _get(dash + "/")
+    assert "ray_tpu dashboard" in html
+    assert "api/overview" in html
+
+
+def test_overview_endpoint(dash):
+    o = json.loads(_get(dash + "/api/overview"))
+    assert o["nodes"] and o["nodes"][0]["state"] == "ALIVE"
+    assert o["resources_total"].get("CPU", 0) >= 4
+    assert isinstance(o["store"], list)
+
+
+def test_tasks_and_actors_endpoints(dash):
+    @ray_tpu.remote
+    def dash_task():
+        return 1
+
+    @ray_tpu.remote
+    class DashActor:
+        def ping(self):
+            return "pong"
+
+    a = DashActor.options(name="dash_actor").remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    ray_tpu.get(dash_task.remote(), timeout=60)
+
+    from ray_tpu import dashboard as dash_mod
+
+    dash_mod._snap_cache["t"] = 0.0  # bypass the 1s TTL for the assert
+    t = json.loads(_get(dash + "/api/tasks"))
+    assert any("dash_task" in name for name in t["by_name"])
+    acts = json.loads(_get(dash + "/api/actors"))["actors"]
+    assert any(x["class_name"] == "DashActor" for x in acts)
+
+
+def test_jobs_and_metrics_endpoints(dash):
+    j = json.loads(_get(dash + "/api/jobs"))
+    assert "jobs" in j  # empty without a JobManager — shape holds
+    m = _get(dash + "/metrics")
+    assert "rtpu_node_num_workers" in m
