@@ -57,6 +57,9 @@ class Config:
     # A failed runtime_env setup poisons that env on the node for this
     # long (fail-fast) before the next task retries it from scratch.
     runtime_env_retry_s: float = _cfg(30.0)
+    # Stream captured worker stdout/stderr lines to the driver console
+    # (reference: ray's log_to_driver).
+    log_to_driver: bool = _cfg(True)
 
     # --- fault tolerance ---
     task_max_retries: int = _cfg(3)

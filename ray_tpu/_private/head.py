@@ -693,6 +693,14 @@ class HeadService:
         if method == "actor_node":
             nid = self.actor_nodes.get(ActorID(payload))
             return nid.binary() if nid is not None else None
+        if method == "worker_logs":
+            # Remote node streaming its workers' output: render on the
+            # driver (this head process) console.
+            from .node_service import _print_worker_logs
+
+            _print_worker_logs(NodeID(payload["node_id"]).hex(),
+                               payload["entries"])
+            return True
         if method == "list_nodes":
             return [e.to_row() for e in self.nodes.values()]
         if method == "create_pg":
@@ -835,6 +843,9 @@ class RemoteHeadClient:
         return await self.conn.call(
             "heartbeat", {"node_id": node_id.binary(),
                           "available": available, "load": load})
+
+    async def push_worker_logs(self, payload):
+        return await self.conn.call("worker_logs", payload)
 
     async def list_nodes(self):
         return await self.conn.call("list_nodes", None)

@@ -120,6 +120,16 @@ class ObjectState:
     creating_spec: Optional[TaskSpec] = None  # lineage (reconstruction)
 
 
+def _print_worker_logs(node_hex: str, entries: list):
+    """Driver-console rendering of streamed worker output (reference:
+    the (pid=…, ip=…) prefixes the log monitor prints)."""
+    for e in entries:
+        prefix = f"(pid={e['pid']}, node={node_hex[:8]})"
+        for line in e["lines"]:
+            sys.stderr.write(f"{prefix} {line}\n")
+    sys.stderr.flush()
+
+
 @dataclass
 class WorkerHandle:
     worker_id: WorkerID
@@ -133,6 +143,9 @@ class WorkerHandle:
     # Runtime-env identity this worker wears; leases only match tasks
     # with the same env (reference: worker_pool.h pools by env hash).
     env_id: str = ""
+    # Captured stdout/stderr file + the tail offset already streamed.
+    log_path: Optional[str] = None
+    log_offset: int = 0
 
 
 @dataclass
@@ -207,6 +220,10 @@ class NodeService:
         self.is_head_node = is_head_node
         self.total_resources = dict(resources)
         self.available = dict(resources)
+        # Worker stdout/stderr capture directory (reference: the session
+        # log dir tailed by log_monitor.py).
+        self.log_dir = os.path.join("/tmp", f"rtpu-{session_id}-logs")
+        os.makedirs(self.log_dir, exist_ok=True)
         # Actor creations parked for lifetime-resource availability.
         self._pending_actor_creations: collections.deque = collections.deque()
         # kill() that raced ahead of the creation it targets.
@@ -271,6 +288,8 @@ class NodeService:
     async def start(self):
         await self.server.start()
         await self.peer_server.start()
+        self._bg_tasks.append(
+            self.loop.create_task(self._log_tail_loop()))
         if self.head is not None:
             self._bg_tasks.append(self.loop.create_task(self._heartbeat_loop()))
             self._bg_tasks.append(
@@ -1110,16 +1129,24 @@ class NodeService:
         env["RT_SESSION_ID"] = self.session_id
         env["RT_SOCK_PATH"] = self.sock_path
         env["RT_WORKER_ID"] = wid.hex()
+        # Per-worker log capture (reference: workers write
+        # worker-<id>.out/.err under the session dir, tailed by the log
+        # monitor): stdout+stderr share one file; the node tails it and
+        # streams new lines to the driver console.
+        log_path = os.path.join(self.log_dir, f"worker-{wid.hex()[:12]}.log")
+        log_f = open(log_path, "ab", buffering=0)
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker"],
             env=env,
-            stdout=None,
-            stderr=None,
+            stdout=log_f,
+            stderr=log_f,
         )
+        log_f.close()  # the child holds the fd
         from ray_tpu import runtime_env as _re
 
         w = WorkerHandle(worker_id=wid, proc=proc, actor_id=actor_id,
                          env_id=_re.env_id(runtime_env))
+        w.log_path = log_path
         w.registered = self.loop.create_future()
         self.workers[wid] = w
         self.counters["workers_started"] += 1
@@ -1736,6 +1763,9 @@ class NodeService:
             return await self._remote_execute(payload)
         if method == "stacks":
             return await self.collect_stacks()
+        if method == "logs":
+            return self.collect_logs(payload.get("tail_bytes", 16_384)
+                                     if isinstance(payload, dict) else 16_384)
         if method == "fetch_object":
             oid = ObjectID(payload["oid"])
             st = await self.wait_object(oid, payload.get("timeout"))
@@ -2151,6 +2181,73 @@ class NodeService:
             out[f"worker:{node}:{w.proc.pid}"] = text
         return out
 
+    async def _log_tail_loop(self):
+        """Stream new worker-log lines to the driver console (reference:
+        python/ray/_private/log_monitor.py tailing the session log dir,
+        publishing to the driver). Lines go to the driver's STDERR with
+        a (pid=…, node=…) prefix so program stdout stays clean."""
+        while not self._closing:
+            await asyncio.sleep(0.5)
+            if not self.cfg.log_to_driver:
+                continue
+            batch = []
+            for w in self.workers.values():
+                if w.log_path is None:
+                    continue
+                try:
+                    size = os.path.getsize(w.log_path)
+                except OSError:
+                    continue
+                if size <= w.log_offset:
+                    continue
+                window = 256 * 1024
+                with open(w.log_path, "rb") as f:
+                    f.seek(w.log_offset)
+                    data = f.read(min(size - w.log_offset, window))
+                cut = data.rfind(b"\n")
+                if cut < 0:
+                    if len(data) < window:
+                        continue  # partial line: wait for the newline
+                    # A single line longer than the window would wedge
+                    # the tail forever: ship the window as one chunk.
+                    cut = len(data) - 1
+                w.log_offset += cut + 1
+                lines = data[:cut + 1].decode("utf-8", "replace").splitlines()
+                batch.append({"pid": w.proc.pid, "lines": lines})
+            if not batch:
+                continue
+            if self.is_head_node or self.head is None \
+                    or getattr(self, "is_driver_node", False):
+                # Head AND attached drivers print their own workers'
+                # output locally — a driver's tasks belong on THAT
+                # driver's console, not the head's.
+                _print_worker_logs(self.node_id.hex(), batch)
+            else:
+                try:
+                    await self.head.push_worker_logs(
+                        {"node_id": self.node_id.binary(),
+                         "entries": batch})
+                except (ConnectionLost, OSError):
+                    pass  # head restarting; lines already in the file
+
+    def collect_logs(self, tail_bytes: int = 16_384) -> dict:
+        """Last ``tail_bytes`` of every live worker's captured log,
+        keyed like collect_stacks (reference: `ray logs`)."""
+        out = {}
+        node = self.node_id.hex()[:8]
+        for w in self.workers.values():
+            if not w.log_path:
+                continue
+            try:
+                size = os.path.getsize(w.log_path)
+                with open(w.log_path, "rb") as f:
+                    f.seek(max(0, size - tail_bytes))
+                    out[f"worker:{node}:{w.proc.pid}"] = \
+                        f.read().decode("utf-8", "replace")
+            except OSError:
+                continue
+        return out
+
     def directory_sync(self) -> dict:
         """What this node contributes to the head's directory tables on
         (re-)registration: live named actors, homes of actors it hosts,
@@ -2409,3 +2506,7 @@ class NodeService:
                 w.proc.wait(timeout=2)
             except subprocess.TimeoutExpired:
                 w.proc.kill()
+        # Session over: reclaim the captured-log namespace.
+        import shutil
+
+        shutil.rmtree(self.log_dir, ignore_errors=True)

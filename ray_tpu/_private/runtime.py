@@ -180,6 +180,8 @@ class Runtime:
         node = NodeService(
             self.session_id, self.sock_path, self._resources, self.shm,
             self.loop, node_id=self.node_id, head=None, is_head_node=False)
+        # A driver's workers log to THIS driver's console (not the head's).
+        node.is_driver_node = True
 
         async def on_head_lost(conn):
             if getattr(self, "_shut", False):
@@ -487,17 +489,21 @@ class Runtime:
     def kv_op(self, op, key, val=None):
         return self._run(self.node.head.kv_op(op, key, val))
 
-    def cluster_stacks(self, timeout: float = 15.0) -> dict:
-        """Thread stacks of every node + worker process cluster-wide
-        (reference: `ray stack`)."""
+    def _node_fanout(self, method: str, payload, local_fn,
+                     timeout: float) -> dict:
+        """Merged dict from one peer RPC per ALIVE node (with a per-node
+        budget) + the local node's in-process answer — the shared shape
+        behind cluster_stacks/cluster_logs (reference: the state API's
+        per-agent aggregation)."""
 
         async def query(n):
             if tuple(n["address"]) == tuple(self.node.peer_address):
-                return await self.node.collect_stacks()
+                out = local_fn()
+                return (await out) if asyncio.iscoroutine(out) else out
             try:
                 conn = await self.node._addr_conn(tuple(n["address"]))
                 return await asyncio.wait_for(
-                    conn.call("stacks", None), timeout)
+                    conn.call(method, payload), timeout)
             except Exception as e:  # noqa: BLE001 - best effort
                 return {f"node:{n['node_id'].hex()[:12]}":
                         f"<unreachable: {e}>"}
@@ -512,6 +518,20 @@ class Runtime:
             return merged
 
         return self._run(gather(), timeout=timeout + 5)
+
+    def cluster_logs(self, tail_bytes: int = 16_384,
+                     timeout: float = 15.0) -> dict:
+        """Recent captured worker logs cluster-wide (reference: `ray
+        logs`), keyed worker:<node>:<pid>."""
+        return self._node_fanout(
+            "logs", {"tail_bytes": tail_bytes},
+            lambda: self.node.collect_logs(tail_bytes), timeout)
+
+    def cluster_stacks(self, timeout: float = 15.0) -> dict:
+        """Thread stacks of every node + worker process cluster-wide
+        (reference: `ray stack`)."""
+        return self._node_fanout(
+            "stacks", None, self.node.collect_stacks, timeout)
 
     def resolve_runtime_env(self, env: dict | None,
                             device_lane: bool = False):

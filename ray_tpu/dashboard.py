@@ -107,14 +107,27 @@ def _snapshot(ttl: float = 1.0) -> dict:
 
     from ._private import context as context_mod
 
+    # Stale-while-refreshing: the lock guards only the cache fields; the
+    # cluster-wide fan-out runs OUTSIDE it (one refresher at a time), so
+    # a hung node's per-node timeout never stalls cache-hit requests.
     with _snap_lock:
         now = _t.monotonic()
-        if _snap_cache["snap"] is None or now - _snap_cache["t"] > ttl:
-            rt = context_mod.require_context()
-            _snap_cache["snap"] = rt.cluster_state(
-                tables=["tasks", "actors"])
-            _snap_cache["t"] = now
-        return _snap_cache["snap"]
+        snap = _snap_cache["snap"]
+        fresh = snap is not None and now - _snap_cache["t"] <= ttl
+        refreshing = _snap_cache.get("refreshing", False)
+        if fresh or (snap is not None and refreshing):
+            return snap
+        _snap_cache["refreshing"] = True
+    try:
+        rt = context_mod.require_context()
+        new = rt.cluster_state(tables=["tasks", "actors"])
+        with _snap_lock:
+            _snap_cache["snap"] = new
+            _snap_cache["t"] = _t.monotonic()
+        return new
+    finally:
+        with _snap_lock:
+            _snap_cache["refreshing"] = False
 
 
 def _overview() -> dict:

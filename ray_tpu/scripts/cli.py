@@ -308,6 +308,22 @@ def cmd_dashboard(args):
         pass
 
 
+def cmd_logs(args):
+    _attach(args)
+    from ray_tpu._private import context as context_mod
+
+    rt = context_mod.require_context()
+    logs = rt.cluster_logs(tail_bytes=args.tail * 100)
+    for name, text in sorted(logs.items()):
+        lines = text.splitlines()[-args.tail:]
+        print(f"===== {name} =====")
+        for line in lines:
+            print(line)
+        print()
+    if not logs:
+        print("no worker logs captured yet")
+
+
 def cmd_stack(args):
     _attach(args)
     from ray_tpu._private import context as context_mod
@@ -448,6 +464,12 @@ def build_parser() -> argparse.ArgumentParser:
                         help="thread stacks of every node/worker process")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_stack)
+
+    sp = sub.add_parser("logs", help="recent worker logs cluster-wide")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--tail", type=int, default=100,
+                    help="lines per worker")
+    sp.set_defaults(fn=cmd_logs)
 
     sp = sub.add_parser("memory", help="object store usage summary")
     sp.add_argument("--address", default=None)

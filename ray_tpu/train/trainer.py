@@ -351,12 +351,13 @@ class JaxTrainer:
                 if gang_failed:
                     break
                 stale = []
+                rank_errors = []
                 for rank, (reports, done, err, beat_age) in \
                         enumerate(results):
                     done_flags[rank] = done
                     if err is not None:
                         gang_failed = True
-                        worker_error = f"rank {rank}: {err}"
+                        rank_errors.append(f"rank {rank}: {err}")
                     if (self.worker_health_timeout_s is not None
                             and not done
                             and beat_age > self.worker_health_timeout_s):
@@ -374,6 +375,10 @@ class JaxTrainer:
                             from .checkpoint import maybe_cleanup_tmp_checkpoint
 
                             maybe_cleanup_tmp_checkpoint(ckpt_path)
+                if rank_errors:
+                    # ALL failing ranks in one message: the first is
+                    # usually the root cause of a gang-wide failure.
+                    worker_error = "; ".join(rank_errors)
                 if stale and not gang_failed:
                     gang_failed = True
                     worker_error = (
