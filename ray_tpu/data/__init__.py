@@ -12,16 +12,23 @@ from .context import DataContext
 from .dataset import (  # noqa: F401
     Dataset,
     DatasetShard,
+    from_arrow,
     from_items,
+    from_numpy,
+    from_pandas,
     range_,
+    read_binary_files,
     read_csv,
+    read_images,
     read_json,
     read_parquet,
+    read_text,
 )
 
 range = range_  # ray.data.range parity (shadows the builtin in this namespace)
 
 __all__ = [
-    "DataContext", "Dataset", "DatasetShard", "from_items", "range",
-    "read_csv", "read_json", "read_parquet",
+    "DataContext", "Dataset", "DatasetShard", "from_arrow", "from_items",
+    "from_numpy", "from_pandas", "range", "read_binary_files", "read_csv",
+    "read_images", "read_json", "read_parquet", "read_text",
 ]

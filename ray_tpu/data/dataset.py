@@ -429,6 +429,18 @@ class Dataset:
             parts = [B.slice_block(buf[0], offset, B.block_len(buf[0]))] + buf[1:]
             yield emit(B.concat_blocks(parts))
 
+    def to_pandas(self):
+        """Materialize as one DataFrame (reference: Dataset.to_pandas)."""
+        import pandas as pd
+
+        full = B.concat_blocks(list(self.iter_blocks()))
+        return pd.DataFrame({k: list(v) if v.ndim > 1 else v
+                             for k, v in full.items()})
+
+    def to_arrow(self):
+        """Materialize as one pyarrow Table (reference: to_arrow_refs)."""
+        return B.block_to_arrow(B.concat_blocks(list(self.iter_blocks())))
+
     def take(self, n: int = 20) -> list:
         return list(itertools.islice(self.iter_rows(), n))
 
@@ -621,7 +633,7 @@ def range_(n: int, *, override_num_blocks: Optional[int] = None) -> Dataset:
     return Dataset(source)
 
 
-def _read_files(paths, reader) -> Dataset:
+def _expand_paths(paths) -> list:
     import glob
     import os
 
@@ -633,6 +645,11 @@ def _read_files(paths, reader) -> Dataset:
             files.extend(sorted(glob.glob(os.path.join(p, "*"))))
         else:
             files.extend(sorted(glob.glob(p)) or [p])
+    return files
+
+
+def _read_files(paths, reader) -> Dataset:
+    files = _expand_paths(paths)
 
     def source():
         for f in files:
@@ -657,3 +674,78 @@ def read_json(paths) -> Dataset:
     from pyarrow import json as pajson
 
     return _read_files(paths, pajson.read_json)
+
+
+def from_pandas(dfs) -> Dataset:
+    """DataFrame(s) -> Dataset (reference: ray.data.from_pandas)."""
+    if not isinstance(dfs, (list, tuple)):
+        dfs = [dfs]
+    blocks = [{c: np.asarray(df[c]) for c in df.columns} for df in dfs]
+    return Dataset(lambda: iter(blocks))
+
+
+def from_arrow(tables) -> Dataset:
+    """pyarrow Table(s) -> Dataset (reference: ray.data.from_arrow)."""
+    if not isinstance(tables, (list, tuple)):
+        tables = [tables]
+    blocks = [B.arrow_to_block(t) for t in tables]
+    return Dataset(lambda: iter(blocks))
+
+
+def from_numpy(arrays, column: str = "data") -> Dataset:
+    """ndarray(s) -> single-column Dataset (reference: from_numpy)."""
+    if not isinstance(arrays, (list, tuple)):
+        arrays = [arrays]
+    blocks = [{column: np.asarray(a)} for a in arrays]
+    return Dataset(lambda: iter(blocks))
+
+
+def read_text(paths, *, encoding: str = "utf-8") -> Dataset:
+    """One row per line, column 'text' (reference: read_text)."""
+    import pyarrow as pa
+
+    def reader(path):
+        with open(path, encoding=encoding) as f:
+            return pa.table({"text": f.read().splitlines()})
+
+    return _read_files(paths, reader)
+
+
+def read_binary_files(paths, *, include_paths: bool = False) -> Dataset:
+    """One row per file, column 'bytes' (reference: read_binary_files)."""
+    import pyarrow as pa
+
+    def reader(path):
+        with open(path, "rb") as f:
+            cols = {"bytes": pa.array([f.read()], type=pa.binary())}
+            if include_paths:
+                cols["path"] = pa.array([path])
+            return pa.table(cols)
+
+    return _read_files(paths, reader)
+
+
+def read_images(paths, *, size=None, mode: str = "RGB",
+                include_paths: bool = False) -> Dataset:
+    """One row per image file, column 'image' [H, W, C] uint8
+    (reference: read_images; decoding via PIL)."""
+    # Images don't fit the arrow reader shape (multi-dim arrays): build
+    # blocks directly.
+    files = _expand_paths(paths)
+
+    def source():
+        from PIL import Image
+
+        for path in files:
+            img = Image.open(path).convert(mode)
+            if size is not None:
+                # size is (height, width) like the reference read_images;
+                # PIL resize wants (width, height).
+                img = img.resize((size[1], size[0]))
+            arr = np.asarray(img)[None]  # [1, H, W, C]
+            cols = {"image": arr}
+            if include_paths:
+                cols["path"] = np.asarray([path])
+            yield cols
+
+    return Dataset(source)

@@ -17,6 +17,13 @@ from .multi_agent import (  # noqa: F401
     MultiAgentEnvRunner,
     MultiAgentPPO,
 )
+from .offline import (  # noqa: F401
+    BC,
+    MARWIL,
+    BCLearner,
+    load_offline_data,
+    write_offline_data,
+)
 from .sac import SAC, SACLearner  # noqa: F401
 from .env_runner import (  # noqa: F401
     SingleAgentEnvRunner,

@@ -70,6 +70,9 @@ class AlgorithmConfig:
         # multi-agent
         self.policies: Optional[dict] = None
         self.policy_mapping_fn: Callable = lambda agent_id: "default"
+        # offline
+        self.input_: Optional[str] = None  # dataset path (BC/MARWIL)
+        self.evaluation_interval: int = 5
 
     # -- builder steps ------------------------------------------------------
     def environment(self, env=None, *, env_config: Optional[dict] = None,
@@ -109,6 +112,17 @@ class AlgorithmConfig:
             self.policies = policies
         if policy_mapping_fn is not None:
             self.policy_mapping_fn = policy_mapping_fn
+        return self
+
+    def offline_data(self, *, input_: Optional[str] = None, **_):
+        """Reference: algorithm_config.offline_data(input_=...)."""
+        if input_ is not None:
+            self.input_ = input_
+        return self
+
+    def evaluation(self, *, evaluation_interval: Optional[int] = None, **_):
+        if evaluation_interval is not None:
+            self.evaluation_interval = evaluation_interval
         return self
 
     def debugging(self, *, seed: Optional[int] = None, **_):

@@ -1,0 +1,181 @@
+"""Offline RL: episode recording + behavior cloning / MARWIL.
+
+Capability parity target: /root/reference/rllib/offline/ (JsonWriter/
+JsonReader feeding offline algorithms) and rllib/algorithms/{bc,marwil}
+(BC = supervised policy learning from logged actions; MARWIL weights
+the cloning loss by exponentiated advantages, beta=0 reduces to BC).
+
+Storage: .npz shards (columnar numpy — obs/actions/rewards/dones), the
+zero-dependency analogue of the reference's JSON episodes; written from
+the same [T, N] sample batches the env runners produce.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .algorithm import Algorithm
+from .learner import Learner, LearnerGroup
+
+
+# ---------------------------------------------------------------------------
+# Episode IO
+# ---------------------------------------------------------------------------
+def write_offline_data(batches: Union[dict, List[dict]], path: str) -> int:
+    """Write env-runner sample batches ([T, N] time-major, the shape
+    SingleAgentEnvRunner.sample returns) as .npz shards under ``path``.
+    Returns the number of transitions written."""
+    if isinstance(batches, dict):
+        batches = [batches]
+    os.makedirs(path, exist_ok=True)
+    existing = len(glob.glob(os.path.join(path, "shard-*.npz")))
+    total = 0
+    for i, b in enumerate(batches):
+        T, N = b["rewards"].shape
+
+        def env_major(x):
+            # [T, N, ...] -> env-major flat [N*T, ...]: each env's
+            # trajectory is CONTIGUOUS, so the sequential return-to-go
+            # scan at load never crosses env boundaries mid-episode.
+            return np.swapaxes(np.asarray(x), 0, 1).reshape(
+                (T * N,) + np.asarray(x).shape[2:])
+
+        flat = {k: env_major(b[k])
+                for k in ("obs", "actions", "rewards", "dones")}
+        # Env boundaries inside the shard (every T steps): the loader
+        # resets its return accumulator there even without a done.
+        flat["episode_breaks"] = np.arange(0, T * N, T)
+        np.savez(os.path.join(path, f"shard-{existing + i:05d}.npz"),
+                 **flat)
+        total += T * N
+    return total
+
+
+def load_offline_data(path: str, gamma: float = 0.99) -> dict:
+    """Load every shard; compute per-step discounted return-to-go
+    (episode boundaries from dones) for advantage weighting."""
+    files = sorted(glob.glob(os.path.join(path, "shard-*.npz")))
+    if not files:
+        raise FileNotFoundError(f"no offline shards under {path!r}")
+    cols: dict = {k: [] for k in ("obs", "actions", "rewards", "dones")}
+    returns = []
+    for f in files:
+        with np.load(f) as z:
+            shard = {k: z[k] for k in cols}
+            breaks = set(z["episode_breaks"].tolist()
+                         if "episode_breaks" in z else [0])
+        for k, v in shard.items():
+            cols[k].append(v)
+        # Return-to-go per SHARD, resetting at env boundaries: a shard
+        # holds independent trajectories back to back.
+        rtg = np.zeros_like(shard["rewards"], dtype=np.float32)
+        acc = 0.0
+        for i in range(len(rtg) - 1, -1, -1):
+            if shard["dones"][i] or (i + 1) in breaks or i + 1 == len(rtg):
+                acc = 0.0
+            acc = shard["rewards"][i] + gamma * acc
+            rtg[i] = acc
+        returns.append(rtg)
+    out = {k: np.concatenate(v) for k, v in cols.items()}
+    out["returns"] = np.concatenate(returns)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Learner + algorithms
+# ---------------------------------------------------------------------------
+class BCLearner(Learner):
+    """Advantage-weighted behavior cloning (parity:
+    rllib/algorithms/marwil/marwil_torch_policy.py): loss =
+    -exp(beta * A_hat) * logp(logged action); beta=0 is plain BC. The
+    value head regresses returns to produce A_hat = G - V(s)."""
+
+    def __init__(self, module, *, beta: float = 0.0,
+                 vf_coeff: float = 1.0, **kw):
+        self.beta = beta
+        self.vf_coeff = vf_coeff
+        super().__init__(module, **kw)
+
+    def loss(self, params, batch):
+        logp, entropy, value = self.module.forward_train(
+            params, batch["obs"], batch["actions"])
+        vf_loss = ((value - batch["returns"]) ** 2).mean()
+        if self.beta:
+            adv = batch["returns"] - jax.lax.stop_gradient(value)
+            adv = adv / jnp.maximum(
+                jax.lax.stop_gradient(jnp.abs(adv).mean()), 1e-6)
+            weight = jnp.exp(jnp.clip(self.beta * adv, -4.0, 4.0))
+        else:
+            weight = jnp.ones_like(logp)
+        bc_loss = -(jax.lax.stop_gradient(weight) * logp).mean()
+        total = bc_loss + self.vf_coeff * vf_loss
+        return total, {"bc_loss": bc_loss, "vf_loss": vf_loss,
+                       "entropy": entropy.mean(),
+                       "mean_weight": weight.mean()}
+
+
+class MARWIL(Algorithm):
+    """Offline training driver: minibatches from the logged dataset,
+    periodic online evaluation through the local env runner (parity:
+    rllib/algorithms/marwil/marwil.py training_step)."""
+
+    beta = 1.0
+
+    def _make_learner_group(self):
+        learner = BCLearner(
+            self._make_module(),
+            beta=self.beta,
+            vf_coeff=self.config.vf_coeff,
+            lr=self.config.lr,
+            grad_clip=self.config.grad_clip,
+            seed=self.config.seed or 0,
+        )
+        return LearnerGroup(learner)
+
+    def setup(self, config):
+        if config.num_env_runners > 0:
+            raise ValueError("offline algorithms train from the dataset; "
+                             "set num_env_runners=0 (the local runner is "
+                             "used for evaluation only)")
+        super().setup(config)
+        if not config.input_:
+            raise ValueError(
+                "offline training needs config.offline_data(input_=path)")
+        self.dataset = load_offline_data(config.input_, config.gamma)
+        self._rng = np.random.default_rng(config.seed)
+        self._eval_every = config.evaluation_interval
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        n = len(self.dataset["actions"])
+        metrics: dict = {}
+        for _ in range(cfg.num_epochs):
+            idx = self._rng.integers(0, n, cfg.train_batch_size)
+            mb = {"obs": self.dataset["obs"][idx],
+                  "actions": self.dataset["actions"][idx],
+                  "returns": self.dataset["returns"][idx]}
+            metrics = self.learner_group.learner.update_from_batch(mb)
+        metrics["num_steps_trained"] = cfg.num_epochs * cfg.train_batch_size
+        if self._eval_every and self.iteration % self._eval_every == 0:
+            self._sync_weights()
+            # Sample until at least one episode COMPLETES (a well-cloned
+            # policy's episodes outlast one fragment), bounded.
+            for _ in range(20):
+                self.local_runner.sample(cfg.rollout_fragment_length)
+                rets = self.local_runner.episode_returns()
+                if rets:
+                    self._record_episodes(rets)
+                    break
+        return metrics
+
+
+class BC(MARWIL):
+    """Plain behavior cloning (parity: rllib/algorithms/bc)."""
+
+    beta = 0.0

@@ -1,0 +1,208 @@
+"""multiprocessing.Pool API over cluster tasks.
+
+Capability parity target: /root/reference/python/ray/util/
+multiprocessing/pool.py — drop-in Pool so existing
+``multiprocessing.Pool`` code scales across the cluster by changing one
+import. Supported surface: map/map_async/imap/imap_unordered/
+starmap/apply/apply_async, chunking, context-manager lifecycle.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, Optional
+
+
+class AsyncResult:
+    def __init__(self, refs, single: bool = False, submitted=None):
+        self._refs = refs  # may still be FILLING (windowed map_async)
+        self._single = single
+        self._submitted = submitted  # threading.Event | None
+
+    def _all_refs(self, timeout=None):
+        if self._submitted is not None and \
+                not self._submitted.wait(timeout=timeout):
+            from ray_tpu import GetTimeoutError
+
+            raise GetTimeoutError("map_async submission still in flight")
+        return list(self._refs)
+
+    def get(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        out = ray_tpu.get(self._all_refs(timeout), timeout=timeout)
+        if self._single:
+            return out[0]
+        return list(itertools.chain.from_iterable(out))
+
+    def wait(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        refs = self._all_refs(timeout)
+        ray_tpu.wait(refs, num_returns=len(refs), timeout=timeout)
+
+    def ready(self) -> bool:
+        import ray_tpu
+
+        if self._submitted is not None and not self._submitted.is_set():
+            return False
+        done, _ = ray_tpu.wait(list(self._refs),
+                               num_returns=len(self._refs), timeout=0)
+        return len(done) == len(self._refs)
+
+    def successful(self) -> bool:
+        if not self.ready():
+            raise ValueError("result is not ready")  # stdlib contract
+        try:
+            self.get(timeout=0)
+            return True
+        except Exception:
+            return False
+
+
+def _run_chunk(fn, chunk, star):
+    return [fn(*item) if star else fn(item) for item in chunk]
+
+
+class Pool:
+    """Tasks instead of forked children: each chunk is one cluster task,
+    so the pool spans every node (processes=None uses cluster CPUs)."""
+
+    def __init__(self, processes: Optional[int] = None):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        if processes is None:
+            total = ray_tpu.cluster_resources().get("CPU", 1)
+            processes = max(1, int(total))
+        self._processes = processes
+        self._remote_chunk = ray_tpu.remote(_run_chunk)
+        self._closed = False
+
+    # -- internals ----------------------------------------------------------
+    def _chunks(self, iterable: Iterable, chunksize: Optional[int],
+                star: bool):
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, len(items) // (self._processes * 4) or 1)
+        return [items[i:i + chunksize]
+                for i in range(0, len(items), chunksize)], star
+
+    def _submit(self, fn, chunks, star):
+        """Windowed dispatch: at most ``processes`` chunks in flight, so
+        Pool(processes=N) actually throttles like the stdlib/reference
+        pools (rate limits, memory-heavy fns)."""
+        import ray_tpu
+
+        if self._closed:
+            raise ValueError("Pool not running")
+        refs, inflight = [], []
+        for c in chunks:
+            if len(inflight) >= self._processes:
+                _done, inflight = ray_tpu.wait(inflight, num_returns=1)
+            r = self._remote_chunk.remote(fn, c, star)
+            refs.append(r)
+            inflight.append(r)
+        return refs
+
+    def _submit_async(self, fn, chunks, star):
+        """map_async must return immediately: the windowed dispatch runs
+        on a background thread filling the shared refs list."""
+        import threading
+
+        import ray_tpu
+
+        if self._closed:
+            raise ValueError("Pool not running")
+        refs: list = []
+        done = threading.Event()
+
+        def run():
+            inflight = []
+            try:
+                for c in chunks:
+                    if len(inflight) >= self._processes:
+                        _d, inflight = ray_tpu.wait(inflight, num_returns=1)
+                    r = self._remote_chunk.remote(fn, c, star)
+                    refs.append(r)
+                    inflight.append(r)
+            finally:
+                done.set()
+
+        threading.Thread(target=run, daemon=True).start()
+        return refs, done
+
+    # -- the API ------------------------------------------------------------
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> list:
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn, iterable, chunksize=None) -> AsyncResult:
+        chunks, star = self._chunks(iterable, chunksize, False)
+        refs, submitted = self._submit_async(fn, chunks, star)
+        return AsyncResult(refs, submitted=submitted)
+
+    def starmap(self, fn: Callable, iterable: Iterable,
+                chunksize: Optional[int] = None) -> list:
+        chunks, star = self._chunks(iterable, chunksize, True)
+        return AsyncResult(self._submit(fn, chunks, star)).get()
+
+    def imap(self, fn, iterable, chunksize: Optional[int] = None):
+        import collections
+
+        import ray_tpu
+
+        chunks, star = self._chunks(iterable, chunksize, False)
+        window: collections.deque = collections.deque()
+        for c in chunks:
+            window.append(self._remote_chunk.remote(fn, c, star))
+            if len(window) > self._processes:
+                yield from ray_tpu.get(window.popleft())
+        while window:
+            yield from ray_tpu.get(window.popleft())
+
+    def imap_unordered(self, fn, iterable, chunksize: Optional[int] = None):
+        import ray_tpu
+
+        chunks, star = self._chunks(iterable, chunksize, False)
+        it = iter(chunks)
+        pending = []
+        for c in it:
+            pending.append(self._remote_chunk.remote(fn, c, star))
+            if len(pending) >= self._processes:
+                break
+        while pending:
+            done, pending = ray_tpu.wait(pending, num_returns=1)
+            nxt = next(it, None)
+            if nxt is not None:
+                pending.append(self._remote_chunk.remote(fn, nxt, star))
+            for ref in done:
+                yield from ray_tpu.get(ref)
+
+    def apply(self, fn: Callable, args: tuple = (), kwds: dict = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn, args=(), kwds=None) -> AsyncResult:
+        import ray_tpu
+
+        kwds = kwds or {}
+        call = ray_tpu.remote(lambda: fn(*args, **kwds))
+        return AsyncResult([call.remote()], single=True)
+
+    # -- lifecycle ----------------------------------------------------------
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+
+    def join(self):
+        pass  # tasks, not child processes: nothing to join
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
