@@ -60,6 +60,12 @@ class Config:
     # Stream captured worker stdout/stderr lines to the driver console
     # (reference: ray's log_to_driver).
     log_to_driver: bool = _cfg(True)
+    # Memory monitor (reference: memory_monitor.h + worker killing
+    # policies): when host memory usage exceeds the threshold, the
+    # fattest retriable task's worker is killed with OutOfMemoryError.
+    # interval 0 disables.
+    memory_monitor_interval_s: float = _cfg(1.0)
+    memory_usage_threshold: float = _cfg(0.95)
 
     # --- fault tolerance ---
     task_max_retries: int = _cfg(3)

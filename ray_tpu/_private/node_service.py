@@ -42,6 +42,7 @@ from .config import get_config
 from .exceptions import (
     ActorDiedError,
     ObjectLostError,
+    OutOfMemoryError,
     RuntimeEnvSetupError,
     TaskCancelledError,
     TaskError,
@@ -290,6 +291,9 @@ class NodeService:
         await self.peer_server.start()
         self._bg_tasks.append(
             self.loop.create_task(self._log_tail_loop()))
+        if self.cfg.memory_monitor_interval_s > 0:
+            self._bg_tasks.append(
+                self.loop.create_task(self._memory_monitor_loop()))
         if self.head is not None:
             self._bg_tasks.append(self.loop.create_task(self._heartbeat_loop()))
             self._bg_tasks.append(
@@ -1273,6 +1277,12 @@ class NodeService:
         if getattr(spec, "_cancel_requested", False):
             self._fail_task(spec, TaskCancelledError(task_name=spec.name))
             return
+        if getattr(spec, "_oom_killed", False):
+            spec._oom_killed = False
+            err = OutOfMemoryError(
+                f"worker killed by the memory monitor while running "
+                f"'{spec.name}' (host memory pressure)",
+                task_name=spec.name)
         if spec.max_retries > 0 and not spec.is_actor_creation and spec.actor_id is None:
             spec.max_retries -= 1
             self.counters["tasks_retried"] += 1
@@ -2180,6 +2190,73 @@ class NodeService:
             # different machines would collide in the merged view.
             out[f"worker:{node}:{w.proc.pid}"] = text
         return out
+
+    # -- memory pressure (reference: src/ray/common/memory_monitor.h:52 +
+    # raylet worker_killing_policy*.h: under host memory pressure, kill
+    # the retriable task using the most memory so the node survives and
+    # the task retries elsewhere/later) ---------------------------------
+    @staticmethod
+    def _read_host_memory_fraction() -> float:
+        """Used/total from /proc/meminfo (MemAvailable-based, the same
+        signal the reference monitor uses). Tests inject a fake."""
+        try:
+            info = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    key, _, rest = line.partition(":")
+                    info[key] = int(rest.split()[0])
+            total = info["MemTotal"]
+            avail = info.get("MemAvailable", info.get("MemFree", total))
+            return 1.0 - avail / total
+        except (OSError, KeyError, ValueError, ZeroDivisionError):
+            return 0.0
+
+    @staticmethod
+    def _read_worker_rss(pid: int) -> int:
+        """Resident bytes of one worker (no psutil in the image)."""
+        try:
+            with open(f"/proc/{pid}/statm") as f:
+                return int(f.read().split()[1]) * os.sysconf("SC_PAGESIZE")
+        except (OSError, ValueError, IndexError):
+            return 0
+
+    async def _memory_monitor_loop(self):
+        while not self._closing:
+            await asyncio.sleep(self.cfg.memory_monitor_interval_s)
+            try:
+                usage = self._read_host_memory_fraction()
+            except Exception:  # noqa: BLE001 - monitor must survive
+                continue
+            if usage <= self.cfg.memory_usage_threshold:
+                continue
+            self._kill_fattest_worker(usage)
+
+    def _kill_fattest_worker(self, usage: float):
+        """Victim selection (reference: RetriableFIFOWorkerKillingPolicy
+        — prefer workers whose tasks can retry; among those, the largest
+        RSS)."""
+        candidates = []
+        for w in self.workers.values():
+            if w.state not in ("IDLE", "BUSY") or not w.inflight:
+                continue
+            retriable = all(s.max_retries > 0 and s.actor_id is None
+                            for s in w.inflight.values())
+            candidates.append((retriable, self._read_worker_rss(w.proc.pid),
+                               w))
+        if not candidates:
+            return
+        # Retriable victims first; largest RSS within the class.
+        retriable, rss, victim = max(
+            candidates, key=lambda c: (c[0], c[1]))
+        for spec in victim.inflight.values():
+            spec._oom_killed = True
+        sys.stderr.write(
+            f"memory monitor: host usage {usage:.0%} > "
+            f"{self.cfg.memory_usage_threshold:.0%}; killing worker "
+            f"pid={victim.proc.pid} (rss={rss / 1e6:.0f}MB, "
+            f"retriable={retriable})\n")
+        self.counters["workers_oom_killed"] += 1
+        self._kill_worker(victim, force=True)
 
     async def _log_tail_loop(self):
         """Stream new worker-log lines to the driver console (reference:

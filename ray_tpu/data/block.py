@@ -92,4 +92,11 @@ def arrow_to_block(table) -> Block:
 def block_to_arrow(b: Block):
     import pyarrow as pa
 
-    return pa.table({k: pa.array(v) for k, v in b.items()})
+    def col(v):
+        if getattr(v, "ndim", 1) > 1:
+            # Multi-dim columns (images, tensors) become nested lists —
+            # arrow has no first-class ndarray type.
+            return pa.array(v.tolist())
+        return pa.array(v)
+
+    return pa.table({k: col(v) for k, v in b.items()})

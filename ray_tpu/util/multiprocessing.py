@@ -20,26 +20,43 @@ class AsyncResult:
         self._submitted = submitted  # threading.Event | None
 
     def _all_refs(self, timeout=None):
+        """Waits for windowed submission to finish; None on timeout."""
         if self._submitted is not None and \
                 not self._submitted.wait(timeout=timeout):
-            from ray_tpu import GetTimeoutError
-
-            raise GetTimeoutError("map_async submission still in flight")
+            return None
         return list(self._refs)
 
     def get(self, timeout: Optional[float] = None):
+        import time as _t
+
         import ray_tpu
 
-        out = ray_tpu.get(self._all_refs(timeout), timeout=timeout)
+        deadline = None if timeout is None else _t.monotonic() + timeout
+        refs = self._all_refs(timeout)
+        if refs is None:
+            from ray_tpu import GetTimeoutError
+
+            raise GetTimeoutError("map_async submission still in flight")
+        remaining = (None if deadline is None
+                     else max(0.0, deadline - _t.monotonic()))
+        out = ray_tpu.get(refs, timeout=remaining)
         if self._single:
-            return out[0]
+            return out[0][0]  # one chunk of one item (apply path)
         return list(itertools.chain.from_iterable(out))
 
     def wait(self, timeout: Optional[float] = None):
+        """stdlib contract: returns None whether or not ready."""
+        import time as _t
+
         import ray_tpu
 
+        deadline = None if timeout is None else _t.monotonic() + timeout
         refs = self._all_refs(timeout)
-        ray_tpu.wait(refs, num_returns=len(refs), timeout=timeout)
+        if refs is None:
+            return
+        remaining = (None if deadline is None
+                     else max(0.0, deadline - _t.monotonic()))
+        ray_tpu.wait(refs, num_returns=len(refs), timeout=remaining)
 
     def ready(self) -> bool:
         import ray_tpu
@@ -60,8 +77,9 @@ class AsyncResult:
             return False
 
 
-def _run_chunk(fn, chunk, star):
-    return [fn(*item) if star else fn(item) for item in chunk]
+def _run_chunk(fn, chunk, star, kwds=None):
+    kwds = kwds or {}
+    return [fn(*item, **kwds) if star else fn(item) for item in chunk]
 
 
 class Pool:
@@ -81,6 +99,21 @@ class Pool:
         self._closed = False
 
     # -- internals ----------------------------------------------------------
+    def _check_open(self):
+        if self._closed:
+            raise ValueError("Pool not running")
+
+    def _iter_chunks(self, iterable: Iterable, chunksize: Optional[int]):
+        """LAZY chunking for imap*: never materializes the iterable
+        (stdlib imap streams; default chunksize=1 like the stdlib)."""
+        it = iter(iterable)
+        size = chunksize or 1
+        while True:
+            chunk = list(itertools.islice(it, size))
+            if not chunk:
+                return
+            yield chunk
+
     def _chunks(self, iterable: Iterable, chunksize: Optional[int],
                 star: bool):
         items = list(iterable)
@@ -153,30 +186,30 @@ class Pool:
 
         import ray_tpu
 
-        chunks, star = self._chunks(iterable, chunksize, False)
+        self._check_open()
         window: collections.deque = collections.deque()
-        for c in chunks:
-            window.append(self._remote_chunk.remote(fn, c, star))
-            if len(window) > self._processes:
+        for c in self._iter_chunks(iterable, chunksize):
+            if len(window) >= self._processes:
                 yield from ray_tpu.get(window.popleft())
+            window.append(self._remote_chunk.remote(fn, c, False))
         while window:
             yield from ray_tpu.get(window.popleft())
 
     def imap_unordered(self, fn, iterable, chunksize: Optional[int] = None):
         import ray_tpu
 
-        chunks, star = self._chunks(iterable, chunksize, False)
-        it = iter(chunks)
+        self._check_open()
+        it = self._iter_chunks(iterable, chunksize)
         pending = []
         for c in it:
-            pending.append(self._remote_chunk.remote(fn, c, star))
+            pending.append(self._remote_chunk.remote(fn, c, False))
             if len(pending) >= self._processes:
                 break
         while pending:
             done, pending = ray_tpu.wait(pending, num_returns=1)
             nxt = next(it, None)
             if nxt is not None:
-                pending.append(self._remote_chunk.remote(fn, nxt, star))
+                pending.append(self._remote_chunk.remote(fn, nxt, False))
             for ref in done:
                 yield from ray_tpu.get(ref)
 
@@ -184,11 +217,11 @@ class Pool:
         return self.apply_async(fn, args, kwds).get()
 
     def apply_async(self, fn, args=(), kwds=None) -> AsyncResult:
-        import ray_tpu
-
-        kwds = kwds or {}
-        call = ray_tpu.remote(lambda: fn(*args, **kwds))
-        return AsyncResult([call.remote()], single=True)
+        self._check_open()
+        # One chunk of one starred item through the shared runner — no
+        # per-call remote-function registration.
+        ref = self._remote_chunk.remote(fn, [tuple(args)], True, kwds)
+        return AsyncResult([ref], single=True)
 
     # -- lifecycle ----------------------------------------------------------
     def close(self):

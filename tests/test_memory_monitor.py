@@ -1,0 +1,96 @@
+"""Memory monitor: OOM worker-killing policy under host pressure.
+
+Parity model: /root/reference/src/ray/common/memory_monitor.h:52 and
+the raylet worker-killing policies (worker_killing_policy*.h) — tested
+the reference's way: injected memory readings drive the policy, no real
+memory pressure needed.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.exceptions import OutOfMemoryError
+
+
+def _pressure(rt, fraction):
+    """Inject a fake host-memory reading into the node's monitor."""
+    rt.node._read_host_memory_fraction = staticmethod(lambda: fraction)
+
+
+def test_reader_sane(rt):
+    frac = rt.node._read_host_memory_fraction()
+    assert 0.0 <= frac <= 1.0
+    import os
+
+    assert rt.node._read_worker_rss(os.getpid()) > 0
+
+
+def test_retriable_task_survives_oom_kill(rt):
+    @ray_tpu.remote(max_retries=5)
+    def marked_sleep(path):
+        import os as _os
+        import time as _t
+
+        with open(path, "a") as f:
+            f.write("x")
+        _t.sleep(1.2)
+        return "done"
+
+    import tempfile
+
+    marker = tempfile.mktemp()
+    ref = marked_sleep.remote(marker)
+    deadline = time.monotonic() + 60
+    import os
+
+    while not os.path.exists(marker):  # running
+        assert time.monotonic() < deadline
+        time.sleep(0.05)
+    _pressure(rt, 0.99)  # trips on the next monitor tick, kills the worker
+    time.sleep(1.5)
+    _pressure(rt, 0.0)  # pressure clears; retry runs to completion
+    assert ray_tpu.get(ref, timeout=120) == "done"
+    with open(marker) as f:
+        assert len(f.read()) >= 2  # original + at least one retry
+    assert rt.node.counters["workers_oom_killed"] >= 1
+
+
+def test_nonretriable_task_fails_typed(rt):
+    @ray_tpu.remote(max_retries=0)
+    def stuck(path):
+        import time as _t
+
+        open(path, "w").close()
+        _t.sleep(60)
+
+    import os
+    import tempfile
+
+    marker = tempfile.mktemp()
+    ref = stuck.remote(marker)
+    deadline = time.monotonic() + 60
+    while not os.path.exists(marker):
+        assert time.monotonic() < deadline
+        time.sleep(0.05)
+    _pressure(rt, 0.99)
+    with pytest.raises(ray_tpu.TaskError) as ei:
+        ray_tpu.get(ref, timeout=60)
+    assert isinstance(ei.value, OutOfMemoryError)
+    assert "memory monitor" in str(ei.value)
+    _pressure(rt, 0.0)
+
+
+def test_no_kill_below_threshold(rt):
+    @ray_tpu.remote(max_retries=5)
+    def quick():
+        import time as _t
+
+        _t.sleep(0.3)
+        return 1
+
+    _pressure(rt, 0.5)  # below the 0.95 default
+    assert ray_tpu.get([quick.remote() for _ in range(3)],
+                       timeout=60) == [1, 1, 1]
+    assert rt.node.counters["workers_oom_killed"] == 0
