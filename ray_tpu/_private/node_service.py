@@ -2225,11 +2225,13 @@ class NodeService:
             await asyncio.sleep(self.cfg.memory_monitor_interval_s)
             try:
                 usage = self._read_host_memory_fraction()
-            except Exception:  # noqa: BLE001 - monitor must survive
+                if usage <= self.cfg.memory_usage_threshold:
+                    continue
+                self._kill_fattest_worker(usage)
+            except Exception:  # noqa: BLE001 - the monitor must survive
+                # ANY tick failure (including a broken stderr in the kill
+                # path): losing one tick is fine, losing the loop is not.
                 continue
-            if usage <= self.cfg.memory_usage_threshold:
-                continue
-            self._kill_fattest_worker(usage)
 
     def _kill_fattest_worker(self, usage: float):
         """Victim selection (reference: RetriableFIFOWorkerKillingPolicy

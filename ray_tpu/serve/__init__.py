@@ -15,6 +15,7 @@ from .api import (  # noqa: F401
     run,
     shutdown,
     start,
+    start_grpc,
     status,
 )
 from .batching import batch  # noqa: F401

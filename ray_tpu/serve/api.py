@@ -58,6 +58,7 @@ class _ProxyClient:
 # Route prefixes by app name, kept even when no proxy exists yet so a
 # later serve.start() serves already-running apps (reference behavior).
 _routes: dict[str, str] = {}
+_grpc_proxy = None
 
 
 def start(*, http_host: str = "127.0.0.1", http_port: int = 8000,
@@ -70,6 +71,23 @@ def start(*, http_host: str = "127.0.0.1", http_port: int = 8000,
         for app_name, prefix in _routes.items():
             _proxy.add_route(prefix, app_name)
     return _proxy
+
+
+def start_grpc(*, grpc_host: str = "127.0.0.1", grpc_port: int = 0,
+               enable_pickle: bool = False):
+    """Start the gRPC ingress (reference: gRPCProxy; apps are selected
+    by the 'app' metadata key). Returns the proxy; .port is bound.
+    ``enable_pickle`` additionally exposes /rtpu.serve/Predict, whose
+    request codec is pickle — arbitrary code execution for anyone who
+    can reach the port; trusted networks only."""
+    global _grpc_proxy
+    _get_controller()
+    if _grpc_proxy is None:
+        from .grpc_proxy import GRPCProxy
+
+        _grpc_proxy = GRPCProxy(_ProxyClient(), grpc_host, grpc_port,
+                                enable_pickle=enable_pickle)
+    return _grpc_proxy
 
 
 def run(app: Application, *, name: str = "default",
@@ -130,7 +148,7 @@ def _wait_controller_alive(timeout: float = 60) -> bool:
 
 
 def shutdown():
-    global _controller, _proxy
+    global _controller, _proxy, _grpc_proxy
     import ray_tpu
 
     _routes.clear()
@@ -138,6 +156,9 @@ def shutdown():
     if _proxy is not None:
         _proxy.shutdown()
         _proxy = None
+    if _grpc_proxy is not None:
+        _grpc_proxy.stop()
+        _grpc_proxy = None
     try:
         controller = _get_controller(create=False)
     except RuntimeError:

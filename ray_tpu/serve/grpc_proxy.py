@@ -1,0 +1,96 @@
+"""gRPC ingress (parity:
+/root/reference/python/ray/serve/_private/proxy.py gRPCProxy:544 +
+serve.proto — a gRPC entrypoint per node routing to apps). No generated
+stubs: a generic bytes-in/bytes-out method handler family serves
+
+    /rtpu.serve/Predict         request/response = pickled python values
+    /rtpu.serve/PredictJson     request/response = UTF-8 JSON
+
+with the target application in the ``app`` metadata key (and an
+optional ``method`` key for handle.options(method_name=...)). Client
+usage needs only grpcio:
+
+    ch = grpc.insecure_channel(addr)
+    call = ch.unary_unary("/rtpu.serve/PredictJson")
+    out = call(b'{"x": 2}', metadata=(("app", "default"),))
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+from concurrent import futures
+from typing import Optional
+
+
+class GRPCProxy:
+    def __init__(self, controller, host: str = "127.0.0.1", port: int = 0,
+                 max_workers: int = 16, enable_pickle: bool = False):
+        import grpc
+
+        self.controller = controller
+
+        proxy = self
+
+        def _resolve(context):
+            meta = dict(context.invocation_metadata())
+            return meta.get("app", "default"), meta.get("method")
+
+        def _call(request_value, context):
+            """Aborts (NOT_FOUND / INTERNAL) propagate to the client as
+            their own status — never re-wrapped."""
+            app, method = _resolve(context)
+            try:
+                handle = proxy.controller.get_app_handle(app)
+            except Exception as e:  # noqa: BLE001 - surfaced as NOT_FOUND
+                context.abort(grpc.StatusCode.NOT_FOUND,
+                              f"no app {app!r}: {e}")
+            if method:
+                handle = handle.options(method_name=method)
+            try:
+                return handle.remote(request_value).result(timeout=60)
+            except Exception as e:  # noqa: BLE001
+                context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+        def predict(request: bytes, context) -> bytes:
+            try:
+                value = pickle.loads(request) if request else None
+            except Exception as e:  # noqa: BLE001
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            return pickle.dumps(_call(value, context))
+
+        def predict_json(request: bytes, context) -> bytes:
+            try:
+                value = json.loads(request) if request else None
+            except Exception as e:  # noqa: BLE001
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            result = _call(value, context)
+            try:
+                return json.dumps(result).encode()
+            except Exception as e:  # noqa: BLE001
+                context.abort(grpc.StatusCode.INTERNAL,
+                              f"result not JSON-serializable: {e}")
+
+        identity = lambda b: b  # bytes on the wire, no proto codec
+        handlers = {
+            "PredictJson": grpc.unary_unary_rpc_method_handler(
+                predict_json, request_deserializer=identity,
+                response_serializer=identity),
+        }
+        if enable_pickle:
+            # SECURITY: unpickling request bytes executes arbitrary code
+            # crafted by whoever can reach this port. Only enable on a
+            # trusted network (the reference avoids this entirely by
+            # speaking protobuf); hence opt-in, default off.
+            handlers["Predict"] = grpc.unary_unary_rpc_method_handler(
+                predict, request_deserializer=identity,
+                response_serializer=identity)
+        self.server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers))
+        self.server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler("rtpu.serve", handlers),))
+        self.port = self.server.add_insecure_port(f"{host}:{port}")
+        self.server.start()
+
+    def stop(self):
+        self.server.stop(grace=1)

@@ -128,8 +128,7 @@ class Pool:
         pools (rate limits, memory-heavy fns)."""
         import ray_tpu
 
-        if self._closed:
-            raise ValueError("Pool not running")
+        self._check_open()
         refs, inflight = [], []
         for c in chunks:
             if len(inflight) >= self._processes:
@@ -146,8 +145,7 @@ class Pool:
 
         import ray_tpu
 
-        if self._closed:
-            raise ValueError("Pool not running")
+        self._check_open()
         refs: list = []
         done = threading.Event()
 

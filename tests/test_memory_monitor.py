@@ -28,6 +28,8 @@ def test_reader_sane(rt):
 
 
 def test_retriable_task_survives_oom_kill(rt):
+    rt.node.cfg.memory_monitor_interval_s = 0.2  # tighten the tick for CI
+
     @ray_tpu.remote(max_retries=5)
     def marked_sleep(path):
         import os as _os
@@ -35,7 +37,7 @@ def test_retriable_task_survives_oom_kill(rt):
 
         with open(path, "a") as f:
             f.write("x")
-        _t.sleep(1.2)
+        _t.sleep(3.0)  # wide window: a kill tick MUST land inside it
         return "done"
 
     import tempfile
@@ -49,7 +51,7 @@ def test_retriable_task_survives_oom_kill(rt):
         assert time.monotonic() < deadline
         time.sleep(0.05)
     _pressure(rt, 0.99)  # trips on the next monitor tick, kills the worker
-    time.sleep(1.5)
+    time.sleep(1.0)
     _pressure(rt, 0.0)  # pressure clears; retry runs to completion
     assert ray_tpu.get(ref, timeout=120) == "done"
     with open(marker) as f:

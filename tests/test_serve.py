@@ -350,3 +350,35 @@ def test_replica_death_retries_on_live_replica(serve_rt):
     # Every request still succeeds (dead-replica sends are retried).
     got = {handle.remote(None).result(timeout=60) for _ in range(8)}
     assert got and victim not in got
+
+
+def test_grpc_ingress(serve_rt):
+    """gRPC entrypoint (parity: gRPCProxy): generic bytes methods with
+    the target app in metadata, JSON and pickle codecs."""
+    import grpc
+    import json
+    import pickle
+
+    @serve.deployment
+    def gadd(body):
+        return {"sum": body["a"] + body["b"]}
+
+    serve.run(gadd.bind(), name="gapp")
+    proxy = serve.start_grpc(enable_pickle=True)  # trusted test network
+    ch = grpc.insecure_channel(f"127.0.0.1:{proxy.port}")
+
+    pj = ch.unary_unary("/rtpu.serve/PredictJson")
+    out = pj(json.dumps({"a": 2, "b": 3}).encode(),
+             metadata=(("app", "gapp"),), timeout=30)
+    assert json.loads(out) == {"sum": 5}
+
+    pp = ch.unary_unary("/rtpu.serve/Predict")
+    out = pickle.loads(pp(pickle.dumps({"a": 10, "b": 1}),
+                          metadata=(("app", "gapp"),), timeout=30))
+    assert out == {"sum": 11}
+
+    # Unknown app -> NOT_FOUND
+    with pytest.raises(grpc.RpcError) as ei:
+        pj(b"{}", metadata=(("app", "nope"),), timeout=30)
+    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+    ch.close()
