@@ -82,11 +82,20 @@ def start_grpc(*, grpc_host: str = "127.0.0.1", grpc_port: int = 0,
     can reach the port; trusted networks only."""
     global _grpc_proxy
     _get_controller()
-    if _grpc_proxy is None:
-        from .grpc_proxy import GRPCProxy
+    if _grpc_proxy is not None:
+        # Settings are fixed at first start; silently returning a proxy
+        # with DIFFERENT settings (port, or worse, the pickle gate)
+        # would mislead the caller.
+        if (enable_pickle and not _grpc_proxy.pickle_enabled) or \
+                (grpc_port and grpc_port != _grpc_proxy.port):
+            raise RuntimeError(
+                "serve gRPC ingress already running with different "
+                "settings; serve.shutdown() first")
+        return _grpc_proxy
+    from .grpc_proxy import GRPCProxy
 
-        _grpc_proxy = GRPCProxy(_ProxyClient(), grpc_host, grpc_port,
-                                enable_pickle=enable_pickle)
+    _grpc_proxy = GRPCProxy(_ProxyClient(), grpc_host, grpc_port,
+                            enable_pickle=enable_pickle)
     return _grpc_proxy
 
 

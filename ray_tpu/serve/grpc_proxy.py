@@ -29,6 +29,7 @@ class GRPCProxy:
         import grpc
 
         self.controller = controller
+        self.pickle_enabled = enable_pickle
 
         proxy = self
 
@@ -90,6 +91,10 @@ class GRPCProxy:
         self.server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler("rtpu.serve", handlers),))
         self.port = self.server.add_insecure_port(f"{host}:{port}")
+        if self.port == 0:
+            raise OSError(
+                f"gRPC ingress could not bind {host}:{port} "
+                f"(port in use?)")
         self.server.start()
 
     def stop(self):

@@ -1,0 +1,82 @@
+"""joblib parallel backend over cluster tasks.
+
+Capability parity target: /root/reference/python/ray/util/joblib/ —
+``register_ray()`` + ``parallel_backend("ray")`` so sklearn and any
+joblib-parallel code fans out across the cluster by adding two lines.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+
+def register_ray_tpu() -> None:
+    """Register the 'ray_tpu' joblib backend (reference: register_ray)."""
+    from joblib.parallel import register_parallel_backend
+
+    register_parallel_backend("ray_tpu", RayTpuBackend)
+
+
+def _call(batched):
+    return batched()
+
+
+class _TaskResult:
+    """future-like the joblib executor polls (.get(timeout))."""
+
+    def __init__(self, ref, callback):
+        self._ref = ref
+        if callback is not None:
+            def run():
+                import ray_tpu
+
+                try:
+                    out = ray_tpu.get(ref)
+                except Exception:  # joblib re-raises from get()
+                    return
+                callback(out)
+
+            threading.Thread(target=run, daemon=True).start()
+
+    def get(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        return ray_tpu.get(self._ref, timeout=timeout)
+
+
+try:
+    from joblib.parallel import ParallelBackendBase
+except Exception:  # pragma: no cover - joblib always in this image
+    ParallelBackendBase = object
+
+
+class RayTpuBackend(ParallelBackendBase):
+    supports_timeout = True
+
+    def configure(self, n_jobs: int = 1, parallel=None, **_):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self.parallel = parallel
+        self._remote = ray_tpu.remote(_call)
+        return self.effective_n_jobs(n_jobs)
+
+    def effective_n_jobs(self, n_jobs: int) -> int:
+        import ray_tpu
+
+        total = int(ray_tpu.cluster_resources().get("CPU", 1)) \
+            if ray_tpu.is_initialized() else 1
+        if n_jobs is None:
+            return 1
+        if n_jobs < 0:
+            # joblib convention: -1 = all CPUs, -2 = all but one, ...
+            return max(1, total + 1 + n_jobs)
+        return max(1, n_jobs)
+
+    def apply_async(self, func, callback=None):
+        return _TaskResult(self._remote.remote(func), callback)
+
+    def abort_everything(self, ensure_ready: bool = True):
+        pass  # tasks already dispatched run to completion

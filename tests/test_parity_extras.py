@@ -167,3 +167,23 @@ class TestOfflineRL:
         algo.stop()
         assert np.isfinite(m["bc_loss"]) and np.isfinite(m["vf_loss"])
         assert m["mean_weight"] != pytest.approx(1.0)  # beta=1 weighting on
+
+
+class TestJoblibBackend:
+    def test_parallel_over_cluster(self, rt):
+        from joblib import Parallel, delayed, parallel_backend
+
+        from ray_tpu.util.joblib_backend import register_ray_tpu
+
+        register_ray_tpu()
+        with parallel_backend("ray_tpu", n_jobs=4):
+            out = Parallel()(delayed(lambda x: x + 100)(i)
+                             for i in range(20))
+        assert out == [i + 100 for i in range(20)]
+
+    def test_effective_n_jobs_from_cluster(self, rt):
+        from ray_tpu.util.joblib_backend import RayTpuBackend
+
+        b = RayTpuBackend()
+        b.configure(n_jobs=-1)
+        assert b.effective_n_jobs(-1) >= 4  # the rt fixture's CPUs
