@@ -308,6 +308,37 @@ def cmd_dashboard(args):
         pass
 
 
+def cmd_serve_deploy(args):
+    _attach(args)
+    # The rtpu entry point doesn't put the working directory on
+    # sys.path; import_path app modules live next to the config.
+    for p in (os.path.dirname(os.path.abspath(args.config)), os.getcwd()):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    from ray_tpu.serve.config import deploy_config_file
+
+    names = deploy_config_file(args.config)
+    print(f"deployed: {', '.join(names)}")
+
+
+def cmd_serve_status(args):
+    _attach(args)
+    from ray_tpu import serve
+
+    st = serve.status()
+    for name, info in st.items():
+        print(f"deployment {name}: replicas "
+              f"{info.get('num_replicas')}/{info.get('target_replicas')}")
+
+
+def cmd_serve_shutdown(args):
+    _attach(args)
+    from ray_tpu import serve
+
+    serve.shutdown()
+    print("serve shut down")
+
+
 def cmd_logs(args):
     _attach(args)
     from ray_tpu._private import context as context_mod
@@ -464,6 +495,19 @@ def build_parser() -> argparse.ArgumentParser:
                         help="thread stacks of every node/worker process")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_stack)
+
+    svp = sub.add_parser("serve", help="model serving")
+    ssub = svp.add_subparsers(dest="serve_cmd", required=True)
+    sp = ssub.add_parser("deploy", help="deploy apps from a YAML config")
+    sp.add_argument("config")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_serve_deploy)
+    sp = ssub.add_parser("status")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_serve_status)
+    sp = ssub.add_parser("shutdown")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_serve_shutdown)
 
     sp = sub.add_parser("logs", help="recent worker logs cluster-wide")
     sp.add_argument("--address", default=None)

@@ -87,7 +87,8 @@ def start_grpc(*, grpc_host: str = "127.0.0.1", grpc_port: int = 0,
         # with DIFFERENT settings (port, or worse, the pickle gate)
         # would mislead the caller.
         if (enable_pickle and not _grpc_proxy.pickle_enabled) or \
-                (grpc_port and grpc_port != _grpc_proxy.port):
+                (grpc_port and grpc_port != _grpc_proxy.port) or \
+                (grpc_host != _grpc_proxy.host):
             raise RuntimeError(
                 "serve gRPC ingress already running with different "
                 "settings; serve.shutdown() first")

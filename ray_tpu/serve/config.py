@@ -1,0 +1,101 @@
+"""Declarative serve config: deploy applications from a YAML file.
+
+Capability parity target: the reference's serve config schema + CLI
+(/root/reference/python/ray/serve/schema.py ServeDeploySchema and
+`serve deploy config.yaml` in serve/scripts.py): applications declared
+as an import path plus per-deployment option overrides, applied
+idempotently to the running cluster.
+
+Schema (YAML):
+
+    applications:
+      - name: text_app                # default: "default"
+        route_prefix: /text           # default: /<name>
+        import_path: my_pkg.app:app   # module:attr -> Application or
+                                      #   Deployment (bound with args)
+        args: {...}                   # bind(**args) when attr is a
+                                      #   Deployment
+        deployments:                  # per-deployment overrides
+          - name: Summarizer
+            num_replicas: 3
+            max_ongoing_requests: 16
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Optional
+
+from .deployment import Application, Deployment
+
+
+def _import_attr(path: str):
+    if ":" in path:
+        mod, _, attr = path.partition(":")
+    else:
+        mod, _, attr = path.rpartition(".")
+    return getattr(importlib.import_module(mod), attr)
+
+
+def _apply_overrides(app: Application, overrides: list) -> Application:
+    """Rebuild the bound graph with per-deployment option overrides
+    applied by deployment name (children included)."""
+    by_name = {o["name"]: {k: v for k, v in o.items() if k != "name"}
+               for o in overrides or []}
+    matched: set = set()
+
+    def rebuild(a: Application) -> Application:
+        d = a.deployment
+        if d.name in by_name:
+            matched.add(d.name)
+            d = d.options(**by_name[d.name])
+        new_args = tuple(rebuild(x) if isinstance(x, Application) else x
+                         for x in d.init_args)
+        new_kwargs = {k: (rebuild(v) if isinstance(v, Application) else v)
+                      for k, v in d.init_kwargs.items()}
+        from dataclasses import replace
+
+        return Application(replace(d, init_args=new_args,
+                                   init_kwargs=new_kwargs))
+
+    out = rebuild(app)
+    unknown = set(by_name) - matched
+    if unknown:
+        raise ValueError(
+            f"deployment overrides name unknown deployments {sorted(unknown)}"
+            f" — not present in the application graph")
+    return out
+
+
+def build_app(spec: dict) -> Application:
+    """One application entry -> a bound Application."""
+    target = _import_attr(spec["import_path"])
+    if isinstance(target, Application):
+        app = target
+    elif isinstance(target, Deployment):
+        app = target.bind(**(spec.get("args") or {}))
+    else:
+        raise TypeError(
+            f"{spec['import_path']} must resolve to a serve Application "
+            f"or Deployment, got {type(target).__name__}")
+    return _apply_overrides(app, spec.get("deployments"))
+
+
+def deploy_config(config: dict) -> list:
+    """Apply a parsed config dict; returns the deployed app names."""
+    from . import api
+
+    names = []
+    for spec in config.get("applications", []):
+        name = spec.get("name", "default")
+        prefix = spec.get("route_prefix", f"/{name}")
+        api.run(build_app(spec), name=name, route_prefix=prefix)
+        names.append(name)
+    return names
+
+
+def deploy_config_file(path: str) -> list:
+    import yaml
+
+    with open(path) as f:
+        return deploy_config(yaml.safe_load(f) or {})

@@ -29,6 +29,7 @@ class GRPCProxy:
         import grpc
 
         self.controller = controller
+        self.host = host
         self.pickle_enabled = enable_pickle
 
         proxy = self

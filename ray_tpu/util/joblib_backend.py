@@ -3,6 +3,12 @@
 Capability parity target: /root/reference/python/ray/util/joblib/ —
 ``register_ray()`` + ``parallel_backend("ray")`` so sklearn and any
 joblib-parallel code fans out across the cluster by adding two lines.
+
+Implements the current ParallelBackendBase contract the way the stock
+Loky/Threading backends do: ``submit(func, callback)`` dispatches a
+cluster task, ONE shared waiter thread fires completion callbacks as
+refs finish (no per-task threads), and ``retrieve_result_callback``
+hands joblib the value or re-raises the task error.
 """
 
 from __future__ import annotations
@@ -22,22 +28,16 @@ def _call(batched):
     return batched()
 
 
+class _TaskError:
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
 class _TaskResult:
-    """future-like the joblib executor polls (.get(timeout))."""
+    """future-like returned by submit (joblib uses it for timeouts)."""
 
-    def __init__(self, ref, callback):
+    def __init__(self, ref):
         self._ref = ref
-        if callback is not None:
-            def run():
-                import ray_tpu
-
-                try:
-                    out = ray_tpu.get(ref)
-                except Exception:  # joblib re-raises from get()
-                    return
-                callback(out)
-
-            threading.Thread(target=run, daemon=True).start()
 
     def get(self, timeout: Optional[float] = None):
         import ray_tpu
@@ -53,6 +53,7 @@ except Exception:  # pragma: no cover - joblib always in this image
 
 class RayTpuBackend(ParallelBackendBase):
     supports_timeout = True
+    supports_retrieve_callback = True
 
     def configure(self, n_jobs: int = 1, parallel=None, **_):
         import ray_tpu
@@ -61,7 +62,40 @@ class RayTpuBackend(ParallelBackendBase):
             ray_tpu.init()
         self.parallel = parallel
         self._remote = ray_tpu.remote(_call)
+        self._lock = threading.Lock()
+        self._pending: dict = {}  # ref -> joblib completion callback
+        self._stop = threading.Event()
+        self._waiter = threading.Thread(target=self._wait_loop,
+                                        daemon=True,
+                                        name="rt-joblib-waiter")
+        self._waiter.start()
         return self.effective_n_jobs(n_jobs)
+
+    def _wait_loop(self):
+        """ONE thread services every in-flight ref: fires each task's
+        joblib callback on completion (value or error sentinel)."""
+        import ray_tpu
+
+        while not self._stop.is_set():
+            with self._lock:
+                refs = list(self._pending)
+            if not refs:
+                self._stop.wait(0.05)
+                continue
+            done, _ = ray_tpu.wait(refs, num_returns=1, timeout=0.5)
+            for ref in done:
+                with self._lock:
+                    callback = self._pending.pop(ref, None)
+                if callback is None:
+                    continue
+                try:
+                    out = ray_tpu.get(ref)
+                except BaseException as e:  # noqa: BLE001 - handed to joblib
+                    out = _TaskError(e)
+                try:
+                    callback(out)
+                except Exception:  # noqa: BLE001 - joblib teardown races
+                    pass
 
     def effective_n_jobs(self, n_jobs: int) -> int:
         import ray_tpu
@@ -75,8 +109,27 @@ class RayTpuBackend(ParallelBackendBase):
             return max(1, total + 1 + n_jobs)
         return max(1, n_jobs)
 
+    def submit(self, func, callback=None):
+        ref = self._remote.remote(func)
+        if callback is not None:
+            with self._lock:
+                self._pending[ref] = callback
+        return _TaskResult(ref)
+
+    # Older joblib versions dispatch through apply_async.
     def apply_async(self, func, callback=None):
-        return _TaskResult(self._remote.remote(func), callback)
+        return self.submit(func, callback)
+
+    def retrieve_result_callback(self, out):
+        """Called by joblib's callback thread with what WE passed to the
+        callback: the task's value, or the error sentinel to re-raise."""
+        if isinstance(out, _TaskError):
+            raise out.exc
+        return out
 
     def abort_everything(self, ensure_ready: bool = True):
-        pass  # tasks already dispatched run to completion
+        with self._lock:
+            self._pending.clear()
+
+    def terminate(self):
+        self._stop.set()

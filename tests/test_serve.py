@@ -382,3 +382,51 @@ def test_grpc_ingress(serve_rt):
         pj(b"{}", metadata=(("app", "nope"),), timeout=30)
     assert ei.value.code() == grpc.StatusCode.NOT_FOUND
     ch.close()
+
+
+def test_yaml_config_deploy(serve_rt, tmp_path):
+    """Declarative deploy (parity: serve deploy config.yaml +
+    ServeDeploySchema): import-path apps with per-deployment overrides,
+    including a composed child."""
+    app_mod = tmp_path / "my_serve_app.py"
+    app_mod.write_text(
+        "from ray_tpu import serve\n"
+        "\n"
+        "@serve.deployment\n"
+        "class Child:\n"
+        "    def __call__(self, x):\n"
+        "        return x + 1\n"
+        "\n"
+        "@serve.deployment\n"
+        "class Front:\n"
+        "    def __init__(self, child, scale=1):\n"
+        "        self.child, self.scale = child, scale\n"
+        "    def __call__(self, x):\n"
+        "        inner = self.child.remote(x).result(timeout=30)\n"
+        "        return inner * self.scale\n"
+        "\n"
+        "app = Front.bind(Child.bind(), scale=10)\n"
+        "plain = Front\n")
+    cfg = tmp_path / "serve.yaml"
+    cfg.write_text(
+        "applications:\n"
+        "  - name: yaml_app\n"
+        "    import_path: my_serve_app:app\n"
+        "    deployments:\n"
+        "      - name: Front\n"
+        "        num_replicas: 2\n")
+    import sys
+
+    sys.path.insert(0, str(tmp_path))
+    try:
+        from ray_tpu.serve.config import deploy_config_file
+
+        names = deploy_config_file(str(cfg))
+        assert names == ["yaml_app"]
+        handle = serve.get_app_handle("yaml_app")
+        assert handle.remote(4).result(timeout=60) == 50  # (4+1)*10
+        st = serve.status()
+        assert st["Front"]["target_replicas"] == 2  # override applied
+    finally:
+        sys.path.remove(str(tmp_path))
+        sys.modules.pop("my_serve_app", None)
