@@ -1,0 +1,28 @@
+"""Streaming data pipeline: transform, distributed shuffle, device-sharded
+batches.
+
+Run:  python examples/data_pipeline.py
+"""
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import ray_tpu
+from ray_tpu import data as rd
+
+if __name__ == "__main__":
+    ray_tpu.init()
+    ds = (rd.range(10_000, override_num_blocks=16)
+          .map_batches(lambda b: {"x": b["id"] * 2.0, "id": b["id"]})
+          .filter(lambda r: r["id"] % 3 == 0)
+          .random_shuffle(seed=0))
+    devices = jax.devices()
+    mesh = Mesh(devices, ("dp",))
+    n = 0
+    for batch in ds.iter_batches(batch_size=len(devices) * 32,
+                                 sharding=NamedSharding(mesh, P("dp")),
+                                 drop_last=True):
+        n += batch["x"].shape[0]
+    print(f"streamed {n} rows as device-sharded batches "
+          f"across {len(devices)} device(s)")
+    ray_tpu.shutdown()

@@ -1,0 +1,27 @@
+"""PPO on CartPole with remote env-runner actors (north star #4/#5 shape:
+CPU rollouts feeding the learner).
+
+Run:  python examples/rllib_ppo.py [--iters 25]
+"""
+
+import argparse
+
+from ray_tpu.rllib import PPO
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=25)
+    args = ap.parse_args()
+
+    config = (PPO.get_default_config()
+              .environment("CartPole-v1")
+              .env_runners(num_envs_per_env_runner=4)
+              .training(lr=3e-3, train_batch_size=512, minibatch_size=128,
+                        num_epochs=6, entropy_coeff=0.01)
+              .debugging(seed=0))
+    algo = config.build()
+    for i in range(args.iters):
+        result = algo.train()
+        if (i + 1) % 5 == 0:
+            print(f"iter {i + 1}: return={result['episode_return_mean']:.1f}")
+    algo.stop()

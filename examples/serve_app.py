@@ -1,0 +1,44 @@
+"""Model composition + HTTP/gRPC ingress.
+
+Run:  python examples/serve_app.py
+"""
+
+import json
+import urllib.request
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@serve.deployment(num_replicas=2)
+class Embedder:
+    def __call__(self, text):
+        return [ord(c) % 7 for c in text]
+
+
+@serve.deployment
+class Classifier:
+    def __init__(self, embedder):
+        self.embedder = embedder
+
+    def __call__(self, body):
+        emb = self.embedder.remote(body["text"]).result(timeout=30)
+        return {"label": "even" if sum(emb) % 2 == 0 else "odd"}
+
+
+if __name__ == "__main__":
+    ray_tpu.init()
+    app = Classifier.bind(Embedder.bind())
+    handle = serve.run(app, name="classifier", route_prefix="/classify")
+    print("direct:", handle.remote({"text": "hello"}).result(timeout=30))
+
+    proxy = serve.start(http_port=0)
+    out = urllib.request.urlopen(
+        urllib.request.Request(
+            f"http://127.0.0.1:{proxy.port}/classify",
+            data=json.dumps({"text": "tpu"}).encode(),
+            headers={"Content-Type": "application/json"}),
+        timeout=30).read()
+    print("http:", out.decode())
+    serve.shutdown()
+    ray_tpu.shutdown()

@@ -1,0 +1,32 @@
+"""Hyperparameter sweep with the native TPE searcher + ASHA.
+
+Run:  python examples/tune_sweep.py
+"""
+
+from ray_tpu import tune
+from ray_tpu.tune import ASHAScheduler, ConcurrencyLimiter, TPESearcher
+
+
+def trainable(config):
+    # A fake training curve: converges faster with better lr.
+    quality = -abs(config["lr"] - 1e-2) / 1e-2
+    for i in range(1, 20):
+        tune.report({"score": quality * (1.0 / i),
+                     "training_iteration": i})
+
+
+if __name__ == "__main__":
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.loguniform(1e-5, 1.0)},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=16,
+            max_concurrent_trials=4,
+            scheduling_strategy="device",
+            search_alg=ConcurrencyLimiter(
+                TPESearcher(n_initial=4, seed=0, num_samples=16), 4),
+            scheduler=ASHAScheduler(grace_period=2, max_t=20)),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    print("best lr:", best.config["lr"], "score:", best.metrics["score"])

@@ -311,8 +311,9 @@ def cmd_dashboard(args):
 def cmd_serve_deploy(args):
     _attach(args)
     # The rtpu entry point doesn't put the working directory on
-    # sys.path; import_path app modules live next to the config.
-    for p in (os.path.dirname(os.path.abspath(args.config)), os.getcwd()):
+    # sys.path; import_path app modules live next to the config (the
+    # config's directory takes precedence over cwd).
+    for p in (os.getcwd(), os.path.dirname(os.path.abspath(args.config))):
         if p not in sys.path:
             sys.path.insert(0, p)
     from ray_tpu.serve.config import deploy_config_file
@@ -325,7 +326,11 @@ def cmd_serve_status(args):
     _attach(args)
     from ray_tpu import serve
 
-    st = serve.status()
+    try:
+        st = serve.status()
+    except RuntimeError:
+        print("serve is not running")
+        return
     for name, info in st.items():
         print(f"deployment {name}: replicas "
               f"{info.get('num_replicas')}/{info.get('target_replicas')}")

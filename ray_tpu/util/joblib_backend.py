@@ -62,25 +62,37 @@ class RayTpuBackend(ParallelBackendBase):
             ray_tpu.init()
         self.parallel = parallel
         self._remote = ray_tpu.remote(_call)
-        self._lock = threading.Lock()
-        self._pending: dict = {}  # ref -> joblib completion callback
-        self._stop = threading.Event()
-        self._waiter = threading.Thread(target=self._wait_loop,
-                                        daemon=True,
-                                        name="rt-joblib-waiter")
-        self._waiter.start()
+        # One LIVE waiter per instance: joblib reuses the backend under
+        # parallel_config, calling configure() per Parallel call and
+        # terminate() between calls. Restart the waiter only when it is
+        # missing or was stopped — re-creating state while the old
+        # thread lives would orphan it spinning forever.
+        if not hasattr(self, "_stop") or self._stop.is_set():
+            self._lock = getattr(self, "_lock", None) or threading.Lock()
+            if not hasattr(self, "_pending"):
+                self._pending = {}  # ref -> joblib completion callback
+            # Each waiter owns ITS stop event (passed in, not re-read
+            # from self): terminate() stops exactly that thread, and a
+            # quick terminate->configure can't strand us with a thread
+            # that is momentarily alive but already told to exit.
+            self._stop = threading.Event()
+            self._waiter = threading.Thread(target=self._wait_loop,
+                                            args=(self._stop,),
+                                            daemon=True,
+                                            name="rt-joblib-waiter")
+            self._waiter.start()
         return self.effective_n_jobs(n_jobs)
 
-    def _wait_loop(self):
+    def _wait_loop(self, stop):
         """ONE thread services every in-flight ref: fires each task's
         joblib callback on completion (value or error sentinel)."""
         import ray_tpu
 
-        while not self._stop.is_set():
+        while not stop.is_set():
             with self._lock:
                 refs = list(self._pending)
             if not refs:
-                self._stop.wait(0.05)
+                stop.wait(0.05)
                 continue
             done, _ = ray_tpu.wait(refs, num_returns=1, timeout=0.5)
             for ref in done:

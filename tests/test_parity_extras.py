@@ -187,3 +187,26 @@ class TestJoblibBackend:
         b = RayTpuBackend()
         b.configure(n_jobs=-1)
         assert b.effective_n_jobs(-1) >= 4  # the rt fixture's CPUs
+
+    def test_parallel_config_reuse_single_waiter(self, rt):
+        """joblib reuses the backend under parallel_config (configure per
+        call, terminate between): the waiter restarts when stopped and
+        never piles up threads."""
+        import threading
+
+        from joblib import Parallel, delayed, parallel_config
+
+        from ray_tpu.util.joblib_backend import register_ray_tpu
+
+        def live():
+            return sum(1 for t in threading.enumerate()
+                       if t.name == "rt-joblib-waiter" and t.is_alive())
+
+        register_ray_tpu()
+        before = live()
+        with parallel_config(backend="ray_tpu", n_jobs=2):
+            for _ in range(3):
+                assert Parallel()(delayed(lambda x: x)(i)
+                                  for i in range(4)) == [0, 1, 2, 3]
+        # Three Parallel calls on one backend never pile up waiters.
+        assert live() - before <= 1
