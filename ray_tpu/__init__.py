@@ -27,6 +27,7 @@ from .api import (  # noqa: F401
     kv_keys,
     kv_put,
     method,
+    nodes,
     placement_group,
     put,
     remote,

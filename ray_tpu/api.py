@@ -111,6 +111,13 @@ def cluster_resources() -> dict:
     return {}
 
 
+def nodes() -> list:
+    """Cluster membership rows (parity: ray.nodes())."""
+    from .util import state
+
+    return state.list_nodes()
+
+
 def available_resources() -> dict:
     ctx = _ensure()
     if hasattr(ctx, "available_resources"):

@@ -512,6 +512,30 @@ class Dataset:
             pq.write_table(B.block_to_arrow(blk),
                            os.path.join(path, f"part-{i:05d}.parquet"))
 
+    def write_csv(self, path: str):
+        """One CSV per block (reference: Dataset.write_csv)."""
+        import os
+
+        from pyarrow import csv as pacsv
+
+        os.makedirs(path, exist_ok=True)
+        for i, blk in enumerate(self.iter_blocks()):
+            pacsv.write_csv(B.block_to_arrow(blk),
+                            os.path.join(path, f"part-{i:05d}.csv"))
+
+    def write_json(self, path: str):
+        """One JSONL file per block (reference: Dataset.write_json)."""
+        import json
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        for i, blk in enumerate(self.iter_blocks()):
+            with open(os.path.join(path, f"part-{i:05d}.json"), "w") as f:
+                for row in B.block_to_rows(blk):
+                    f.write(json.dumps(
+                        {k: (v.item() if hasattr(v, "item") else v)
+                         for k, v in row.items()}) + "\n")
+
     def __repr__(self):
         return f"Dataset(stages={len(self._stages)})"
 

@@ -25,7 +25,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
-__all__ = ["init", "step", "run", "resume", "resume_all", "get_status",
+__all__ = ["init", "step", "run", "run_async", "resume", "resume_all", "get_status",
            "get_output", "list_all", "delete", "WorkflowStep",
            "StepNode", "WorkflowError"]
 
@@ -295,6 +295,18 @@ def run(entry: Optional[StepNode], workflow_id: Optional[str] = None) -> Any:
         raise
     storage.set_status(SUCCESSFUL)
     return value
+
+
+def run_async(entry: Optional[StepNode],
+              workflow_id: Optional[str] = None):
+    """run() on a background thread; returns a concurrent Future
+    (reference: workflow.run_async)."""
+    import concurrent.futures
+
+    pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+    fut = pool.submit(run, entry, workflow_id)
+    pool.shutdown(wait=False)
+    return fut
 
 
 def resume(workflow_id: str) -> Any:

@@ -210,3 +210,38 @@ class TestJoblibBackend:
                                   for i in range(4)) == [0, 1, 2, 3]
         # Three Parallel calls on one backend never pile up waiters.
         assert live() - before <= 1
+
+
+class TestSmallParity:
+    def test_write_csv_json_roundtrip(self, tmp_path):
+        ds = rd.range(20, override_num_blocks=2).map(
+            lambda r: {"id": r["id"], "half": r["id"] / 2})
+        ds.write_csv(str(tmp_path / "csv"))
+        back = rd.read_csv(str(tmp_path / "csv"))
+        assert sorted(r["id"] for r in back.take_all()) == list(range(20))
+
+        ds.write_json(str(tmp_path / "json"))
+        back = rd.read_json(str(tmp_path / "json"))
+        rows = back.take_all()
+        assert sorted(r["id"] for r in rows) == list(range(20))
+        assert all(r["half"] == r["id"] / 2 for r in rows)
+
+    def test_nodes_api(self, rt):
+        rows = ray_tpu.nodes()
+        assert rows and rows[0]["state"] == "ALIVE"
+
+    def test_workflow_run_async(self, rt, tmp_path):
+        from ray_tpu import workflow as wf
+
+        wf.init(str(tmp_path / "wfa"))
+
+        @wf.step
+        def slow():
+            import time as _t
+
+            _t.sleep(0.2)
+            return 11
+
+        fut = wf.run_async(slow.step(), workflow_id="async1")
+        assert fut.result(timeout=120) == 11
+        assert wf.get_status("async1") == wf.SUCCESSFUL
