@@ -115,6 +115,7 @@ def nodes() -> list:
     """Cluster membership rows (parity: ray.nodes())."""
     from .util import state
 
+    _ensure()  # auto-init like the sibling cluster APIs
     return state.list_nodes()
 
 

@@ -502,39 +502,46 @@ class Dataset:
                 for rank in builtins.range(n)]
 
     # -- IO ----------------------------------------------------------------
-    def write_parquet(self, path: str):
+    def _write_files(self, path: str, ext: str, write_block):
+        """Shared writer shape: one part file per block."""
         import os
-
-        import pyarrow.parquet as pq
 
         os.makedirs(path, exist_ok=True)
         for i, blk in enumerate(self.iter_blocks()):
-            pq.write_table(B.block_to_arrow(blk),
-                           os.path.join(path, f"part-{i:05d}.parquet"))
+            write_block(blk, os.path.join(path, f"part-{i:05d}.{ext}"))
+
+    def write_parquet(self, path: str):
+        import pyarrow.parquet as pq
+
+        self._write_files(
+            path, "parquet",
+            lambda blk, p: pq.write_table(B.block_to_arrow(blk), p))
 
     def write_csv(self, path: str):
         """One CSV per block (reference: Dataset.write_csv)."""
-        import os
-
         from pyarrow import csv as pacsv
 
-        os.makedirs(path, exist_ok=True)
-        for i, blk in enumerate(self.iter_blocks()):
-            pacsv.write_csv(B.block_to_arrow(blk),
-                            os.path.join(path, f"part-{i:05d}.csv"))
+        self._write_files(
+            path, "csv",
+            lambda blk, p: pacsv.write_csv(B.block_to_arrow(blk), p))
 
     def write_json(self, path: str):
-        """One JSONL file per block (reference: Dataset.write_json)."""
+        """One JSONL file per block (reference: Dataset.write_json);
+        tensor columns serialize as nested lists."""
         import json
-        import os
 
-        os.makedirs(path, exist_ok=True)
-        for i, blk in enumerate(self.iter_blocks()):
-            with open(os.path.join(path, f"part-{i:05d}.json"), "w") as f:
+        def enc(v):
+            if getattr(v, "ndim", 0) >= 1:
+                return v.tolist()
+            return v.item() if hasattr(v, "item") else v
+
+        def write_block(blk, p):
+            with open(p, "w") as f:
                 for row in B.block_to_rows(blk):
-                    f.write(json.dumps(
-                        {k: (v.item() if hasattr(v, "item") else v)
-                         for k, v in row.items()}) + "\n")
+                    f.write(json.dumps({k: enc(v)
+                                        for k, v in row.items()}) + "\n")
+
+        self._write_files(path, "json", write_block)
 
     def __repr__(self):
         return f"Dataset(stages={len(self._stages)})"

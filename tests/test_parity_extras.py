@@ -245,3 +245,16 @@ class TestSmallParity:
         fut = wf.run_async(slow.step(), workflow_id="async1")
         assert fut.result(timeout=120) == 11
         assert wf.get_status("async1") == wf.SUCCESSFUL
+
+    def test_write_json_tensor_columns(self, tmp_path):
+        import json as _json
+
+        ds = rd.from_numpy(np.arange(12).reshape(4, 3), column="vec")
+        ds.write_json(str(tmp_path / "tj"))
+        rows = []
+        import glob as _glob
+
+        for f in sorted(_glob.glob(str(tmp_path / "tj" / "*.json"))):
+            rows += [_json.loads(line) for line in open(f)]
+        assert rows[0]["vec"] == [0, 1, 2]
+        assert len(rows) == 4
