@@ -146,6 +146,39 @@ def _sort_reduce(key, descending, *parts):
     return {k: v[order] for k, v in blk.items()}
 
 
+def _groupby_reduce(key, agg, on, *parts):
+    """Aggregate one key-range partition (groups are complete here)."""
+    import numpy as np
+
+    import ray_tpu.data.block as B
+
+    blk = B.concat_blocks(list(parts))
+    if not B.block_len(blk):
+        return {}
+    order = np.argsort(blk[key], kind="stable")
+    keys = blk[key][order]
+    uniq, starts = np.unique(keys, return_index=True)
+    bounds = list(starts) + [len(keys)]
+    vals = blk[on][order] if on is not None else None
+    out = []
+    for i in range(len(uniq)):
+        lo, hi = bounds[i], bounds[i + 1]
+        if agg == "count":
+            out.append(hi - lo)
+        elif agg == "sum":
+            out.append(vals[lo:hi].sum())
+        elif agg == "mean":
+            out.append(vals[lo:hi].mean())
+        elif agg == "min":
+            out.append(vals[lo:hi].min())
+        elif agg == "max":
+            out.append(vals[lo:hi].max())
+        else:
+            raise ValueError(agg)
+    col = agg if on is None else f"{agg}({on})"
+    return {key: uniq, col: np.asarray(out)}
+
+
 def _remote_opts():
     ctx = DataContext.get_current()
     if ctx.execution_lane == "device":
@@ -302,6 +335,13 @@ class Dataset:
                     yield blk
 
         return Dataset(source)
+
+    def groupby(self, key: str) -> "GroupedData":
+        """Distributed group-by (reference: Dataset.groupby ->
+        GroupedData aggregations): rows range-partition by key — equal
+        keys always land in ONE partition — so each reduce task
+        aggregates its groups completely."""
+        return GroupedData(self, key)
 
     def sort(self, key: str, *, descending: bool = False) -> "Dataset":
         """Distributed sample-partitioned sort (reference: the sort
@@ -705,6 +745,67 @@ def read_json(paths) -> Dataset:
     from pyarrow import json as pajson
 
     return _read_files(paths, pajson.read_json)
+
+
+class GroupedData:
+    """Aggregations over a distributed group-by (reference:
+    ray.data.grouped_data.GroupedData: count/sum/mean/min/max)."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _aggregate(self, agg: str, on: Optional[str]) -> Dataset:
+        ds, key = self._ds, self._key
+
+        def source():
+            import ray_tpu
+
+            refs, _lens, samples = ds._stage_refs(sample_key=key)
+            if not refs:
+                return
+            sample = np.concatenate(samples) if samples else np.array([])
+            P = max(1, len(refs))
+            if P > 1 and len(sample):
+                qs = np.linspace(0, 100, P + 1)[1:-1]
+                splitters = np.unique(np.percentile(
+                    np.sort(sample), qs, method="nearest"))
+            else:
+                splitters = np.array([])
+            P = len(splitters) + 1
+            opts = _remote_opts()
+            mapper = ray_tpu.remote(num_returns=P, **opts)(_sort_map)
+            cols = [[] for _ in builtins.range(P)]
+            for ref in refs:
+                out = mapper.remote(ref, key, splitters)
+                if P == 1:
+                    out = [out]
+                for r in builtins.range(P):
+                    cols[r].append(out[r])
+            reducer = ray_tpu.remote(**opts)(_groupby_reduce)
+            pending = [reducer.remote(key, agg, on, *cols[r])
+                       for r in builtins.range(P)]
+            for ref in pending:
+                blk = ray_tpu.get(ref)
+                if B.block_len(blk):
+                    yield blk
+
+        return Dataset(source)
+
+    def count(self) -> Dataset:
+        return self._aggregate("count", None)
+
+    def sum(self, on: str) -> Dataset:
+        return self._aggregate("sum", on)
+
+    def mean(self, on: str) -> Dataset:
+        return self._aggregate("mean", on)
+
+    def min(self, on: str) -> Dataset:
+        return self._aggregate("min", on)
+
+    def max(self, on: str) -> Dataset:
+        return self._aggregate("max", on)
 
 
 def from_pandas(dfs) -> Dataset:

@@ -159,3 +159,36 @@ class TestStreamingSplitOnePass:
         for i in range(3):
             for j in range(i + 1, 3):
                 assert not (set(rows[i]) & set(rows[j]))
+
+class TestGroupBy:
+    def test_count_sum_mean(self):
+        items = [{"k": i % 4, "v": float(i)} for i in range(100)]
+        ds = rd.from_items(items, override_num_blocks=8)
+
+        counts = {r["k"]: r["count"]
+                  for r in ds.groupby("k").count().take_all()}
+        assert counts == {0: 25, 1: 25, 2: 25, 3: 25}
+
+        sums = {r["k"]: r["sum(v)"]
+                for r in ds.groupby("k").sum("v").take_all()}
+        assert sums[0] == sum(float(i) for i in range(0, 100, 4))
+
+        means = {r["k"]: r["mean(v)"]
+                 for r in ds.groupby("k").mean("v").take_all()}
+        assert means[1] == pytest.approx(
+            np.mean([float(i) for i in range(1, 100, 4)]))
+
+    def test_min_max_and_group_integrity(self):
+        """Equal keys must land in ONE partition even under skew."""
+        items = [{"k": 7, "v": i} for i in range(50)] + \
+            [{"k": 1, "v": -i} for i in range(10)]
+        ds = rd.from_items(items, override_num_blocks=6)
+        maxes = {r["k"]: r["max(v)"]
+                 for r in ds.groupby("k").max("v").take_all()}
+        mins = {r["k"]: r["min(v)"]
+                for r in ds.groupby("k").min("v").take_all()}
+        assert maxes == {7: 49, 1: 0}
+        assert mins == {7: 0, 1: -9}
+        # Every key appears EXACTLY once in the aggregate output.
+        rows = ds.groupby("k").count().take_all()
+        assert sorted(r["k"] for r in rows) == [1, 7]
