@@ -298,12 +298,13 @@ class WorkerContext:
         task_id = TaskID(p["task_id"])
         tok = _running_task.set(task_id)
         tracer = None
-        # register() is IMMEDIATELY followed by the try whose finally
-        # unregisters — any injected cancel landing after registration
-        # reaches that finally, so a stale mapping can never target this
-        # (reused) pool thread.
-        self._interrupts.register(task_id.binary())
         try:
+            # register() INSIDE the try: an async cancel landing at any
+            # point after it reaches this try's finally, so a stale
+            # mapping can never target this (reused) pool thread. A
+            # cancel landing before registration finds no mapping and
+            # reports "not running" — also safe.
+            self._interrupts.register(task_id.binary())
             from ray_tpu.util import tracing
 
             trace_ctx = p.get("trace_ctx")

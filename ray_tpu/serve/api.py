@@ -74,7 +74,8 @@ def start(*, http_host: str = "127.0.0.1", http_port: int = 8000,
 
 
 def start_grpc(*, grpc_host: str = "127.0.0.1", grpc_port: int = 0,
-               enable_pickle: bool = False):
+               enable_pickle: bool = False,
+               request_timeout_s: float = 60.0):
     """Start the gRPC ingress (reference: gRPCProxy; apps are selected
     by the 'app' metadata key). Returns the proxy; .port is bound.
     ``enable_pickle`` additionally exposes /rtpu.serve/Predict, whose
@@ -96,7 +97,8 @@ def start_grpc(*, grpc_host: str = "127.0.0.1", grpc_port: int = 0,
     from .grpc_proxy import GRPCProxy
 
     _grpc_proxy = GRPCProxy(_ProxyClient(), grpc_host, grpc_port,
-                            enable_pickle=enable_pickle)
+                            enable_pickle=enable_pickle,
+                            request_timeout_s=request_timeout_s)
     return _grpc_proxy
 
 

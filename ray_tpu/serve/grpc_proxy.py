@@ -25,12 +25,14 @@ from typing import Optional
 
 class GRPCProxy:
     def __init__(self, controller, host: str = "127.0.0.1", port: int = 0,
-                 max_workers: int = 16, enable_pickle: bool = False):
+                 max_workers: int = 16, enable_pickle: bool = False,
+                 request_timeout_s: float = 60.0):
         import grpc
 
         self.controller = controller
         self.host = host
         self.pickle_enabled = enable_pickle
+        self.request_timeout_s = request_timeout_s
 
         proxy = self
 
@@ -49,8 +51,17 @@ class GRPCProxy:
                               f"no app {app!r}: {e}")
             if method:
                 handle = handle.options(method_name=method)
+            # Deadline: whatever the client asked for (gRPC deadline via
+            # time_remaining), bounded by the proxy-level default.
+            timeout = proxy.request_timeout_s
+            remaining = context.time_remaining()
+            if remaining is not None:
+                timeout = min(timeout, remaining)
             try:
-                return handle.remote(request_value).result(timeout=60)
+                return handle.remote(request_value).result(timeout=timeout)
+            except (TimeoutError, futures.TimeoutError):
+                context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                              f"no reply within {timeout:.1f}s")
             except Exception as e:  # noqa: BLE001
                 context.abort(grpc.StatusCode.INTERNAL, str(e))
 

@@ -157,6 +157,14 @@ def _norm_tags(tag_keys: tuple, default_tags: dict,
             raise ValueError(f"unknown tag keys {unknown}; declared "
                              f"tag_keys={tag_keys}")
         merged.update(tags)
+    # Every declared key must resolve (default or per-record value):
+    # otherwise the same metric accumulates Prometheus series with
+    # inconsistent label sets (reference: ray.util.metrics errors on
+    # missing tags without defaults).
+    missing = set(tag_keys) - set(merged)
+    if missing:
+        raise ValueError(f"missing value for declared tag keys {missing}; "
+                         f"pass them per-record or set_default_tags()")
     return tuple(sorted(merged.items()))
 
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 import json
 import os
 import pickle
+import threading
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -34,6 +35,12 @@ RUNNING = "RUNNING"
 SUCCESSFUL = "SUCCESSFUL"
 FAILED = "FAILED"
 RESUMABLE = "RESUMABLE"
+
+# A live run() refreshes its status ts every LEASE_INTERVAL_S; resume_all()
+# treats a RUNNING workflow as orphaned only after LEASE_TIMEOUT_S without a
+# refresh.
+LEASE_INTERVAL_S = 2.0
+LEASE_TIMEOUT_S = 10.0
 
 
 class WorkflowError(RuntimeError):
@@ -286,13 +293,37 @@ def run(entry: Optional[StepNode], workflow_id: Optional[str] = None) -> Any:
     else:
         storage.create(entry)
     storage.set_status(RUNNING)
+    # Lease heartbeat: while we execute, periodically refresh status.json's
+    # ts so resume_all() can tell a live RUNNING workflow (fresh lease) from
+    # one orphaned by a crashed process (expired lease) and only re-execute
+    # the latter.
+    stop_beat = threading.Event()
+
+    def _beat():
+        while not stop_beat.wait(LEASE_INTERVAL_S):
+            try:
+                storage.set_status(RUNNING)
+            except OSError:
+                return
+
+    beat = threading.Thread(target=_beat, daemon=True, name="wf-lease")
+    beat.start()
+    def _stop_beat():
+        # Join before writing the terminal status: an in-flight
+        # set_status(RUNNING) in the beat thread must not land after (and
+        # overwrite) SUCCESSFUL/FAILED.
+        stop_beat.set()
+        beat.join()
+
     try:
         value = _execute_node(entry, storage, inflight={})
     except BaseException as e:
+        _stop_beat()
         storage.set_status(
             RESUMABLE if not isinstance(e, WorkflowError) else FAILED,
             error=str(e))
         raise
+    _stop_beat()
     storage.set_status(SUCCESSFUL)
     return value
 
@@ -318,9 +349,17 @@ def resume_all() -> Dict[str, Any]:
     """Resume every non-successful workflow; returns id -> result/error
     (reference: workflow.resume_all on startup)."""
     out = {}
-    for wid, meta in list_all():
-        if meta in (SUCCESSFUL,):
+    for wid, status in list_all():
+        if status in (SUCCESSFUL,):
             continue
+        if status == RUNNING:
+            # Only take over a RUNNING workflow whose lease heartbeat has
+            # expired (owner process presumed dead); a live owner refreshes
+            # ts every LEASE_INTERVAL_S.
+            meta = _Storage(wid).get_status()
+            ts = (meta or {}).get("ts", 0)
+            if time.time() - ts < LEASE_TIMEOUT_S:
+                continue
         try:
             out[wid] = resume(wid)
         except BaseException as e:  # noqa: BLE001 - caller inspects

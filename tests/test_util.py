@@ -139,6 +139,15 @@ class TestMetrics:
         with pytest.raises(ValueError):
             c.inc(1, tags={"b": "x"})
 
+    def test_missing_declared_tag_rejected(self, rt):
+        from ray_tpu.util import metrics
+
+        c = metrics.Counter("t_tagcheck2", tag_keys=("a",))
+        with pytest.raises(ValueError):
+            c.inc(1)  # declared tag has neither default nor value
+        c.set_default_tags({"a": "x"})
+        c.inc(1)  # default supplies it
+
     def test_worker_metrics_flow_to_node(self, rt):
         @ray_tpu.remote
         def record():
