@@ -41,6 +41,25 @@ class Config:
     object_spill_dir: str = _cfg("/tmp/ray_tpu_spill")
     object_store_eviction_fraction: float = _cfg(0.8)
 
+    # --- object plane: cross-node transfer (reference: ObjectManager
+    # chunked push/pull, push_manager.h max_chunks_in_flight,
+    # object_manager.proto:61) ---
+    # Objects larger than min_chunked cross nodes as bounded chunks with a
+    # windowed pull (other RPC frames interleave between chunks, so a
+    # multi-GB transfer never stalls a node's event loop); smaller ones ride
+    # a single fetch frame.
+    object_transfer_chunk_bytes: int = _cfg(4 * 1024 * 1024)
+    object_transfer_min_chunked_bytes: int = _cfg(1024 * 1024)
+    object_transfer_max_chunks_in_flight: int = _cfg(8)
+    # Owner-side concurrent outbound transfers per object before new
+    # pullers are asked to wait for a peer copy (broadcast becomes a tree
+    # instead of N pulls from the owner).
+    object_transfer_max_pushes: int = _cfg(2)
+    # Big results kept pinned on the executor for the owner's chunked pull
+    # are reclaimed after this long if the pull never happens (lost reply,
+    # dead owner).
+    object_transfer_result_pin_ttl_s: float = _cfg(300.0)
+
     # --- scheduling ---
     # Pack below this node-utilization score, spread above (reference:
     # scheduler_spread_threshold, hybrid_scheduling_policy.h).

@@ -26,6 +26,7 @@ import asyncio
 import collections
 import json
 import os
+import random as _random
 import subprocess
 import sys
 import threading
@@ -119,6 +120,13 @@ class ObjectState:
     refcount: int = 0
     waiters: list = field(default_factory=list)  # asyncio.Future
     creating_spec: Optional[TaskSpec] = None  # lineage (reconstruction)
+    # Owner-side location directory: peer address tuple -> node_id bytes for
+    # every node known to hold a full copy (reference:
+    # ownership_based_object_directory.h). Lazily allocated.
+    holders: Optional[dict] = None
+    # Borrower-side: the address we pulled this foreign copy from (the
+    # owner) — freeing the copy deregisters it there.
+    pulled_from: Optional[tuple] = None
 
 
 def _print_worker_logs(node_hex: str, entries: list):
@@ -277,6 +285,13 @@ class NodeService:
         self._bg_tasks: list[asyncio.Task] = []
         # metrics / introspection counters
         self.counters = collections.Counter()
+        # Object plane: in-flight inbound pulls (dedupe), outbound
+        # transfer start-times per object (push-cap accounting), and
+        # big-result pins awaiting the owner's pull (TTL-swept so a lost
+        # reply can't leak the pinned shm segment forever).
+        self._fetching: set = set()
+        self._serving: dict = {}
+        self._result_pins: dict = {}
         self.task_events: collections.deque = collections.deque(
             maxlen=self.cfg.task_events_buffer_size
         )
@@ -291,6 +306,8 @@ class NodeService:
         await self.peer_server.start()
         self._bg_tasks.append(
             self.loop.create_task(self._log_tail_loop()))
+        self._bg_tasks.append(
+            self.loop.create_task(self._result_pin_sweep_loop()))
         if self.cfg.memory_monitor_interval_s > 0:
             self._bg_tasks.append(
                 self.loop.create_task(self._memory_monitor_loop()))
@@ -546,35 +563,173 @@ class NodeService:
         return conn
 
     async def ensure_object(self, oid: ObjectID, owner_addr, timeout=None):
-        """Pull a copy of a foreign-owned object from its owner into the
-        local store (reference: PullManager/ObjectManager push-pull,
-        object_manager.h:117 — collapsed to one fetch RPC)."""
+        """Pull a copy of a foreign-owned object into the local store
+        (reference: PullManager/ObjectManager chunked push-pull,
+        object_manager.h:117, pull_manager.h:52, push_manager.h:30).
+
+        Small objects ride one fetch frame. Large ones stream as bounded
+        chunks with a concurrency window, sourced from the owner OR any
+        registered holder copy (the owner's location directory), so a gang
+        broadcast fans out as a tree instead of N serial pulls from the
+        owner's event loop."""
         if owner_addr is None or tuple(owner_addr) == tuple(self.peer_address):
             return
         st = self._obj(oid)
         if st.status != PENDING:
             return
-        if not hasattr(self, "_fetching"):
-            self._fetching = set()
         if oid in self._fetching:
             return  # in-flight fetch will wake the waiters
         self._fetching.add(oid)
         try:
+            await self._pull_object(oid, tuple(owner_addr), timeout)
+        finally:
+            self._fetching.discard(oid)
+
+    async def _pull_object(self, oid: ObjectID, owner_addr: tuple, timeout):
+        st = self._obj(oid)
+        try:
             conn = await self._addr_conn(owner_addr)
-            res = await conn.call("fetch_object",
+            res = await conn.call("fetch_meta",
                                   {"oid": oid.binary(), "timeout": timeout})
+        except (ConnectionLost, OSError) as e:
+            self.mark_error(oid, ObjectLostError(
+                f"owner of {oid.hex()[:16]} unreachable: {e}"))
+            return
+        # Pull loop. The owner enforces a concurrent-push cap at
+        # fetch_begin ("busy"): saturated pullers back off, re-read the
+        # location directory, and usually land on a freshly-registered
+        # peer copy — an N-node broadcast becomes a tree instead of N
+        # serial pulls from the owner (reference: push_manager.h bounds
+        # concurrent chunked pushes the same way). After the busy-wait
+        # deadline we force the owner to serve anyway (bounded latency).
+        busy_deadline = self.loop.time() + 2.0
+        buf = None
+        while True:
             if st.status != PENDING:
                 return
             if res[0] == "err":
                 self.mark_error(oid, res[1])
-            elif res[0] == "b":
+                return
+            if res[0] == "timeout":
+                return  # stays pending; the caller's own deadline rules
+            if res[0] == "b":
                 self._ingest_result_blob(oid, res[1])
-            # ("timeout",): stays pending; the caller's own deadline rules.
-        except (ConnectionLost, OSError) as e:
+                return
+            meta = res[1]
+            sources = [tuple(a) for a in meta["holders"]
+                       if tuple(a) != tuple(self.peer_address)]
+            # Prefer peer copies over the owner: the owner pays for at
+            # most the first max_pushes transfers, then the tree takes
+            # over.
+            src_addr = _random.choice(sources) if sources else owner_addr
+            force = (src_addr != owner_addr
+                     or self.loop.time() >= busy_deadline)
+            buf = await self._pull_chunks(oid, src_addr, force=force)
+            if buf == "busy":
+                await asyncio.sleep(0.05)
+                try:
+                    res = await conn.call(
+                        "fetch_meta",
+                        {"oid": oid.binary(), "timeout": timeout})
+                except (ConnectionLost, OSError) as e:
+                    self.mark_error(oid, ObjectLostError(
+                        f"owner of {oid.hex()[:16]} unreachable: {e}"))
+                    return
+                continue
+            if buf is None:
+                # Stale/dead holder, or a transient failure on the owner
+                # path itself: the owner gets one fresh retry before we
+                # declare the object lost (a single dropped chunk must not
+                # discard a successfully-computed result).
+                await asyncio.sleep(0.1)
+                buf = await self._pull_chunks(oid, owner_addr, force=True)
+            break
+        if st.status != PENDING:
+            return
+        if buf is None:
             self.mark_error(oid, ObjectLostError(
-                f"owner of {oid.hex()[:16]} unreachable: {e}"))
-        finally:
-            self._fetching.discard(oid)
+                f"object {oid.hex()[:16]} could not be pulled "
+                f"from {src_addr} or its owner"))
+            return
+        self._ingest_result_blob(oid, buf)
+        st.pulled_from = owner_addr
+        self.counters["objects_pulled_chunked"] += 1
+        # Register our copy so later pullers can source from us.
+        try:
+            await conn.notify("copy_added", {
+                "oid": oid.binary(),
+                "addr": list(self.peer_address),
+                "node_id": self.node_id.binary(),
+            })
+        except (ConnectionLost, OSError):
+            pass
+
+    async def _pull_chunks(self, oid: ObjectID, addr: tuple,
+                           force: bool = False):
+        """Windowed chunk pull of a READY object from one source node.
+        Returns the assembled bytearray, "busy" when the source declined
+        (push cap, only without force), or None on failure (caller falls
+        back to the owner)."""
+        try:
+            src = await self._addr_conn(addr)
+            ok = await src.call("fetch_begin",
+                                {"oid": oid.binary(), "force": force})
+            if ok[0] == "busy":
+                return "busy"
+            if ok[0] != "ok":
+                return None
+            size = ok[1]
+            buf = bytearray(size)
+            chunk = self.cfg.object_transfer_chunk_bytes
+            sem = asyncio.Semaphore(
+                self.cfg.object_transfer_max_chunks_in_flight)
+
+            async def pull(off: int):
+                ln = min(chunk, size - off)
+                async with sem:
+                    r = await src.call("fetch_chunk", {
+                        "oid": oid.binary(), "off": off, "len": ln})
+                    if r[0] != "c":
+                        raise ObjectLostError(str(r[1]))
+                    buf[off:off + len(r[1])] = r[1]
+
+            try:
+                await asyncio.gather(
+                    *[pull(off) for off in range(0, size, chunk)])
+            finally:
+                try:
+                    await src.notify("fetch_end", oid.binary())
+                except (ConnectionLost, OSError):
+                    pass
+            self.counters["object_bytes_pulled"] += size
+            return buf
+        except (ConnectionLost, OSError, ObjectLostError):
+            return None
+
+    async def _result_pin_sweep_loop(self):
+        """Reclaim big-result pins whose owner never pulled (reply lost,
+        owner died): without this a dropped remote_execute reply leaks the
+        pinned shm segment until node restart."""
+        ttl = self.cfg.object_transfer_result_pin_ttl_s
+        while not self._closing:
+            await asyncio.sleep(min(30.0, ttl / 4))
+            cutoff = time.time() - ttl
+            for rid in [r for r, ts in self._result_pins.items()
+                        if ts < cutoff]:
+                self._result_pins.pop(rid, None)
+                self.counters["result_pins_expired"] += 1
+                self.decref(rid)
+
+    def _serving_count(self, oid: ObjectID) -> int:
+        ts = self._serving.get(oid)
+        if not ts:
+            return 0
+        cutoff = time.time() - 60.0  # decay: crashed pullers don't leak
+        ts[:] = [t for t in ts if t > cutoff]
+        if not ts:
+            self._serving.pop(oid, None)
+            return 0
+        return len(ts)
 
     async def _peer_conn(self, node_id: NodeID, address: tuple) -> ServerConn:
         conn = self.peer_conns.get(node_id)
@@ -609,6 +764,12 @@ class NodeService:
         conn = self.peer_conns.pop(node_id, None)
         if conn is not None:
             await conn.close()  # fails in-flight forwards -> retry paths
+        # Drop the dead node from every location directory entry so new
+        # pulls don't target its copies.
+        nid = node_id.binary()
+        for st in self.objects.values():
+            if st.holders:
+                st.holders = {a: n for a, n in st.holders.items() if n != nid}
         for entry in list(self.remote_actors.values()):
             if entry.node_id == node_id and entry.state == "ALIVE":
                 await self._remote_actor_died(entry, f"node died: {cause}")
@@ -745,6 +906,19 @@ class NodeService:
             if st.location == "shm":
                 self.shm.unpin(oid)
                 self.shm.delete(oid)
+            if st.pulled_from is not None:
+                # Foreign copy released: deregister from the owner's
+                # location directory so new pullers don't target us.
+                self.loop.create_task(
+                    self._notify_copy_removed(oid, st.pulled_from))
+
+    async def _notify_copy_removed(self, oid: ObjectID, owner_addr: tuple):
+        try:
+            conn = await self._addr_conn(owner_addr)
+            await conn.notify("copy_removed", {
+                "oid": oid.binary(), "addr": list(self.peer_address)})
+        except (ConnectionLost, OSError):
+            pass
 
     def materialize_for_ipc(self, oid: ObjectID) -> tuple:
         """Return ("bytes", blob) | ("shm",) | ("err", e) for a READY object,
@@ -1424,11 +1598,16 @@ class NodeService:
             if st.status == ERROR:
                 raise st.error
 
-    def _resolved_copy(self, spec: TaskSpec) -> TaskSpec:
-        """A copy of the spec with every REF arg resolved to a value blob —
-        the executor needs nothing but the head (for the function) to run
-        it. Deps must be terminal."""
+    def _resolved_copy(self, spec: TaskSpec) -> tuple:
+        """(spec copy, ref_sources): small REF args resolve to inline value
+        blobs; large ones stay as REFs with our address recorded in
+        ref_sources so the executor pulls them chunked from us instead of
+        shipping multi-MB blobs inside the forward frame (reference: task
+        args above max_direct_call_object_size go through the object
+        plane, not the task spec). Deps must be terminal."""
         import copy as _copy
+
+        ref_sources: dict = {}
 
         def enc(a):
             if a[0] != REF:
@@ -1436,12 +1615,19 @@ class NodeService:
             st = self.objects[a[1]]
             if st.status == ERROR:
                 raise st.error
+            form = self.materialize_for_ipc(a[1])
+            if (form[0] == "shm" and st.size >
+                    self.cfg.object_transfer_min_chunked_bytes):
+                ref_sources[a[1].binary()] = list(self.peer_address)
+                return a
+            if form[0] == "bytes":
+                return (VAL, form[1])
             return (VAL, self._materialize_blob(a[1]))
 
         out = _copy.copy(spec)
         out.args = [enc(a) for a in spec.args]
         out.kwargs = {k: enc(v) for k, v in spec.kwargs.items()}
-        return out
+        return out, ref_sources
 
     def _materialize_blob(self, oid: ObjectID) -> bytes:
         """Serialized bytes of a READY object (from memory store or shm)."""
@@ -1475,7 +1661,7 @@ class NodeService:
         exclude = set(exclude)
         try:
             await self._await_deps(spec)
-            payload_spec = self._resolved_copy(spec)
+            payload_spec, ref_sources = self._resolved_copy(spec)
         except TaskError as e:
             self._fail_task(spec, e)
             return
@@ -1528,6 +1714,7 @@ class NodeService:
                 reply = await conn.call("remote_execute", {
                     "spec": payload_spec,
                     "owner": self.node_id.binary(),
+                    "ref_sources": ref_sources,
                 })
             except (ConnectionLost, OSError):
                 self.counters["remote_forward_failures"] += 1
@@ -1545,10 +1732,10 @@ class NodeService:
                     continue
                 self._fail_task(spec, WorkerCrashedError(task_name=spec.name))
                 return
-            self._handle_remote_reply(spec, reply)
+            await self._handle_remote_reply(spec, reply)
             return
 
-    def _handle_remote_reply(self, spec: TaskSpec, reply: dict):
+    async def _handle_remote_reply(self, spec: TaskSpec, reply: dict):
         rids = spec.return_ids()
         err = reply.get("error")
         if err is not None:
@@ -1560,8 +1747,19 @@ class NodeService:
             self._event(spec, "FAILED")
             return
         results = reply["results"]
+        exec_addr = tuple(reply["addr"]) if reply.get("addr") else None
         for rid, blob in zip(rids, results):
-            self._ingest_result_blob(rid, blob)
+            if isinstance(blob, tuple) and blob[0] == "ref":
+                # Big result: pull it chunked from the executing node, then
+                # release the transfer pin it kept for us.
+                await self.ensure_object(rid, exec_addr)
+                try:
+                    conn = await self._addr_conn(exec_addr)
+                    await conn.notify("decref", rid.binary())
+                except (ConnectionLost, OSError):
+                    pass
+            else:
+                self._ingest_result_blob(rid, blob)
         self._release_deps(spec)
         self.counters["tasks_finished"] += 1
         self.counters["tasks_finished_remote"] += 1
@@ -1584,7 +1782,7 @@ class NodeService:
         exclude = set(exclude or ())
         try:
             await self._await_deps(spec)
-            payload_spec = self._resolved_copy(spec)
+            payload_spec, ref_sources = self._resolved_copy(spec)
         except BaseException as e:  # noqa: BLE001
             err = e if isinstance(e, TaskError) else \
                 TaskError.from_exception(e, spec.name)
@@ -1637,7 +1835,8 @@ class NodeService:
             try:
                 conn = await self._peer_conn(target, placed["address"])
                 reply = await conn.call("remote_execute", {
-                    "spec": payload_spec, "owner": self.node_id.binary()})
+                    "spec": payload_spec, "owner": self.node_id.binary(),
+                    "ref_sources": ref_sources})
             except (ConnectionLost, OSError):
                 exclude.add(target)
                 # A pinned target stays the same next iteration (it is
@@ -1701,7 +1900,7 @@ class NodeService:
                 spec = entry.queue.popleft()
                 try:
                     await self._await_deps(spec)
-                    payload_spec = self._resolved_copy(spec)
+                    payload_spec, ref_sources = self._resolved_copy(spec)
                 except BaseException as e:  # noqa: BLE001
                     err = e if isinstance(e, TaskError) else \
                         TaskError.from_exception(e, spec.name)
@@ -1710,7 +1909,8 @@ class NodeService:
                 try:
                     conn = await self._peer_conn(entry.node_id, entry.address)
                     fut = asyncio.ensure_future(conn.call("remote_execute", {
-                        "spec": payload_spec, "owner": self.node_id.binary()}))
+                        "spec": payload_spec, "owner": self.node_id.binary(),
+                        "ref_sources": ref_sources}))
                 except (ConnectionLost, OSError):
                     self._fail_task(spec, ActorDiedError(
                         "actor node unreachable", task_name=spec.name))
@@ -1733,7 +1933,7 @@ class NodeService:
             self._fail_task(spec, ActorDiedError(
                 "actor node died mid-call", task_name=spec.name))
             return
-        self._handle_remote_reply(spec, reply)
+        await self._handle_remote_reply(spec, reply)
 
     def _fail_remote_actor_queue(self, entry: RemoteActorEntry):
         while entry.queue:
@@ -1798,10 +1998,107 @@ class NodeService:
                 except ObjectLostError as e2:
                     e = e2
                 return ("err", e)
+        if method == "fetch_meta":
+            # First leg of a chunked pull: resolves to the object inline
+            # (small), or to {size, holders, serving} for a chunked pull
+            # (reference: the pull manager asking the directory + owner).
+            oid = ObjectID(payload["oid"])
+            st = await self.wait_object(oid, payload.get("timeout"))
+            if st.status == PENDING:
+                return ("timeout",)
+            if st.status == ERROR:
+                return ("err", st.error)
+            try:
+                form = self.materialize_for_ipc(oid)
+            except (KeyError, ObjectLostError) as e:
+                # Serve-side loss: reconstruct from lineage, then retry once.
+                try:
+                    if await self.recover_object(oid, payload.get("timeout")):
+                        st = self.objects.get(oid)
+                        if st is None:
+                            return ("err", ObjectLostError(str(e)))
+                        if st.status == ERROR:
+                            return ("err", st.error)
+                        form = self.materialize_for_ipc(oid)
+                    else:
+                        return ("err", ObjectLostError(str(e)))
+                except (KeyError, ObjectLostError) as e2:
+                    return ("err", ObjectLostError(str(e2)))
+            if form[0] == "err":
+                return form
+            if form[0] == "bytes":
+                return ("b", form[1])
+            # shm-resident: small ones still ride one frame
+            st = self.objects[oid]
+            if st.size <= self.cfg.object_transfer_min_chunked_bytes:
+                try:
+                    return ("b", self._materialize_blob(oid))
+                except ObjectLostError as e:
+                    return ("err", e)
+            holders = [list(a) for a in (st.holders or ())]
+            return ("meta", {"size": st.size, "holders": holders})
+        if method == "fetch_begin":
+            oid = ObjectID(payload["oid"])
+            st = self.objects.get(oid)
+            if st is None or st.status != READY:
+                return ("err", ObjectLostError(
+                    f"object {oid.hex()[:16]} not held here"))
+            if (not payload.get("force")
+                    and self._serving_count(oid) >=
+                    self.cfg.object_transfer_max_pushes):
+                # Push cap (enforced here, not just advertised in meta, so
+                # simultaneous pullers can't all slip past it).
+                return ("busy",)
+            try:
+                form = self.materialize_for_ipc(oid)
+            except (KeyError, ObjectLostError) as e:
+                return ("err", ObjectLostError(str(e)))
+            if form[0] == "err":
+                return form
+            size = len(form[1]) if form[0] == "bytes" else st.size
+            self._serving.setdefault(oid, []).append(time.time())
+            self.counters["object_transfers_served"] += 1
+            return ("ok", size)
+        if method == "fetch_chunk":
+            oid = ObjectID(payload["oid"])
+            st = self.objects.get(oid)
+            if st is None:
+                return ("err", ObjectLostError(
+                    f"object {oid.hex()[:16]} not held here"))
+            off, ln = payload["off"], payload["len"]
+            if st.location == "shm":
+                mv = self.shm.get(oid)
+                if mv is None:
+                    return ("err", ObjectLostError(
+                        f"object {oid.hex()[:16]} missing from store"))
+                return ("c", bytes(mv[off:off + ln]))
+            kind, val = st.value
+            blob = val if kind == "bytes" else serialization.serialize(val)
+            return ("c", blob[off:off + ln])
+        if method == "fetch_end":
+            ts = self._serving.get(ObjectID(payload))
+            if ts:
+                ts.pop(0)
+                if not ts:
+                    self._serving.pop(ObjectID(payload), None)
+            return True
+        if method == "copy_added":
+            st = self.objects.get(ObjectID(payload["oid"]))
+            if st is not None and st.status == READY:
+                if st.holders is None:
+                    st.holders = {}
+                st.holders[tuple(payload["addr"])] = payload["node_id"]
+            return True
+        if method == "copy_removed":
+            st = self.objects.get(ObjectID(payload["oid"]))
+            if st is not None and st.holders:
+                st.holders.pop(tuple(payload["addr"]), None)
+            return True
         if method == "incref":
             self.incref(ObjectID(payload))
             return True
         if method == "decref":
+            self._result_pins.pop(ObjectID(payload), None)
             self.decref(ObjectID(payload))
             return True
         if method == "kill_actor":
@@ -1822,9 +2119,16 @@ class NodeService:
         freed once the reply ships."""
         spec: TaskSpec = payload["spec"]
         spec._remote = True
+        # Large REF args arrive unresolved with their source addresses:
+        # pull them chunked into the local store before/while the task is
+        # queued (the dispatch path waits on local dep readiness).
+        for dep_bin, src in (payload.get("ref_sources") or {}).items():
+            self.loop.create_task(
+                self.ensure_object(ObjectID(dep_bin), tuple(src)))
         self.counters["remote_tasks_received"] += 1
         rids = self.submit(spec)
         results = []
+        keep = set()
         err = None
         for rid in rids:
             st = await self.wait_object(rid)
@@ -1833,15 +2137,36 @@ class NodeService:
                 break
         if err is None:
             try:
-                results = [self._materialize_blob(rid) for rid in rids]
+                for rid in rids:
+                    form = self.materialize_for_ipc(rid)
+                    if form[0] == "err":
+                        err = form[1]
+                        break
+                    st = self.objects[rid]
+                    if (form[0] == "shm" and st.size >
+                            self.cfg.object_transfer_min_chunked_bytes):
+                        # Big result: reply with a reference — the owner
+                        # pulls it chunked and then releases our pin with a
+                        # decref notify (reference: large returns go through
+                        # plasma + object transfer, never the reply frame).
+                        # TTL-tracked: if the reply is lost and the decref
+                        # never arrives, the sweep reclaims the pin.
+                        results.append(("ref", st.size))
+                        keep.add(rid)
+                        self._result_pins[rid] = time.time()
+                    else:
+                        results.append(self._materialize_blob(rid))
             except BaseException as e:  # noqa: BLE001
                 err = TaskError.from_exception(e, spec.name)
+        if err is not None:
+            keep.clear()  # error reply: owner will never pull, drop pins too
         if not spec.is_actor_creation:
             for rid in rids:
-                self.decref(rid)  # drop the submitter ref; owner has its own
+                if rid not in keep:
+                    self.decref(rid)  # drop submitter ref; owner has its own
         if err is not None:
             return {"error": err}
-        return {"results": results}
+        return {"results": results, "addr": list(self.peer_address)}
 
     # ------------------------------------------------------------------
     # Actors

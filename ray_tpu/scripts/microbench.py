@@ -1,0 +1,236 @@
+"""Runtime microbenchmarks: recorded ops/s for the control and object planes.
+
+Parity target: the reference's microbenchmark suite
+(/root/reference/python/ray/_private/ray_perf.py:129-198, run by
+release/microbenchmark/run_microbenchmark.py) and the scalability envelope
+(/root/reference/release/benchmarks/README.md:7-31). The reference keeps
+absolute thresholds in its external release pipeline; we commit ours in-tree:
+``python -m ray_tpu.scripts.microbench`` writes MICROBENCH.json at the repo
+root, and tests/test_microbench.py runs a reduced-scale pass in CI with
+regression floors.
+
+Metric families:
+  * object plane: put/get ops/s for small values, put bandwidth for 100 MB
+    arrays, cross-node fetch MB/s (2-node cluster harness)
+  * task plane: submit sync (round-trip) and async (batched) tasks/s on the
+    CPU lane (subprocess workers) AND the device lane (in-process, the
+    TPU-first hot path — the reference has no equivalent split)
+  * actor plane: 1:1 sync / async / max_concurrency calls/s
+  * coordination: ray.wait over 1k refs, placement-group create+remove/s
+
+Methodology mirrors ray_perf.timeit: warmup until stable, then fixed-length
+trials, report mean and stddev. Durations scale down via RT_MB_TRIAL_S /
+RT_MB_TRIALS so CI stays fast while the committed numbers use full scale.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+TRIALS = int(os.environ.get("RT_MB_TRIALS", "3"))
+TRIAL_S = float(os.environ.get("RT_MB_TRIAL_S", "1.0"))
+WARMUP_S = float(os.environ.get("RT_MB_WARMUP_S", "0.5"))
+FILTER = os.environ.get("RT_MB_FILTER", "")
+
+
+def timeit(name: str, fn: Callable[[], None], multiplier: float = 1.0,
+           results: Optional[list] = None):
+    """Run fn repeatedly; record multiplier*calls/s mean±sd over TRIALS."""
+    if FILTER and FILTER not in name:
+        return None
+    # Warmup: run until WARMUP_S has elapsed (compiles code paths, fills
+    # worker pools) and learn the per-call cost for trial batching.
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < WARMUP_S:
+        fn()
+        count += 1
+    step = max(1, count // 10)
+    rates = []
+    for _ in range(TRIALS):
+        t0 = time.perf_counter()
+        n = 0
+        while time.perf_counter() - t0 < TRIAL_S:
+            for _ in range(step):
+                fn()
+            n += step
+        rates.append(multiplier * n / (time.perf_counter() - t0))
+    mean = statistics.fmean(rates)
+    sd = statistics.pstdev(rates)
+    row = {"name": name, "per_s": round(mean, 2), "sd": round(sd, 2)}
+    print(f"{name}: {mean:,.1f} ± {sd:,.1f} /s", flush=True)
+    if results is not None:
+        results.append(row)
+    return row
+
+
+def run(include_cluster: bool = True, results: Optional[list] = None) -> list:
+    import ray_tpu
+
+    results = results if results is not None else []
+
+    # ---------------- object plane ----------------
+    small_ref = ray_tpu.put(0)
+    timeit("get_small_ops", lambda: ray_tpu.get(small_ref), results=results)
+    timeit("put_small_ops", lambda: ray_tpu.put(0), results=results)
+
+    arr = np.zeros(100 * 1024 * 1024 // 8, dtype=np.int64)  # 100 MB
+    gb = arr.nbytes / 1e9
+    timeit("put_gigabytes_gb", lambda: ray_tpu.put(arr), multiplier=gb,
+           results=results)
+
+    big_ref = ray_tpu.put(arr)
+    timeit("get_gigabytes_gb", lambda: ray_tpu.get(big_ref), multiplier=gb,
+           results=results)
+
+    # ---------------- task plane: device lane (in-process) ----------------
+    @ray_tpu.remote(scheduling_strategy="device")
+    def dev_value():
+        return b"ok"
+
+    timeit("task_device_sync",
+           lambda: ray_tpu.get(dev_value.remote()), results=results)
+
+    def dev_async():
+        ray_tpu.get([dev_value.remote() for _ in range(100)])
+
+    timeit("task_device_async", dev_async, multiplier=100, results=results)
+
+    # ---------------- task plane: cpu lane (subprocess workers) -----------
+    @ray_tpu.remote
+    def cpu_value():
+        return b"ok"
+
+    timeit("task_cpu_sync",
+           lambda: ray_tpu.get(cpu_value.remote()), results=results)
+
+    def cpu_async():
+        ray_tpu.get([cpu_value.remote() for _ in range(100)])
+
+    timeit("task_cpu_async", cpu_async, multiplier=100, results=results)
+
+    # ---------------- actor plane ----------------
+    @ray_tpu.remote
+    class Bench:
+        def value(self):
+            return b"ok"
+
+        def value_batch(self, n):
+            return [b"ok"] * n
+
+    a = Bench.remote()
+    ray_tpu.get(a.value.remote(), timeout=60)  # ensure started
+    timeit("actor_call_sync",
+           lambda: ray_tpu.get(a.value.remote()), results=results)
+
+    def actor_async():
+        ray_tpu.get([a.value.remote() for _ in range(100)])
+
+    timeit("actor_call_async", actor_async, multiplier=100, results=results)
+
+    c = Bench.options(max_concurrency=16).remote()
+    ray_tpu.get(c.value.remote(), timeout=60)
+
+    def actor_concurrent():
+        ray_tpu.get([c.value.remote() for _ in range(100)])
+
+    timeit("actor_call_concurrent", actor_concurrent, multiplier=100,
+           results=results)
+
+    # ---------------- coordination ----------------
+    @ray_tpu.remote(scheduling_strategy="device")
+    def quick():
+        return 1
+
+    def wait_1k():
+        not_ready = [quick.remote() for _ in range(1000)]
+        while not_ready:
+            _, not_ready = ray_tpu.wait(not_ready,
+                                        num_returns=len(not_ready))
+
+    timeit("wait_1k_refs", wait_1k, multiplier=1000, results=results)
+
+    def pg_cycle():
+        pg = ray_tpu.placement_group([{"CPU": 1}], strategy="PACK")
+        pg.wait(timeout=30)
+        ray_tpu.remove_placement_group(pg)
+
+    timeit("pg_create_remove", pg_cycle, results=results)
+
+    # ---------------- cross-node object plane ----------------
+    if include_cluster:
+        results.append(_cross_node_fetch())
+    return results
+
+
+def _cross_node_fetch(payload_mb: int = 64) -> dict:
+    """Fetch a payload_mb object produced on a worker node from the driver:
+    measures the node→node object-plane path (chunked fetch RPCs)."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    mb = float(os.environ.get("RT_MB_FETCH_MB", payload_mb))
+    n = int(mb * 1024 * 1024 // 8)
+
+    @ray_tpu.remote(resources={"src": 1})
+    def produce():
+        return np.ones(n, dtype=np.int64)
+
+    cluster = Cluster(init_args={"num_cpus": 1})
+    try:
+        cluster.add_node(num_cpus=1, resources={"src": 1})
+        cluster.wait_for_nodes(2)
+        rates = []
+        for _ in range(max(1, TRIALS)):
+            ref = produce.remote()
+            # Wait for the result to exist on the remote node without
+            # pulling it here (wait is metadata-only).
+            ray_tpu.wait([ref], num_returns=1, timeout=120)
+            t0 = time.perf_counter()
+            val = ray_tpu.get(ref, timeout=120)
+            dt = time.perf_counter() - t0
+            rates.append(val.nbytes / 1e6 / dt)
+            del val, ref
+        row = {"name": "cross_node_fetch_mb_s",
+               "per_s": round(statistics.fmean(rates), 2),
+               "sd": round(statistics.pstdev(rates), 2)}
+        print(f"cross_node_fetch_mb_s: {row['per_s']:,.1f} MB/s",
+              flush=True)
+        return row
+    finally:
+        cluster.shutdown()
+
+
+def main():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2)
+    try:
+        results = run(include_cluster=False)
+    finally:
+        ray_tpu.shutdown()
+    # The cluster benchmark owns its own init/shutdown cycle.
+    results.append(_cross_node_fetch())
+
+    doc = {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "trials": TRIALS,
+        "trial_s": TRIAL_S,
+        "results": {r["name"]: {"per_s": r["per_s"], "sd": r["sd"]}
+                    for r in results if r},
+    }
+    out = os.environ.get("RT_MB_OUT", "MICROBENCH.json")
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

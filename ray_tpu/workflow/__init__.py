@@ -292,10 +292,42 @@ def run(entry: Optional[StepNode], workflow_id: Optional[str] = None) -> Any:
         raise WorkflowError(f"no workflow {workflow_id!r} in storage")
     else:
         storage.create(entry)
+    # Atomic lease claim (O_EXCL): two processes racing to (re)run the
+    # same workflow — e.g. concurrent resume_all() after a crash — must
+    # not both execute it. A stale lock (holder crashed: mtime older than
+    # LEASE_TIMEOUT_S) is broken exactly once; losing the re-create race
+    # after breaking it means someone else claimed.
+    lock_path = os.path.join(storage.dir, "lease.lock")
+    claimed = False
+    for attempt in (0, 1):
+        try:
+            fd = os.open(lock_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.write(fd, str(os.getpid()).encode())
+            os.close(fd)
+            claimed = True
+            break
+        except FileExistsError:
+            try:
+                stale = (time.time() - os.path.getmtime(lock_path)
+                         > LEASE_TIMEOUT_S)
+            except FileNotFoundError:
+                continue  # holder just released; retry the create
+            if stale and attempt == 0:
+                try:
+                    os.unlink(lock_path)
+                except FileNotFoundError:
+                    pass
+                continue
+            break
+    if not claimed:
+        raise WorkflowError(
+            f"workflow {workflow_id!r} is already running "
+            f"(live lease {lock_path})")
+
     storage.set_status(RUNNING)
     # Lease heartbeat: while we execute, periodically refresh status.json's
-    # ts so resume_all() can tell a live RUNNING workflow (fresh lease) from
-    # one orphaned by a crashed process (expired lease) and only re-execute
+    # ts (and the lock mtime) so resume_all() can tell a live RUNNING
+    # workflow from one orphaned by a crashed process and only re-execute
     # the latter.
     stop_beat = threading.Event()
 
@@ -303,17 +335,23 @@ def run(entry: Optional[StepNode], workflow_id: Optional[str] = None) -> Any:
         while not stop_beat.wait(LEASE_INTERVAL_S):
             try:
                 storage.set_status(RUNNING)
+                os.utime(lock_path)
             except OSError:
                 return
 
     beat = threading.Thread(target=_beat, daemon=True, name="wf-lease")
     beat.start()
+
     def _stop_beat():
         # Join before writing the terminal status: an in-flight
         # set_status(RUNNING) in the beat thread must not land after (and
-        # overwrite) SUCCESSFUL/FAILED.
+        # overwrite) SUCCESSFUL/FAILED. Then release the claim.
         stop_beat.set()
         beat.join()
+        try:
+            os.unlink(lock_path)
+        except FileNotFoundError:
+            pass
 
     try:
         value = _execute_node(entry, storage, inflight={})

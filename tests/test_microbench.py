@@ -1,0 +1,69 @@
+"""CI pass of the runtime microbenchmarks at reduced scale with regression
+floors (parity: the reference's release microbenchmark pipeline keeps
+thresholds out-of-tree; ours are committed here so a control-plane
+regression fails CI).
+
+Floors are deliberately ~5-10x below the recorded MICROBENCH.json numbers:
+CI boxes are noisy and share one core with other tests — the gate catches
+order-of-magnitude regressions (an accidental O(n^2), a sleep in the hot
+path), not few-percent drift.
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu.scripts import microbench
+
+# name -> minimum acceptable per_s at CI scale
+FLOORS = {
+    "get_small_ops": 2000,
+    "put_small_ops": 1000,
+    "put_gigabytes_gb": 0.2,      # GB/s into the local store
+    "get_gigabytes_gb": 0.2,
+    "task_device_sync": 100,
+    "task_device_async": 200,
+    "task_cpu_sync": 20,
+    "task_cpu_async": 50,
+    "actor_call_sync": 20,
+    "actor_call_async": 50,
+    "actor_call_concurrent": 50,
+    "wait_1k_refs": 500,          # refs resolved/s
+    "pg_create_remove": 2,
+}
+
+
+@pytest.fixture(scope="module", autouse=True)
+def quick_scale():
+    os.environ["RT_MB_TRIALS"] = "1"
+    os.environ["RT_MB_TRIAL_S"] = "0.4"
+    os.environ["RT_MB_WARMUP_S"] = "0.2"
+    # module reads these at import; refresh
+    microbench.TRIALS = 1
+    microbench.TRIAL_S = 0.4
+    microbench.WARMUP_S = 0.2
+    yield
+
+
+def test_microbench_floors():
+    ray_tpu.init(num_cpus=2)
+    try:
+        results = microbench.run(include_cluster=False)
+    finally:
+        ray_tpu.shutdown()
+    by_name = {r["name"]: r["per_s"] for r in results if r}
+    missing = set(FLOORS) - set(by_name)
+    assert not missing, f"benchmarks did not run: {missing}"
+    failures = {n: (by_name[n], floor)
+                for n, floor in FLOORS.items() if by_name[n] < floor}
+    assert not failures, (
+        f"microbenchmark regression (observed, floor): {failures}")
+
+
+def test_cross_node_fetch_floor():
+    os.environ["RT_MB_FETCH_MB"] = "16"
+    row = microbench._cross_node_fetch()
+    # 16 MB across the loopback object plane: anything under 20 MB/s means
+    # the transfer path is broken (e.g. chunking regressed to per-byte).
+    assert row["per_s"] > 20, row
