@@ -34,7 +34,8 @@ class ActorMethod:
     def remote(self, *args, **kwargs):
         h = self._handle
         ctx = context_mod.require_context()
-        enc_args, enc_kwargs = encode_args(args, kwargs, h._is_device)
+        enc_args, enc_kwargs, nested_refs = encode_args(
+            args, kwargs, h._is_device)
         spec = TaskSpec(
             task_id=TaskID.for_actor_task(h._actor_id),
             name=f"{h._class_name}.{self._method_name}",
@@ -46,6 +47,7 @@ class ActorMethod:
             strategy=SchedulingStrategy(kind="device" if h._is_device else "default"),
             actor_id=h._actor_id,
             method_name=self._method_name,
+            nested_refs=nested_refs or None,
         )
         refs = ctx.submit_spec(spec)
         return refs[0] if self._num_returns == 1 else refs
@@ -199,7 +201,7 @@ class ActorClass:
             fid = ctx.export_function(self._cls)
             self._export_cache = (ctx, fid)
         device = self._device_lane()
-        enc_args, enc_kwargs = encode_args(args, kwargs, device)
+        enc_args, enc_kwargs, nested_refs = encode_args(args, kwargs, device)
         actor_id = ActorID.of(ctx.job_id)
         method_names = self._method_names()
         spec = TaskSpec(
@@ -219,6 +221,7 @@ class ActorClass:
             actor_methods=method_names,
             runtime_env=ctx.resolve_runtime_env(self._runtime_env,
                                                 device_lane=device),
+            nested_refs=nested_refs or None,
         )
         refs = ctx.submit_spec(spec)
         return ActorHandle(actor_id, method_names, self._class_name, device,

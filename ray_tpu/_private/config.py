@@ -55,6 +55,9 @@ class Config:
     # pullers are asked to wait for a peer copy (broadcast becomes a tree
     # instead of N pulls from the owner).
     object_transfer_max_pushes: int = _cfg(2)
+    # How long a puller waits for a peer copy to appear when the owner is
+    # at its push cap, before forcing the owner to serve anyway.
+    object_transfer_busy_wait_s: float = _cfg(2.0)
     # Big results kept pinned on the executor for the owner's chunked pull
     # are reclaimed after this long if the pull never happens (lost reply,
     # dead owner).

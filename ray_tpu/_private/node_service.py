@@ -127,6 +127,20 @@ class ObjectState:
     # Borrower-side: the address we pulled this foreign copy from (the
     # owner) — freeing the copy deregisters it there.
     pulled_from: Optional[tuple] = None
+    # Borrowing protocol (reference: reference_count.h:61):
+    # owner side — borrower address -> node_id bytes, each holding one
+    # deferred-free count until that node releases (or dies);
+    # borrower side — the owner's address plus whether our aggregate
+    # borrow is registered there.
+    borrowers: Optional[dict] = None
+    borrow_owner: Optional[tuple] = None
+    borrow_registered: bool = False  # borrow_add issued
+    borrow_confirmed: bool = False   # borrow_add acked by the owner
+    # Refs serialized INSIDE this object's bytes ([(oid_bytes, owner)]):
+    # pinned for the container's lifetime, released when it frees — a
+    # container transitively keeps its contents alive (reference: the
+    # reference counter's contained/inlined-ref tracking).
+    inner_refs: Optional[list] = None
 
 
 def _print_worker_logs(node_hex: str, entries: list):
@@ -155,6 +169,10 @@ class WorkerHandle:
     # Captured stdout/stderr file + the tail offset already streamed.
     log_path: Optional[str] = None
     log_offset: int = 0
+    # Refs this worker process holds (ref_hold/ref_drop): released in bulk
+    # if the worker dies without dropping them.
+    held_refs: collections.Counter = field(
+        default_factory=collections.Counter)
 
 
 @dataclass
@@ -602,7 +620,8 @@ class NodeService:
         # serial pulls from the owner (reference: push_manager.h bounds
         # concurrent chunked pushes the same way). After the busy-wait
         # deadline we force the owner to serve anyway (bounded latency).
-        busy_deadline = self.loop.time() + 2.0
+        busy_deadline = (self.loop.time()
+                         + self.cfg.object_transfer_busy_wait_s)
         buf = None
         while True:
             if st.status != PENDING:
@@ -644,7 +663,9 @@ class NodeService:
                 await asyncio.sleep(0.1)
                 buf = await self._pull_chunks(oid, owner_addr, force=True)
             break
-        if st.status != PENDING:
+        if st.status != PENDING or self.objects.get(oid) is not st:
+            # Resolved elsewhere, or freed mid-pull (borrow released):
+            # ingesting into a stale/orphaned state would leak shm.
             return
         if buf is None:
             self.mark_error(oid, ObjectLostError(
@@ -706,6 +727,18 @@ class NodeService:
         except (ConnectionLost, OSError, ObjectLostError):
             return None
 
+    def _attach_inner_refs(self, oid: ObjectID, refs):
+        """Pin refs serialized inside a container object for the
+        container's lifetime (released in _maybe_free)."""
+        if not refs:
+            return
+        st = self._obj(oid)
+        st.inner_refs = (st.inner_refs or []) + [
+            (b, tuple(o) if o else None) for b, o in refs]
+        for oid_b, owner in refs:
+            self.incref_ref(ObjectID(oid_b),
+                            tuple(owner) if owner else None)
+
     async def _result_pin_sweep_loop(self):
         """Reclaim big-result pins whose owner never pulled (reply lost,
         owner died): without this a dropped remote_execute reply leaks the
@@ -765,11 +798,16 @@ class NodeService:
         if conn is not None:
             await conn.close()  # fails in-flight forwards -> retry paths
         # Drop the dead node from every location directory entry so new
-        # pulls don't target its copies.
+        # pulls don't target its copies, and release every borrow it held
+        # (a dead borrower can never send borrow_release).
         nid = node_id.binary()
-        for st in self.objects.values():
+        for oid, st in list(self.objects.items()):
             if st.holders:
                 st.holders = {a: n for a, n in st.holders.items() if n != nid}
+            if st.borrowers:
+                for addr in [a for a, n in st.borrowers.items() if n == nid]:
+                    st.borrowers.pop(addr, None)
+                    self.decref(oid)
         for entry in list(self.remote_actors.values()):
             if entry.node_id == node_id and entry.state == "ALIVE":
                 await self._remote_actor_died(entry, f"node died: {cause}")
@@ -861,6 +899,9 @@ class NodeService:
         spec._deps_released = False
         for dep in spec.dependencies():
             self.incref(dep)
+        for oid_b, owner in (spec.nested_refs or ()):
+            self.incref_ref(ObjectID(oid_b),
+                            tuple(owner) if owner else None)
         spec._remote = False
         spec._charged = None
         self._event(spec, "RECONSTRUCTING")
@@ -869,8 +910,24 @@ class NodeService:
 
     async def recover_object(self, oid: ObjectID,
                              timeout: float | None = None) -> bool:
-        """Start reconstruction of a lost object and wait for it to reach a
-        terminal state again. True = worth re-reading (READY or ERROR)."""
+        """Recover a lost local object: first re-pin a surviving copy from
+        the location directory (cheap — and the only option for
+        non-replayable objects like actor results and puts), then fall
+        back to lineage reconstruction (reference:
+        object_recovery_manager.h:74-78 pins other copies before
+        resubmitting the creating task). True = worth re-reading."""
+        st = self.objects.get(oid)
+        if st is not None and st.holders:
+            for addr in list(st.holders):
+                buf = await self._pull_chunks(oid, tuple(addr), force=True)
+                if buf is not None and buf != "busy":
+                    self.shm.unpin(oid)
+                    self.shm.delete(oid)
+                    st.status, st.location, st.value = PENDING, "memory", None
+                    st.error = None
+                    self._ingest_result_blob(oid, buf)
+                    self.counters["objects_recovered_from_copy"] += 1
+                    return True
         if not self._start_reconstruction(oid):
             return False
         st = await self.wait_object(oid, timeout)
@@ -893,6 +950,41 @@ class NodeService:
     def incref(self, oid: ObjectID, n: int = 1):
         self._obj(oid).refcount += n
 
+    def incref_ref(self, oid: ObjectID, owner_addr=None):
+        """incref that understands ownership: a count on a foreign-owned
+        object additionally registers ONE aggregate borrow with the owner
+        (deferring the owner's free until we release) — the borrowing
+        protocol of reference_count.h:61. Loop thread only."""
+        st = self._obj(oid)
+        st.refcount += 1
+        if owner_addr is not None:
+            owner_addr = tuple(owner_addr)
+            if owner_addr != tuple(self.peer_address):
+                st.borrow_owner = owner_addr
+                if not st.borrow_registered:
+                    st.borrow_registered = True
+                    self.loop.create_task(
+                        self._register_borrow(oid, owner_addr))
+
+    async def _register_borrow(self, oid: ObjectID, owner_addr: tuple):
+        try:
+            conn = await self._addr_conn(owner_addr)
+            await conn.call("borrow_add", {
+                "oid": oid.binary(),
+                "addr": list(self.peer_address),
+                "node_id": self.node_id.binary(),
+            })
+        except (ConnectionLost, OSError):
+            return  # owner gone: fetches will surface the loss
+        st = self.objects.get(oid)
+        if st is None:
+            # Freed locally while the registration was in flight — the
+            # release was deferred (never allowed to overtake the add):
+            # send it now.
+            await self._release_borrow(oid, owner_addr)
+        else:
+            st.borrow_confirmed = True
+
     def decref(self, oid: ObjectID, n: int = 1):
         st = self.objects.get(oid)
         if st is None:
@@ -901,7 +993,15 @@ class NodeService:
         self._maybe_free(oid, st)
 
     def _maybe_free(self, oid: ObjectID, st: ObjectState):
-        if st.refcount <= 0 and st.status != PENDING and not st.waiters:
+        # PENDING entries are kept alive awaiting production — EXCEPT pure
+        # borrow placeholders (foreign-owned, nothing local will ever
+        # produce them): those must free on release or the borrow_release
+        # below never reaches the owner and the object leaks there.
+        borrow_placeholder = (st.status == PENDING
+                              and st.borrow_owner is not None
+                              and st.creating_spec is None)
+        if (st.refcount <= 0 and not st.waiters
+                and (st.status != PENDING or borrow_placeholder)):
             self.objects.pop(oid, None)
             if st.location == "shm":
                 self.shm.unpin(oid)
@@ -911,6 +1011,24 @@ class NodeService:
                 # location directory so new pullers don't target us.
                 self.loop.create_task(
                     self._notify_copy_removed(oid, st.pulled_from))
+            if st.borrow_confirmed and st.borrow_owner is not None:
+                # Last local count on a borrowed object: release our
+                # aggregate borrow so the owner may free. (If the add is
+                # still in flight, _register_borrow sends the release on
+                # ack — a release must never overtake its registration.)
+                self.loop.create_task(
+                    self._release_borrow(oid, st.borrow_owner))
+            # A freed container releases what it transitively pinned.
+            for oid_b, _owner in (st.inner_refs or ()):
+                self.decref(ObjectID(oid_b))
+
+    async def _release_borrow(self, oid: ObjectID, owner_addr: tuple):
+        try:
+            conn = await self._addr_conn(owner_addr)
+            await conn.notify("borrow_release", {
+                "oid": oid.binary(), "addr": list(self.peer_address)})
+        except (ConnectionLost, OSError):
+            pass
 
     async def _notify_copy_removed(self, oid: ObjectID, owner_addr: tuple):
         try:
@@ -932,7 +1050,11 @@ class NodeService:
         if kind == "bytes":
             blob = val
         else:
-            blob = serialization.serialize(val)
+            # Converting a live value to bytes may drop the only ObjectRefs
+            # keeping nested objects alive (st.value is discarded below):
+            # the container object pins them from here on.
+            blob, refs = serialization.serialize_with_refs(val)
+            self._attach_inner_refs(oid, refs)
         if len(blob) > self.cfg.max_inline_object_size:
             self.shm.put(oid, blob)
             # Same invariant as mark_ready_shm: table-referenced segments
@@ -972,9 +1094,15 @@ class NodeService:
             st.creating_spec = spec
             st.refcount += 1  # submitter's implicit ref, released by ObjectRef
         # Pin args until the task reaches a terminal state (reference:
-        # task-argument pinning in the raylet's DependencyManager).
+        # task-argument pinning in the raylet's DependencyManager). Refs
+        # nested inside by-value args are pinned the same way — borrowed
+        # from their owner when foreign — so the submitter dropping its
+        # handle mid-flight cannot free what the task carries.
         for dep in spec.dependencies():
             self.incref(dep)
+        for oid_b, owner in (spec.nested_refs or ()):
+            self.incref_ref(ObjectID(oid_b),
+                            tuple(owner) if owner else None)
         self.counters["tasks_submitted"] += 1
         self._event(spec, "SUBMITTED")
         self._route(spec)
@@ -1399,7 +1527,12 @@ class NodeService:
                 f"task '{spec.name}' declared num_returns={len(rids)} but "
                 f"returned {len(results)} values"))
             return
-        for rid, res in zip(rids, results):
+        # Refs serialized inside each result value are pinned for that
+        # result object's lifetime — the consumer deserializing the result
+        # registers its own borrow before it could ever drop the result.
+        nested_per = reply.get("nested_refs") or [()] * len(rids)
+        for rid, res, inner in zip(rids, results, nested_per):
+            self._attach_inner_refs(rid, inner)
             if res[0] == "b":
                 self.mark_ready_bytes(rid, res[1])
             else:
@@ -1416,6 +1549,8 @@ class NodeService:
         spec._deps_released = True
         for dep in spec.dependencies():
             self.decref(dep)
+        for oid_b, _owner in (spec.nested_refs or ()):
+            self.decref(ObjectID(oid_b))
 
     def cancel_task(self, task_id: TaskID, force: bool = False):
         """Cancel a task wherever it is: queued specs are dropped at
@@ -1748,7 +1883,12 @@ class NodeService:
             return
         results = reply["results"]
         exec_addr = tuple(reply["addr"]) if reply.get("addr") else None
-        for rid, blob in zip(rids, results):
+        nested_per = reply.get("nested_refs") or [()] * len(rids)
+        for rid, blob, inner in zip(rids, results, nested_per):
+            # Our copy of the result pins the refs inside it, exactly as
+            # the executor's copy did (registered BEFORE the executor
+            # releases its own pins via the decref notify below).
+            self._attach_inner_refs(rid, inner)
             if isinstance(blob, tuple) and blob[0] == "ref":
                 # Big result: pull it chunked from the executing node, then
                 # release the transfer pin it kept for us.
@@ -2038,11 +2178,12 @@ class NodeService:
             holders = [list(a) for a in (st.holders or ())]
             return ("meta", {"size": st.size, "holders": holders})
         if method == "fetch_begin":
+            # msgpack-schema'd method: plain-data responses only (errors
+            # as strings — the puller falls back to the owner on any err).
             oid = ObjectID(payload["oid"])
             st = self.objects.get(oid)
             if st is None or st.status != READY:
-                return ("err", ObjectLostError(
-                    f"object {oid.hex()[:16]} not held here"))
+                return ("err", f"object {oid.hex()[:16]} not held here")
             if (not payload.get("force")
                     and self._serving_count(oid) >=
                     self.cfg.object_transfer_max_pushes):
@@ -2052,9 +2193,9 @@ class NodeService:
             try:
                 form = self.materialize_for_ipc(oid)
             except (KeyError, ObjectLostError) as e:
-                return ("err", ObjectLostError(str(e)))
+                return ("err", str(e))
             if form[0] == "err":
-                return form
+                return ("err", str(form[1]))
             size = len(form[1]) if form[0] == "bytes" else st.size
             self._serving.setdefault(oid, []).append(time.time())
             self.counters["object_transfers_served"] += 1
@@ -2063,14 +2204,13 @@ class NodeService:
             oid = ObjectID(payload["oid"])
             st = self.objects.get(oid)
             if st is None:
-                return ("err", ObjectLostError(
-                    f"object {oid.hex()[:16]} not held here"))
+                return ("err", f"object {oid.hex()[:16]} not held here")
             off, ln = payload["off"], payload["len"]
             if st.location == "shm":
                 mv = self.shm.get(oid)
                 if mv is None:
-                    return ("err", ObjectLostError(
-                        f"object {oid.hex()[:16]} missing from store"))
+                    return ("err",
+                            f"object {oid.hex()[:16]} missing from store")
                 return ("c", bytes(mv[off:off + ln]))
             kind, val = st.value
             blob = val if kind == "bytes" else serialization.serialize(val)
@@ -2093,6 +2233,28 @@ class NodeService:
             st = self.objects.get(ObjectID(payload["oid"]))
             if st is not None and st.holders:
                 st.holders.pop(tuple(payload["addr"]), None)
+            return True
+        if method == "borrow_add":
+            # A remote node now holds references to an object we own:
+            # defer its free until that node releases (reference:
+            # reference_count.h borrower registration / WaitForRefRemoved).
+            st = self.objects.get(ObjectID(payload["oid"]))
+            if st is None:
+                return False  # already freed; borrower's fetches will fail
+            key = tuple(payload["addr"])
+            if st.borrowers is None:
+                st.borrowers = {}
+            if key not in st.borrowers:
+                st.borrowers[key] = payload["node_id"]
+                st.refcount += 1
+            return True
+        if method == "borrow_release":
+            oid = ObjectID(payload["oid"])
+            st = self.objects.get(oid)
+            if (st is not None and st.borrowers
+                    and st.borrowers.pop(tuple(payload["addr"]), None)
+                    is not None):
+                self.decref(oid)
             return True
         if method == "incref":
             self.incref(ObjectID(payload))
@@ -2135,6 +2297,7 @@ class NodeService:
             if st.status == ERROR:
                 err = st.error
                 break
+        inner_per = []
         if err is None:
             try:
                 for rid in rids:
@@ -2143,6 +2306,9 @@ class NodeService:
                         err = form[1]
                         break
                     st = self.objects[rid]
+                    # Inner-ref info travels with the result so the owner's
+                    # copy pins the same refs our copy does.
+                    inner_per.append(list(st.inner_refs or ()))
                     if (form[0] == "shm" and st.size >
                             self.cfg.object_transfer_min_chunked_bytes):
                         # Big result: reply with a reference — the owner
@@ -2166,7 +2332,8 @@ class NodeService:
                     self.decref(rid)  # drop submitter ref; owner has its own
         if err is not None:
             return {"error": err}
-        return {"results": results, "addr": list(self.peer_address)}
+        return {"results": results, "addr": list(self.peer_address),
+                "nested_refs": inner_per if any(inner_per) else None}
 
     # ------------------------------------------------------------------
     # Actors
@@ -2827,10 +2994,41 @@ class NodeService:
         if method == "put_object":
             oid = ObjectID(payload["oid"])
             self._obj(oid).refcount += 1
+            w = conn.meta.get("worker")
+            if w is not None:
+                # The put count belongs to the worker's ObjectRef; if the
+                # worker dies without dropping it, disconnect cleanup
+                # releases it.
+                w.held_refs[oid] += 1
+            self._attach_inner_refs(oid, payload.get("inner_refs"))
             if payload.get("inline") is not None:
                 self.mark_ready_bytes(oid, payload["inline"])
             else:
                 self.mark_ready_shm(oid, payload["size"])
+            return True
+
+        if method == "ref_hold":
+            # Worker-process ref bookkeeping (nested refs an actor/task
+            # keeps): counts here, borrows at the owner when foreign.
+            oid = ObjectID(payload["oid"])
+            owner = payload.get("owner")
+            self.incref_ref(oid, tuple(owner) if owner else None)
+            w = conn.meta.get("worker")
+            if w is not None:
+                w.held_refs[oid] += 1
+            return True
+
+        if method == "ref_drop_batch":
+            w = conn.meta.get("worker")
+            for oid_b in payload:
+                oid = ObjectID(oid_b)
+                if w is not None:
+                    if w.held_refs[oid] <= 0:
+                        continue  # unmatched drop (hold raced death)
+                    w.held_refs[oid] -= 1
+                    if w.held_refs[oid] <= 0:
+                        del w.held_refs[oid]
+                self.decref(oid)
             return True
 
         if method == "decref":
@@ -2871,6 +3069,10 @@ class NodeService:
         w.state = "DEAD"
         self.counters["workers_died"] += 1
         self._retire_worker_metrics(w.worker_id.hex())
+        # A dead worker can never send its ref_drops: release them here.
+        for oid, n in w.held_refs.items():
+            self.decref(oid, n)
+        w.held_refs.clear()
         # Plain task workers: inflight tasks handled by ConnectionLost in
         # _run_on_worker (retry path). Actor workers: restart FSM.
         if w.actor_id is not None:

@@ -44,7 +44,7 @@ class ObjectRef:
         if _register:
             ctx = _current_context()
             if ctx is not None:
-                ctx.incref(oid)
+                ctx.incref(oid, self._owner_addr)
 
     @property
     def owner_addr(self):
@@ -85,11 +85,16 @@ class ObjectRef:
     def __reduce__(self):
         # Travelling refs re-register at the destination so the owner-side
         # count reflects remote holders (borrowing), and carry the owner's
-        # address so foreign processes can fetch the value.
+        # address so foreign processes can fetch the value. An active
+        # serialize_with_refs collector additionally records the ref so the
+        # carrier (task spec / result reply) can pin it in transit.
         owner = self._owner_addr
         if owner is None:
             ctx = _current_context()
             owner = getattr(ctx, "node_addr", None)
+        from . import serialization
+
+        serialization.note_serialized_ref(self._id.binary(), owner)
         return (_deserialize_ref, (self._id.binary(), owner))
 
     def __del__(self):
@@ -97,7 +102,7 @@ class ObjectRef:
             try:
                 ctx = _current_context()
                 if ctx is not None:
-                    ctx.decref(self._id)
+                    ctx.decref(self._id, self._owner_addr)
             except Exception:
                 pass
 

@@ -33,14 +33,26 @@ def _is_passthrough(v) -> bool:
 
 
 def encode_args(args, kwargs, device_lane: bool):
+    """(enc_args, enc_kwargs, nested_refs): top-level ObjectRefs become REF
+    deps; refs nested inside by-value args are collected so the spec can
+    pin/borrow them for the task's lifetime (reference: contained-ref
+    tracking feeding the borrowing protocol, reference_count.h:61)."""
+    nested: list = []
+
     def enc(v):
         if isinstance(v, ObjectRef):
             return (REF, v.id)
         if device_lane:
+            # Live values keep their own ObjectRefs alive (and with them
+            # the refcounts) — no pinning needed for the copy path either.
             return ("o", v) if _is_passthrough(v) else ("o", serialization.deserialize(serialization.serialize(v)))
-        return (VAL, serialization.serialize(v))
+        blob, refs = serialization.serialize_with_refs(v)
+        nested.extend(refs)
+        return (VAL, blob)
 
-    return [enc(a) for a in args], {k: enc(v) for k, v in kwargs.items()}
+    return ([enc(a) for a in args],
+            {k: enc(v) for k, v in kwargs.items()},
+            nested)
 
 
 class RemoteFunction:
@@ -114,7 +126,7 @@ class RemoteFunction:
             fid = ctx.export_function(self._function)
             self._export_cache = (ctx, fid)
         device = self._device_lane()
-        enc_args, enc_kwargs = encode_args(args, kwargs, device)
+        enc_args, enc_kwargs, nested_refs = encode_args(args, kwargs, device)
         spec = TaskSpec(
             task_id=TaskID.for_task(ctx.job_id),
             name=self._name,
@@ -128,6 +140,7 @@ class RemoteFunction:
             strategy=self._strategy,
             runtime_env=ctx.resolve_runtime_env(self._runtime_env,
                                                 device_lane=device),
+            nested_refs=nested_refs or None,
         )
         from ray_tpu.util import tracing
 

@@ -1,17 +1,29 @@
-"""Control-plane RPC: length-prefixed pickled messages over unix or TCP
-sockets.
+"""Control-plane RPC: versioned, authenticated, length-prefixed frames over
+unix or TCP sockets.
 
 Capability parity target: the reference's gRPC control plane
-(/root/reference/src/ray/rpc/grpc_server.h, grpc_client.h) — per-call
-request/response with correlation, plus server push (the reference pushes
-tasks to leased workers via CoreWorkerService.PushTask). We keep the same
-duplex shape over a single persistent socket per peer:
+(/root/reference/src/ray/rpc/grpc_server.h, grpc_client.h) and its proto
+wire schema (/root/reference/src/ray/protobuf/ — versioned messages). We
+keep the same duplex shape over a single persistent socket per peer:
 
-  * Either side sends ``(kind, seqno, method, payload)`` frames.
+  * Either side sends ``(kind, enc, seqno, method, payload)`` frames.
   * kind=REQ expects a matching kind=RESP with the same seqno.
   * Both sides can originate REQs concurrently (full duplex): the node
-    service pushes ``execute_task`` REQs to a busy worker's socket while the
-    worker has its own outstanding ``submit_task`` REQs.
+    service pushes ``execute_task`` REQs to a busy worker's socket while
+    the worker has its own outstanding ``submit_task`` REQs.
+
+Security/compat model (VERDICT r2 item 8):
+
+  * Every connection opens with a HELLO frame — msgpack-only parsing —
+    carrying a magic, the protocol version, and the cluster session
+    token. NOTHING is unpickled before the token verifies: an
+    unauthenticated peer can at most trigger a msgpack parse error.
+    Version mismatch and bad token are rejected with an ERR frame.
+  * After auth, frame payloads carry an encoding tag: methods in
+    MSGPACK_METHODS (the hot object-plane / refcount / liveness set)
+    ride a typed msgpack schema; the rest (task specs with user
+    functions, exceptions) remain cloudpickle envelopes — pickle stays
+    confined to authenticated, same-session peers.
 
 Addresses: a ``str`` is a unix-socket path (node ↔ its local workers); a
 ``(host, port)`` tuple is TCP (node ↔ head, node ↔ node across the
@@ -21,14 +33,13 @@ The server side is asyncio (runs in the node service's event-loop thread).
 The blocking ``DuplexClient`` (workers) is a socket plus a reader thread
 that routes RESP frames to waiting futures and REQ frames to a handler.
 ``async_connect`` gives the asyncio side a client-initiated peer with the
-same interface as a server-accepted one. Payloads are cloudpickle:
-control-plane messages are small; bulk data rides the shared-memory store
-or the object plane, never this channel.
+same interface as a server-accepted one.
 """
 
 from __future__ import annotations
 
 import asyncio
+import os
 import socket
 import struct
 import threading
@@ -36,16 +47,103 @@ from concurrent.futures import Future
 from typing import Any, Awaitable, Callable, Union
 
 import cloudpickle
+import msgpack
 
-REQ, RESP, ERR = 0, 1, 2
-_HDR = struct.Struct("<BQQ")  # kind, payload_len, seqno
+REQ, RESP, ERR, HELLO, HELLO_OK = 0, 1, 2, 3, 4
+ENC_MSGPACK, ENC_PICKLE = 0, 1
+_HDR = struct.Struct("<BBQQ")  # kind, enc, payload_len, seqno
+
+MAGIC = "rtpu"
+PROTOCOL_VERSION = 1
+_HANDSHAKE_TIMEOUT_S = 10.0
+
+# Methods whose requests AND responses are plain data (bytes/str/int/bool/
+# list/dict) — they ride the msgpack schema; note msgpack returns tuples
+# as lists, so these handlers only index/compare positionally.
+MSGPACK_METHODS = frozenset({
+    "ping",
+    "incref", "decref", "ref_hold", "ref_drop_batch",
+    "fetch_begin", "fetch_chunk", "fetch_end",
+    "copy_added", "copy_removed",
+    "borrow_add", "borrow_release",
+})
 
 Address = Union[str, tuple]  # unix path | (host, port)
 
+# Cluster session token, shared by every process of one session (driver,
+# node daemons, workers) via the RT_SESSION_TOKEN env. Set by the runtime
+# at startup; empty means "no cluster running yet" (unit tests of this
+# module; the handshake still runs and both sides must agree).
+_session_token = os.environ.get("RT_SESSION_TOKEN", "")
 
-def _pack(kind: int, seqno: int, body: Any) -> bytes:
-    payload = cloudpickle.dumps(body)
-    return _HDR.pack(kind, len(payload), seqno) + payload
+
+def set_session_token(token: str):
+    global _session_token
+    _session_token = token or ""
+
+
+def get_session_token() -> str:
+    return _session_token
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+class AuthError(RpcError):
+    pass
+
+
+def _encode_body(enc: int, body: Any) -> bytes:
+    if enc == ENC_MSGPACK:
+        return msgpack.packb(body, use_bin_type=True)
+    return cloudpickle.dumps(body)
+
+
+def _decode_body(enc: int, payload: bytes) -> Any:
+    if enc == ENC_MSGPACK:
+        return msgpack.unpackb(payload, raw=False)
+    return cloudpickle.loads(payload)
+
+
+def _pack(kind: int, enc: int, seqno: int, body: Any) -> bytes:
+    payload = _encode_body(enc, body)
+    return _HDR.pack(kind, enc, len(payload), seqno) + payload
+
+
+def _req_enc(method: str) -> int:
+    return ENC_MSGPACK if method in MSGPACK_METHODS else ENC_PICKLE
+
+
+def _hello_frame() -> bytes:
+    return _pack(HELLO, ENC_MSGPACK, 0,
+                 {"m": MAGIC, "v": PROTOCOL_VERSION, "t": _session_token})
+
+
+def _check_hello(kind: int, enc: int, body_raw: bytes,
+                 expected_token: str | None = None) -> str | None:
+    """Validate a HELLO frame (msgpack-ONLY parsing — never pickle before
+    auth). Returns an error string, or None when accepted."""
+    if kind != HELLO or enc != ENC_MSGPACK:
+        return "protocol error: expected HELLO"
+    try:
+        hello = msgpack.unpackb(body_raw, raw=False)
+        magic, ver, tok = hello["m"], hello["v"], hello["t"]
+    except Exception:
+        return "protocol error: malformed HELLO"
+    if magic != MAGIC:
+        return "protocol error: bad magic"
+    if ver != PROTOCOL_VERSION:
+        return (f"protocol version mismatch: server={PROTOCOL_VERSION} "
+                f"client={ver}")
+    want = _session_token if expected_token is None else expected_token
+    if tok != want:
+        return "authentication failed: bad session token"
+    return None
 
 
 def _open_socket(address: Address) -> socket.socket:
@@ -57,14 +155,6 @@ def _open_socket(address: Address) -> socket.socket:
         s = socket.create_connection((host, port))
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     return s
-
-
-class RpcError(Exception):
-    pass
-
-
-class ConnectionLost(RpcError):
-    pass
 
 
 # ---------------------------------------------------------------------------
@@ -83,6 +173,7 @@ class DuplexClient:
         self._pending: dict[int, Future] = {}
         self._handler = handler
         self._closed = threading.Event()
+        self._handshake()
         from concurrent.futures import ThreadPoolExecutor
 
         self._exec = ThreadPoolExecutor(
@@ -92,21 +183,37 @@ class DuplexClient:
                                         name="rpc-reader")
         self._reader.start()
 
+    def _handshake(self):
+        self._sock.settimeout(_HANDSHAKE_TIMEOUT_S)
+        try:
+            self._sock.sendall(_hello_frame())
+            hdr = self._recv_exact(_HDR.size)
+            kind, enc, plen, _seq = _HDR.unpack(hdr)
+            body_raw = self._recv_exact(plen)
+            if kind == ERR:
+                raise AuthError(msgpack.unpackb(body_raw, raw=False))
+            if kind != HELLO_OK:
+                raise RpcError("protocol error: expected HELLO_OK")
+        except socket.timeout as e:
+            raise ConnectionLost(f"handshake timeout: {e}") from e
+        finally:
+            self._sock.settimeout(None)
+
     def call(self, method: str, payload: Any = None, timeout: float | None = None) -> Any:
         with self._seqlock:
             self._seq += 1
             seq = self._seq
         fut: Future = Future()
         self._pending[seq] = fut
-        self._send(REQ, seq, (method, payload))
+        self._send(REQ, _req_enc(method), seq, (method, payload))
         return fut.result(timeout=timeout)
 
     def notify(self, method: str, payload: Any = None):
         """Fire-and-forget (seqno 0 never gets a response)."""
-        self._send(REQ, 0, (method, payload))
+        self._send(REQ, _req_enc(method), 0, (method, payload))
 
-    def _send(self, kind: int, seq: int, body: Any):
-        data = _pack(kind, seq, body)
+    def _send(self, kind: int, enc: int, seq: int, body: Any):
+        data = _pack(kind, enc, seq, body)
         with self._wlock:
             try:
                 self._sock.sendall(data)
@@ -126,8 +233,8 @@ class DuplexClient:
         try:
             while not self._closed.is_set():
                 hdr = self._recv_exact(_HDR.size)
-                kind, plen, seq = _HDR.unpack(hdr)
-                body = cloudpickle.loads(self._recv_exact(plen))
+                kind, enc, plen, seq = _HDR.unpack(hdr)
+                body = _decode_body(enc, self._recv_exact(plen))
                 if kind == REQ:
                     method, payload = body
                     self._exec.submit(self._serve, method, payload, seq)
@@ -152,13 +259,14 @@ class DuplexClient:
         try:
             result = self._handler(method, payload)
             if seq:
-                self._send(RESP, seq, result)
+                self._send(RESP, _req_enc(method), seq, result)
         except ConnectionLost:
             pass
         except BaseException as e:  # noqa: BLE001 - forwarded to peer
             if seq:
                 try:
-                    self._send(ERR, seq, f"{type(e).__name__}: {e}")
+                    self._send(ERR, ENC_MSGPACK, seq,
+                               f"{type(e).__name__}: {e}")
                 except ConnectionLost:
                     pass
 
@@ -190,16 +298,16 @@ class ServerConn:
         seq = self._seq
         fut = asyncio.get_running_loop().create_future()
         self._pending[seq] = fut
-        await self._write(REQ, seq, (method, payload))
+        await self._write(REQ, _req_enc(method), seq, (method, payload))
         return await fut
 
     async def notify(self, method: str, payload: Any = None):
-        await self._write(REQ, 0, (method, payload))
+        await self._write(REQ, _req_enc(method), 0, (method, payload))
 
-    async def _write(self, kind: int, seq: int, body: Any):
+    async def _write(self, kind: int, enc: int, seq: int, body: Any):
         if not self.alive:
             raise ConnectionLost("peer gone")
-        self._writer.write(_pack(kind, seq, body))
+        self._writer.write(_pack(kind, enc, seq, body))
         await self._writer.drain()
 
     def _fail_pending(self):
@@ -228,12 +336,15 @@ class DuplexServer:
         address: Address,
         handler: Callable[[ServerConn, str, Any], Awaitable[Any]],
         on_disconnect: Callable[[ServerConn], Awaitable[None]] | None = None,
+        token: str | None = None,
     ):
         self.address = address
         self._handler = handler
         self._on_disconnect = on_disconnect
         self._server: asyncio.AbstractServer | None = None
         self.conns: set[ServerConn] = set()
+        # None = use the process-global session token at handshake time.
+        self._token = token
 
     async def start(self):
         if isinstance(self.address, str):
@@ -249,6 +360,30 @@ class DuplexServer:
 
     async def _accept(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         conn = ServerConn(reader, writer)
+        # Handshake BEFORE anything touches pickle: msgpack-only parse of
+        # the HELLO frame; reject bad magic/version/token with an ERR.
+        try:
+            hdr = await asyncio.wait_for(reader.readexactly(_HDR.size),
+                                         _HANDSHAKE_TIMEOUT_S)
+            kind, enc, plen, _seq = _HDR.unpack(hdr)
+            body_raw = await asyncio.wait_for(reader.readexactly(plen),
+                                              _HANDSHAKE_TIMEOUT_S)
+            problem = _check_hello(kind, enc, body_raw, self._token)
+            if problem is not None:
+                writer.write(_pack(ERR, ENC_MSGPACK, 0, problem))
+                await writer.drain()
+                writer.close()
+                return
+            writer.write(_pack(HELLO_OK, ENC_MSGPACK, 0,
+                               {"v": PROTOCOL_VERSION}))
+            await writer.drain()
+        except (asyncio.TimeoutError, asyncio.IncompleteReadError,
+                ConnectionResetError, OSError):
+            try:
+                writer.close()
+            except OSError:
+                pass
+            return
         self.conns.add(conn)
         try:
             await _peer_read_loop(conn, reader, self._handler)
@@ -278,21 +413,22 @@ async def _peer_read_loop(conn: ServerConn, reader: asyncio.StreamReader,
         try:
             result = await handler(conn, method, payload)
             if seq:
-                await conn._write(RESP, seq, result)
+                await conn._write(RESP, _req_enc(method), seq, result)
         except ConnectionLost:
             pass
         except BaseException as e:  # noqa: BLE001 - forwarded to peer
             if seq:
                 try:
-                    await conn._write(ERR, seq, f"{type(e).__name__}: {e}")
+                    await conn._write(ERR, ENC_MSGPACK, seq,
+                                      f"{type(e).__name__}: {e}")
                 except (ConnectionLost, OSError):
                     pass
 
     try:
         while True:
             hdr = await reader.readexactly(_HDR.size)
-            kind, plen, seq = _HDR.unpack(hdr)
-            body = cloudpickle.loads(await reader.readexactly(plen))
+            kind, enc, plen, seq = _HDR.unpack(hdr)
+            body = _decode_body(enc, await reader.readexactly(plen))
             if kind == REQ:
                 method, payload = body
                 asyncio.ensure_future(serve(method, payload, seq))
@@ -326,6 +462,27 @@ async def async_connect(
         if sock is not None:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     conn = ServerConn(reader, writer)
+    # Handshake (symmetric with DuplexClient._handshake).
+    try:
+        writer.write(_hello_frame())
+        await writer.drain()
+        hdr = await asyncio.wait_for(reader.readexactly(_HDR.size),
+                                     _HANDSHAKE_TIMEOUT_S)
+        kind, enc, plen, _seq = _HDR.unpack(hdr)
+        body_raw = await asyncio.wait_for(reader.readexactly(plen),
+                                          _HANDSHAKE_TIMEOUT_S)
+        if kind == ERR:
+            writer.close()
+            raise AuthError(msgpack.unpackb(body_raw, raw=False))
+        if kind != HELLO_OK:
+            writer.close()
+            raise RpcError("protocol error: expected HELLO_OK")
+    except (asyncio.TimeoutError, asyncio.IncompleteReadError) as e:
+        try:
+            writer.close()
+        except OSError:
+            pass
+        raise ConnectionLost(f"handshake failed: {e}") from e
 
     async def run():
         try:

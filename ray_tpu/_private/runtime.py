@@ -69,6 +69,18 @@ class Runtime:
         # applied to every task/actor of the job, merged task-side).
         self.default_runtime_env = _re.validate(runtime_env)
         self.session_id = uuid.uuid4().hex[:12]
+        # Session token: every RPC connection (head, peers, workers)
+        # authenticates with it in the HELLO handshake — nothing is
+        # unpickled from an unauthenticated socket. A new head mints one;
+        # attaching drivers/nodes must present the cluster's (via the
+        # RT_SESSION_TOKEN env, set by `rtpu start` / cluster_utils).
+        import secrets
+
+        from . import rpc as _rpc
+
+        token = os.environ.get("RT_SESSION_TOKEN") or secrets.token_hex(16)
+        os.environ["RT_SESSION_TOKEN"] = token  # children inherit
+        _rpc.set_session_token(token)
         self.job_id = JobID.from_random()
         self.node_id = NodeID.from_random()
         self.worker_id = WorkerID.from_random()
@@ -222,11 +234,13 @@ class Runtime:
     def current_actor_id(self):
         return None
 
-    def incref(self, oid: ObjectID):
+    def incref(self, oid: ObjectID, owner_addr=None):
         if self.loop.is_running():
-            self._call_soon(self.node.incref, oid)
+            # Foreign-owned refs (owner_addr of another node) register a
+            # borrow with the owner so it defers the free to us.
+            self._call_soon(self.node.incref_ref, oid, owner_addr)
 
-    def decref(self, oid: ObjectID):
+    def decref(self, oid: ObjectID, owner_addr=None):
         if self.loop.is_running():
             try:
                 self._call_soon(self.node.decref, oid)
@@ -256,10 +270,15 @@ class Runtime:
             self._put_counter += 1
             idx = self._put_counter
         oid = ObjectID.for_put(self._driver_task, idx)
-        blob = serialization.serialize(value)
+        # Refs nested inside the value are pinned by the container for its
+        # lifetime (attach below) — dropping the standalone handles can't
+        # free what the container still points to.
+        blob, inner = serialization.serialize_with_refs(value)
         # incref strictly before mark_ready: a READY object with refcount 0
         # is freed on arrival.
         self._call_soon(self.node.incref, oid)
+        if inner:
+            self._call_soon(self.node._attach_inner_refs, oid, inner)
         if len(blob) > self.cfg.max_inline_object_size:
             self.shm.put(oid, blob)
             self._call_soon(self.node.mark_ready_shm, oid, len(blob))

@@ -23,12 +23,35 @@ from __future__ import annotations
 import io
 import pickle
 import struct
+import threading
 from typing import Any, Callable
 
 import cloudpickle
 import msgpack
 
 _REDUCERS: dict[type, Callable] = {}
+
+# Active nested-ref collector (reference: the SerializationContext's
+# contained-ObjectRef tracking that feeds the borrowing protocol,
+# reference_count.h:61). ObjectRef.__reduce__ appends
+# (oid_bytes, owner_addr) here while a collecting serialize is active.
+_ref_collector = threading.local()
+
+
+def serialize_with_refs(obj: Any) -> tuple:
+    """(blob, [(oid_bytes, owner_addr), ...]) — the refs serialized inside
+    obj, so callers can pin/borrow them for the blob's journey."""
+    _ref_collector.refs = []
+    try:
+        return serialize(obj), _ref_collector.refs
+    finally:
+        _ref_collector.refs = None
+
+
+def note_serialized_ref(oid_bytes: bytes, owner_addr):
+    refs = getattr(_ref_collector, "refs", None)
+    if refs is not None:
+        refs.append((oid_bytes, tuple(owner_addr) if owner_addr else None))
 
 
 def register_reducer(typ: type, reducer: Callable):

@@ -84,6 +84,12 @@ class TaskSpec:
     # Resolved runtime environment (env_vars + kv:// package URIs —
     # see ray_tpu.runtime_env); workers are pooled by its hash.
     runtime_env: Optional[dict] = None
+    # ObjectRefs serialized INSIDE by-value args ([(oid_bytes, owner_addr)]):
+    # pinned (local) or borrowed (foreign owner) by the executing node until
+    # the task is terminal, so an owner dropping its handle mid-flight can't
+    # free an object the task still carries (reference: borrowed refs in
+    # TaskSpec, reference_count.h borrowing protocol).
+    nested_refs: Optional[list] = None
 
     @property
     def env_id(self) -> str:

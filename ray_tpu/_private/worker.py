@@ -17,6 +17,7 @@ import contextvars
 import os
 import sys
 import threading
+import time
 import traceback
 from typing import Any, Optional
 
@@ -78,6 +79,10 @@ class WorkerContext:
                           kv_get=lambda k: self.kv_op("get", k))
             except BaseException as e:  # noqa: BLE001 - report, then die
                 setup_error = f"{type(e).__name__}: {e}"
+        # The context must be visible BEFORE registration: the node may
+        # push a task the instant the register RESP lands, and that task
+        # can run on the reader pool before main() executes another line.
+        context_mod.set_context(self)
         reply = self.client.call(
             "register", {"worker_id": worker_id.hex(),
                          "setup_error": setup_error})
@@ -89,6 +94,30 @@ class WorkerContext:
         # resolvable when they travel to other nodes.
         self.node_addr = tuple(reply["peer_address"]) \
             if isinstance(reply, dict) and reply.get("peer_address") else None
+        # Drop notifications are BUFFERED and flushed on a timer, never
+        # sent inline: a ref dropped at task-frame exit would otherwise
+        # race ahead of the task reply on the socket and free an object
+        # the reply is about to hand to the consumer.
+        self._drop_flusher = threading.Thread(
+            target=self._flush_drops_loop, daemon=True, name="ref-drops")
+        self._drop_flusher.start()
+
+    def _flush_drops_loop(self):
+        while True:
+            time.sleep(1.0)
+            if not self._flush_drops():
+                return
+
+    def _flush_drops(self) -> bool:
+        with self._decref_lock:
+            batch, self._decref_buf = self._decref_buf, []
+        if not batch:
+            return True
+        try:
+            self.client.notify("ref_drop_batch", batch)
+            return True
+        except Exception:
+            return False  # connection gone; worker is dying
 
     # -- context protocol --------------------------------------------------
     @property
@@ -103,11 +132,23 @@ class WorkerContext:
         aid = t.actor_id()
         return None if aid.binary().endswith(b"\x00" * 8) else aid
 
-    def incref(self, oid: ObjectID):
-        pass  # owner-side count covers borrows conservatively in round 1
+    def incref(self, oid: ObjectID, owner_addr=None):
+        """A ref deserialized/held in this worker counts at the node (and,
+        transitively, at the owner via the node's borrow registration) —
+        so an actor storing a ref keeps the object alive cluster-wide
+        (reference: the core worker's borrower bookkeeping,
+        reference_count.h:61). Notify ordering on the duplex socket puts
+        the hold before this task's reply."""
+        try:
+            self.client.notify("ref_hold", {
+                "oid": oid.binary(),
+                "owner": list(owner_addr) if owner_addr else None})
+        except Exception:
+            pass
 
-    def decref(self, oid: ObjectID):
-        pass
+    def decref(self, oid: ObjectID, owner_addr=None):
+        with self._decref_lock:
+            self._decref_buf.append(oid.binary())
 
     def _next_put_id(self) -> ObjectID:
         task = _running_task.get()
@@ -120,14 +161,19 @@ class WorkerContext:
 
     def put(self, value: Any) -> ObjectRef:
         oid = self._next_put_id()
-        blob = serialization.serialize(value)
+        # Refs nested inside the value are pinned by the container object
+        # for its lifetime (the node attaches them), so dropping the
+        # standalone handles can't free what the container still points to.
+        blob, inner = serialization.serialize_with_refs(value)
         if len(blob) > self.cfg.max_inline_object_size:
             self.shm.put(oid, blob)
             self.client.call("put_object", {"oid": oid.binary(), "inline": None,
-                                            "size": len(blob)})
+                                            "size": len(blob),
+                                            "inner_refs": inner or None})
         else:
             self.client.call("put_object", {"oid": oid.binary(), "inline": bytes(blob),
-                                            "size": len(blob)})
+                                            "size": len(blob),
+                                            "inner_refs": inner or None})
         return ObjectRef(oid, _register=False, owner_addr=self.node_addr)
 
     def get(self, refs, timeout: float | None = None):
@@ -250,18 +296,25 @@ class WorkerContext:
             return serialization.deserialize(mv)
         raise RuntimeError(f"bad arg encoding {tag}")
 
-    def _encode_results(self, task_id: TaskID, num_returns: int, value: Any) -> list:
+    def _encode_results(self, task_id: TaskID, num_returns: int,
+                        value: Any) -> tuple:
+        """(encoded results, per-result nested refs): refs serialized
+        inside each result value are reported to the node, which pins
+        them for the RESULT OBJECT's lifetime — a returned ref must
+        survive this worker dropping its local handle."""
         values = [value] if num_returns == 1 else list(value)
         out = []
+        nested: list = []
         for i, v in enumerate(values):
-            blob = serialization.serialize(v)
+            blob, refs = serialization.serialize_with_refs(v)
+            nested.append(refs)
             if len(blob) > self.cfg.max_inline_object_size:
                 oid = ObjectID.for_return(task_id, i)
                 self.shm.put(oid, blob)
                 out.append(("shm", len(blob)))
             else:
                 out.append(("b", bytes(blob)))
-        return out
+        return out, nested
 
     def _handle(self, method: str, payload: Any):
         if method == "execute_task":
@@ -324,8 +377,11 @@ class WorkerContext:
             else:
                 fn = self._get_callable(p["func_id"])
             value = fn(*args, **kwargs)
-            return {"results": self._encode_results(task_id, p["num_returns"], value),
-                    "error": None}
+            results, nested_refs = self._encode_results(
+                task_id, p["num_returns"], value)
+            return {"results": results, "error": None,
+                    "nested_refs": (nested_refs
+                                    if any(nested_refs) else None)}
         except BaseException as e:  # noqa: BLE001
             if tracer is not None:
                 tracer.error(e)

@@ -36,6 +36,27 @@ def _address_file(args) -> str:
     return os.path.join(_temp_dir(args), "head_address")
 
 
+def _token_file(args) -> str:
+    return os.path.join(_temp_dir(args), "session_token")
+
+
+def _load_token(args):
+    """Session token for attaching to a local cluster: env wins, else the
+    head's token file (0600) under the temp dir."""
+    if os.environ.get("RT_SESSION_TOKEN"):
+        return
+    try:
+        with open(_token_file(args)) as f:
+            tok = f.read().strip()
+        if tok:
+            os.environ["RT_SESSION_TOKEN"] = tok
+            from ray_tpu._private import rpc
+
+            rpc.set_session_token(tok)
+    except FileNotFoundError:
+        pass
+
+
 def _pids_file(args) -> str:
     return os.path.join(_temp_dir(args), "pids")
 
@@ -64,6 +85,7 @@ def _attach(args):
     import ray_tpu
 
     if not ray_tpu.is_initialized():
+        _load_token(args)
         ray_tpu.init(address=_resolve_address(args))
     return ray_tpu
 
@@ -132,6 +154,12 @@ def _head_daemon(args):
     rt = ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus,
                       resources=resources)
     host, port = rt.head_address
+    # Token file (0600) BEFORE the address file: by the time attachers see
+    # the address, the credential is readable.
+    tok_path = _token_file(args)
+    fd = os.open(tok_path, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as f:
+        f.write(os.environ["RT_SESSION_TOKEN"])
     with open(_address_file(args), "w") as f:
         f.write(f"{host}:{port}")
     print(f"head up at {host}:{port}", flush=True)
@@ -148,6 +176,7 @@ def _head_daemon(args):
 
 
 def _start_worker_node(args):
+    _load_token(args)
     addr = _resolve_address(args)
     resources = json.loads(args.resources) if args.resources else {}
     resources.setdefault("CPU", args.num_cpus)

@@ -27,17 +27,18 @@ MIN_CHUNKED = 512 * 1024
 @pytest.fixture
 def cluster(monkeypatch):
     # Small chunks so mid-size test objects exercise the windowed path;
-    # node daemons inherit via env, the driver via system_config.
-    monkeypatch.setenv("RT_OBJECT_TRANSFER_CHUNK_BYTES", str(CHUNK))
-    monkeypatch.setenv("RT_OBJECT_TRANSFER_MIN_CHUNKED_BYTES",
-                       str(MIN_CHUNKED))
-    c = Cluster(init_args={
-        "num_cpus": 1,
-        "system_config": {
-            "object_transfer_chunk_bytes": CHUNK,
-            "object_transfer_min_chunked_bytes": MIN_CHUNKED,
-        },
-    })
+    # push cap of 1 + a long busy-wait so the broadcast-tree property is
+    # deterministic even on a loaded single-core CI box. Node daemons
+    # inherit via env, the driver via system_config.
+    overrides = {
+        "object_transfer_chunk_bytes": CHUNK,
+        "object_transfer_min_chunked_bytes": MIN_CHUNKED,
+        "object_transfer_max_pushes": 1,
+        "object_transfer_busy_wait_s": 30.0,
+    }
+    for k, v in overrides.items():
+        monkeypatch.setenv("RT_" + k.upper(), str(v))
+    c = Cluster(init_args={"num_cpus": 1, "system_config": overrides})
     try:
         yield c
     finally:
@@ -102,7 +103,9 @@ def test_broadcast_pulls_from_peers(cluster):
             import time as _t
 
             s = int(a.sum())
-            _t.sleep(2.0)
+            # Hold this node's copy pinned long enough for later (possibly
+            # starved, single-core CI) pullers to source from it.
+            _t.sleep(6.0)
             return s
 
         refs.append(consume.remote(ref))
