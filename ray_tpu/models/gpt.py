@@ -43,6 +43,23 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = False
     use_flash: bool = False
+    # Flash kernel block sizes (swept per shape; 512 is the v5e sweet spot
+    # for seq 1024 — see BENCH notes).
+    flash_block: int = 512
+    # Rematerialization policy when remat=True:
+    #   "dots_no_batch" — save only weight-stationary dots (max memory
+    #       savings, recomputes every activation matmul in the backward)
+    #   "dots"          — save every matmul output, recompute only the
+    #       elementwise ops (layernorm/gelu/softmax) — near remat=False
+    #       speed at a fraction of the extra memory
+    #   "mlp_only"      — checkpoint ONLY each block's MLP; attention (the
+    #       flash kernel) keeps its residuals, so the backward never
+    #       re-runs the attention forward.
+    #   "dots_flash"    — "dots" plus the flash kernel's tagged outputs
+    #       (out + LSE): every attention residual is saved, so the remat
+    #       retrace DCEs the kernel recompute while elementwise ops still
+    #       rematerialize. Measured fastest at the bench shape.
+    remat_policy: str = "dots_flash"
 
     @property
     def head_dim(self) -> int:
@@ -136,28 +153,44 @@ def _constrain(x, logical, mesh, rules):
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
-def _block(x, p, cfg: GPTConfig, mesh, rules):
+def _block(x, p, cfg: GPTConfig, mesh, rules, mlp_remat: bool = False):
     """One transformer block. p: per-layer slice of the stacked block params."""
     dt = cfg.dtype
     h = _layernorm(x, p["ln1"])
-    q = jnp.einsum("bsm,mhd->bshd", h, p["wq"].astype(dt))
-    kk = jnp.einsum("bsm,mhd->bshd", h, p["wk"].astype(dt))
-    v = jnp.einsum("bsm,mhd->bshd", h, p["wv"].astype(dt))
-    q = _constrain(q, ("batch", "seq", "heads", None), mesh, rules)
     if cfg.use_flash:
+        # Heads-major end to end: q/k/v are emitted in the kernel's native
+        # [b, heads, seq, d] layout, so there are no transposes around the
+        # kernel AND autodiff saves ONE copy of each tensor (kernel
+        # residuals == the weight-grad einsum inputs). (A fused qkv
+        # concat-matmul was measured SLOWER — the per-layer concat breaks
+        # XLA's cast/einsum fusion — so the three einsums stay separate.)
         from ..ops.flash_attention import flash_attention
 
-        o = flash_attention(q, kk, v, causal=True)
+        q = jnp.einsum("bsm,mhd->bhsd", h, p["wq"].astype(dt))
+        kk = jnp.einsum("bsm,mhd->bhsd", h, p["wk"].astype(dt))
+        v = jnp.einsum("bsm,mhd->bhsd", h, p["wv"].astype(dt))
+        q = _constrain(q, ("batch", "heads", "seq", None), mesh, rules)
+        o = flash_attention(q, kk, v, causal=True,
+                            block_size=cfg.flash_block, layout="bhsd")
+        o = jnp.einsum("bhsd,hdm->bsm", o, p["wo"].astype(dt))
     else:
+        q = jnp.einsum("bsm,mhd->bshd", h, p["wq"].astype(dt))
+        kk = jnp.einsum("bsm,mhd->bshd", h, p["wk"].astype(dt))
+        v = jnp.einsum("bsm,mhd->bshd", h, p["wv"].astype(dt))
+        q = _constrain(q, ("batch", "seq", "heads", None), mesh, rules)
         o = causal_attention(q, kk, v)
-    o = jnp.einsum("bshd,hdm->bsm", o, p["wo"].astype(dt))
+        o = jnp.einsum("bshd,hdm->bsm", o, p["wo"].astype(dt))
     x = x + _constrain(o, ("batch", "seq", "embed_act"), mesh, rules)
 
-    h = _layernorm(x, p["ln2"])
-    ff = jax.nn.gelu(jnp.einsum("bsm,mf->bsf", h, p["wi"].astype(dt)))
-    ff = _constrain(ff, ("batch", "seq", "mlp"), mesh, rules)
-    ff = jnp.einsum("bsf,fm->bsm", ff, p["wm"].astype(dt))
-    x = x + _constrain(ff, ("batch", "seq", "embed_act"), mesh, rules)
+    def mlp(xin):
+        h2 = _layernorm(xin, p["ln2"])
+        ff = jax.nn.gelu(jnp.einsum("bsm,mf->bsf", h2, p["wi"].astype(dt)))
+        ff = _constrain(ff, ("batch", "seq", "mlp"), mesh, rules)
+        return jnp.einsum("bsf,fm->bsm", ff, p["wm"].astype(dt))
+
+    if mlp_remat:
+        mlp = jax.checkpoint(mlp)
+    x = x + _constrain(mlp(x), ("batch", "seq", "embed_act"), mesh, rules)
     return x
 
 
@@ -188,11 +221,32 @@ def forward(params, tokens, cfg: GPTConfig, mesh: Optional[Mesh] = None,
     x = wte_lookup[tokens] + params["wpe"].astype(dt)[:s]
     x = _constrain(x, ("batch", "seq", "embed_act"), mesh, rules)
 
-    block_fn = functools.partial(_block, cfg=cfg, mesh=mesh, rules=rules)
-    if cfg.remat:
-        block_fn = jax.checkpoint(
-            block_fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-        )
+    if cfg.remat and cfg.remat_policy == "mlp_only":
+        # Checkpoint lives INSIDE the block (around the MLP); the block
+        # itself — attention included — keeps its residuals.
+        block_fn = functools.partial(_block, cfg=cfg, mesh=mesh,
+                                     rules=rules, mlp_remat=True)
+    else:
+        block_fn = functools.partial(_block, cfg=cfg, mesh=mesh, rules=rules)
+        if cfg.remat:
+            cp = jax.checkpoint_policies
+            name = cfg.remat_policy
+            if name == "dots_flash" and not cfg.use_flash:
+                # dots_flash would save the dense path's O(seq^2) score
+                # matrices (they are dot outputs); dense attention needs
+                # the aggressive policy.
+                name = "dots_no_batch"
+            policies = {
+                "dots_no_batch": cp.dots_with_no_batch_dims_saveable,
+                "dots": cp.dots_saveable,
+                "dots_flash": cp.save_from_both_policies(
+                    cp.dots_saveable, cp.save_only_these_names("flash")),
+            }
+            if name not in policies:
+                raise ValueError(
+                    f"remat_policy={cfg.remat_policy!r}; valid: "
+                    f"{sorted(policies)} or 'mlp_only'")
+            block_fn = jax.checkpoint(block_fn, policy=policies[name])
 
     def scan_body(x, layer_params):
         return block_fn(x, layer_params), None
@@ -203,19 +257,46 @@ def forward(params, tokens, cfg: GPTConfig, mesh: Optional[Mesh] = None,
     return _constrain(logits, ("batch", "seq", "vocab"), mesh, rules)
 
 
-def loss_fn(params, tokens, cfg: GPTConfig, mesh=None, rules=None):
-    """Next-token cross-entropy (targets = tokens shifted left).
+@jax.custom_vjp
+def _xent(logits, targets):
+    """Mean next-token cross-entropy with a hand-written VJP.
 
-    The bf16 logits are NOT cast to f32 as a whole — that would
-    materialize a [b, s, vocab] f32 copy (3.3GB at the bench config)
-    just to feed two consumers. Instead each consumer fuses its own
-    cast: the logsumexp reduces a fused f32 upcast, and the gold-logit
-    gather reads bf16 and upcasts per element (measured +2% MFU)."""
-    logits = forward(params, tokens[:, :-1], cfg, mesh, rules)
-    targets = tokens[:, 1:]
+    Two reasons not to let autodiff handle this:
+      * the f32 upcasts stay FUSED (a whole-[b,s,vocab] f32 copy is
+        3.3 GB at the bench config);
+      * the backward emits dlogits in the LOGITS' dtype (bf16), not f32 —
+        at the bench shape that halves the single biggest transient of
+        the whole step (3.2 GB -> 1.6 GB), which is what lets the
+        remat-free configuration fit in one v5e's HBM.
+    """
     logz = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     return (logz - gold.astype(jnp.float32)).mean()
+
+
+def _xent_fwd(logits, targets):
+    logz = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return (logz - gold.astype(jnp.float32)).mean(), (logits, targets, logz)
+
+
+def _xent_bwd(res, g):
+    logits, targets, logz = res
+    n = logz.size
+    # softmax - onehot, elementwise-fused in f32, landed in logits dtype.
+    p = jnp.exp(logits.astype(jnp.float32) - logz[..., None])
+    onehot = jax.nn.one_hot(targets, logits.shape[-1], dtype=jnp.float32)
+    dlogits = ((p - onehot) * (g / n)).astype(logits.dtype)
+    return dlogits, None
+
+
+_xent.defvjp(_xent_fwd, _xent_bwd)
+
+
+def loss_fn(params, tokens, cfg: GPTConfig, mesh=None, rules=None):
+    """Next-token cross-entropy (targets = tokens shifted left)."""
+    logits = forward(params, tokens[:, :-1], cfg, mesh, rules)
+    return _xent(logits, tokens[:, 1:])
 
 
 def make_train_step(cfg: GPTConfig, optimizer, mesh: Optional[Mesh] = None,

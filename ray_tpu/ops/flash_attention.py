@@ -29,8 +29,11 @@ _PALLAS_FALLBACK_WARNED = False
 
 def flash_attention(q, k, v, *, causal: bool = True,
                     block_size: int = DEFAULT_BLOCK,
-                    use_pallas: Optional[bool] = None):
-    """q,k,v: [batch, seq, heads, head_dim] -> [batch, seq, heads, head_dim].
+                    use_pallas: Optional[bool] = None,
+                    layout: str = "bshd"):
+    """q,k,v: [batch, seq, heads, head_dim] (layout="bshd") or
+    [batch, heads, seq, head_dim] (layout="bhsd", the kernel's native
+    layout — no transposes); output matches the input layout.
 
     Softmax statistics are computed in f32; inputs may be bf16.
     """
@@ -45,7 +48,7 @@ def flash_attention(q, k, v, *, causal: bool = True,
         try:
             return flash_attention_pallas(
                 q, k, v, causal=causal,
-                block_q=block_size, block_k=block_size)
+                block_q=block_size, block_k=block_size, layout=layout)
         except Exception as e:  # noqa: BLE001
             # Loud, once-per-process fallback: a kernel lowering failure
             # must not abort training, but it must not hide either (a
@@ -60,8 +63,17 @@ def flash_attention(q, k, v, *, causal: bool = True,
                     f"Pallas flash attention failed ({e!r}); falling back "
                     f"to the jax blockwise reference implementation",
                     RuntimeWarning, stacklevel=2)
-            return _flash_reference(q, k, v, causal=causal,
-                                    block_size=block_size)
+            return _reference_any_layout(q, k, v, causal, block_size, layout)
+    return _reference_any_layout(q, k, v, causal, block_size, layout)
+
+
+def _reference_any_layout(q, k, v, causal, block_size, layout):
+    """The jax reference path is bshd-native; bhsd callers transpose
+    around it (correctness fallback, not the perf path)."""
+    if layout == "bhsd":
+        t = lambda x: x.transpose(0, 2, 1, 3)
+        return t(_flash_reference(t(q), t(k), t(v), causal=causal,
+                                  block_size=block_size))
     return _flash_reference(q, k, v, causal=causal, block_size=block_size)
 
 

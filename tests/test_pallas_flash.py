@@ -81,6 +81,40 @@ def test_pallas_flash_grad():
 
 
 @pytest.mark.parametrize("causal", [True, False])
+def test_pallas_flash_bhsd_layout_fwd_and_grad(causal):
+    """layout="bhsd" (the heads-major path the GPT model uses) must match
+    the bshd path exactly — forward AND gradients, including ragged seq
+    (pad/unpad logic is layout-dependent)."""
+    for s in (48, 41):  # block-divisible and ragged
+        q, k, v = _rand_qkv(jax.random.key(21), 2, s, 2, 16)
+        t = lambda x: x.transpose(0, 2, 1, 3)
+
+        def loss_bshd(q, k, v):
+            return (flash_attention_pallas(
+                q, k, v, causal=causal, block_q=16, block_k=16,
+                interpret=True) ** 2).sum()
+
+        def loss_bhsd(q, k, v):
+            return (flash_attention_pallas(
+                t(q), t(k), t(v), causal=causal, block_q=16, block_k=16,
+                interpret=True, layout="bhsd") ** 2).sum()
+
+        out_a = flash_attention_pallas(
+            q, k, v, causal=causal, block_q=16, block_k=16, interpret=True)
+        out_b = flash_attention_pallas(
+            t(q), t(k), t(v), causal=causal, block_q=16, block_k=16,
+            interpret=True, layout="bhsd")
+        np.testing.assert_allclose(np.asarray(out_a), np.asarray(t(out_b)),
+                                   atol=2e-5)
+
+        g_a = jax.grad(loss_bshd, argnums=(0, 1, 2))(q, k, v)
+        g_b = jax.grad(loss_bhsd, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_a, g_b):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-3, rtol=1e-3)
+
+
+@pytest.mark.parametrize("causal", [True, False])
 def test_pallas_flash_grad_ragged_seq(causal):
     """Gradients with a seq length that does NOT divide the block size:
     the padded-row/padded-key masking in the backward kernels must zero
