@@ -231,10 +231,14 @@ def forward(params, tokens, cfg: GPTConfig, mesh: Optional[Mesh] = None,
         if cfg.remat:
             cp = jax.checkpoint_policies
             name = cfg.remat_policy
-            if name == "dots_flash" and not cfg.use_flash:
-                # dots_flash would save the dense path's O(seq^2) score
-                # matrices (they are dot outputs); dense attention needs
-                # the aggressive policy.
+            if name == "dots_flash" and not (
+                    cfg.use_flash and jax.default_backend() not in
+                    ("cpu", "gpu", "cuda", "rocm", "METAL")):
+                # Without the Pallas kernel (flash disabled, or a backend
+                # where flash_attention lowers the blockwise-jnp reference
+                # instead), dots_saveable would save O(seq^2) per-block
+                # score/probability matmul outputs; those paths need the
+                # aggressive policy.
                 name = "dots_no_batch"
             policies = {
                 "dots_no_batch": cp.dots_with_no_batch_dims_saveable,

@@ -270,9 +270,17 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dq_ref[0, 0, :, :] = dq_acc[...].astype(dq_ref.dtype)
 
 
-# dq accumulator must fit VMEM alongside the working blocks; above this
-# the backward falls back to the split dq / dkv kernels.
-_FUSED_BWD_MAX_SCRATCH = 8 * 1024 * 1024
+# The fused backward pins full-sequence q/dO/dq (+ f32 dq scratch) and
+# k/v/dk/dv blocks in VMEM; its total estimated footprint must stay under
+# this budget or the backward falls back to the split dq / dkv kernels
+# (the long-sequence path).
+_FUSED_BWD_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def _fused_bwd_fits(sq_p: int, sk_p: int, d: int, itemsize: int) -> bool:
+    q_side = sq_p * d * (3 * itemsize + 4)   # q, dO, dq + f32 scratch
+    k_side = sk_p * d * (4 * itemsize)       # k, v, dk, dv
+    return q_side + k_side <= _FUSED_BWD_VMEM_BUDGET
 
 
 def _pad_seq(x, blk):
@@ -378,7 +386,7 @@ def _bwd(res, g, *, causal: bool, blk_q: int, blk_k: int, interpret: bool,
     delta = jnp.sum(gp.astype(jnp.float32) * op.astype(jnp.float32),
                     axis=-1, keepdims=True)  # [b,h,sq_p,1]
 
-    if sq_p * d * 4 <= _FUSED_BWD_MAX_SCRATCH:
+    if _fused_bwd_fits(sq_p, sk_p, d, qp.dtype.itemsize):
         full = pl.BlockSpec((1, 1, sq_p, d), lambda bi, hi: (bi, hi, 0, 0))
         kfull_f = pl.BlockSpec((1, 1, sk_p, d), lambda bi, hi: (bi, hi, 0, 0))
         rows = pl.BlockSpec((1, 1, sq_p, 1), lambda bi, hi: (bi, hi, 0, 0))

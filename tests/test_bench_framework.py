@@ -1,0 +1,15 @@
+"""The through-the-framework bench path (JaxTrainer + Data ingest) runs
+end to end on the CPU backend — the same code the TPU bench measures."""
+
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+
+
+def test_framework_bench_path_runs():
+    import bench
+
+    result = bench.run_bench_framework()
+    assert result["metric"].endswith("_framework")
+    assert result["value"] > 0

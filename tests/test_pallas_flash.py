@@ -115,6 +115,34 @@ def test_pallas_flash_bhsd_layout_fwd_and_grad(causal):
 
 
 @pytest.mark.parametrize("causal", [True, False])
+def test_pallas_flash_split_bwd_kernels(causal, monkeypatch):
+    """The split dq/dkv kernels are the long-sequence fallback (fused path
+    over VMEM budget): force them and check gradients still match."""
+    from ray_tpu.ops.pallas import flash as flash_mod
+
+    monkeypatch.setattr(flash_mod, "_FUSED_BWD_VMEM_BUDGET", 0)
+    flash_mod._make_op.cache_clear()
+    try:
+        q, k, v = _rand_qkv(jax.random.key(30), 1, 41, 2, 16)
+
+        def loss_pl(q, k, v):
+            return (flash_attention_pallas(
+                q, k, v, causal=causal, block_q=16, block_k=16,
+                interpret=True) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (causal_attention(q, k, v, causal=causal) ** 2).sum()
+
+        g_pl = jax.grad(loss_pl, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_pl, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-3, rtol=2e-3)
+    finally:
+        flash_mod._make_op.cache_clear()
+
+
+@pytest.mark.parametrize("causal", [True, False])
 def test_pallas_flash_grad_ragged_seq(causal):
     """Gradients with a seq length that does NOT divide the block size:
     the padded-row/padded-key masking in the backward kernels must zero
