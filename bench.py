@@ -236,6 +236,16 @@ def run_bench_framework() -> dict:
     n_chips = len(devs)
     print(f"framework path: {tps:,.0f} tokens/s "
           f"(loss={m['loss']:.3f})", file=sys.stderr)
+    if not on_tpu:
+        # Same guard as run_bench: a silent CPU fallback must ship a
+        # clearly-labeled smoke metric, never masquerade as the gpt2
+        # number (it would corrupt framework_overhead).
+        return {
+            "metric": "gpt_tiny_cpu_smoke_tokens_per_sec_framework",
+            "value": round(tps / n_chips, 1),
+            "unit": "tokens/s/chip",
+            "vs_baseline": 0.0,
+        }
     return {
         "metric": "gpt2_small_train_tokens_per_sec_per_chip_framework",
         "value": round(tps / n_chips, 1),
@@ -414,6 +424,10 @@ def _finish_with_flash_pass(base: dict) -> int:
         return 0
     rc, out, err = _run_child(["--child", "--framework"], {}, CHILD_TIMEOUT_S)
     fw = _extract_json_line(out)
+    if fw is not None and not fw["metric"].startswith("gpt2_small"):
+        print(f"framework pass fell back to CPU ({fw['metric']}); "
+              f"not recording overhead", file=sys.stderr)
+        fw = None
     if fw is not None:
         sys.stderr.write(err)
         best = dict(best)
