@@ -1,0 +1,135 @@
+"""Serve latency/throughput benchmark — recorded numbers for the ingress.
+
+Parity target: the reference treats serve performance as a release suite
+(/root/reference/release/release_tests.yaml serve microbenchmarks:
+p50/p99 latency + RPS). ``python -m ray_tpu.scripts.serve_bench`` deploys
+a JAX model behind the aiohttp ingress, drives closed-loop concurrent
+HTTP clients, and writes SERVE_BENCH.json with latency percentiles and
+sustained RPS for (a) the HTTP path and (b) the in-process handle path
+(ingress overhead = the gap).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import threading
+import time
+
+
+def _percentiles(xs):
+    xs = sorted(xs)
+
+    def pct(p):
+        if not xs:
+            return 0.0
+        i = min(len(xs) - 1, int(round(p / 100 * (len(xs) - 1))))
+        return xs[i]
+
+    return {"p50_ms": round(pct(50) * 1000, 2),
+            "p90_ms": round(pct(90) * 1000, 2),
+            "p99_ms": round(pct(99) * 1000, 2),
+            "mean_ms": round(statistics.fmean(xs) * 1000, 2)}
+
+
+def run(duration_s: float = 3.0, clients: int = 4) -> dict:
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment
+    class Model:
+        def __init__(self):
+            import jax
+            import jax.numpy as jnp
+
+            w = jax.random.normal(jax.random.key(0), (64, 64))
+            self._fwd = jax.jit(lambda x: (x @ w).sum())
+            float(self._fwd(jnp.ones((8, 64))))  # compile
+
+        def __call__(self, req):
+            import jax.numpy as jnp
+
+            x = jnp.ones((8, 64)) * float(
+                req.get("scale", 1.0) if isinstance(req, dict) else 1.0)
+            return {"y": float(self._fwd(x))}
+
+    serve.run(Model.bind(), name="default")
+    handle = serve.get_app_handle("default")
+    proxy = serve.start(http_port=0)
+    url = f"http://127.0.0.1:{proxy.port}/"
+
+    # Warm: replica startup + jit compile must not pollute latency.
+    for _ in range(5):
+        handle.remote({"scale": 1.0}).result(timeout=120)
+
+    # -- handle path (no HTTP) --------------------------------------------
+    lat_handle: list = []
+    deadline = time.perf_counter() + duration_s
+    while time.perf_counter() < deadline:
+        t0 = time.perf_counter()
+        handle.remote({"scale": 2.0}).result(timeout=30)
+        lat_handle.append(time.perf_counter() - t0)
+
+    # -- HTTP path, closed loop with N concurrent clients ------------------
+    import urllib.request
+
+    lat_http: list = []
+    lock = threading.Lock()
+    stop_at = time.perf_counter() + duration_s
+
+    def client():
+        body = json.dumps({"scale": 2.0}).encode()
+        mine = []
+        while time.perf_counter() < stop_at:
+            t0 = time.perf_counter()
+            req = urllib.request.Request(url, data=body, method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                r.read()
+            mine.append(time.perf_counter() - t0)
+        with lock:
+            lat_http.extend(mine)
+
+    threads = [threading.Thread(target=client) for _ in range(clients)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t_start
+
+    serve.shutdown()
+    return {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "duration_s": duration_s,
+        "clients": clients,
+        "handle": {**_percentiles(lat_handle),
+                   "rps": round(len(lat_handle) / duration_s, 1)},
+        "http": {**_percentiles(lat_http),
+                 "rps": round(len(lat_http) / elapsed, 1)},
+    }
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2)
+    try:
+        doc = run(duration_s=float(os.environ.get("RT_SERVE_BENCH_S", "3")),
+                  clients=int(os.environ.get("RT_SERVE_BENCH_CLIENTS", "4")))
+    finally:
+        ray_tpu.shutdown()
+    out = os.environ.get("RT_SERVE_BENCH_OUT", "SERVE_BENCH.json")
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(json.dumps(doc))
+
+
+if __name__ == "__main__":
+    main()

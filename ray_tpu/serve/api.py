@@ -62,14 +62,23 @@ _grpc_proxy = None
 
 
 def start(*, http_host: str = "127.0.0.1", http_port: int = 8000,
-          detached: bool = True):
-    """Start the HTTP proxy (handles work without it)."""
+          detached: bool = True, request_timeout_s: float = 60.0):
+    """Start the HTTP ingress (handles work without it)."""
     global _proxy
     _get_controller()
-    if _proxy is None:
-        _proxy = HTTPProxy(_ProxyClient(), http_host, http_port)
-        for app_name, prefix in _routes.items():
-            _proxy.add_route(prefix, app_name)
+    if _proxy is not None:
+        # Settings are fixed at first start (same contract as start_grpc):
+        # silently returning a differently-configured proxy misleads.
+        if ((http_port and http_port != _proxy.port)
+                or request_timeout_s != _proxy.request_timeout_s):
+            raise RuntimeError(
+                "serve HTTP ingress already running with different "
+                "settings; serve.shutdown() first")
+        return _proxy
+    _proxy = HTTPProxy(_ProxyClient(), http_host, http_port,
+                       request_timeout_s=request_timeout_s)
+    for app_name, prefix in _routes.items():
+        _proxy.add_route(prefix, app_name)
     return _proxy
 
 

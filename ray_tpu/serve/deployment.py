@@ -151,6 +151,43 @@ class DeploymentResponse:
         finally:
             self._settle()
 
+    def iter_stream(self, timeout: Optional[float] = None,
+                    chunk_batch: int = 16):
+        """Iterate a STREAMING response (deployment returned a generator):
+        yields chunks pulled from the serving replica. A non-streaming
+        result is yielded as the single item (reference:
+        handle.options(stream=True) -> DeploymentResponseGenerator)."""
+        from .replica import STREAM_MARKER
+
+        result = self.result(timeout=timeout)
+        if not (isinstance(result, dict) and STREAM_MARKER in result):
+            yield result
+            return
+        import ray_tpu
+
+        sid = result[STREAM_MARKER]
+        actor = self._router.actor_for_key(self._replica_key)
+        if actor is None:
+            raise RuntimeError("streaming replica is gone")
+        try:
+            # Ramp the pull batch from 1: time-to-first-chunk tracks the
+            # generator's first item, not a full batch of them.
+            batch = 1
+            while True:
+                chunks, done = ray_tpu.get(
+                    actor.stream_next.remote(sid, batch),
+                    timeout=timeout)
+                batch = min(chunk_batch, batch * 2)
+                yield from chunks
+                if done:
+                    return
+        finally:
+            # Early consumer exit: free the parked generator.
+            try:
+                actor.stream_cancel.remote(sid)
+            except Exception:
+                pass
+
     def _to_object_ref(self):
         self._settle()  # ref handed off; router stops tracking it
         return self._ref
@@ -292,6 +329,15 @@ class Router:
     def replica(self, idx: int):
         with self._lock:
             return self._replicas[idx]
+
+    def actor_for_key(self, key):
+        """The replica actor behind a routing key (streaming pulls must
+        target the replica that parked the generator)."""
+        with self._lock:
+            for k, r in zip(self._keys, self._replicas):
+                if k == key:
+                    return r
+        return None
 
     def remove_replica(self, key):
         """Drop a replica observed dead so the retry (and subsequent

@@ -58,7 +58,15 @@ class GRPCProxy:
             if remaining is not None:
                 timeout = min(timeout, remaining)
             try:
-                return handle.remote(request_value).result(timeout=timeout)
+                resp = handle.remote(request_value)
+                value = resp.result(timeout=timeout)
+                from .replica import STREAM_MARKER
+
+                if isinstance(value, dict) and STREAM_MARKER in value:
+                    # Unary gRPC: drain a streaming deployment into a
+                    # list (and free the replica-side generator).
+                    value = list(resp.iter_stream(timeout=timeout))
+                return value
             except (TimeoutError, futures.TimeoutError):
                 context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
                               f"no reply within {timeout:.1f}s")

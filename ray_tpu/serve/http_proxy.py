@@ -1,66 +1,123 @@
-"""HTTP ingress (parity:
-/root/reference/python/ray/serve/_private/proxy.py — uvicorn HTTPProxy per
-node routing to apps by route prefix). Stdlib ThreadingHTTPServer: each
-request resolves its route prefix to an app handle, forwards the JSON body
-(or raw text), and returns the JSON-encoded result.
+"""HTTP ingress on aiohttp (asyncio, streaming-capable).
+
+Parity: /root/reference/python/ray/serve/_private/proxy.py — uvicorn ASGI
+``HTTPProxy:761`` per node routing to apps by route prefix, with
+streaming responses. Ours is an aiohttp application on a dedicated event
+loop thread: requests parse JSON (or raw text), dispatch through a
+client-side handle (blocking handle calls run on the loop's executor so
+the accept loop never blocks), and stream chunked responses when the
+deployment returned a generator (newline-delimited JSON frames, raw for
+bytes chunks).
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 
 class HTTPProxy:
-    def __init__(self, controller, host: str = "127.0.0.1", port: int = 8000):
+    def __init__(self, controller, host: str = "127.0.0.1", port: int = 8000,
+                 request_timeout_s: float = 60.0):
         self.controller = controller
         self.routes: dict[str, str] = {}  # prefix -> app name
-        proxy = self
+        self.request_timeout_s = request_timeout_s
+        self._loop = asyncio.new_event_loop()
+        self._runner = None
+        started = threading.Event()
+        boot_err: list = []
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):  # quiet
-                pass
+        def main():
+            asyncio.set_event_loop(self._loop)
+            try:
+                self._loop.run_until_complete(self._start(host, port))
+            except BaseException as e:  # noqa: BLE001 - surfaced to ctor
+                boot_err.append(e)
+                started.set()
+                return
+            started.set()
+            self._loop.run_forever()
 
-            def _dispatch(self, body):
-                app = proxy.resolve(self.path)
-                if app is None:
-                    self.send_response(404)
-                    self.end_headers()
-                    self.wfile.write(b'{"error": "no route"}')
-                    return
-                try:
-                    handle = proxy.controller.get_app_handle(app)
-                    result = handle.remote(body).result(timeout=60)
-                    payload = json.dumps(result).encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/json")
-                    self.end_headers()
-                    self.wfile.write(payload)
-                except Exception as e:  # noqa: BLE001 - surfaced as 500
-                    self.send_response(500)
-                    self.end_headers()
-                    self.wfile.write(
-                        json.dumps({"error": str(e)}).encode())
-
-            def do_GET(self):
-                self._dispatch(None)
-
-            def do_POST(self):
-                n = int(self.headers.get("Content-Length", 0))
-                raw = self.rfile.read(n) if n else b""
-                try:
-                    body = json.loads(raw) if raw else None
-                except json.JSONDecodeError:
-                    body = raw.decode()
-                self._dispatch(body)
-
-        self.server = ThreadingHTTPServer((host, port), Handler)
-        self.port = self.server.server_port
-        self._thread = threading.Thread(
-            target=self.server.serve_forever, daemon=True, name="serve-http")
+        self._thread = threading.Thread(target=main, daemon=True,
+                                        name="serve-http")
         self._thread.start()
+        if not started.wait(30):
+            raise TimeoutError("serve HTTP ingress did not start in 30s")
+        if boot_err:
+            raise boot_err[0]
+
+    async def _start(self, host: str, port: int):
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        app = self.resolve(request.path)
+        if app is None:
+            return web.json_response({"error": "no route"}, status=404)
+        raw = await request.read()
+        try:
+            body = json.loads(raw) if raw else None
+        except json.JSONDecodeError:
+            body = raw.decode()
+
+        loop = asyncio.get_running_loop()
+        try:
+            handle = self.controller.get_app_handle(app)
+            # Blocking handle work happens off the event loop.
+            resp = await loop.run_in_executor(
+                None, lambda: handle.remote(body))
+            result = await asyncio.wait_for(
+                loop.run_in_executor(
+                    None, lambda: resp.result(self.request_timeout_s)),
+                self.request_timeout_s + 5,
+            )
+        except (TimeoutError, asyncio.TimeoutError):
+            return web.json_response({"error": "request timed out"},
+                                     status=504)
+        except Exception as e:  # noqa: BLE001 - surfaced as 500
+            return web.json_response({"error": str(e)}, status=500)
+
+        from .replica import STREAM_MARKER
+
+        if isinstance(result, dict) and STREAM_MARKER in result:
+            return await self._stream(request, resp, loop)
+        return web.json_response(result)
+
+    async def _stream(self, request, resp, loop):
+        """Chunked transfer of a generator response: each chunk is a raw
+        bytes frame or one newline-delimited JSON document."""
+        from aiohttp import web
+
+        sr = web.StreamResponse(
+            headers={"Content-Type": "application/x-ndjson"})
+        sr.enable_chunked_encoding()
+        await sr.prepare(request)
+        it = resp.iter_stream(timeout=self.request_timeout_s)
+        try:
+            while True:
+                chunk = await loop.run_in_executor(
+                    None, lambda: next(it, _END))
+                if chunk is _END:
+                    break
+                if isinstance(chunk, (bytes, bytearray)):
+                    await sr.write(bytes(chunk))
+                else:
+                    await sr.write((json.dumps(chunk) + "\n").encode())
+        finally:
+            it.close()  # frees the replica-side generator on early exit
+        await sr.write_eof()
+        return sr
 
     def add_route(self, prefix: str, app_name: str):
         self.routes[prefix.rstrip("/") or "/"] = app_name
@@ -77,5 +134,16 @@ class HTTPProxy:
         return best[1] if best else None
 
     def shutdown(self):
-        self.server.shutdown()
-        self.server.server_close()
+        async def stop():
+            if self._runner is not None:
+                await self._runner.cleanup()
+            self._loop.stop()
+
+        try:
+            asyncio.run_coroutine_threadsafe(stop(), self._loop)
+            self._thread.join(timeout=5)
+        except Exception:
+            pass
+
+
+_END = object()

@@ -12,9 +12,31 @@ from __future__ import annotations
 
 import threading
 import time
+import types
 from typing import Any, Optional
 
 from .multiplex import _set_request_model_id
+
+# A request whose user code returned a generator answers with this marker;
+# the caller pulls chunks from the SAME replica via stream_next
+# (reference: streaming responses through the handle,
+# python/ray/serve/handle.py DeploymentResponseGenerator).
+STREAM_MARKER = "__rtpu_stream__"
+
+
+def _with_model_id(gen, model_id: str):
+    """Run each next() of a parked generator under the request's
+    multiplex id (the body executes lazily on stream_next threads)."""
+    while True:
+        _set_request_model_id(model_id)
+        try:
+            try:
+                v = next(gen)
+            except StopIteration:
+                return
+        finally:
+            _set_request_model_id(None)
+        yield v
 
 
 class Replica:
@@ -24,6 +46,8 @@ class Replica:
         self._ongoing = 0
         self._total = 0
         self._window: list[float] = []  # request-arrival timestamps
+        self._streams: dict[int, Any] = {}
+        self._stream_counter = 0
         if isinstance(cls_or_fn, type):
             self.instance = cls_or_fn(*init_args, **init_kwargs)
         else:
@@ -53,17 +77,56 @@ class Replica:
                 target = self.instance
             else:
                 target = getattr(self.instance, method)
-            return target(*args, **kwargs)
+            result = target(*args, **kwargs)
+            if isinstance(result, types.GeneratorType):
+                # Streaming response: park the generator; the caller
+                # drains it chunk-at-a-time from THIS replica. The body
+                # runs lazily inside stream_next, so the request's
+                # multiplex id must travel with it.
+                if multiplexed_model_id:
+                    result = _with_model_id(result, multiplexed_model_id)
+                with self._lock:
+                    self._stream_counter += 1
+                    sid = self._stream_counter
+                    self._streams[sid] = result
+                return {STREAM_MARKER: sid}
+            return result
         finally:
             _set_request_model_id(None)
             with self._lock:
                 self._ongoing -= 1
 
+    def stream_next(self, sid: int, max_chunks: int = 16):
+        """(chunks, done) — up to max_chunks items of stream ``sid``."""
+        gen = self._streams.get(sid)
+        if gen is None:
+            return [], True
+        out = []
+        try:
+            for _ in range(max_chunks):
+                out.append(next(gen))
+        except StopIteration:
+            self._streams.pop(sid, None)
+            return out, True
+        except BaseException:
+            self._streams.pop(sid, None)
+            raise
+        return out, False
+
+    def stream_cancel(self, sid: int):
+        gen = self._streams.pop(sid, None)
+        if gen is not None:
+            gen.close()
+        return True
+
     def stats(self) -> dict:
         with self._lock:
             now = time.monotonic()
             recent = sum(1 for t in self._window if now - t < 10.0)
-            return {"ongoing": self._ongoing, "total": self._total,
+            # Parked streams ARE ongoing work: autoscaling/drain must not
+            # kill a replica mid-stream.
+            return {"ongoing": self._ongoing + len(self._streams),
+                    "total": self._total,
                     "rate_10s": recent / 10.0}
 
     def check_health(self) -> bool:

@@ -1,0 +1,16 @@
+"""CI pass of the serve latency bench with loose regression floors
+(order-of-magnitude gate, same doctrine as test_microbench)."""
+
+import ray_tpu
+from ray_tpu.scripts import serve_bench
+
+
+def test_serve_bench_floors():
+    ray_tpu.init(num_cpus=2)
+    try:
+        doc = serve_bench.run(duration_s=1.0, clients=2)
+    finally:
+        ray_tpu.shutdown()
+    assert doc["handle"]["rps"] > 50, doc
+    assert doc["http"]["rps"] > 25, doc
+    assert doc["http"]["p99_ms"] < 2000, doc
