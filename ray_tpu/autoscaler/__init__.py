@@ -18,13 +18,16 @@ import asyncio
 from .autoscaler import (AutoscalerMonitor, AutoscalingConfig,
                          NodeTypeConfig, ResourceDemandScheduler,
                          ScalingActions, StandardAutoscaler)
+from .instance_manager import (Instance, InstanceManager,
+                               QueuedSliceProvider, StandardAutoscalerV2)
 from .node_provider import LocalNodeProvider, NodeProvider, SliceHandle
 
 __all__ = [
     "AutoscalerMonitor", "AutoscalingCluster", "AutoscalingConfig",
-    "LocalNodeProvider", "NodeProvider", "NodeTypeConfig",
-    "ResourceDemandScheduler", "ScalingActions", "SliceHandle",
-    "StandardAutoscaler",
+    "Instance", "InstanceManager", "LocalNodeProvider", "NodeProvider",
+    "NodeTypeConfig", "QueuedSliceProvider", "ResourceDemandScheduler",
+    "ScalingActions", "SliceHandle", "StandardAutoscaler",
+    "StandardAutoscalerV2",
 ]
 
 

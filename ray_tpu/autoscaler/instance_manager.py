@@ -1,0 +1,298 @@
+"""Autoscaler v2: instance-lifecycle FSM + queued-resource slice provider.
+
+Capability parity target:
+/root/reference/python/ray/autoscaler/v2/instance_manager/ — explicit
+per-instance states driven by a reconciler, with crash requeue — and the
+Cloud-TPU/GKE QueuedResource provisioning shape (a slice request sits in
+a queue, becomes ACTIVE, or fails and must be re-requested).
+
+States:
+
+    PENDING    requested; not yet submitted to the provider
+    LAUNCHING  submitted; provisioning and/or member hosts registering
+    ALIVE      every member host registered alive in the cluster
+    DRAINING   scale-down decided; terminating on the next reconcile
+    TERMINATED terminal (idle drain, slice death, or giving up a launch)
+
+Transitions are recorded with timestamps+reasons in each instance's
+history — the v2 storage/observability contract.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from .autoscaler import AutoscalingConfig, ScalingActions, StandardAutoscaler
+from .node_provider import NodeProvider, SliceHandle
+
+PENDING = "PENDING"
+LAUNCHING = "LAUNCHING"
+ALIVE = "ALIVE"
+DRAINING = "DRAINING"
+TERMINATED = "TERMINATED"
+
+
+@dataclass
+class Instance:
+    instance_id: str
+    node_type: str
+    state: str = PENDING
+    slice: Optional[SliceHandle] = None
+    launch_attempts: int = 0
+    state_since: float = field(default_factory=time.monotonic)
+    history: List[tuple] = field(default_factory=list)  # (ts, state, reason)
+
+    def transition(self, state: str, reason: str, now: Optional[float] = None):
+        now = time.monotonic() if now is None else now
+        self.state = state
+        self.state_since = now
+        self.history.append((now, state, reason))
+
+
+class InstanceManager:
+    """The FSM: owns every instance's lifecycle against a provider.
+    ``reconcile`` is the single driver — idempotent, callable every tick."""
+
+    def __init__(self, provider: NodeProvider, type_map: dict,
+                 max_launch_retries: int = 3,
+                 launch_timeout_s: float = 120.0):
+        self.provider = provider
+        self.types = type_map
+        self.max_launch_retries = max_launch_retries
+        self.launch_timeout_s = launch_timeout_s
+        self._instances: Dict[str, Instance] = {}
+        self._counter = 0
+
+    # -- commands ----------------------------------------------------------
+    def request(self, node_type: str) -> Instance:
+        self._counter += 1
+        inst = Instance(instance_id=f"i-{node_type}-{self._counter}",
+                        node_type=node_type)
+        inst.transition(PENDING, "requested")
+        self._instances[inst.instance_id] = inst
+        return inst
+
+    def drain(self, slice_id: str, reason: str = "idle"):
+        for inst in self._instances.values():
+            if (inst.slice is not None and inst.slice.slice_id == slice_id
+                    and inst.state in (LAUNCHING, ALIVE)):
+                inst.transition(DRAINING, reason)
+                return inst
+        return None
+
+    # -- queries -----------------------------------------------------------
+    def instances(self, states: Optional[Set[str]] = None) -> List[Instance]:
+        out = list(self._instances.values())
+        if states is not None:
+            out = [i for i in out if i.state in states]
+        return out
+
+    def visible_slices(self) -> List[SliceHandle]:
+        """What the planner should count as existing capacity: one handle
+        per non-terminal instance (PENDING instances synthesize an empty
+        handle so their capacity is already spoken for)."""
+        out = []
+        for inst in self._instances.values():
+            if inst.state in (LAUNCHING, ALIVE) and inst.slice is not None:
+                out.append(inst.slice)
+            elif inst.state == PENDING:
+                t = self.types.get(inst.node_type)
+                hosts = t.hosts if t is not None else 1
+                out.append(SliceHandle(
+                    slice_id=inst.instance_id, node_type=inst.node_type,
+                    node_ids=[f"pending-{inst.instance_id}-{i}"
+                              for i in range(hosts)]))
+        return out
+
+    # -- the reconciler ----------------------------------------------------
+    def reconcile(self, alive_node_ids: Set[str],
+                  now: Optional[float] = None) -> List[tuple]:
+        """One FSM tick; returns [(instance_id, old_state, new_state)]."""
+        now = time.monotonic() if now is None else now
+        provider_live = {h.slice_id: h
+                         for h in self.provider.non_terminated_slices()}
+        events = []
+
+        def move(inst, state, reason):
+            events.append((inst.instance_id, inst.state, state))
+            inst.transition(state, reason, now)
+
+        def requeue_or_fail(inst, what: str):
+            inst.launch_attempts += 1
+            if inst.launch_attempts > self.max_launch_retries:
+                move(inst, TERMINATED,
+                     f"{what}; giving up after {inst.launch_attempts - 1} "
+                     f"retries")
+            else:
+                inst.slice = None
+                move(inst, PENDING, f"{what}; requeued "
+                     f"(attempt {inst.launch_attempts})")
+
+        for inst in list(self._instances.values()):
+            if inst.state == PENDING:
+                t = self.types.get(inst.node_type)
+                if t is None:
+                    move(inst, TERMINATED, "unknown node type")
+                    continue
+                try:
+                    inst.slice = self.provider.create_slice(
+                        t.name, t.resources, t.hosts)
+                except Exception as e:  # noqa: BLE001 - provider hiccup
+                    requeue_or_fail(inst, f"provider create failed: {e}")
+                    continue
+                move(inst, LAUNCHING, "submitted to provider")
+
+            elif inst.state == LAUNCHING:
+                live = provider_live.get(inst.slice.slice_id)
+                if live is None:
+                    # Crashed/failed while provisioning: the core v2
+                    # contract — requeue, don't leak a phantom instance.
+                    requeue_or_fail(inst, "slice lost while launching")
+                    continue
+                inst.slice = live  # node ids fill in as provisioning lands
+                if live.node_ids and all(
+                        nid in alive_node_ids for nid in live.node_ids):
+                    move(inst, ALIVE, "all member hosts registered")
+                elif now - inst.state_since > self.launch_timeout_s:
+                    try:
+                        self.provider.terminate_slice(inst.slice.slice_id)
+                    except Exception:
+                        pass
+                    requeue_or_fail(inst, "launch timed out")
+
+            elif inst.state == ALIVE:
+                live = provider_live.get(inst.slice.slice_id)
+                dead = live is None or any(
+                    nid not in alive_node_ids for nid in inst.slice.node_ids)
+                if dead:
+                    # Gang semantics: one dead member kills the slice.
+                    try:
+                        self.provider.terminate_slice(inst.slice.slice_id)
+                    except Exception:
+                        pass
+                    move(inst, TERMINATED, "slice died")
+
+            elif inst.state == DRAINING:
+                try:
+                    self.provider.terminate_slice(inst.slice.slice_id)
+                except Exception:
+                    pass
+                move(inst, TERMINATED, "drained")
+        return events
+
+
+class QueuedSliceProvider(NodeProvider):
+    """Fake GKE / Cloud-TPU QueuedResource front: ``create_slice`` only
+    ENQUEUES a request; after ``provisioning_delay_s`` the queued resource
+    activates by delegating to an inner provider (which actually spawns
+    hosts) — or fails, if a failure was injected (``fail_next``), in
+    which case the handle disappears from ``non_terminated_slices`` and
+    the instance manager requeues. ``queued_resources()`` exposes the
+    queue states for observability parity."""
+
+    QUEUED, ACTIVE, FAILED = "QUEUED", "ACTIVE", "FAILED"
+
+    def __init__(self, inner: NodeProvider, provisioning_delay_s: float = 0.0):
+        self.inner = inner
+        self.delay = provisioning_delay_s
+        self._queue: Dict[str, dict] = {}
+        self._counter = 0
+        self._fail_budget = 0
+
+    def fail_next(self, n: int = 1):
+        self._fail_budget += n
+
+    def create_slice(self, node_type: str, resources: dict,
+                     hosts: int = 1) -> SliceHandle:
+        self._counter += 1
+        qid = f"qr-{node_type}-{self._counter}"
+        self._queue[qid] = {
+            "state": self.QUEUED, "node_type": node_type,
+            "resources": dict(resources), "hosts": hosts,
+            "enqueued": time.monotonic(), "inner": None,
+        }
+        return SliceHandle(slice_id=qid, node_type=node_type, node_ids=[])
+
+    # FAILED records are kept for observability, but bounded — the FSM's
+    # requeue means failures can recur indefinitely.
+    MAX_FAILED_RECORDS = 32
+
+    def _step(self):
+        now = time.monotonic()
+        for qid, q in self._queue.items():
+            if q["state"] != self.QUEUED or now - q["enqueued"] < self.delay:
+                continue
+            if self._fail_budget > 0:
+                self._fail_budget -= 1
+                q["state"] = self.FAILED
+                continue
+            q["inner"] = self.inner.create_slice(
+                q["node_type"], q["resources"], q["hosts"])
+            q["state"] = self.ACTIVE
+        failed = [qid for qid, q in self._queue.items()
+                  if q["state"] == self.FAILED]
+        for qid in failed[:-self.MAX_FAILED_RECORDS or None]:
+            self._queue.pop(qid, None)
+
+    def non_terminated_slices(self) -> List[SliceHandle]:
+        self._step()
+        inner_live = {h.slice_id: h
+                      for h in self.inner.non_terminated_slices()}
+        out = []
+        for qid, q in list(self._queue.items()):
+            if q["state"] == self.QUEUED:
+                out.append(SliceHandle(slice_id=qid,
+                                       node_type=q["node_type"],
+                                       node_ids=[]))
+            elif q["state"] == self.ACTIVE:
+                live = inner_live.get(q["inner"].slice_id)
+                if live is None:
+                    # Inner gang died: surface as gone.
+                    self._queue.pop(qid, None)
+                    continue
+                out.append(SliceHandle(slice_id=qid,
+                                       node_type=q["node_type"],
+                                       node_ids=live.node_ids))
+            # FAILED entries are simply absent (caller requeues).
+        return out
+
+    def terminate_slice(self, slice_id: str) -> None:
+        q = self._queue.pop(slice_id, None)
+        if q and q.get("inner") is not None:
+            self.inner.terminate_slice(q["inner"].slice_id)
+
+    def queued_resources(self) -> List[dict]:
+        return [{"id": qid, "state": q["state"],
+                 "node_type": q["node_type"]}
+                for qid, q in self._queue.items()]
+
+
+class StandardAutoscalerV2:
+    """v2 autoscaler: the v1 planner's decisions executed through the
+    instance-manager FSM (launch -> PENDING instances; scale-down ->
+    DRAINING) with crash requeue handled by ``reconcile``."""
+
+    def __init__(self, config: AutoscalingConfig, provider: NodeProvider,
+                 max_launch_retries: int = 3,
+                 launch_timeout_s: float = 120.0):
+        self.config = config
+        self.provider = provider
+        self.im = InstanceManager(provider, config.type_map(),
+                                  max_launch_retries, launch_timeout_s)
+        self._planner = StandardAutoscaler(config, provider)
+
+    def update(self, snapshot: dict) -> ScalingActions:
+        alive_ids = {n["node_id"] for n in snapshot["nodes"]
+                     if n["state"] == "ALIVE"}
+        self.im.reconcile(alive_ids)
+        actions = self._planner.plan(snapshot, self.im.visible_slices())
+        for type_name, count in actions.launch.items():
+            for _ in range(count):
+                self.im.request(type_name)
+        for slice_id in actions.terminate:
+            self.im.drain(slice_id)
+        # Apply drains/launches decided this tick promptly.
+        self.im.reconcile(alive_ids)
+        return actions

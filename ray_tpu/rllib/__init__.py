@@ -9,6 +9,16 @@ DDP), and env runners are CPU actors feeding the TPU learner.
 """
 
 from .algorithm import DQN, PPO, Algorithm, AlgorithmConfig  # noqa: F401
+from .connectors import (  # noqa: F401
+    CastObs,
+    ClipActions,
+    ClipObs,
+    Connector,
+    ConnectorPipeline,
+    FlattenObs,
+    NormalizeObs,
+    UnsquashActions,
+)
 from .appo import APPO, APPOLearner  # noqa: F401
 from .impala import IMPALA, IMPALALearner, vtrace_returns  # noqa: F401
 from .env import SyncVectorEnv, make_env  # noqa: F401

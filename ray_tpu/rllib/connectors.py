@@ -1,0 +1,155 @@
+"""Connector pipelines — composable transforms between env and module.
+
+Capability parity target: /root/reference/rllib/connectors/ (ConnectorV2:
+env-to-module and module-to-env pipelines — observation preprocessing and
+action postprocessing as reusable, stateful, composable pieces instead of
+logic baked into the rollout loop).
+
+Env-to-module connectors consume a batched observation array [N, ...];
+module-to-env connectors consume a batched action array. Stateful
+connectors (running normalization) expose get_state/set_state —
+SingleAgentEnvRunner surfaces them via get/set_connector_state for
+checkpointing. Statistics are PER RUNNER (the reference's periodic
+cross-worker filter synchronization is not implemented).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import numpy as np
+
+
+class Connector:
+    def __call__(self, data: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def get_state(self) -> dict:
+        return {}
+
+    def set_state(self, state: dict) -> None:
+        pass
+
+
+class ConnectorPipeline(Connector):
+    """Ordered composition; itself a Connector (pipelines nest)."""
+
+    def __init__(self, connectors: Iterable[Connector] = ()):
+        self.connectors = list(connectors)
+
+    def append(self, connector: Connector) -> "ConnectorPipeline":
+        self.connectors.append(connector)
+        return self
+
+    def __call__(self, data):
+        for c in self.connectors:
+            data = c(data)
+        return data
+
+    def get_state(self) -> dict:
+        return {str(i): c.get_state()
+                for i, c in enumerate(self.connectors)}
+
+    def set_state(self, state: dict) -> None:
+        for i, c in enumerate(self.connectors):
+            if str(i) in state:
+                c.set_state(state[str(i)])
+
+
+# -- env -> module (observations) -------------------------------------------
+class CastObs(Connector):
+    def __init__(self, dtype=np.float32):
+        self.dtype = dtype
+
+    def __call__(self, obs):
+        return np.asarray(obs, dtype=self.dtype)
+
+
+class FlattenObs(Connector):
+    """[N, ...] -> [N, prod(...)] (reference: flatten_observations)."""
+
+    def __call__(self, obs):
+        obs = np.asarray(obs)
+        return obs.reshape(obs.shape[0], -1)
+
+
+class ClipObs(Connector):
+    def __init__(self, low: float = -10.0, high: float = 10.0):
+        self.low, self.high = low, high
+
+    def __call__(self, obs):
+        return np.clip(obs, self.low, self.high)
+
+
+class NormalizeObs(Connector):
+    """Running mean/std normalization (Welford), the
+    MeanStdObservationFilter equivalent. ``frozen=True`` stops updating
+    (evaluation) while still applying the learned statistics."""
+
+    def __init__(self, epsilon: float = 1e-8, clip: Optional[float] = 10.0,
+                 frozen: bool = False):
+        self.eps = epsilon
+        self.clip = clip
+        self.frozen = frozen
+        self._count = 0.0
+        self._mean: Optional[np.ndarray] = None
+        self._m2: Optional[np.ndarray] = None
+
+    def __call__(self, obs):
+        obs = np.asarray(obs, dtype=np.float64)
+        if self._mean is None:
+            self._mean = np.zeros(obs.shape[1:], np.float64)
+            self._m2 = np.ones(obs.shape[1:], np.float64)
+        if not self.frozen:
+            for row in obs.reshape(-1, *self._mean.shape):
+                self._count += 1.0
+                delta = row - self._mean
+                self._mean += delta / self._count
+                self._m2 += delta * (row - self._mean)
+        var = self._m2 / max(1.0, self._count)
+        out = (obs - self._mean) / np.sqrt(var + self.eps)
+        if self.clip is not None:
+            out = np.clip(out, -self.clip, self.clip)
+        return out.astype(np.float32)
+
+    def get_state(self) -> dict:
+        return {"count": self._count,
+                "mean": None if self._mean is None else self._mean.copy(),
+                "m2": None if self._m2 is None else self._m2.copy()}
+
+    def set_state(self, state: dict) -> None:
+        self._count = state["count"]
+        self._mean = state["mean"]
+        self._m2 = state["m2"]
+
+
+# -- module -> env (actions) -------------------------------------------------
+class ClipActions(Connector):
+    def __init__(self, low, high):
+        self.low, self.high = np.asarray(low), np.asarray(high)
+
+    def __call__(self, actions):
+        return np.clip(actions, self.low, self.high)
+
+
+class UnsquashActions(Connector):
+    """tanh-squashed [-1, 1] policy outputs -> the env's [low, high] box
+    (reference: unsquash_action)."""
+
+    def __init__(self, low, high):
+        self.low, self.high = np.asarray(low), np.asarray(high)
+
+    def __call__(self, actions):
+        a = np.clip(actions, -1.0, 1.0)
+        return self.low + (a + 1.0) * 0.5 * (self.high - self.low)
+
+
+def build_pipeline(spec) -> Optional[ConnectorPipeline]:
+    """None | Connector | [Connector, ...] -> pipeline (or None)."""
+    if spec is None:
+        return None
+    if isinstance(spec, ConnectorPipeline):
+        return spec
+    if isinstance(spec, Connector):
+        return ConnectorPipeline([spec])
+    return ConnectorPipeline(list(spec))

@@ -30,10 +30,51 @@ class SingleAgentEnvRunner:
         self.params = self.module.init(
             jax.random.key(config.get("seed", 0) or 0))
         self._key = jax.random.key((config.get("seed", 0) or 0) + 1)
-        self._obs = self.vec.reset()
         self._episode_returns = np.zeros(self.vec.num_envs, np.float32)
         self._completed: list[float] = []
         self._explore_fn = jax.jit(self.module.forward_exploration)
+        # Connector pipelines (reference: rllib/connectors/ ConnectorV2):
+        # env_to_module preprocesses observations (the module trains on
+        # and acts from the TRANSFORMED obs); module_to_env postprocesses
+        # actions before they hit the env. Each raw observation passes the
+        # pipeline exactly ONCE (self._obs always holds the transformed
+        # current obs) — a stateful normalizer must never double-count.
+        from .connectors import build_pipeline
+
+        self._obs_connector = build_pipeline(
+            config.get("env_to_module_connector"))
+        self._act_connector = build_pipeline(
+            config.get("module_to_env_connector"))
+        self._obs = self._obs_in(self.vec.reset())
+
+    def _obs_in(self, obs) -> np.ndarray:
+        obs = np.asarray(obs, dtype=np.float32)
+        if self._obs_connector is not None:
+            obs = np.asarray(self._obs_connector(obs), dtype=np.float32)
+        return obs
+
+    def _act_out(self, action):
+        if self._act_connector is not None:
+            action = np.asarray(self._act_connector(action))
+        return action
+
+    def get_connector_state(self) -> dict:
+        """Per-runner connector statistics (e.g. NormalizeObs running
+        mean/var) for checkpointing. NOTE: stats are per-runner — the
+        reference's periodic cross-worker filter sync is not implemented."""
+        return {
+            "obs": (self._obs_connector.get_state()
+                    if self._obs_connector else {}),
+            "act": (self._act_connector.get_state()
+                    if self._act_connector else {}),
+        }
+
+    def set_connector_state(self, state: dict):
+        if self._obs_connector is not None and state.get("obs"):
+            self._obs_connector.set_state(state["obs"])
+        if self._act_connector is not None and state.get("act"):
+            self._act_connector.set_state(state["act"])
+        return True
 
     def set_state(self, params):
         """Weight sync from the learner (reference: sync_weights)."""
@@ -52,13 +93,13 @@ class SingleAgentEnvRunner:
         for _ in range(num_steps):
             self._key, k = jax.random.split(self._key)
             action, logp, value = self._explore_fn(
-                self.params, self._obs.astype(np.float32), k)
+                self.params, self._obs, k)
             action = np.asarray(action)
-            obs_buf.append(self._obs.astype(np.float32))
+            obs_buf.append(self._obs)
             act_buf.append(action)
             logp_buf.append(np.asarray(logp))
             val_buf.append(np.asarray(value))
-            obs, rew, term, trunc = self.vec.step(action)
+            obs, rew, term, trunc = self.vec.step(self._act_out(action))
             done = term | trunc
             rew_buf.append(rew)
             done_buf.append(done)
@@ -66,9 +107,9 @@ class SingleAgentEnvRunner:
             for i in np.nonzero(done)[0]:
                 self._completed.append(float(self._episode_returns[i]))
                 self._episode_returns[i] = 0.0
-            self._obs = obs
-        bootstrap = np.asarray(
-            self.module.value(self.params, self._obs.astype(np.float32)))
+            self._obs = self._obs_in(obs)
+        final_obs = self._obs
+        bootstrap = np.asarray(self.module.value(self.params, final_obs))
         return {
             "obs": np.stack(obs_buf),        # [T, N, obs_dim]
             "actions": np.stack(act_buf),    # [T, N]
@@ -79,8 +120,8 @@ class SingleAgentEnvRunner:
             "bootstrap_value": bootstrap,    # [N]
             # Off-policy learners (IMPALA/V-trace) bootstrap with the
             # TARGET policy's value of the final obs, not the behavior
-            # policy's value above.
-            "final_obs": self._obs.astype(np.float32),  # [N, obs_dim]
+            # policy's value above. Already connector-transformed.
+            "final_obs": final_obs,  # [N, obs_dim]
         }
 
     def rollout_transitions(self, num_steps: int, action_fn) -> dict:
@@ -89,20 +130,21 @@ class SingleAgentEnvRunner:
         one rollout implementation for every value-based algorithm."""
         obs_b, act_b, rew_b, next_b, done_b = [], [], [], [], []
         for _ in range(num_steps):
-            obs = self._obs.astype(np.float32)
-            action = np.asarray(action_fn(obs))
-            nobs, rew, term, trunc = self.vec.step(action)
+            cur = self._obs  # already transformed (invariant of _obs)
+            action = np.asarray(action_fn(cur))
+            nobs, rew, term, trunc = self.vec.step(self._act_out(action))
             done = term | trunc
-            obs_b.append(obs)
+            nxt = self._obs_in(nobs)
+            obs_b.append(cur)
             act_b.append(action)
             rew_b.append(rew)
-            next_b.append(nobs.astype(np.float32))
+            next_b.append(nxt)
             done_b.append(done)
             self._episode_returns += rew
             for i in np.nonzero(done)[0]:
                 self._completed.append(float(self._episode_returns[i]))
                 self._episode_returns[i] = 0.0
-            self._obs = nobs
+            self._obs = nxt
         cat = lambda xs: np.concatenate(xs, axis=0)
         return {"obs": cat(obs_b), "actions": cat(act_b),
                 "rewards": cat(rew_b), "next_obs": cat(next_b),

@@ -50,6 +50,9 @@ class RunConfig:
     # Stop criteria: {"metric": bound} — a trial stops once any reported
     # metric reaches its bound (parity: reference RunConfig(stop=...)).
     stop: Optional[dict] = None
+    # Experiment callbacks (ray_tpu.tune.logger.Callback instances —
+    # CSV/JSON/TensorBoard loggers etc.), driven by the Tune controller.
+    callbacks: Optional[list] = None
 
 
 @dataclass

@@ -24,12 +24,19 @@ from .search import (  # noqa: F401
     sample_from,
     uniform,
 )
+from .logger import (  # noqa: F401
+    Callback,
+    CSVLoggerCallback,
+    JsonLoggerCallback,
+    TensorBoardLoggerCallback,
+)
 from .schedulers import (  # noqa: F401
     ASHAScheduler,
     AsyncHyperBandScheduler,
     FIFOScheduler,
     HyperBandScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
     TrialScheduler,
 )

@@ -34,7 +34,8 @@ class TuneController:
                  checkpoint_keep: Optional[int] = None,
                  scheduling_strategy: Optional[str] = None,
                  trial_cpus: float = 1.0,
-                 restored_trials: Optional[list[Trial]] = None):
+                 restored_trials: Optional[list[Trial]] = None,
+                 callbacks: Optional[list] = None):
         self.trainable = trainable
         self.exp_dir = experiment_dir
         self.searcher = searcher
@@ -51,6 +52,11 @@ class TuneController:
         for t in self.trials:
             self._manager_for(t)
         os.makedirs(self.exp_dir, exist_ok=True)
+        # Logger/observer callback stack (reference: tune/logger/ driven
+        # through ray.tune.Callback hooks).
+        self.callbacks = list(callbacks or [])
+        for cb in self.callbacks:
+            cb.setup(self.exp_dir)
 
     # -- helpers ------------------------------------------------------------
     def _manager_for(self, trial: Trial) -> CheckpointManager:
@@ -181,6 +187,8 @@ class TuneController:
                     if hasattr(self.scheduler, "record_checkpoint"):
                         self.scheduler.record_checkpoint(trial, ckpt)
                 self.searcher.on_trial_result(trial.trial_id, metrics)
+                for cb in self.callbacks:
+                    cb.on_trial_result(trial, metrics)
                 if self._should_stop_by_criteria(metrics):
                     decision = STOP
                     break
@@ -204,6 +212,8 @@ class TuneController:
         while self.step():
             time.sleep(POLL_INTERVAL)
         self._save_state()
+        for cb in self.callbacks:
+            cb.on_experiment_end(self.trials)
 
     # -- transitions --------------------------------------------------------
     def _complete(self, trial: Trial):
@@ -211,6 +221,8 @@ class TuneController:
         trial.status = TERMINATED
         self.scheduler.on_trial_complete(trial, trial.last_result)
         self.searcher.on_trial_complete(trial.trial_id, trial.last_result)
+        for cb in self.callbacks:
+            cb.on_trial_complete(trial, trial.last_result)
 
     def _pause(self, trial: Trial):
         self._teardown(trial)

@@ -1,0 +1,159 @@
+"""Tune logger callbacks: per-trial CSV / JSONL / TensorBoard output.
+
+Capability parity target: /root/reference/python/ray/tune/logger/
+(CSVLoggerCallback, JsonLoggerCallback, TBXLoggerCallback) — a callback
+stack the controller drives on every trial result/completion, writing
+under each trial's directory inside the experiment dir.
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import numbers
+import os
+from typing import Optional
+
+
+class Callback:
+    """Experiment callback interface (reference: ray.tune.Callback)."""
+
+    def setup(self, experiment_dir: str):
+        pass
+
+    def on_trial_result(self, trial, result: dict):
+        pass
+
+    def on_trial_complete(self, trial, result: Optional[dict]):
+        pass
+
+    def on_experiment_end(self, results):
+        pass
+
+
+class _TrialFileLogger(Callback):
+    """Shared plumbing: one output file per trial under
+    <experiment_dir>/<trial_id>/."""
+
+    filename = ""
+
+    def setup(self, experiment_dir: str):
+        self.exp_dir = experiment_dir
+        self._files: dict = {}
+
+    def _trial_dir(self, trial) -> str:
+        d = os.path.join(self.exp_dir, trial.trial_id)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def on_experiment_end(self, results):
+        for f in self._files.values():
+            try:
+                f.close()
+            except Exception:
+                pass
+        self._files.clear()
+
+
+def _scalars(result: dict) -> dict:
+    return {k: v for k, v in result.items()
+            if isinstance(v, numbers.Number)}
+
+
+class JsonLoggerCallback(_TrialFileLogger):
+    """result.json — one JSON document per reported result (reference:
+    tune/logger/json.py)."""
+
+    def on_trial_result(self, trial, result: dict):
+        f = self._files.get(trial.trial_id)
+        if f is None:
+            f = open(os.path.join(self._trial_dir(trial), "result.json"),
+                     "a")
+            self._files[trial.trial_id] = f
+        json.dump({k: v for k, v in result.items()
+                   if isinstance(v, (numbers.Number, str, bool,
+                                     type(None)))}, f)
+        f.write("\n")
+        f.flush()
+
+
+class CSVLoggerCallback(_TrialFileLogger):
+    """progress.csv — scalar metrics per row (reference:
+    tune/logger/csv.py). The header is fixed by the first result; later
+    rows fill missing keys with blanks and drop new ones."""
+
+    def setup(self, experiment_dir: str):
+        super().setup(experiment_dir)
+        self._writers: dict = {}
+        self._fields: dict = {}
+
+    def on_trial_result(self, trial, result: dict):
+        tid = trial.trial_id
+        row = _scalars(result)
+        if tid not in self._writers:
+            path = os.path.join(self._trial_dir(trial), "progress.csv")
+            resumed = os.path.exists(path) and os.path.getsize(path) > 0
+            f = open(path, "a", newline="")
+            self._files[tid] = f
+            if resumed:
+                # Restored experiment: reuse the existing header (a second
+                # header row mid-file breaks CSV readers).
+                with open(path, newline="") as rf:
+                    self._fields[tid] = next(csv.reader(rf))
+            else:
+                self._fields[tid] = sorted(row)
+            w = csv.DictWriter(f, fieldnames=self._fields[tid],
+                               extrasaction="ignore", restval="")
+            if not resumed:
+                w.writeheader()
+            self._writers[tid] = w
+        self._writers[tid].writerow(row)
+        self._files[tid].flush()
+
+
+class TensorBoardLoggerCallback(_TrialFileLogger):
+    """TensorBoard event files per trial via torch.utils.tensorboard
+    (reference: tune/logger/tensorboardx.py). No-ops with a one-time
+    warning if no writer implementation is importable."""
+
+    _warned = False
+
+    def setup(self, experiment_dir: str):
+        super().setup(experiment_dir)
+        self._writers: dict = {}
+        try:
+            from torch.utils.tensorboard import SummaryWriter  # noqa: F401
+
+            self._cls = SummaryWriter
+        except Exception:  # noqa: BLE001 - optional dependency
+            self._cls = None
+            if not TensorBoardLoggerCallback._warned:
+                TensorBoardLoggerCallback._warned = True
+                import warnings
+
+                warnings.warn("tensorboard writer unavailable; "
+                              "TensorBoardLoggerCallback is a no-op")
+
+    def on_trial_result(self, trial, result: dict):
+        if self._cls is None:
+            return
+        w = self._writers.get(trial.trial_id)
+        if w is None:
+            w = self._cls(log_dir=self._trial_dir(trial))
+            self._writers[trial.trial_id] = w
+        step = int(result.get("training_iteration", 0))
+        for k, v in _scalars(result).items():
+            w.add_scalar(k, v, global_step=step)
+        w.flush()
+
+    def on_experiment_end(self, results):
+        for w in self._writers.values():
+            try:
+                w.close()
+            except Exception:
+                pass
+        self._writers.clear()
+        super().on_experiment_end(results)
+
+
+DEFAULT_CALLBACKS = (JsonLoggerCallback, CSVLoggerCallback)

@@ -198,6 +198,99 @@ class PopulationBasedTraining(TrialScheduler):
         return self._exploit_plan.pop(trial.trial_id, None)
 
 
+class PB2(PopulationBasedTraining):
+    """PB2 — population-based training with a GP-bandit explore step
+    (parity: /root/reference/python/ray/tune/schedulers/pb2.py, which
+    wraps GPy; ours is a self-contained numpy GP).
+
+    Instead of PBT's random perturbation, the exploit step fits a
+    Gaussian process mapping (hyperparameters, time) -> observed reward
+    CHANGE per interval across the whole population's history, and picks
+    the new config by maximizing a UCB acquisition within
+    ``hyperparam_bounds`` — data-efficient tuning for small populations.
+    """
+
+    def __init__(self, *, hyperparam_bounds: dict,
+                 ucb_kappa: float = 1.5, **kw):
+        kw.pop("hyperparam_mutations", None)
+        super().__init__(hyperparam_mutations={}, **kw)
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in hyperparam_bounds.items()}
+        self.kappa = ucb_kappa
+        self._keys = sorted(self.bounds)
+        self._obs_x: list = []   # [hyperparams..., t] rows
+        self._obs_y: list = []   # reward delta over the interval
+        self._prev: dict = {}    # trial_id -> (t, score)
+
+    def on_trial_result(self, trial, result: dict) -> str:
+        t = result.get(self.time_attr, 0)
+        score = self._score(result)
+        prev = self._prev.get(trial.trial_id)
+        self._prev[trial.trial_id] = (t, score)
+        if prev is not None and t > prev[0]:
+            cfg = trial.config
+            if all(k in cfg for k in self._keys):
+                x = [float(cfg[k]) for k in self._keys] + [float(t)]
+                self._obs_x.append(x)
+                self._obs_y.append((score - prev[1]) / (t - prev[0]))
+        decision = super().on_trial_result(trial, result)
+        if decision == PAUSE:
+            # The trial restarts from ANOTHER trial's checkpoint: the next
+            # score delta would credit that weight-clone jump to the new
+            # hyperparameters and corrupt the GP — drop the baseline.
+            self._prev.pop(trial.trial_id, None)
+        return decision
+
+    # -- GP machinery ------------------------------------------------------
+    def _normalize(self, X):
+        import numpy as np
+
+        X = np.asarray(X, dtype=float)
+        lo = np.array([self.bounds[k][0] for k in self._keys] + [0.0])
+        hi = np.array([self.bounds[k][1] for k in self._keys]
+                      + [max(1.0, X[:, -1].max())])
+        return (X - lo) / np.maximum(hi - lo, 1e-12)
+
+    def _explore(self, config: dict) -> dict:
+        import numpy as np
+
+        new = dict(config)
+        if len(self._obs_y) < 2 * max(1, len(self._keys)):
+            # Cold start: uniform sample within bounds.
+            for k, (lo, hi) in self.bounds.items():
+                new[k] = lo + (hi - lo) * self.rng.random()
+            return new
+        X = self._normalize(self._obs_x[-200:])
+        y = np.asarray(self._obs_y[-200:], dtype=float)
+        y_mu, y_sd = y.mean(), y.std() + 1e-9
+        y = (y - y_mu) / y_sd
+        ls, noise = 0.2, 1e-3
+
+        def rbf(A, Bm):
+            d2 = ((A[:, None, :] - Bm[None, :, :]) ** 2).sum(-1)
+            return np.exp(-d2 / (2 * ls * ls))
+
+        K = rbf(X, X) + noise * np.eye(len(X))
+        alpha = np.linalg.solve(K, y)
+        t_now = max(x[-1] for x in self._obs_x)
+        cand_raw = []
+        rngs = [self.bounds[k] for k in self._keys]
+        for _ in range(128):
+            cand_raw.append([lo + (hi - lo) * self.rng.random()
+                             for lo, hi in rngs] + [t_now])
+        C = self._normalize(cand_raw)
+        Kc = rbf(C, X)
+        mu = Kc @ alpha
+        # Diagonal predictive variance (cheap, enough for UCB ranking).
+        v = np.linalg.solve(K, Kc.T)
+        var = np.maximum(1e-12, 1.0 - (Kc * v.T).sum(-1))
+        best = int(np.argmax(mu + self.kappa * np.sqrt(var)))
+        for i, k in enumerate(self._keys):
+            lo, hi = self.bounds[k]
+            new[k] = float(np.clip(cand_raw[best][i], lo, hi))
+        return new
+
+
 # Reference exposes ASHAScheduler as the recommended alias.
 ASHAScheduler = AsyncHyperBandScheduler
 

@@ -135,6 +135,7 @@ class Tuner:
             scheduling_strategy=tc.scheduling_strategy,
             trial_cpus=tc.trial_cpus,
             restored_trials=self._restored,
+            callbacks=getattr(self.run_config, "callbacks", None),
         )
         controller.run()
 

@@ -1,0 +1,186 @@
+"""Autoscaler v2: instance FSM lifecycle, crash requeue, queued-resource
+provider, idle drain.
+
+Parity model: /root/reference/python/ray/autoscaler/v2/instance_manager/
+(instance states driven by a reconciler) + the Cloud-TPU QueuedResource
+provisioning shape.
+"""
+
+import itertools
+
+from ray_tpu.autoscaler import (AutoscalingConfig, InstanceManager,
+                                NodeProvider, NodeTypeConfig,
+                                QueuedSliceProvider, SliceHandle,
+                                StandardAutoscalerV2)
+from ray_tpu.autoscaler.instance_manager import (ALIVE, DRAINING, LAUNCHING,
+                                                 PENDING, TERMINATED)
+
+
+class FakeProvider(NodeProvider):
+    """Deterministic in-memory provider: slices 'boot' when the test says
+    so (their node ids appear), and can be killed."""
+
+    def __init__(self):
+        self._slices = {}
+        self._counter = itertools.count(1)
+        self.created = 0
+
+    def create_slice(self, node_type, resources, hosts=1):
+        sid = f"s-{next(self._counter)}"
+        h = SliceHandle(slice_id=sid, node_type=node_type,
+                        node_ids=[f"{sid}-h{i}" for i in range(hosts)])
+        self._slices[sid] = h
+        self.created += 1
+        return h
+
+    def terminate_slice(self, slice_id):
+        self._slices.pop(slice_id, None)
+
+    def non_terminated_slices(self):
+        return list(self._slices.values())
+
+    def kill(self, slice_id):
+        self._slices.pop(slice_id, None)
+
+
+TYPES = {"cpu": NodeTypeConfig(name="cpu", resources={"CPU": 2.0},
+                               max_workers=4)}
+
+
+def test_fsm_happy_path_pending_launching_alive_drain():
+    p = FakeProvider()
+    im = InstanceManager(p, TYPES)
+    inst = im.request("cpu")
+    assert inst.state == PENDING
+
+    im.reconcile(alive_node_ids=set())
+    assert inst.state == LAUNCHING and inst.slice is not None
+
+    im.reconcile(alive_node_ids=set(inst.slice.node_ids))
+    assert inst.state == ALIVE
+
+    im.drain(inst.slice.slice_id, "idle")
+    assert inst.state == DRAINING
+    im.reconcile(alive_node_ids=set(inst.slice.node_ids))
+    assert inst.state == TERMINATED
+    assert not p.non_terminated_slices()
+    # Full history recorded.
+    assert [s for _, s, _ in inst.history] == [
+        PENDING, LAUNCHING, ALIVE, DRAINING, TERMINATED]
+
+
+def test_fsm_requeues_crashed_launching_slice():
+    p = FakeProvider()
+    im = InstanceManager(p, TYPES, max_launch_retries=3)
+    inst = im.request("cpu")
+    im.reconcile(set())
+    assert inst.state == LAUNCHING
+
+    p.kill(inst.slice.slice_id)  # dies while launching
+    im.reconcile(set())
+    assert inst.state == PENDING and inst.launch_attempts == 1
+
+    im.reconcile(set())  # resubmitted
+    assert inst.state == LAUNCHING
+    im.reconcile(set(inst.slice.node_ids))
+    assert inst.state == ALIVE
+    assert p.created == 2
+
+
+def test_fsm_gives_up_after_retry_budget():
+    p = FakeProvider()
+    im = InstanceManager(p, TYPES, max_launch_retries=2)
+    inst = im.request("cpu")
+    for _ in range(10):
+        im.reconcile(set())
+        if inst.state == LAUNCHING:
+            p.kill(inst.slice.slice_id)
+        if inst.state == TERMINATED:
+            break
+    assert inst.state == TERMINATED
+    assert "giving up" in inst.history[-1][2]
+
+
+def test_fsm_launch_timeout_requeues():
+    p = FakeProvider()
+    im = InstanceManager(p, TYPES, launch_timeout_s=5.0)
+    inst = im.request("cpu")
+    im.reconcile(set(), now=100.0)
+    assert inst.state == LAUNCHING
+    im.reconcile(set(), now=102.0)  # hosts never register
+    assert inst.state == LAUNCHING
+    im.reconcile(set(), now=106.0)
+    assert inst.state == PENDING and "timed out" in inst.history[-1][2]
+
+
+def test_fsm_alive_slice_member_death_terminates_gang():
+    p = FakeProvider()
+    types = {"tpu": NodeTypeConfig(name="tpu", resources={"TPU_HOST": 1.0},
+                                   hosts=2, max_workers=2)}
+    im = InstanceManager(p, types)
+    inst = im.request("tpu")
+    im.reconcile(set())
+    members = set(inst.slice.node_ids)
+    im.reconcile(members)
+    assert inst.state == ALIVE
+    im.reconcile(members - {inst.slice.node_ids[0]})  # one member dies
+    assert inst.state == TERMINATED
+    assert "slice died" in inst.history[-1][2]
+
+
+def test_queued_provider_lifecycle_and_failure_injection():
+    inner = FakeProvider()
+    qp = QueuedSliceProvider(inner, provisioning_delay_s=0.0)
+    h = qp.create_slice("cpu", {"CPU": 2.0}, hosts=1)
+    assert qp.queued_resources()[0]["state"] in (qp.QUEUED, qp.ACTIVE)
+    live = qp.non_terminated_slices()  # steps the queue -> ACTIVE
+    assert len(live) == 1 and live[0].node_ids
+    qp.terminate_slice(h.slice_id)
+    assert not qp.non_terminated_slices()
+    assert not inner.non_terminated_slices()
+
+    qp.fail_next(1)
+    h2 = qp.create_slice("cpu", {"CPU": 2.0})
+    assert qp.non_terminated_slices() == []  # provisioning failed
+    states = {q["id"]: q["state"] for q in qp.queued_resources()}
+    assert states[h2.slice_id] == qp.FAILED
+
+
+def test_v2_autoscaler_end_to_end_with_queued_provider():
+    """Demand -> PENDING -> queued provisioning fails once -> FSM requeues
+    -> ALIVE; then demand clears -> idle drain -> TERMINATED."""
+    inner = FakeProvider()
+    qp = QueuedSliceProvider(inner)
+    cfg = AutoscalingConfig(
+        node_types=[NodeTypeConfig(name="cpu", resources={"CPU": 2.0},
+                                   max_workers=4)],
+        idle_timeout_s=0.0)
+    a = StandardAutoscalerV2(cfg, qp, max_launch_retries=3)
+
+    def snap(nodes=(), demand=()):
+        return {"nodes": list(nodes), "demand": list(demand),
+                "pending_pg_bundles": []}
+
+    qp.fail_next(1)  # first provisioning attempt dies mid-launch
+    a.update(snap(demand=[{"CPU": 1.0}]))
+    # Tick until the requeued attempt is ACTIVE at the provider.
+    for _ in range(5):
+        a.update(snap(demand=[{"CPU": 1.0}]))
+        if inner.non_terminated_slices():
+            break
+    assert inner.non_terminated_slices(), "relaunch after failure"
+    assert inner.created == 1  # the failed attempt never reached inner
+
+    # Hosts register -> ALIVE.
+    live = qp.non_terminated_slices()[0]
+    rows = [{"node_id": nid, "state": "ALIVE", "reservations": 0,
+             "available": {"CPU": 2.0}, "resources": {"CPU": 2.0}}
+            for nid in live.node_ids]
+    a.update(snap(nodes=rows, demand=[{"CPU": 1.0}]))
+    assert a.im.instances({ALIVE}), "instance reached ALIVE"
+
+    # Demand gone + idle -> drain -> terminated at the provider.
+    for _ in range(3):
+        a.update(snap(nodes=rows))
+    assert not inner.non_terminated_slices(), "idle slice drained"
+    assert a.im.instances({TERMINATED})

@@ -1,0 +1,167 @@
+"""PB2 (GP-bandit PBT explore), logger callbacks, RLlib connectors.
+
+Parity: /root/reference/python/ray/tune/schedulers/pb2.py,
+tune/logger/{csv,json,tensorboardx}.py, rllib/connectors/.
+"""
+
+import csv
+import json
+import os
+import random
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import PB2
+
+
+class _T:
+    def __init__(self, tid, config):
+        self.trial_id = tid
+        self.config = config
+
+
+def test_pb2_gp_explore_prefers_high_reward_region():
+    """Feed observations where reward change peaks at lr=0.5; the GP
+    explore step must select near the peak, not the edges."""
+    sched = PB2(hyperparam_bounds={"lr": (0.0, 1.0)},
+                perturbation_interval=1, seed=0)
+    sched.set_search_properties("reward", "max")
+    rng = random.Random(0)
+    # Synthetic population history: dy = lr*(1-lr) (max at 0.5).
+    for step in range(1, 6):
+        for i in range(8):
+            lr = rng.random()
+            sched._obs_x.append([lr, float(step)])
+            sched._obs_y.append(lr * (1 - lr))
+    picks = [sched._explore({"lr": 0.05})["lr"] for _ in range(5)]
+    # All GP picks should land well inside the high-value middle region.
+    assert all(0.2 < p < 0.8 for p in picks), picks
+    assert abs(np.mean(picks) - 0.5) < 0.2, picks
+
+
+def test_pb2_cold_start_samples_within_bounds():
+    sched = PB2(hyperparam_bounds={"lr": (1e-4, 1e-1)}, seed=1)
+    sched.set_search_properties("reward", "max")
+    out = sched._explore({"lr": 0.5})
+    assert 1e-4 <= out["lr"] <= 1e-1
+
+
+def test_pb2_records_observations_from_results():
+    sched = PB2(hyperparam_bounds={"lr": (0.0, 1.0)},
+                perturbation_interval=100, seed=2)
+    sched.set_search_properties("reward", "max")
+    t = _T("t1", {"lr": 0.3})
+    sched.on_trial_result(t, {"training_iteration": 1, "reward": 1.0})
+    sched.on_trial_result(t, {"training_iteration": 2, "reward": 3.0})
+    assert sched._obs_x == [[0.3, 2.0]]
+    assert sched._obs_y == [2.0]
+
+
+def test_logger_callbacks_write_csv_json_tb(tmp_path):
+    from ray_tpu.tune.logger import (CSVLoggerCallback, JsonLoggerCallback,
+                                     TensorBoardLoggerCallback)
+
+    cbs = [JsonLoggerCallback(), CSVLoggerCallback(),
+           TensorBoardLoggerCallback()]
+    for cb in cbs:
+        cb.setup(str(tmp_path))
+    t = _T("trial_a", {"lr": 0.1})
+    for i in range(3):
+        for cb in cbs:
+            cb.on_trial_result(t, {"training_iteration": i + 1,
+                                   "loss": 1.0 / (i + 1), "tag": "x"})
+    for cb in cbs:
+        cb.on_experiment_end([t])
+
+    trial_dir = tmp_path / "trial_a"
+    rows = [json.loads(l) for l in
+            (trial_dir / "result.json").read_text().splitlines()]
+    assert len(rows) == 3 and rows[2]["loss"] == pytest.approx(1 / 3)
+    with open(trial_dir / "progress.csv") as f:
+        recs = list(csv.DictReader(f))
+    assert len(recs) == 3 and float(recs[0]["loss"]) == 1.0
+    assert any(n.startswith("events.out.tfevents")
+               for n in os.listdir(trial_dir)), "no TB event file"
+
+
+def test_tuner_with_logger_callbacks_end_to_end(tmp_path):
+    from ray_tpu.train import RunConfig
+
+    ray_tpu.init(num_cpus=2)
+    try:
+        def trainable(config):
+            for i in range(3):
+                tune.report({"score": config["x"] * (i + 1)})
+
+        tuner = tune.Tuner(
+            trainable,
+            param_space={"x": tune.grid_search([1.0, 2.0])},
+            tune_config=tune.TuneConfig(metric="score", mode="max"),
+            run_config=RunConfig(
+                name="cb_exp", storage_path=str(tmp_path),
+                callbacks=[tune.JsonLoggerCallback(),
+                           tune.CSVLoggerCallback()]),
+        )
+        grid = tuner.fit()
+    finally:
+        ray_tpu.shutdown()
+    assert grid.get_best_result().metrics["score"] == 6.0
+    exp = tmp_path / "cb_exp"
+    trial_dirs = [d for d in exp.iterdir()
+                  if d.is_dir() and (d / "result.json").exists()]
+    assert len(trial_dirs) == 2
+    for d in trial_dirs:
+        assert (d / "progress.csv").exists()
+
+
+# -- connectors --------------------------------------------------------------
+def test_connector_pipeline_compose_and_state():
+    from ray_tpu.rllib import (CastObs, ClipObs, ConnectorPipeline,
+                               NormalizeObs)
+
+    norm = NormalizeObs(clip=5.0)
+    pipe = ConnectorPipeline([CastObs(), norm, ClipObs(-3, 3)])
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        out = pipe(rng.normal(2.0, 0.5, (16, 4)))
+    assert out.shape == (16, 4)
+    # Normalization centered the data.
+    assert abs(float(out.mean())) < 1.0
+    # State round-trips.
+    state = pipe.get_state()
+    fresh = ConnectorPipeline([CastObs(), NormalizeObs(clip=5.0),
+                               ClipObs(-3, 3)])
+    fresh.set_state(state)
+    x = rng.normal(2.0, 0.5, (4, 4))
+    np.testing.assert_allclose(
+        np.asarray(pipe(x.copy())), np.asarray(fresh(x.copy())), atol=0.2)
+
+
+def test_action_connectors():
+    from ray_tpu.rllib import ClipActions, UnsquashActions
+
+    clip = ClipActions(low=-1.0, high=1.0)
+    np.testing.assert_allclose(clip(np.array([-5.0, 0.3, 5.0])),
+                               [-1.0, 0.3, 1.0])
+    un = UnsquashActions(low=0.0, high=10.0)
+    np.testing.assert_allclose(un(np.array([-1.0, 0.0, 1.0])),
+                               [0.0, 5.0, 10.0])
+
+
+def test_env_runner_with_obs_connector():
+    from ray_tpu.rllib import NormalizeObs
+    from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
+
+    r = SingleAgentEnvRunner({
+        "env": "CartPole-v1", "num_envs_per_runner": 2, "seed": 0,
+        "env_to_module_connector": [NormalizeObs()],
+    })
+    batch = r.sample(8)
+    assert batch["obs"].shape[0] == 8
+    # Normalized observations are bounded by the connector's clip.
+    assert float(np.abs(batch["obs"]).max()) <= 10.0
+    assert batch["final_obs"].shape[0] == 2
+    r.stop()
