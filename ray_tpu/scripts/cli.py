@@ -182,6 +182,14 @@ def _start_worker_node(args):
     resources.setdefault("CPU", args.num_cpus)
     if args.num_tpus is not None:
         resources.setdefault("TPU", args.num_tpus)
+    elif "TPU" not in resources:
+        # Autodetect with a hard wall-time bound — a wedged chip tunnel
+        # must not hang `rtpu start` (backend_probe.py).
+        from ray_tpu._private.backend_probe import device_count
+
+        n = device_count()
+        if n:
+            resources["TPU"] = float(n)
     env = dict(os.environ)
     env["RT_HEAD_ADDR"] = addr
     env["RT_SESSION_ID"] = env.get("RT_SESSION_ID", "cli")

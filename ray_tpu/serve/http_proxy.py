@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
 
@@ -24,6 +25,11 @@ class HTTPProxy:
         self.controller = controller
         self.routes: dict[str, str] = {}  # prefix -> app name
         self.request_timeout_s = request_timeout_s
+        # Streaming chunk pulls block a thread each; a dedicated bounded
+        # pool keeps a slow deployment generator from exhausting the
+        # loop's shared default executor (ADVICE r3).
+        self._stream_pool = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="serve-stream")
         self._loop = asyncio.new_event_loop()
         self._runner = None
         started = threading.Event()
@@ -91,10 +97,10 @@ class HTTPProxy:
         from .replica import STREAM_MARKER
 
         if isinstance(result, dict) and STREAM_MARKER in result:
-            return await self._stream(request, resp, loop)
+            return await self._stream(request, resp)
         return web.json_response(result)
 
-    async def _stream(self, request, resp, loop):
+    async def _stream(self, request, resp):
         """Chunked transfer of a generator response: each chunk is a raw
         bytes frame or one newline-delimited JSON document."""
         from aiohttp import web
@@ -104,10 +110,23 @@ class HTTPProxy:
         sr.enable_chunked_encoding()
         await sr.prepare(request)
         it = resp.iter_stream(timeout=self.request_timeout_s)
+        timed_out = False
+        cf = None
         try:
             while True:
-                chunk = await loop.run_in_executor(
-                    None, lambda: next(it, _END))
+                # Per-chunk deadline: a generator that stalls mid-stream
+                # must not tie up a pool thread forever past the request
+                # timeout (ADVICE r3). The blocked thread itself cannot be
+                # cancelled, but the bounded dedicated pool contains the
+                # damage and the client sees an ABORTED (not cleanly
+                # completed) stream.
+                cf = self._stream_pool.submit(lambda: next(it, _END))
+                try:
+                    chunk = await asyncio.wait_for(
+                        asyncio.wrap_future(cf), self.request_timeout_s)
+                except (TimeoutError, asyncio.TimeoutError):
+                    timed_out = True
+                    break
                 if chunk is _END:
                     break
                 if isinstance(chunk, (bytes, bytearray)):
@@ -115,7 +134,27 @@ class HTTPProxy:
                 else:
                     await sr.write((json.dumps(chunk) + "\n").encode())
         finally:
-            it.close()  # frees the replica-side generator on early exit
+            # Free the replica-side generator. If a pull is still
+            # executing in the pool thread (timeout above, or the client
+            # disconnected cancelling this handler mid-await),
+            # generator.close() from here would raise "generator already
+            # executing" — defer it to the pool thread via the future's
+            # completion instead.
+            if cf is not None and not cf.done():
+                cf.add_done_callback(lambda f: _safe_close(it))
+            else:
+                _safe_close(it)
+        if timed_out:
+            # In-band error frame, then abort the connection WITHOUT the
+            # terminating chunk: a truncated stream must not look like a
+            # well-formed completed one to the client.
+            try:
+                await sr.write(b'{"error": "stream chunk timed out"}\n')
+            except (ConnectionError, OSError):
+                pass
+            if request.transport is not None:
+                request.transport.close()
+            return sr
         await sr.write_eof()
         return sr
 
@@ -144,6 +183,14 @@ class HTTPProxy:
             self._thread.join(timeout=5)
         except Exception:
             pass
+        self._stream_pool.shutdown(wait=False)
 
 
 _END = object()
+
+
+def _safe_close(it):
+    try:
+        it.close()
+    except Exception:  # noqa: BLE001 - best-effort release
+        pass

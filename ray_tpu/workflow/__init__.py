@@ -17,6 +17,7 @@ remote functions, so TPU device-lane steps work unchanged.
 
 from __future__ import annotations
 
+import fcntl
 import json
 import os
 import pickle
@@ -292,55 +293,70 @@ def run(entry: Optional[StepNode], workflow_id: Optional[str] = None) -> Any:
         raise WorkflowError(f"no workflow {workflow_id!r} in storage")
     else:
         storage.create(entry)
-    # Atomic lease claim (O_EXCL): two processes racing to (re)run the
+    # Lease claim via kernel flock: two processes racing to (re)run the
     # same workflow — e.g. concurrent resume_all() after a crash — must
-    # not both execute it. A stale lock (holder crashed: mtime older than
-    # LEASE_TIMEOUT_S) is broken exactly once; losing the re-create race
-    # after breaking it means someone else claimed.
+    # not both execute it. flock is the right primitive here (ADVICE r3
+    # found unfixable TOCTOU races in every unlink/rename staleness-break
+    # scheme): the kernel releases the lock the instant the holder dies,
+    # so there IS no stale-lock case, and with LOCK_NB a held lock fails
+    # the claim immediately. The lock file is never unlinked — unlink +
+    # re-create lets two claimants lock different inodes of the same
+    # path; the inode re-check below closes the remaining window against
+    # historical unlinkers.
     lock_path = os.path.join(storage.dir, "lease.lock")
-    claimed = False
-    for attempt in (0, 1):
+    lock_fd = None
+    for _ in range(3):
+        fd = os.open(lock_path, os.O_CREAT | os.O_RDWR, 0o644)
         try:
-            fd = os.open(lock_path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-            os.write(fd, str(os.getpid()).encode())
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except BlockingIOError:
             os.close(fd)
-            claimed = True
+            break  # live holder
+        except OSError:
+            # Not "held" — the lock syscall itself failed (e.g. ENOLCK
+            # on an flock-less mount). Surface the real failure rather
+            # than a misleading "already running".
+            os.close(fd)
+            raise
+        try:
+            same = os.stat(lock_path).st_ino == os.fstat(fd).st_ino
+        except FileNotFoundError:
+            same = False
+        if same:
+            lock_fd = fd
             break
-        except FileExistsError:
-            try:
-                stale = (time.time() - os.path.getmtime(lock_path)
-                         > LEASE_TIMEOUT_S)
-            except FileNotFoundError:
-                continue  # holder just released; retry the create
-            if stale and attempt == 0:
-                try:
-                    os.unlink(lock_path)
-                except FileNotFoundError:
-                    pass
-                continue
-            break
-    if not claimed:
+        os.close(fd)  # locked a ghost inode (file was replaced); retry
+    if lock_fd is None:
         raise WorkflowError(
             f"workflow {workflow_id!r} is already running "
             f"(live lease {lock_path})")
+    # Anything failing between the claim and the main try/finally must
+    # release the flock, or a long-lived driver process would hold the
+    # lease forever (the kernel only drops it at process exit).
+    try:
+        os.ftruncate(lock_fd, 0)
+        os.write(lock_fd, str(os.getpid()).encode())
 
-    storage.set_status(RUNNING)
-    # Lease heartbeat: while we execute, periodically refresh status.json's
-    # ts (and the lock mtime) so resume_all() can tell a live RUNNING
-    # workflow from one orphaned by a crashed process and only re-execute
-    # the latter.
-    stop_beat = threading.Event()
+        storage.set_status(RUNNING)
+        # Lease heartbeat: while we execute, periodically refresh
+        # status.json's ts so resume_all() can tell a live RUNNING
+        # workflow from one orphaned by a crashed process and only
+        # re-execute the latter. (The flock itself needs no refreshing —
+        # the kernel drops it on death.)
+        stop_beat = threading.Event()
 
-    def _beat():
-        while not stop_beat.wait(LEASE_INTERVAL_S):
-            try:
-                storage.set_status(RUNNING)
-                os.utime(lock_path)
-            except OSError:
-                return
+        def _beat():
+            while not stop_beat.wait(LEASE_INTERVAL_S):
+                try:
+                    storage.set_status(RUNNING)
+                except OSError:
+                    return
 
-    beat = threading.Thread(target=_beat, daemon=True, name="wf-lease")
-    beat.start()
+        beat = threading.Thread(target=_beat, daemon=True, name="wf-lease")
+        beat.start()
+    except BaseException:
+        os.close(lock_fd)
+        raise
 
     def _stop_beat():
         # Join before writing the terminal status: an in-flight
@@ -348,10 +364,9 @@ def run(entry: Optional[StepNode], workflow_id: Optional[str] = None) -> Any:
         # overwrite) SUCCESSFUL/FAILED. Then release the claim.
         stop_beat.set()
         beat.join()
-        try:
-            os.unlink(lock_path)
-        except FileNotFoundError:
-            pass
+        # Releases the flock; the lock file itself stays (see claim
+        # comment: unlinking would allow two claimants on two inodes).
+        os.close(lock_fd)
 
     try:
         value = _execute_node(entry, storage, inflight={})

@@ -148,3 +148,30 @@ def _recv_exact(s, n):
         assert chunk, "server closed early"
         buf += chunk
     return buf
+
+
+def test_oversized_hello_rejected_before_read():
+    """A pre-auth peer claiming a multi-GB HELLO body must be cut off at
+    the header — the server may not buffer attacker-sized payloads before
+    the token check (ADVICE r3, medium)."""
+    server, loop = _run_server(token="tok-big")
+    try:
+        host, port = server.address
+        s = socket.create_connection((host, port))
+        # HELLO header with a 2 GB length; send only a little data after.
+        s.sendall(rpc._HDR.pack(rpc.HELLO, rpc.ENC_MSGPACK, 2 << 30, 0))
+        try:
+            s.sendall(b"x" * 4096)
+        except (ConnectionResetError, BrokenPipeError):
+            pass  # server already hung up on the header — that's the point
+        # Server must cut the connection without waiting for 2 GB; a
+        # clean FIN reads b"", an RST (unread bytes in the server's
+        # buffer at close) raises — both mean it hung up.
+        s.settimeout(5)
+        try:
+            assert s.recv(1) == b"", "server kept oversized handshake open"
+        except ConnectionResetError:
+            pass
+        s.close()
+    finally:
+        _stop(server, loop)
