@@ -96,6 +96,9 @@ def device_count(timeout_s: float | None = None) -> int:
         sys.stderr.write(
             f"ray_tpu: accelerator backend probe errored ({e!r}); "
             f"continuing WITHOUT accelerators.\n")
+        # Same containment as a failed probe: later in-process jax use
+        # must not dial a possibly-dead tunnel either.
+        _pin_cpu_platform()
         _cached = 0
         return 0
 

@@ -8,10 +8,14 @@ from dataclasses import dataclass
 
 @dataclass
 class DataContext:
-    # Streaming backpressure: max map-task outputs in flight per stage
-    # (reference: backpressure policies under
+    # Streaming backpressure: max map tasks in flight per operator
+    # (reference: ConcurrencyCapBackpressurePolicy under
     # _internal/execution/backpressure_policy/).
     max_in_flight_blocks: int = 4
+    # Max completed-but-unconsumed blocks buffered per operator output
+    # (reference: OutputBufferBackpressurePolicy). Together these bound
+    # total in-flight data at O(depth * (tasks + buffer)) blocks.
+    max_buffered_blocks: int = 8
     # Target rows per block for sources that chunk.
     target_block_rows: int = 1000
     # "cpu" -> subprocess workers (production); "device" -> in-process

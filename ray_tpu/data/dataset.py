@@ -30,30 +30,44 @@ from .context import DataContext
 # ---------------------------------------------------------------------------
 class _Stage:
     def __init__(self, kind: str, fn: Callable | None = None,
-                 batch_size: Optional[int] = None):
-        self.kind = kind  # map_rows | map_batches | filter | flat_map
+                 batch_size: Optional[int] = None,
+                 pool: int = 1, ctor_args: tuple = (),
+                 ctor_kwargs: dict | None = None):
+        self.kind = kind  # map_rows | map_batches | filter | flat_map |
+        #                   actor_map (stateful pool; fn is a class)
         self.fn = fn
         self.batch_size = batch_size
+        self.pool = pool
+        self.ctor_args = ctor_args
+        self.ctor_kwargs = ctor_kwargs or {}
+
+
+def _apply_batched(fn: Callable, blk: B.Block,
+                   batch_size: Optional[int]) -> B.Block:
+    """Apply a batch fn to a block in batch_size chunks (shared by fused
+    task-pool stages and actor-pool stages)."""
+
+    def one(chunk):
+        out = fn(chunk)
+        if not isinstance(out, dict):
+            raise TypeError(
+                "map_batches fn must return a dict of numpy arrays, "
+                f"got {type(out).__name__}")
+        return {k: np.asarray(v) for k, v in out.items()}
+
+    n = B.block_len(blk)
+    if batch_size is None or n <= batch_size:
+        return one(blk)
+    outs = [one(B.slice_block(blk, i, min(i + batch_size, n)))
+            for i in builtins.range(0, n, batch_size)]
+    return B.concat_blocks(outs)
 
 
 def _fuse(stages: list[_Stage]) -> Callable[[B.Block], B.Block]:
     """Compose stages into one Block -> Block function (operator fusion)."""
 
     def apply_map_batches(st: _Stage, blk: B.Block) -> B.Block:
-        def one(chunk):
-            out = st.fn(chunk)
-            if not isinstance(out, dict):
-                raise TypeError(
-                    "map_batches fn must return a dict of numpy arrays, "
-                    f"got {type(out).__name__}")
-            return {k: np.asarray(v) for k, v in out.items()}
-
-        n = B.block_len(blk)
-        if st.batch_size is None or n <= st.batch_size:
-            return one(blk)
-        outs = [one(B.slice_block(blk, i, min(i + st.batch_size, n)))
-                for i in builtins.range(0, n, st.batch_size)]
-        return B.concat_blocks(outs)
+        return _apply_batched(st.fn, blk, st.batch_size)
 
     def apply(blk: B.Block) -> B.Block:
         for st in stages:
@@ -225,6 +239,38 @@ def _remote_opts():
     return {"num_cpus": 1}
 
 
+class _ReadTransform:
+    """Fused read(+map) task body: parse one file AND apply the first
+    fused transform segment in the same task (the reference planner's
+    ReadOp→MapOp fusion — one task hop instead of two, and the raw
+    parsed block never re-enters the object store)."""
+
+    def __init__(self, kind, fused: Callable | None):
+        self._kind = kind
+        self._fused = fused
+        # Task-plane observability name (state API lists it).
+        self.__name__ = "_read_file" + ("+map" if fused else "")
+
+    def __call__(self, path):
+        blk = _read_file(path, self._kind)
+        return self._fused(blk) if self._fused is not None else blk
+
+
+class _ActorMapWrapper:
+    """Actor body for actor-pool map stages: instantiates the user's
+    callable class once (expensive setup amortized over all blocks sent
+    to this pool member) and applies it batch-wise to each block."""
+
+    def __init__(self, cls, ctor_args, ctor_kwargs, batch_size):
+        self._fn = cls(*ctor_args, **ctor_kwargs)
+        self._bs = batch_size
+
+    def apply(self, blk):
+        if not B.block_len(blk):
+            return {}
+        return _apply_batched(self._fn, blk, self._bs)
+
+
 class Dataset:
     """Lazy dataset: a source of blocks + a chain of transform stages.
 
@@ -241,22 +287,42 @@ class Dataset:
 
     def __init__(self, source: Optional[Callable[[], Iterator[B.Block]]] = None,
                  stages: Optional[list[_Stage]] = None,
-                 ref_source: Optional[Callable[[], Iterator]] = None):
-        if (source is None) == (ref_source is None):
-            raise ValueError("exactly one of source/ref_source required")
+                 ref_source: Optional[Callable[[], Iterator]] = None,
+                 read_plan: Optional[tuple] = None):
+        if sum(x is not None
+               for x in (source, ref_source, read_plan)) != 1:
+            raise ValueError(
+                "exactly one of source/ref_source/read_plan required")
         self._source = source
         self._ref_source = ref_source
+        self._read_plan = read_plan  # (files, kind): fusable read tasks
         self._stages = stages or []
 
     # -- transforms (lazy) -------------------------------------------------
     def _with(self, stage: _Stage) -> "Dataset":
         return Dataset(self._source, self._stages + [stage],
-                       ref_source=self._ref_source)
+                       ref_source=self._ref_source,
+                       read_plan=self._read_plan)
 
     def map(self, fn) -> "Dataset":
         return self._with(_Stage("map_rows", fn))
 
-    def map_batches(self, fn, *, batch_size: Optional[int] = None) -> "Dataset":
+    def map_batches(self, fn, *, batch_size: Optional[int] = None,
+                    concurrency: Optional[int] = None,
+                    fn_constructor_args: tuple = (),
+                    fn_constructor_kwargs: Optional[dict] = None) -> "Dataset":
+        """Batch transform. A CLASS ``fn`` runs on an actor pool of
+        ``concurrency`` members — setup (model weights etc.) paid once
+        per actor, not per batch (reference: ActorPoolMapOperator via
+        map_batches(Cls, concurrency=N))."""
+        if isinstance(fn, type):
+            return self._with(_Stage(
+                "actor_map", fn, batch_size, pool=concurrency or 1,
+                ctor_args=fn_constructor_args,
+                ctor_kwargs=fn_constructor_kwargs))
+        if fn_constructor_args or fn_constructor_kwargs:
+            raise ValueError(
+                "fn_constructor_args requires a class-based fn")
         return self._with(_Stage("map_batches", fn, batch_size))
 
     def filter(self, fn) -> "Dataset":
@@ -300,7 +366,8 @@ class Dataset:
         Driver-local value sources keep the cheap inline path."""
         import ray_tpu
 
-        if self._ref_source is None and not self._stages:
+        if (self._ref_source is None and self._read_plan is None
+                and not self._stages):
             refs, lens, samples = [], [], []
             for blk in self.iter_blocks():
                 refs.append(ray_tpu.put(blk))
@@ -453,38 +520,88 @@ class Dataset:
         return Dataset(ref_source=source)
 
     # -- execution ---------------------------------------------------------
+    def _compiled(self):
+        """Logical plan -> (lazy source iterator, physical operator
+        specs) for the streaming executor.
+
+        Optimizer rules (reference: the logical-plan optimizer under
+        _internal/logical/ + operator fusion in the physical planner):
+          1. consecutive stateless stages fuse into ONE task-pool map
+             (``_fuse``) — actor stages are fusion barriers;
+          2. for read_plan sources, the first fused map segment rides
+             INSIDE the read task (Read→Map fusion: one task hop, no
+             intermediate block in the store).
+        """
+        from .execution import ActorPoolSpec, MapSpec
+
+        segments: list = []
+        cur: list[_Stage] = []
+        for st in self._stages:
+            if st.kind == "actor_map":
+                if cur:
+                    segments.append(("map", cur))
+                    cur = []
+                segments.append(("actor", st))
+            else:
+                cur.append(st)
+        if cur:
+            segments.append(("map", cur))
+
+        specs = []
+        if self._read_plan is not None:
+            files, kind = self._read_plan
+            fused = None
+            if segments and segments[0][0] == "map":
+                fused = _fuse(segments.pop(0)[1])
+            specs.append(MapSpec(_ReadTransform(kind, fused),
+                                 _remote_opts(),
+                                 name="ReadFiles" + ("+Map" if fused
+                                                    else "")))
+            source: Iterator = iter(files)
+        elif self._ref_source is not None:
+            source = self._ref_source()
+        else:
+            import ray_tpu
+
+            # Lazy puts: admission control in the executor paces these,
+            # so a huge local generator never floods the store.
+            source = (ray_tpu.put(b) for b in self._source()
+                      if B.block_len(b))
+        for seg_kind, payload in segments:
+            if seg_kind == "map":
+                specs.append(MapSpec(_fuse(payload), _remote_opts(),
+                                     name="MapBlocks"))
+            else:
+                st = payload
+                specs.append(ActorPoolSpec(
+                    _ActorMapWrapper, st.pool, _remote_opts(),
+                    ctor_args=(st.fn, st.ctor_args, st.ctor_kwargs,
+                               st.batch_size),
+                    name=f"ActorMap({getattr(st.fn, '__name__', '?')}"
+                         f"x{st.pool})"))
+        return source, specs
+
     def iter_refs(self) -> Iterator:
         """Yield ObjectRefs of this dataset's (transformed) blocks.
 
-        The fused transform chain runs as remote tasks consuming upstream
-        REFS directly — for task-produced sources (file reads, exchanges)
-        no block bytes ever pass through the driver (reference:
-        streaming_executor.py:57 operators exchange refs, not values).
-        Submission is bounded by DataContext.max_in_flight_blocks.
+        Execution is the streaming operator topology (execution.py):
+        bounded task pools + bounded ordered buffers per operator,
+        consumer-paced admission — total in-flight data is O(pipeline
+        depth × bounds) regardless of dataset size, and block bytes
+        never transit the driver for task-produced sources (reference:
+        streaming_executor.py:57).
         """
-        import ray_tpu
-
-        ctx = DataContext.get_current()
-        if self._ref_source is not None:
-            upstream = self._ref_source()
-        else:
-            upstream = (ray_tpu.put(b) for b in self._source()
-                        if B.block_len(b))
-        if not self._stages:
-            yield from upstream
+        source, specs = self._compiled()
+        if not specs:
+            yield from source
             return
-        fused = _fuse(self._stages)
-        transform = ray_tpu.remote(**_remote_opts())(fused)
-        window: list = []
-        for ref in upstream:
-            window.append(transform.remote(ref))
-            if len(window) >= ctx.max_in_flight_blocks:
-                yield window.pop(0)
-        yield from window
+        from .execution import StreamingExecutor
+
+        yield from StreamingExecutor(source, specs).run()
 
     def iter_blocks(self) -> Iterator[B.Block]:
         """Streaming execution with bounded in-flight transform tasks."""
-        if self._ref_source is None and not self._stages:
+        if self._source is not None and not self._stages:
             # Driver-local source, no transforms: no task round trip.
             yield from (b for b in self._source() if B.block_len(b))
             return
@@ -809,15 +926,10 @@ def _read_files(paths, kind) -> Dataset:
     cluster's workers and the driver only ever holds refs. ``kind``:
     format name or a path->arrow-table callable."""
     files = _expand_paths(paths)
-
-    def ref_source():
-        import ray_tpu
-
-        read = ray_tpu.remote(**_remote_opts())(_read_file)
-        for f in files:
-            yield read.remote(f, kind)
-
-    return Dataset(ref_source=ref_source)
+    # A read_plan (not a pre-submitted ref generator) lets the optimizer
+    # fuse the first transform segment into the read tasks and lets the
+    # executor pace read submission by downstream demand.
+    return Dataset(read_plan=(files, kind))
 
 
 def read_parquet(paths) -> Dataset:

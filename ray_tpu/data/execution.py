@@ -1,0 +1,284 @@
+"""Streaming operator-topology executor for ray_tpu.data.
+
+Capability parity target: the reference's streaming execution engine —
+`python/ray/data/_internal/execution/streaming_executor.py:57,99,242`
+(scheduling loop over an operator Topology), `operators/
+task_pool_map_operator.py` / `actor_pool_map_operator.py`, and the
+backpressure policies under `_internal/execution/backpressure_policy/`.
+
+Shape: a Dataset's logical plan compiles to a chain of physical
+operators (task-pool maps, actor-pool maps) fed by a lazy block-ref
+source.  One driver-side scheduling loop owns the whole topology:
+
+  * every operator has a bounded input queue, a bounded task pool and a
+    bounded ordered output buffer — the three knobs that keep total
+    in-flight data O(pipeline depth × bounds), independent of dataset
+    size (the larger-than-RAM contract);
+  * the loop only pulls another block from the source when the first
+    operator has room (backpressure propagates upstream queue by
+    queue, exactly the reference's ConcurrencyCapBackpressurePolicy +
+    OutputBufferBackpressurePolicy composition);
+  * completed outputs move downstream the moment they finish; the final
+    operator's buffer yields to the consumer in input order, and the
+    loop parks (waits on task completion) only when it can make no
+    other progress.
+
+Everything here moves OBJECT REFS — block bytes live in the shm object
+store / remote nodes and never transit the driver (the consumer gets
+refs; `Dataset.iter_blocks` resolves them one at a time).
+
+TPU-first notes: blocks are dict-of-numpy (host) precisely so the LAST
+hop can be `jax.device_put` with a `NamedSharding` straight into device
+HBM (`Dataset.iter_batches(sharding=...)`); the executor keeps enough
+read/transform tasks in flight to hide host-side parse latency behind
+device steps without unbounded prefetch.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Iterator, Optional
+
+from .context import DataContext
+
+__all__ = [
+    "MapSpec", "ActorPoolSpec", "StreamingExecutor",
+]
+
+
+class MapSpec:
+    """Task-pool map operator: each input block ref becomes one remote
+    task running ``fn`` (the FUSED Block->Block function).  Reference:
+    TaskPoolMapOperator."""
+
+    def __init__(self, fn: Callable, opts: dict, name: str = "Map"):
+        self.fn = fn
+        self.opts = opts
+        self.name = name
+
+
+class ActorPoolSpec:
+    """Actor-pool map operator: ``cls`` is instantiated ``pool_size``
+    times as actors (expensive setup — model weights, tokenizers — paid
+    once per actor, not per block); blocks dispatch to the least-loaded
+    actor.  Reference: ActorPoolMapOperator (`actor_pool_map_operator.py`),
+    created by `map_batches(Cls, concurrency=N)`."""
+
+    def __init__(self, cls: type, pool_size: int, opts: dict,
+                 ctor_args: tuple = (), ctor_kwargs: dict | None = None,
+                 name: str = "ActorMap"):
+        self.cls = cls
+        self.pool_size = max(1, int(pool_size))
+        self.opts = opts
+        self.ctor_args = ctor_args
+        self.ctor_kwargs = ctor_kwargs or {}
+        self.name = name
+
+
+class _OpState:
+    """Runtime state of one physical operator in the topology."""
+
+    def __init__(self, spec, index: int, ctx: DataContext):
+        self.spec = spec
+        self.index = index
+        self.inq: collections.deque = collections.deque()  # (seq, ref)
+        self.inflight: dict[Any, int] = {}                  # out_ref -> seq
+        self.outbuf: dict[int, Any] = {}                    # seq -> ref
+        self.next_emit = 0         # next seq owed downstream (ordering)
+        self.submitted = 0
+        self.max_tasks = ctx.max_in_flight_blocks
+        self.max_outbuf = ctx.max_buffered_blocks
+        # lazily-built executable handle (remote fn / actor pool)
+        self._remote = None
+        self._actors: list = []
+        self._actor_load: list[int] = []
+        self._ref_actor: dict[Any, int] = {}
+
+    # -- submission ------------------------------------------------------
+    def can_submit(self) -> bool:
+        return (bool(self.inq)
+                and len(self.inflight) < self.max_tasks
+                and len(self.outbuf) + len(self.inflight) < self.max_outbuf)
+
+    def submit_one(self) -> None:
+        import ray_tpu
+
+        seq, ref = self.inq.popleft()
+        spec = self.spec
+        if isinstance(spec, MapSpec):
+            if self._remote is None:
+                self._remote = ray_tpu.remote(**spec.opts)(spec.fn)
+            out = self._remote.remote(ref)
+        else:  # ActorPoolSpec
+            if not self._actors:
+                acls = ray_tpu.remote(**spec.opts)(spec.cls)
+                for _ in range(spec.pool_size):
+                    self._actors.append(
+                        acls.remote(*spec.ctor_args, **spec.ctor_kwargs))
+                self._actor_load = [0] * len(self._actors)
+            i = min(range(len(self._actors)),
+                    key=lambda j: self._actor_load[j])
+            self._actor_load[i] += 1
+            # Dispatch method is `apply` (actor handles don't proxy
+            # dunders like __call__).
+            out = self._actors[i].apply.remote(ref)
+            self._ref_actor[out] = i
+        self.inflight[out] = seq
+        self.submitted += 1
+
+    def complete(self, out_ref) -> None:
+        seq = self.inflight.pop(out_ref)
+        i = self._ref_actor.pop(out_ref, None)
+        if i is not None:
+            self._actor_load[i] -= 1
+        self.outbuf[seq] = out_ref
+
+    def pop_ready(self) -> Optional[tuple[int, Any]]:
+        """The next in-input-order completed (seq, ref), if finished."""
+        if self.next_emit in self.outbuf:
+            seq = self.next_emit
+            self.next_emit += 1
+            return seq, self.outbuf.pop(seq)
+        return None
+
+    def has_room(self) -> bool:
+        """May more input be queued here? This is the backpressure edge:
+        a full operator refuses upstream emits, which fills the upstream
+        buffers, which (at the head) stops source admission."""
+        return (len(self.inq) + len(self.inflight) + len(self.outbuf)
+                < self.max_outbuf + self.max_tasks)
+
+    def idle(self) -> bool:
+        return not (self.inq or self.inflight or self.outbuf)
+
+    def shutdown(self) -> None:
+        if self._actors:
+            import ray_tpu
+
+            for a in self._actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:  # noqa: BLE001 - already dead
+                    pass
+            self._actors = []
+
+
+class StreamingExecutor:
+    """Drives a source of block refs through a chain of operators.
+
+    Consumer-driven: `run()` is a generator; each `next()` advances the
+    scheduling loop until the next IN-ORDER final output ref is ready.
+    While the consumer holds a yielded ref, the loop is parked — so a
+    slow consumer throttles the whole pipeline (no unbounded buffering
+    anywhere).  Reference: StreamingExecutor.run / scheduling loop at
+    streaming_executor.py:99,242.
+    """
+
+    def __init__(self, source: Iterator, specs: list,
+                 ctx: Optional[DataContext] = None):
+        self._source = source
+        self._ctx = ctx or DataContext.get_current()
+        self._ops = [_OpState(s, i, self._ctx)
+                     for i, s in enumerate(specs)]
+        self._source_done = False
+        self._pulled = 0
+        self.stats: dict = {"ops": [getattr(s, "name", "?") for s in specs]}
+
+    # -- scheduling loop --------------------------------------------------
+    def _pull_source(self) -> bool:
+        """Admit one more source block if the head op has room."""
+        if self._source_done:
+            return False
+        head = self._ops[0] if self._ops else None
+        if head is not None and not head.has_room():
+            return False  # head is full: backpressure reaches the source
+        if head is None and len(self._tail_out) >= self._ctx.max_buffered_blocks:
+            return False  # consumer-paced even with no operators
+        try:
+            ref = next(self._source)
+        except StopIteration:
+            self._source_done = True
+            return False
+        if head is None:
+            # No operators: the source IS the output (seqs unused).
+            self._tail_out.append(ref)
+        else:
+            head.inq.append((self._pulled, ref))
+        self._pulled += 1
+        return True
+
+    def _advance(self) -> bool:
+        """One pass of the loop. Returns True if any progress was made."""
+        progress = False
+        # Move completed outputs downstream (in order, op by op), but
+        # only into operators/buffers with room — the emit edge is where
+        # backpressure propagates.
+        for i, op in enumerate(self._ops):
+            while True:
+                nxt = self._ops[i + 1] if i + 1 < len(self._ops) else None
+                if nxt is not None and not nxt.has_room():
+                    break
+                if nxt is None and (len(self._tail_out)
+                                    >= self._ctx.max_buffered_blocks):
+                    break
+                item = op.pop_ready()
+                if item is None:
+                    break
+                seq, ref = item
+                if nxt is not None:
+                    nxt.inq.append((seq, ref))
+                else:
+                    self._tail_out.append(ref)
+                progress = True
+        # Submit wherever there is room (downstream ops first: draining
+        # late stages frees room that propagates backwards).
+        for op in reversed(self._ops):
+            while op.can_submit():
+                op.submit_one()
+                progress = True
+        # Admit more input.
+        while self._pull_source():
+            progress = True
+        return progress
+
+    def _poll(self, timeout: float) -> bool:
+        """Wait for at least one in-flight task to finish; mark it."""
+        import ray_tpu
+
+        pending = [r for op in self._ops for r in op.inflight]
+        if not pending:
+            return False
+        ready, _ = ray_tpu.wait(pending, num_returns=1, timeout=timeout)
+        done_any = False
+        for r in ready:
+            for op in self._ops:
+                if r in op.inflight:
+                    op.complete(r)
+                    done_any = True
+                    break
+        return done_any
+
+    def run(self) -> Iterator:
+        """Yield final output refs in input order."""
+        self._tail_out: collections.deque = collections.deque()
+        try:
+            while True:
+                while self._tail_out:
+                    yield self._tail_out.popleft()
+                self._advance()
+                if self._tail_out:
+                    continue
+                if (self._source_done
+                        and all(op.idle() for op in self._ops)):
+                    return
+                if not self._poll(timeout=5.0):
+                    # No tasks in flight yet nothing advanced: the source
+                    # is momentarily dry or ops are blocked on each other;
+                    # loop again (advance() will pull / submit).
+                    if (self._source_done
+                            and all(op.idle() for op in self._ops)
+                            and not self._tail_out):
+                        return
+        finally:
+            for op in self._ops:
+                op.shutdown()

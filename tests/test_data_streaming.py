@@ -1,0 +1,211 @@
+"""Streaming operator-topology executor: bounded memory, actor pools,
+ordering, read fusion.
+
+Parity model: the reference's StreamingExecutor + backpressure policies
+(/root/reference/python/ray/data/_internal/execution/streaming_executor.py:57,
+backpressure_policy/) and ActorPoolMapOperator
+(operators/actor_pool_map_operator.py). The headline contract (VERDICT
+r3 item 3): a dataset much larger than the driver's memory budget
+streams read→map→consume with peak storage bounded by the pipeline's
+backpressure knobs, NOT by dataset size.
+"""
+
+import os
+import uuid
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rt_data
+from ray_tpu.data.context import DataContext
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    ctx = DataContext.get_current()
+    old_lane = ctx.execution_lane
+    ctx.execution_lane = "device"  # in-process: no 2.5s worker forks
+    try:
+        yield
+    finally:
+        ctx.execution_lane = old_lane
+        ray_tpu.shutdown()
+
+
+def _shm_bytes(session_dirs):
+    total = 0
+    for d in session_dirs:
+        try:
+            for name in os.listdir(d):
+                try:
+                    total += os.path.getsize(os.path.join(d, name))
+                except OSError:
+                    pass
+        except OSError:
+            pass
+    return total
+
+
+def _produce(i, rows, cols):
+    # ~rows*cols*8 bytes per block, produced IN A TASK (driver never
+    # holds the dataset).
+    return {"x": np.full((rows, cols), i, dtype=np.float64),
+            "i": np.full(rows, i, dtype=np.int64)}
+
+
+def test_larger_than_budget_streams_bounded(rt):
+    """64 x ~4MB blocks (256MB total) stream through produce→map→consume
+    while peak shm stays under a budget set by the backpressure knobs —
+    an order of magnitude below the dataset size."""
+    n_blocks, rows, cols = 64, 4096, 128  # 4 MiB per block
+    block_bytes = rows * cols * 8
+    ctx = DataContext.get_current()
+    old = (ctx.max_in_flight_blocks, ctx.max_buffered_blocks)
+    ctx.max_in_flight_blocks, ctx.max_buffered_blocks = 2, 3
+    try:
+        produce = ray_tpu.remote(scheduling_strategy="device")(_produce)
+
+        def ref_source():
+            for i in range(n_blocks):
+                yield produce.remote(i, rows, cols)
+
+        ds = rt_data.Dataset(ref_source=ref_source).map_batches(
+            lambda b: {"x": b["x"] * 2.0, "i": b["i"]})
+
+        import glob
+        import resource
+
+        # Device-lane blocks live in the node's in-memory object table
+        # (driver RSS); shm carries pins/spill. Bound BOTH: unbounded
+        # buffering would hold ~the whole dataset in one or the other.
+        dirs = glob.glob("/dev/shm/rtpu-*")
+        rss0 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss  # KiB
+        peak_shm = 0
+        seen = 0
+        total = 0.0
+        for blk in ds.iter_blocks():
+            seen += len(blk["i"])
+            total += float(blk["x"][0, 0])
+            peak_shm = max(peak_shm, _shm_bytes(dirs))
+        rss_growth = (resource.getrusage(
+            resource.RUSAGE_SELF).ru_maxrss - rss0) * 1024
+        assert seen == n_blocks * rows
+        assert total == sum(2.0 * i for i in range(n_blocks))
+        dataset_bytes = n_blocks * block_bytes
+        held = peak_shm + rss_growth
+        assert held < dataset_bytes // 2, (
+            f"peak held {held / 1e6:.0f}MB (shm {peak_shm / 1e6:.0f} + rss "
+            f"growth {rss_growth / 1e6:.0f}) for a "
+            f"{dataset_bytes / 1e6:.0f}MB dataset — streaming is not "
+            f"bounded by the backpressure knobs")
+    finally:
+        ctx.max_in_flight_blocks, ctx.max_buffered_blocks = old
+
+
+class _Embedder:
+    """Stateful map_batches callable: expensive setup per POOL MEMBER,
+    not per block. Each instantiation drops a marker file."""
+
+    def __init__(self, marker_dir, scale):
+        with open(os.path.join(marker_dir, uuid.uuid4().hex), "w"):
+            pass
+        self.scale = scale
+
+    def __call__(self, batch):
+        return {"y": batch["x"] * self.scale}
+
+
+def test_actor_pool_map_operator(rt, tmp_path):
+    marker = str(tmp_path)
+    ds = rt_data.from_items(
+        [{"x": float(i)} for i in range(40)],
+        override_num_blocks=8,
+    ).map_batches(_Embedder, concurrency=2,
+                  fn_constructor_args=(marker, 10.0))
+    out = sorted(r["y"] for r in ds.iter_rows())
+    assert out == [10.0 * i for i in range(40)]
+    setups = len(os.listdir(marker))
+    assert 1 <= setups <= 2, f"expected <=2 actor setups, saw {setups}"
+
+
+def test_actor_pool_then_map_chain(rt, tmp_path):
+    """Actor stage is a fusion barrier; stages after it run as their own
+    task-pool operator, all inside one streaming topology."""
+    ds = (rt_data.range_(30, override_num_blocks=6)
+          .map_batches(lambda b: {"x": b["id"].astype(np.float64)})
+          .map_batches(_Embedder, concurrency=2,
+                       fn_constructor_args=(str(tmp_path), 2.0))
+          .map_batches(lambda b: {"y": b["y"] + 1.0}))
+    assert sorted(r["y"] for r in ds.iter_rows()) == [
+        2.0 * i + 1.0 for i in range(30)]
+
+
+def test_ordering_preserved_under_variable_latency(rt):
+    def jitter(b):
+        import time
+
+        time.sleep(float(np.random.default_rng(int(b["id"][0])).uniform(
+            0, 0.05)))
+        return b
+
+    ds = rt_data.from_items(
+        [{"id": i} for i in range(24)], override_num_blocks=24,
+    ).map_batches(jitter)
+    ids = [r["id"] for r in ds.iter_rows()]
+    assert ids == list(range(24)), "streaming output must preserve order"
+
+
+def test_read_map_fusion_single_task_hop(rt, tmp_path):
+    """read_parquet -> map_batches fuses into one task per file (the
+    optimizer's Read+Map rule): no separate MapBlocks task runs."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    for i in range(4):
+        pq.write_table(pa.table({"x": np.arange(5) + i * 5}),
+                       str(tmp_path / f"p{i}.parquet"))
+    ds = rt_data.read_parquet(str(tmp_path)).map_batches(
+        lambda b: {"x": b["x"] * 3})
+    assert sorted(r["x"] for r in ds.iter_rows()) == [
+        3 * v for v in range(20)]
+
+    from ray_tpu.util import state as state_api
+
+    names = [t.get("name") or "" for t in state_api.list_tasks(limit=1000)]
+    fused = [n for n in names if "_read_file+map" in n]
+    plain_maps = [n for n in names if "MapBlocks" in n or n == "apply"]
+    assert len(fused) == 4, f"expected 4 fused read+map tasks: {names}"
+    assert not plain_maps, f"map should have fused into reads: {names}"
+
+
+def test_backpressure_admission_is_lazy(rt):
+    """The source generator is pulled on demand, never drained eagerly:
+    with tight knobs, admissions stay within the topology's capacity
+    while the consumer holds the first block."""
+    ctx = DataContext.get_current()
+    old = (ctx.max_in_flight_blocks, ctx.max_buffered_blocks)
+    ctx.max_in_flight_blocks, ctx.max_buffered_blocks = 1, 2
+    pulled = []
+    try:
+        def source():
+            for i in range(100):
+                pulled.append(i)
+                yield {"x": np.array([float(i)])}
+
+        ds = rt_data.Dataset(source=source).map_batches(
+            lambda b: {"x": b["x"] + 1})
+        it = ds.iter_blocks()
+        first = next(it)
+        assert first["x"][0] == 1.0
+        # Head capacity: inq+inflight+outbuf < buffer+tasks (=3) per op,
+        # 2 ops + tail buffer (2) + the consumed one => far below 100.
+        assert len(pulled) <= 12, (
+            f"source over-pulled: {len(pulled)} admissions with capacity ~8")
+        rest = sum(1 for _ in it)
+        assert rest == 99
+        assert len(pulled) == 100
+    finally:
+        ctx.max_in_flight_blocks, ctx.max_buffered_blocks = old
