@@ -107,6 +107,8 @@ class Config:
     kv_max_value_bytes: int = _cfg(512 * 1024 * 1024)
     # Multi-node: head bind host, node heartbeat cadence, death detection.
     head_host: str = _cfg("127.0.0.1")
+    # Append-log head persistence: full-snapshot compaction cadence.
+    head_log_compact_every: int = _cfg(512)
     heartbeat_interval_s: float = _cfg(0.25)
     node_death_timeout_s: float = _cfg(3.0)
     node_register_timeout_s: float = _cfg(30.0)

@@ -97,7 +97,12 @@ class HeadService:
         self._local_node_service = None  # driver node (in-process)
         if store is None:
             path = os.environ.get("RT_HEAD_PERSIST")
-            store = FileHeadStore(path) if path else InMemoryHeadStore()
+            # Default durable backend is the append-log store: O(delta)
+            # per mutation + periodic compaction (FileHeadStore remains
+            # available for tooling that wants one-file snapshots).
+            from .head_store import AppendLogHeadStore
+
+            store = AppendLogHeadStore(path) if path else InMemoryHeadStore()
         self.store = store
         # Snapshot writes happen off the event loop; one thread keeps
         # them ordered (last save wins on disk as it does in memory).
@@ -110,6 +115,9 @@ class HeadService:
         self._persist_lock = threading.Lock()
         self._persist_pending = None
         self._persist_inflight = False
+        # Append-capable stores take O(delta) per mutation; a periodic
+        # full snapshot compacts the log (head_store.AppendLogHeadStore).
+        self._appends_since_snapshot = 0
         self._replay()
         self.server = DuplexServer(
             (self.cfg.head_host, port), self._handle_rpc, self._on_disconnect)
@@ -138,6 +146,30 @@ class HeadService:
                 strategy=row["strategy"], state="PENDING",
                 ready_event=asyncio.Event())
             self.placement_groups[pg.pg_id] = pg
+
+    def _persist_delta(self, kind: str, rec):
+        """O(delta) persistence for one mutation. Falls back to a full
+        snapshot for stores without append support; compacts the log
+        every head_log_compact_every appends."""
+        if self._closing or self._persist_pool is None:
+            return
+        if not getattr(self.store, "supports_append", False):
+            self._persist()
+            return
+        self._appends_since_snapshot += 1
+        if self._appends_since_snapshot >= self.cfg.head_log_compact_every:
+            self._appends_since_snapshot = 0
+            self._persist()
+            return
+        self._persist_pool.submit(self._append_safe, kind, rec)
+
+    def _append_safe(self, kind, rec):
+        try:
+            self.store.append(kind, rec)
+        except Exception as e:  # noqa: BLE001 - same contract as writes
+            import sys
+
+            sys.stderr.write(f"head persistence append failed: {e}\n")
 
     def _persist(self):
         if self._closing or self._persist_pool is None:
@@ -203,11 +235,13 @@ class HeadService:
                       conn: Optional[ServerConn],
                       is_driver: bool = False,
                       node_type: Optional[str] = None,
-                      sync: Optional[dict] = None) -> dict:
+                      sync: Optional[dict] = None,
+                      is_head_node: bool = False) -> dict:
         entry = NodeEntry(
             node_id=node_id, address=tuple(address),
             resources=dict(resources), available=dict(resources), conn=conn,
-            is_driver=is_driver, node_type=node_type)
+            is_driver=is_driver, node_type=node_type,
+            is_head_node=is_head_node)
         self.nodes[node_id] = entry
         if conn is not None:
             conn.meta["node_id"] = node_id
@@ -428,7 +462,9 @@ class HeadService:
         pg = PGEntry(pg_id=pg_id, bundles=[dict(b) for b in bundles],
                      strategy=strategy, ready_event=asyncio.Event())
         self.placement_groups[pg_id] = pg
-        self._persist()
+        self._persist_delta("pg", {"pg_id": pg_id.binary(),
+                                   "bundles": [dict(b) for b in bundles],
+                                   "strategy": strategy})
         await self._try_place_pg(pg)
         return pg
 
@@ -523,7 +559,7 @@ class HeadService:
         if pg is None:
             return
         pg.state = "REMOVED"
-        self._persist()
+        self._persist_delta("pg_del", pg_id.binary())
         for idx, nid in pg.placement.items():
             entry = self.nodes.get(nid)
             if entry is None:
@@ -595,14 +631,14 @@ class HeadService:
     def kv_op(self, op: str, key: str, val=None):
         if op == "put":
             self.kv[key] = val
-            self._persist()
+            self._persist_delta("kv", (key, val))
             return True
         if op == "get":
             return self.kv.get(key)
         if op == "del":
             existed = self.kv.pop(key, None) is not None
             if existed:
-                self._persist()
+                self._persist_delta("kv_del", key)
             return existed
         if op == "exists":
             return key in self.kv
@@ -613,7 +649,7 @@ class HeadService:
     def put_function(self, fid: str, blob) -> bool:
         if blob is not None and fid not in self.functions:
             self.functions[fid] = blob
-            self._persist()
+            self._persist_delta("fn", (fid, blob))
         return fid in self.functions
 
     def register_named_actor(self, name: str, actor_id: ActorID,
@@ -647,7 +683,8 @@ class HeadService:
                 payload["resources"], conn,
                 is_driver=bool(payload.get("is_driver")),
                 node_type=payload.get("node_type"),
-                sync=payload.get("sync"))
+                sync=payload.get("sync"),
+                is_head_node=bool(payload.get("is_head")))
         if method == "heartbeat":
             ok = self.heartbeat(NodeID(payload["node_id"]),
                                 payload["available"],
@@ -725,6 +762,9 @@ class HeadService:
             # Let the queued (ordered) snapshot writes land.
             await self.loop.run_in_executor(
                 None, self._persist_pool.shutdown, True)
+        close = getattr(self.store, "close", None)
+        if close is not None:
+            close()
         await self.server.stop()
 
 

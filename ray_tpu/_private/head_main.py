@@ -1,0 +1,89 @@
+"""Standalone head (GCS-equivalent) process: `python -m
+ray_tpu._private.head_main`.
+
+The control plane detached from any driver: this process hosts ONLY the
+HeadService — no node service, no object store, no jax. Drivers attach
+with `ray_tpu.init(address=...)`; node daemons register via
+RT_HEAD_ADDR. Killing a driver no longer kills the cluster, and killing
+THIS process is recoverable: restart it on the same port with the same
+RT_HEAD_PERSIST path and nodes resync (tested by test_head_ft.py /
+test_head_chaos.py).
+
+Reference parity: src/ray/gcs/gcs_server/gcs_server_main.cc — the GCS
+is its own process started by `ray start --head`, with Redis-backed
+restartability (redis_store_client.h); ours persists through the
+append-log store (head_store.py).
+
+Env: RT_HEAD_PORT (default 0 = ephemeral), RT_HEAD_PERSIST (append-log
+path; unset = in-memory), RT_SESSION_TOKEN (minted if absent),
+RT_ADDR_FILE (write "host:port" here once serving, after RT_TOKEN_FILE
+gets the session token with mode 0600).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import secrets
+import signal
+import sys
+
+
+async def amain():
+    from . import rpc as _rpc
+    from .head import HeadService
+
+    token = os.environ.get("RT_SESSION_TOKEN")
+    if not token:
+        # Restart case: reuse the cluster credential from the token file
+        # so SURVIVING nodes can re-authenticate when they resync
+        # (reference: a restarted GCS keeps the cluster's Redis auth).
+        tok_path = os.environ.get("RT_TOKEN_FILE")
+        if tok_path:
+            try:
+                with open(tok_path) as f:
+                    token = f.read().strip() or None
+            except OSError:
+                token = None
+    token = token or secrets.token_hex(16)
+    os.environ["RT_SESSION_TOKEN"] = token
+    _rpc.set_session_token(token)
+
+    loop = asyncio.get_running_loop()
+    head = HeadService(
+        os.environ.get("RT_SESSION_ID", "head"), loop,
+        port=int(os.environ.get("RT_HEAD_PORT", "0")))
+    await head.start()
+    host, port = head.address
+
+    tok_path = os.environ.get("RT_TOKEN_FILE")
+    if tok_path:
+        # Credential becomes readable BEFORE the address is advertised.
+        fd = os.open(tok_path, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            f.write(token)
+    addr_path = os.environ.get("RT_ADDR_FILE")
+    if addr_path:
+        tmp = addr_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(f"{host}:{port}")
+        os.replace(tmp, addr_path)
+    print(f"head up at {host}:{port}", flush=True)
+
+    stop = asyncio.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await head.shutdown()
+
+
+def main():
+    try:
+        asyncio.run(amain())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

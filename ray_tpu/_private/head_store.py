@@ -18,12 +18,22 @@ from typing import Any, Dict, Optional
 
 
 class HeadStore:
-    """Interface: load() -> dict of tables; save(tables)."""
+    """Interface: load() -> dict of tables; save(tables) full snapshot.
+    Append-capable stores additionally take per-mutation deltas
+    (``append``) so steady-state persistence cost is O(delta), not
+    O(total state) — the property that makes a restartable head viable
+    UNDER LOAD (reference: RedisStoreClient's per-key writes vs our
+    round-3 full-snapshot-per-mutation file)."""
+
+    supports_append = False
 
     def load(self) -> Optional[Dict[str, Any]]:
         raise NotImplementedError
 
     def save(self, tables: Dict[str, Any]) -> None:
+        raise NotImplementedError
+
+    def append(self, kind: str, rec: Any) -> None:
         raise NotImplementedError
 
 
@@ -67,3 +77,144 @@ class FileHeadStore(HeadStore):
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self.path)
+
+
+class AppendLogHeadStore(HeadStore):
+    """Snapshot + mutation log (the production default for a detached
+    head).  Each control-plane mutation appends ONE length-prefixed
+    pickle record to ``<path>.log``; ``save`` compacts: atomic-replace a
+    full snapshot (stamped with the last applied record seq), then
+    truncate the log.  ``load`` reads the snapshot and re-applies log
+    records with seq greater than the snapshot's stamp — so a crash
+    between snapshot-replace and log-truncate only replays records
+    idempotently skipped by the seq check.
+
+    Record kinds are table-level CRUD (the store stays ignorant of head
+    semantics): ("kv", key, val) / ("kv_del", key) / ("fn", fid, blob) /
+    ("pg", row) / ("pg_del", pg_id_bytes).
+
+    Reference: src/ray/gcs/store_client/redis_store_client.h (per-key
+    writes + replay via gcs_init_data.h). All calls arrive on the head's
+    single persist thread, so no internal ordering races; the lock only
+    guards against load() from another process's tooling.
+    """
+
+    _KINDS = ("kv", "kv_del", "fn", "pg", "pg_del")
+
+    def __init__(self, path: str):
+        self.path = path
+        self.log_path = path + ".log"
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._log_f = None
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    supports_append = True
+
+    # -- load -------------------------------------------------------------
+    def load(self):
+        with self._lock:
+            snap_tables, base_seq = self._load_snapshot()
+            # Future appends must number AFTER the snapshot even when the
+            # log is empty (compaction + restart): otherwise new records
+            # carry seqs <= the snapshot stamp and a later load would
+            # skip them as already-folded.
+            self._seq = max(self._seq, base_seq)
+            tables = snap_tables or {"kv": {}, "functions": {},
+                                     "placement_groups": []}
+            n_applied = 0
+            for seq, kind, rec in self._read_log():
+                self._seq = max(self._seq, seq)
+                if seq <= base_seq:
+                    continue  # already folded into the snapshot
+                self._apply(tables, kind, rec)
+                n_applied += 1
+            if snap_tables is None and not n_applied:
+                return None
+            return tables
+
+    def _load_snapshot(self):
+        try:
+            with open(self.path, "rb") as f:
+                snap = pickle.load(f)
+            if isinstance(snap, dict) and "tables" not in snap \
+                    and "kv" in snap:
+                # Legacy FileHeadStore layout (bare tables pickle from
+                # before the append-log default): migrate, don't drop.
+                return snap, 0
+            return snap.get("tables"), snap.get("seq", 0)
+        except FileNotFoundError:
+            return None, 0
+        except Exception:
+            return None, 0  # corrupt snapshot: rebuild from log alone
+
+    def _read_log(self):
+        try:
+            f = open(self.log_path, "rb")
+        except FileNotFoundError:
+            return
+        with f:
+            while True:
+                hdr = f.read(4)
+                if len(hdr) < 4:
+                    return
+                ln = int.from_bytes(hdr, "little")
+                body = f.read(ln)
+                if len(body) < ln:
+                    return  # torn tail record (crash mid-append): drop
+                try:
+                    yield pickle.loads(body)
+                except Exception:
+                    return
+
+    @staticmethod
+    def _apply(tables, kind, rec):
+        tables.setdefault("kv", {})
+        tables.setdefault("functions", {})
+        tables.setdefault("placement_groups", [])
+        if kind == "kv":
+            tables["kv"][rec[0]] = rec[1]
+        elif kind == "kv_del":
+            tables["kv"].pop(rec, None)
+        elif kind == "fn":
+            tables["functions"][rec[0]] = rec[1]
+        elif kind == "pg":
+            pgs = [p for p in tables["placement_groups"]
+                   if p["pg_id"] != rec["pg_id"]]
+            pgs.append(rec)
+            tables["placement_groups"] = pgs
+        elif kind == "pg_del":
+            tables["placement_groups"] = [
+                p for p in tables["placement_groups"]
+                if p["pg_id"] != rec]
+
+    # -- writes -----------------------------------------------------------
+    def append(self, kind, rec):
+        if kind not in self._KINDS:
+            raise ValueError(kind)
+        with self._lock:
+            self._seq += 1
+            body = pickle.dumps((self._seq, kind, rec))
+            if self._log_f is None:
+                self._log_f = open(self.log_path, "ab")
+            self._log_f.write(len(body).to_bytes(4, "little") + body)
+            self._log_f.flush()
+
+    def save(self, tables):
+        """Full snapshot + log truncation (compaction)."""
+        tmp = f"{self.path}.tmp-{os.getpid()}"
+        with self._lock:
+            with open(tmp, "wb") as f:
+                pickle.dump({"tables": tables, "seq": self._seq}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            if self._log_f is not None:
+                self._log_f.close()
+            self._log_f = open(self.log_path, "wb")  # truncate
+
+    def close(self):
+        with self._lock:
+            if self._log_f is not None:
+                self._log_f.close()
+                self._log_f = None

@@ -30,6 +30,11 @@ from .object_store import make_store
 
 
 async def amain():
+    # Manual node bring-up against a CLI-started head: the cluster
+    # credential lives in the env or the head's token file.
+    from . import rpc as _rpc
+
+    _rpc.discover_session_token()
     head_host, head_port = os.environ["RT_HEAD_ADDR"].rsplit(":", 1)
     head_addr = (head_host, int(head_port))
     session_id = os.environ["RT_SESSION_ID"]
@@ -79,7 +84,8 @@ async def amain():
                 try:
                     await attach_node_to_head(
                         node, head_addr, resources, node_type=node_type,
-                        on_lost=on_head_lost, start=False)
+                        on_lost=on_head_lost, start=False,
+                        is_head_node=bool(os.environ.get("RT_NODE_IS_HEAD")))
                     sys.stderr.write(f"node {node_id.hex()[:12]}: "
                                      f"re-registered with head\n")
                     return
@@ -93,9 +99,12 @@ async def amain():
         finally:
             reconnecting["active"] = False
 
-    await attach_node_to_head(node, head_addr, resources,
-                              node_type=node_type,
-                              on_lost=on_head_lost)
+    await attach_node_to_head(
+        node, head_addr, resources, node_type=node_type,
+        on_lost=on_head_lost,
+        # The node daemon co-located with a detached head registers as
+        # the cluster's head node (rtpu start --head sets this).
+        is_head_node=bool(os.environ.get("RT_NODE_IS_HEAD")))
     sys.stderr.write(f"node {node_id.hex()[:12]} up: peer={node.peer_address} "
                      f"resources={resources}\n")
     # Park forever; work arrives via the peer server / head pushes.

@@ -60,7 +60,8 @@ PENDING, READY, ERROR = "PENDING", "READY", "ERROR"
 async def attach_node_to_head(node: "NodeService", head_addr: tuple,
                               resources: dict, *, is_driver: bool = False,
                               node_type: str = None, on_lost=None,
-                              start: bool = True):
+                              start: bool = True,
+                              is_head_node: bool = False):
     """Shared node bring-up against a remote head: dial, wire head pushes,
     start the node, register, and install the re-register callback.
     Used by both the standalone node daemon (node_main.py) and attaching
@@ -93,6 +94,7 @@ async def attach_node_to_head(node: "NodeService", head_addr: tuple,
             "address": node.peer_address,
             "resources": dict(resources),
             "is_driver": is_driver,
+            "is_head": is_head_node,
             "node_type": node_type,
             # Live state for head-restart reconciliation (reference:
             # raylet resync after NotifyGCSRestart).
@@ -289,6 +291,13 @@ class NodeService:
         self.peer_conns: dict[NodeID, ServerConn] = {}
         self.dead_nodes: set[NodeID] = set()
         self._pending_remote: collections.deque = collections.deque()
+        # Strong refs for fire-and-forget tasks: asyncio only weakly
+        # references tasks, so an un-referenced pending task (an
+        # in-flight _execute_remotely, a result ingest) can be GARBAGE
+        # COLLECTED mid-await — observed as silently lost task replies
+        # under the head-restart chaos test. spawn() parks every such
+        # task until it completes.
+        self._spawned_tasks: set = set()
 
         # Device lane: tasks with TPU resources (or strategy "device").
         self.device_pool = ThreadPoolExecutor(
@@ -323,16 +332,16 @@ class NodeService:
         await self.server.start()
         await self.peer_server.start()
         self._bg_tasks.append(
-            self.loop.create_task(self._log_tail_loop()))
+            self.spawn(self._log_tail_loop()))
         self._bg_tasks.append(
-            self.loop.create_task(self._result_pin_sweep_loop()))
+            self.spawn(self._result_pin_sweep_loop()))
         if self.cfg.memory_monitor_interval_s > 0:
             self._bg_tasks.append(
-                self.loop.create_task(self._memory_monitor_loop()))
+                self.spawn(self._memory_monitor_loop()))
         if self.head is not None:
-            self._bg_tasks.append(self.loop.create_task(self._heartbeat_loop()))
+            self._bg_tasks.append(self.spawn(self._heartbeat_loop()))
             self._bg_tasks.append(
-                self.loop.create_task(self._pending_remote_loop()))
+                self.spawn(self._pending_remote_loop()))
 
     @property
     def peer_address(self) -> tuple:
@@ -560,7 +569,7 @@ class NodeService:
             n = len(self._pending_remote)
             for _ in range(n):
                 spec, exclude = self._pending_remote.popleft()
-                self.loop.create_task(self._execute_remotely(spec, exclude))
+                self.spawn(self._execute_remotely(spec, exclude))
 
     async def _addr_conn(self, address: tuple) -> ServerConn:
         """Peer connection keyed by address (object-plane fetches from an
@@ -963,7 +972,7 @@ class NodeService:
                 st.borrow_owner = owner_addr
                 if not st.borrow_registered:
                     st.borrow_registered = True
-                    self.loop.create_task(
+                    self.spawn(
                         self._register_borrow(oid, owner_addr))
 
     async def _register_borrow(self, oid: ObjectID, owner_addr: tuple):
@@ -1009,14 +1018,14 @@ class NodeService:
             if st.pulled_from is not None:
                 # Foreign copy released: deregister from the owner's
                 # location directory so new pullers don't target us.
-                self.loop.create_task(
+                self.spawn(
                     self._notify_copy_removed(oid, st.pulled_from))
             if st.borrow_confirmed and st.borrow_owner is not None:
                 # Last local count on a borrowed object: release our
                 # aggregate borrow so the owner may free. (If the add is
                 # still in flight, _register_borrow sends the release on
                 # ack — a release must never overtake its registration.)
-                self.loop.create_task(
+                self.spawn(
                     self._release_borrow(oid, st.borrow_owner))
             # A freed container releases what it transitively pinned.
             for oid_b, _owner in (st.inner_refs or ()):
@@ -1086,6 +1095,13 @@ class NodeService:
     # ------------------------------------------------------------------
     # Task submission & scheduling
     # ------------------------------------------------------------------
+    def spawn(self, coro):
+        """create_task with a strong reference held until completion."""
+        t = self.loop.create_task(coro)
+        self._spawned_tasks.add(t)
+        t.add_done_callback(self._spawned_tasks.discard)
+        return t
+
     def submit(self, spec: TaskSpec) -> list[ObjectID]:
         """Register returns + route. Loop thread only."""
         rids = spec.return_ids()
@@ -1122,7 +1138,7 @@ class NodeService:
                 self._enqueue_remote_actor_task(
                     self.remote_actors[spec.actor_id], spec)
             else:
-                self.loop.create_task(self._route_unknown_actor_task(spec))
+                self.spawn(self._route_unknown_actor_task(spec))
             return
         strat = spec.strategy
         if strat.kind == "node" and strat.node_id is not None \
@@ -1133,13 +1149,13 @@ class NodeService:
                 # immediately so method calls submitted right after
                 # creation queue behind the in-flight construction
                 # instead of failing as "unknown actor".
-                self.loop.create_task(self._create_actor_remotely(spec))
+                self.spawn(self._create_actor_remotely(spec))
             else:
-                self.loop.create_task(self._execute_remotely(
+                self.spawn(self._execute_remotely(
                     spec, pin_node=NodeID(strat.node_id)))
             return
         if strat.kind == "pg" and strat.pg_id is not None:
-            self.loop.create_task(self._route_pg_task(spec))
+            self.spawn(self._route_pg_task(spec))
             return
         needs_placement = (strat.kind == "spread"
                            or not self._locally_feasible(spec)
@@ -1151,15 +1167,15 @@ class NodeService:
                                and self._lacks_lifetime_room(spec.resources)))
         if needs_placement and self.head is not None:
             if spec.is_actor_creation:
-                self.loop.create_task(self._create_actor_remotely(spec))
+                self.spawn(self._create_actor_remotely(spec))
             else:
-                self.loop.create_task(self._execute_remotely(spec))
+                self.spawn(self._execute_remotely(spec))
             return
         self._enqueue_local(spec)
 
     def _enqueue_local(self, spec: TaskSpec):
         if spec.is_actor_creation:
-            self.loop.create_task(self._create_actor(spec))
+            self.spawn(self._create_actor(spec))
         elif spec.actor_id is not None:
             self._submit_actor_task(spec)
         else:
@@ -1296,11 +1312,11 @@ class NodeService:
             if worker is None:
                 if self._should_spill(spec):
                     spec._spill_inflight = True
-                    self.loop.create_task(self._try_spill(spec))
+                    self.spawn(self._try_spill(spec))
                 else:
                     still_pending.append(spec)
                 continue
-            self.loop.create_task(self._run_on_worker(worker, spec))
+            self.spawn(self._run_on_worker(worker, spec))
         self.pending_cpu = still_pending
         for actor in self.actors.values():
             if actor.queue:
@@ -1571,7 +1587,7 @@ class NodeService:
                 # TaskCancelledError failure.
                 self._kill_worker(w, force=True)
             elif w.conn is not None and w.conn.alive:
-                self.loop.create_task(self._send_cancel(w, task_id))
+                self.spawn(self._send_cancel(w, task_id))
         self._device_interrupts.interrupt(task_id.binary(),
                                           TaskCancelledError)
         self._kick()
@@ -2024,7 +2040,7 @@ class NodeService:
         if entry.pumping or entry.state == "DEAD":
             return
         entry.pumping = True
-        self.loop.create_task(self._remote_actor_pump(entry))
+        self.spawn(self._remote_actor_pump(entry))
 
     async def _remote_actor_pump(self, entry: RemoteActorEntry):
         """Forward queued actor tasks in submission order. Requests are
@@ -2058,7 +2074,7 @@ class NodeService:
                 # Let the write go out before sending the next (ordering);
                 # the reply resolves in its own task (pipelining).
                 await asyncio.sleep(0)
-                self.loop.create_task(self._finish_remote_actor_task(
+                self.spawn(self._finish_remote_actor_task(
                     entry, spec, fut))
         finally:
             entry.pumping = False
@@ -2290,7 +2306,7 @@ class NodeService:
         # pull them chunked into the local store before/while the task is
         # queued (the dispatch path waits on local dep readiness).
         for dep_bin, src in (payload.get("ref_sources") or {}).items():
-            self.loop.create_task(
+            self.spawn(
                 self.ensure_object(ObjectID(dep_bin), tuple(src)))
         self.counters["remote_tasks_received"] += 1
         rids = self.submit(spec)
@@ -2475,7 +2491,7 @@ class NodeService:
         pending = list(self._pending_actor_creations)
         self._pending_actor_creations.clear()
         for spec in pending:
-            self.loop.create_task(self._create_actor(spec))
+            self.spawn(self._create_actor(spec))
 
     def _actor_alive(self, actor: ActorState):
         if actor.state == "DEAD":
@@ -2517,7 +2533,7 @@ class NodeService:
             except (ConnectionLost, OSError):
                 pass
 
-        self.loop.create_task(do())
+        self.spawn(do())
 
     def _actor_creation_failed(self, actor: ActorState, err):
         if not isinstance(err, TaskError):
@@ -2565,7 +2581,7 @@ class NodeService:
                     spec, pool=actor.device_pool, instance=actor.instance, actor=actor
                 )
             else:
-                self.loop.create_task(self._run_actor_task(actor, spec))
+                self.spawn(self._run_actor_task(actor, spec))
 
     async def _run_actor_task(self, actor: ActorState, spec: TaskSpec):
         worker = actor.worker
@@ -2967,7 +2983,7 @@ class NodeService:
             for b, owner in zip(payload["oids"],
                                 payload.get("owners") or []):
                 if owner is not None:
-                    self.loop.create_task(
+                    self.spawn(
                         self.ensure_object(ObjectID(b), tuple(owner)))
             num_returns = payload["num_returns"]
             timeout = payload.get("timeout")

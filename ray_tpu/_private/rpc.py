@@ -83,9 +83,51 @@ Address = Union[str, tuple]  # unix path | (host, port)
 _session_token = os.environ.get("RT_SESSION_TOKEN", "")
 
 
+# asyncio holds only WEAK references to tasks: a fire-and-forget
+# handler task with no other reference can be garbage-collected while
+# still pending (observed under chaos: replies silently never sent).
+# Every fire-and-forget task must be parked here until done.
+_bg_tasks: set = set()
+
+
+def _keep_task(task):
+    _bg_tasks.add(task)
+    task.add_done_callback(_bg_tasks.discard)
+    return task
+
+
 def set_session_token(token: str):
     global _session_token
     _session_token = token or ""
+
+
+def discover_session_token(required: bool = False) -> str | None:
+    """Resolve the cluster credential for a process joining an existing
+    cluster: RT_SESSION_TOKEN env wins, else the head's token file
+    (RT_TOKEN_FILE, else the default temp dir written by `rtpu start
+    --head`) — the analogue of finding /tmp/ray session files. On
+    success the token is installed (env + module global) so children
+    inherit it."""
+    token = os.environ.get("RT_SESSION_TOKEN")
+    if not token:
+        for p in (os.environ.get("RT_TOKEN_FILE"),
+                  "/tmp/rtpu/session_token"):
+            if not p:
+                continue
+            try:
+                with open(p) as f:
+                    token = f.read().strip() or None
+            except OSError:
+                continue
+            if token:
+                break
+    if token:
+        os.environ["RT_SESSION_TOKEN"] = token
+        set_session_token(token)
+    elif required:
+        raise AuthError("no cluster session token (set RT_SESSION_TOKEN "
+                        "or RT_TOKEN_FILE)")
+    return token
 
 
 def get_session_token() -> str:
@@ -445,7 +487,7 @@ async def _peer_read_loop(conn: ServerConn, reader: asyncio.StreamReader,
             body = _decode_body(enc, await reader.readexactly(plen))
             if kind == REQ:
                 method, payload = body
-                asyncio.ensure_future(serve(method, payload, seq))
+                _keep_task(asyncio.ensure_future(serve(method, payload, seq)))
             elif kind == RESP:
                 fut = conn._pending.pop(seq, None)
                 if fut and not fut.done():

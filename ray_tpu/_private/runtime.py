@@ -79,7 +79,12 @@ class Runtime:
 
         from . import rpc as _rpc
 
-        token = os.environ.get("RT_SESSION_TOKEN") or secrets.token_hex(16)
+        token = os.environ.get("RT_SESSION_TOKEN")
+        if not token and address is not None:
+            # Attaching without an explicit credential: shared discovery
+            # (env, then the head's token file).
+            token = _rpc.discover_session_token()
+        token = token or secrets.token_hex(16)
         os.environ["RT_SESSION_TOKEN"] = token  # children inherit
         _rpc.set_session_token(token)
         self.job_id = JobID.from_random()
@@ -196,14 +201,46 @@ class Runtime:
         # A driver's workers log to THIS driver's console (not the head's).
         node.is_driver_node = True
 
+        reconnecting = {"active": False}
+
         async def on_head_lost(conn):
-            if getattr(self, "_shut", False):
-                return  # our own shutdown closed it
-            # The cluster is gone. Unlike the node daemon (which exits),
-            # a library must not kill the user's process: tear the
-            # runtime down so subsequent API calls fail fast, and leave
-            # the process alive.
-            sys.stderr.write("ray_tpu: head connection lost; shutting "
+            if getattr(self, "_shut", False) or reconnecting["active"]:
+                return  # our own shutdown closed it / already retrying
+            # The head may be RESTARTING (reference: drivers survive a
+            # GCS restart like raylets do, resyncing via
+            # NotifyGCSRestart): retry the dial for the grace period
+            # before declaring the cluster gone. In-flight tasks on
+            # worker nodes keep running either way — results ride peer
+            # connections, not the head.
+            reconnecting["active"] = True
+            try:
+                from .rpc import ConnectionLost
+
+                grace = self.cfg.head_reconnect_grace_s
+                sys.stderr.write(
+                    f"ray_tpu: head connection lost; retrying for "
+                    f"{grace:.0f}s\n")
+                deadline = self.loop.time() + grace
+                while self.loop.time() < deadline:
+                    if getattr(self, "_shut", False):
+                        return
+                    try:
+                        await attach_node_to_head(
+                            node, self._attach_addr, self._resources,
+                            is_driver=True, on_lost=on_head_lost,
+                            start=False)
+                        sys.stderr.write(
+                            "ray_tpu: re-registered with restarted head\n")
+                        return
+                    except (OSError, ConnectionLost):
+                        await asyncio.sleep(1.0)
+            finally:
+                reconnecting["active"] = False
+            # Grace exhausted: the cluster is gone. Unlike the node
+            # daemon (which exits), a library must not kill the user's
+            # process: tear the runtime down so later API calls fail
+            # fast, and leave the process alive.
+            sys.stderr.write("ray_tpu: head did not come back; shutting "
                              "down this driver's runtime\n")
             threading.Thread(target=self.shutdown, daemon=True).start()
 
@@ -343,7 +380,7 @@ class Runtime:
             # Foreign-owned refs: pull copies from their owners first.
             for r in refs:
                 if is_foreign(r):
-                    self.loop.create_task(
+                    self.node.spawn(
                         self.node.ensure_object(r.id, r.owner_addr, timeout))
             for r in refs:
                 # Unknown id => nothing will ever produce it (e.g. a ref from
@@ -412,7 +449,7 @@ class Runtime:
         async def do():
             for r in refs:
                 if r.owner_addr is not None and tuple(r.owner_addr) != my_addr:
-                    self.loop.create_task(
+                    self.node.spawn(
                         self.node.ensure_object(r.id, r.owner_addr))
             oids = [r.id for r in refs]
             deadline = None if timeout is None else self.loop.time() + timeout
@@ -466,7 +503,7 @@ class Runtime:
 
         async def do():
             if owner_addr is not None and tuple(owner_addr) != self.node_addr:
-                self.loop.create_task(self.node.ensure_object(oid, owner_addr))
+                self.node.spawn(self.node.ensure_object(oid, owner_addr))
             st = await self.node.wait_object(oid)
             return st
 

@@ -31,8 +31,10 @@ from .context import DataContext
 class _Stage:
     def __init__(self, kind: str, fn: Callable | None = None,
                  batch_size: Optional[int] = None,
-                 pool: int = 1, ctor_args: tuple = (),
+                 pool: int = 0, ctor_args: tuple = (),
                  ctor_kwargs: dict | None = None):
+        # pool: actor_map -> pool size (>=1); other kinds -> requested
+        # task concurrency, 0 = unspecified (DataContext default).
         self.kind = kind  # map_rows | map_batches | filter | flat_map |
         #                   actor_map (stateful pool; fn is a class)
         self.fn = fn
@@ -323,7 +325,10 @@ class Dataset:
         if fn_constructor_args or fn_constructor_kwargs:
             raise ValueError(
                 "fn_constructor_args requires a class-based fn")
-        return self._with(_Stage("map_batches", fn, batch_size))
+        # For plain fns, concurrency bounds the task pool of the fused
+        # operator this stage lands in (reference honors it for both).
+        return self._with(_Stage("map_batches", fn, batch_size,
+                                 pool=concurrency or 0))
 
     def filter(self, fn) -> "Dataset":
         return self._with(_Stage("filter", fn))
@@ -569,8 +574,10 @@ class Dataset:
                       if B.block_len(b))
         for seg_kind, payload in segments:
             if seg_kind == "map":
+                conc = max((st.pool for st in payload), default=0) or None
                 specs.append(MapSpec(_fuse(payload), _remote_opts(),
-                                     name="MapBlocks"))
+                                     name="MapBlocks",
+                                     max_concurrency=conc))
             else:
                 st = payload
                 specs.append(ActorPoolSpec(

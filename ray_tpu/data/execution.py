@@ -51,10 +51,14 @@ class MapSpec:
     task running ``fn`` (the FUSED Block->Block function).  Reference:
     TaskPoolMapOperator."""
 
-    def __init__(self, fn: Callable, opts: dict, name: str = "Map"):
+    def __init__(self, fn: Callable, opts: dict, name: str = "Map",
+                 max_concurrency: Optional[int] = None):
         self.fn = fn
         self.opts = opts
         self.name = name
+        # Per-operator override of DataContext.max_in_flight_blocks
+        # (map_batches(..., concurrency=N)).
+        self.max_concurrency = max_concurrency
 
 
 class ActorPoolSpec:
@@ -86,8 +90,9 @@ class _OpState:
         self.outbuf: dict[int, Any] = {}                    # seq -> ref
         self.next_emit = 0         # next seq owed downstream (ordering)
         self.submitted = 0
-        self.max_tasks = ctx.max_in_flight_blocks
-        self.max_outbuf = ctx.max_buffered_blocks
+        self.max_tasks = (getattr(spec, "max_concurrency", None)
+                          or ctx.max_in_flight_blocks)
+        self.max_outbuf = max(ctx.max_buffered_blocks, self.max_tasks)
         # lazily-built executable handle (remote fn / actor pool)
         self._remote = None
         self._actors: list = []

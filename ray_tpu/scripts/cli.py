@@ -100,83 +100,75 @@ def cmd_start(args):
 
 
 def _start_head(args):
-    if args.block:
-        return _head_daemon(args)
-    env = dict(os.environ)
-    cmd = [sys.executable, "-m", "ray_tpu.scripts.cli"]
-    if args.temp_dir:
-        cmd += ["--temp-dir", args.temp_dir]  # top-level flag: before `start`
-    cmd += ["start", "--head", "--block", "--port", str(args.port),
-            "--num-cpus", str(args.num_cpus)]
-    if args.num_tpus is not None:
-        cmd += ["--num-tpus", str(args.num_tpus)]
-    if args.resources:
-        cmd += ["--resources", args.resources]
+    """Bring up a DETACHED control plane: the head is its own minimal
+    process (head_main: no node service, no driver, no jax) plus a node
+    daemon contributing this machine's resources. Driver death can no
+    longer take the cluster down, and the head can be killed/restarted
+    on the same port + persist path with nodes resyncing (reference:
+    `ray start --head` starting gcs_server as a separate process,
+    services.py:1421)."""
     addr_file = _address_file(args)
     try:
         os.unlink(addr_file)
     except FileNotFoundError:
         pass
+    env = dict(os.environ)
+    env["RT_HEAD_PORT"] = str(args.port)
+    env.setdefault(
+        "RT_HEAD_PERSIST", os.path.join(_temp_dir(args), "head_state.bin"))
+    env["RT_ADDR_FILE"] = addr_file
+    env["RT_TOKEN_FILE"] = _token_file(args)
+    env.setdefault("RT_SESSION_ID", f"cli-{os.getpid():x}")
     log = open(os.path.join(_temp_dir(args), "head.log"), "ab")
-    proc = subprocess.Popen(cmd, env=env, stdout=log, stderr=log,
-                            start_new_session=True)
-    _record_pid(args, proc.pid)
+    head_proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.head_main"],
+        env=env, stdout=log, stderr=log, start_new_session=True)
+    _record_pid(args, head_proc.pid)  # first pid == the head
+
+    addr = None
     deadline = time.monotonic() + 60
     while time.monotonic() < deadline:
         if os.path.exists(addr_file):
             with open(addr_file) as f:
                 addr = f.read().strip()
             if addr:
-                print(f"head started at {addr} (pid {proc.pid})")
-                print(f"attach with: ray_tpu.init(address=\"{addr}\") or "
-                      f"RT_ADDRESS={addr}")
-                return
-        if proc.poll() is not None:
-            sys.exit(f"head process exited rc={proc.returncode}; see "
-                     f"{log.name}")
+                break
+        if head_proc.poll() is not None:
+            sys.exit(f"head process exited rc={head_proc.returncode}; "
+                     f"see {log.name}")
         time.sleep(0.1)
-    sys.exit("timed out waiting for the head to come up")
+    if not addr:
+        sys.exit("timed out waiting for the head to come up")
+
+    # The local node daemon (this machine's capacity), attached like any
+    # worker node. Session token comes from the head's token file.
+    with open(_token_file(args)) as f:
+        env["RT_SESSION_TOKEN"] = f.read().strip()
+    env["RT_NODE_IS_HEAD"] = "1"
+    node_args = argparse.Namespace(**vars(args))
+    node_args.address = addr
+    _start_worker_node(node_args, env=env)
+
+    print(f"head started at {addr} (pid {head_proc.pid})")
+    print(f"attach with: ray_tpu.init(address=\"{addr}\") or "
+          f"RT_ADDRESS={addr}")
+    if args.block:
+        # Foreground semantics: Ctrl-C / SIGTERM stops the WHOLE cluster
+        # (the daemons run in their own sessions and would otherwise
+        # survive as orphans — e.g. outliving a container's PID 1).
+        def bye(*_):
+            cmd_stop(args)
+            sys.exit(0)
+
+        signal.signal(signal.SIGTERM, bye)
+        signal.signal(signal.SIGINT, bye)
+        head_proc.wait()
 
 
-def _head_daemon(args):
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    os.environ["RT_HEAD_PORT"] = str(args.port)
-    # Durable head tables (KV, functions, PG definitions): a head
-    # restarted on the same port replays them and worker nodes resync
-    # (reference: Redis-backed GCS fault tolerance).
-    os.environ.setdefault(
-        "RT_HEAD_PERSIST", os.path.join(_temp_dir(args), "head_state.bin"))
-    import ray_tpu
-
-    resources = json.loads(args.resources) if args.resources else None
-    rt = ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus,
-                      resources=resources)
-    host, port = rt.head_address
-    # Token file (0600) BEFORE the address file: by the time attachers see
-    # the address, the credential is readable.
-    tok_path = _token_file(args)
-    fd = os.open(tok_path, os.O_CREAT | os.O_WRONLY | os.O_TRUNC, 0o600)
-    with os.fdopen(fd, "w") as f:
-        f.write(os.environ["RT_SESSION_TOKEN"])
-    with open(_address_file(args), "w") as f:
-        f.write(f"{host}:{port}")
-    print(f"head up at {host}:{port}", flush=True)
-    stop = {"flag": False}
-
-    def bye(*_):
-        stop["flag"] = True
-
-    signal.signal(signal.SIGTERM, bye)
-    signal.signal(signal.SIGINT, bye)
-    while not stop["flag"]:
-        time.sleep(0.2)
-    ray_tpu.shutdown()
-
-
-def _start_worker_node(args):
-    _load_token(args)
+def _start_worker_node(args, env=None):
+    if env is None:
+        _load_token(args)
+        env = dict(os.environ)
     addr = _resolve_address(args)
     resources = json.loads(args.resources) if args.resources else {}
     resources.setdefault("CPU", args.num_cpus)
@@ -190,7 +182,7 @@ def _start_worker_node(args):
         n = device_count()
         if n:
             resources["TPU"] = float(n)
-    env = dict(os.environ)
+    env = dict(env)
     env["RT_HEAD_ADDR"] = addr
     env["RT_SESSION_ID"] = env.get("RT_SESSION_ID", "cli")
     env["RT_NODE_RESOURCES"] = json.dumps(resources)

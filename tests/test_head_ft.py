@@ -170,7 +170,9 @@ def test_head_restart_cluster_survives(tmp_path):
                        env=env, check=True, timeout=90)
 
     def script(code):
-        e = dict(env, RT_ADDRESS=f"127.0.0.1:{port}")
+        e = dict(env, RT_ADDRESS=f"127.0.0.1:{port}",
+                 RT_TOKEN_FILE=os.path.join(temp, "session_token"))
+        e.pop("RT_SESSION_TOKEN", None)  # token comes from the file
         return subprocess.run([sys.executable, "-c", code], env=e,
                               capture_output=True, text=True, timeout=90)
 
@@ -178,7 +180,10 @@ def test_head_restart_cluster_survives(tmp_path):
     try:
         # A worker node that must survive the head restart.
         node_env = dict(env, RT_HEAD_ADDR=f"127.0.0.1:{port}",
-                        RT_SESSION_ID="headft", RT_NODE_RESOURCES='{"CPU": 1, "x": 1}')
+                        RT_SESSION_ID="headft",
+                        RT_NODE_RESOURCES='{"CPU": 1, "x": 1}',
+                        RT_TOKEN_FILE=os.path.join(temp, "session_token"))
+        node_env.pop("RT_SESSION_TOKEN", None)
         node = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.node_main"],
             env=node_env)
