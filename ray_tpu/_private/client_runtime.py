@@ -1,0 +1,266 @@
+"""Client-mode runtime: `ray_tpu.init(address="rtpu://host:port")`.
+
+A drop-in context implementation whose every operation is proxied over
+one authenticated TCP connection to a dedicated cluster-side session
+host (client_host.py). Because the context protocol is the narrow waist
+of the whole API, tasks, actors, placement groups, the KV, the state
+API — and libraries built on them (data, tune, workflow) — work
+unchanged from a process that shares NOTHING with the cluster (no
+filesystem, no shm, no node service): the reference's Ray Client
+out-of-trust-domain model (python/ray/util/client/,
+src/ray/protobuf/ray_client.proto:326).
+
+Differences from a local driver by design:
+  * objects live in the session host's registry; `get` ships value bytes
+    over the proxy connection (no zero-copy shm);
+  * device-lane fast paths serialize (no in-process device arrays);
+  * the session dies with the connection — cluster-side cleanup is the
+    proxy's kill of the host process.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Any, Optional, Sequence
+
+import cloudpickle
+
+from .exceptions import GetTimeoutError
+from .ids import ActorID, JobID, ObjectID, PlacementGroupID
+from .object_ref import ObjectRef
+from .rpc import DuplexClient
+
+SCHEME = "rtpu://"
+
+
+class ClientRuntime:
+    """One per client process; context-protocol over the proxy."""
+
+    is_client = True
+
+    def __init__(self, address: str, show_logs: bool = True,
+                 runtime_env: dict | None = None):
+        from ray_tpu import runtime_env as _re
+
+        hostport = address[len(SCHEME):] if address.startswith(SCHEME) \
+            else address
+        host, _, port = hostport.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"rtpu:// address must be host:port, "
+                             f"got {address!r}")
+        self._show_logs = show_logs
+        # Job-level default env, merged into every task/actor like a
+        # local driver's init(runtime_env=...).
+        self.default_runtime_env = _re.validate(runtime_env)
+        from . import rpc as _rpc
+
+        # Credential: RT_SESSION_TOKEN env, else the cluster's token
+        # file (RT_TOKEN_FILE) — same discovery as attaching drivers.
+        _rpc.discover_session_token()
+        self._conn = DuplexClient((host, int(port)), self._on_push,
+                                  handler_threads=1)
+        info = self._call("new_session", timeout=90)
+        self.job_id = JobID(info["job_id"])
+        self.session_id = info["session_id"]
+        # The session host's identity — truthful answers for
+        # get_runtime_context() in client mode.
+        from .ids import NodeID, WorkerID
+
+        self.node_id = NodeID(info["node_id"])
+        self.worker_id = WorkerID(info["worker_id"])
+        self._decref_buf: list[bytes] = []
+        self._decref_lock = threading.Lock()
+        self._decref_timer: Optional[threading.Timer] = None
+
+    # -- pushes from the session host ------------------------------------
+    def _on_push(self, method: str, payload):
+        if method == "log" and self._show_logs:
+            sys.stderr.write(f"(client) {payload}\n")
+        return True
+
+    def _call(self, method: str, payload=None, timeout=None):
+        """Proxied call with exception fidelity: the session host ships
+        ("ok", result) or ("err", pickled_exception); re-raise the
+        ORIGINAL exception so `except GetTimeoutError` / user error
+        types work unchanged in client mode."""
+        out = self._conn.call(method, payload, timeout=timeout)
+        if isinstance(out, tuple) and len(out) == 2 \
+                and out[0] in ("ok", "err"):
+            if out[0] == "err":
+                raise cloudpickle.loads(out[1])
+            return out[1]
+        return out
+
+    # -- context protocol -------------------------------------------------
+    @property
+    def current_task_id(self):
+        return None
+
+    @property
+    def current_actor_id(self):
+        return None
+
+    def incref(self, oid: ObjectID, owner_addr=None):
+        try:
+            self._conn.notify("incref", oid.binary())
+        except Exception:  # noqa: BLE001 - conn gone; session cleans up
+            pass
+
+    def decref(self, oid: ObjectID, owner_addr=None):
+        # Batched: ref churn (comprehensions over many refs) must not
+        # pay one proxy round per release. Releases coalesce for 50ms
+        # (or until 256 pile up), then flush as one notify.
+        with self._decref_lock:
+            self._decref_buf.append(oid.binary())
+            n = len(self._decref_buf)
+            if n >= 256:
+                self._flush_decrefs_locked()
+            elif self._decref_timer is None:
+                t = threading.Timer(0.05, self._flush_decrefs)
+                t.daemon = True
+                self._decref_timer = t
+                t.start()
+
+    def _flush_decrefs(self):
+        with self._decref_lock:
+            self._flush_decrefs_locked()
+
+    def _flush_decrefs_locked(self):
+        buf, self._decref_buf = self._decref_buf, []
+        if self._decref_timer is not None:
+            self._decref_timer.cancel()
+            self._decref_timer = None
+        if not buf:
+            return
+        try:
+            self._conn.notify("decref_batch", buf)
+        except Exception:  # noqa: BLE001 - conn gone; session cleans up
+            pass
+
+    def export_function(self, fn) -> str:
+        from .task_spec import export_function
+
+        fid, blob = export_function(fn)
+        self._call("export_function", {"fid": fid, "blob": blob},
+                        timeout=60)
+        return fid
+
+    def submit_spec(self, spec) -> list[ObjectRef]:
+        ids = self._call("submit_spec", cloudpickle.dumps(spec),
+                              timeout=120)
+        return [ObjectRef(ObjectID(b), _register=False) for b in ids]
+
+    def put(self, value: Any) -> ObjectRef:
+        b = self._call("put", cloudpickle.dumps(value), timeout=120)
+        return ObjectRef(ObjectID(b), _register=False)
+
+    def get(self, refs, timeout: float | None = None):
+        single = isinstance(refs, ObjectRef)
+        items = [refs] if single else list(refs)
+        try:
+            blobs = self._call(
+                "get", {"ids": [r.id.binary() for r in items],
+                        "timeout": timeout, "is_list": not single},
+                timeout=None if timeout is None else timeout + 30)
+        except TimeoutError as e:
+            raise GetTimeoutError(str(e)) from None
+        values = [cloudpickle.loads(b) for b in blobs]
+        return values[0] if single else values
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns=1, timeout=None):
+        out = self._call(
+            "wait", {"ids": [r.id.binary() for r in refs],
+                     "num_returns": num_returns, "timeout": timeout},
+            timeout=None if timeout is None else timeout + 30)
+        ready_set = set(out["ready"])
+        ready = [r for r in refs if r.id.binary() in ready_set]
+        not_ready = [r for r in refs if r.id.binary() not in ready_set]
+        return ready, not_ready
+
+    def cancel(self, ref: ObjectRef, force=False):
+        self._call("cancel", {"id": ref.id.binary(), "force": force},
+                        timeout=30)
+
+    def kill_actor(self, actor_id: ActorID, no_restart=True):
+        self._call("kill_actor", {"actor_id": actor_id.binary(),
+                                       "no_restart": no_restart}, timeout=30)
+
+    def get_actor_by_name(self, name: str):
+        return self._call("get_actor_by_name", name, timeout=30)
+
+    def kv_op(self, op, key, val=None):
+        return self._call("kv_op", {"op": op, "key": key, "val": val},
+                               timeout=120)
+
+    def resolve_runtime_env(self, env: dict | None,
+                            device_lane: bool = False):
+        from ray_tpu import runtime_env as _re
+
+        if device_lane:
+            if _re.validate(env):
+                raise ValueError(
+                    "runtime_env is not supported on device-lane "
+                    "tasks/actors")
+            return None
+        merged = _re.merge(self.default_runtime_env, _re.validate(env))
+        if not merged:
+            return None
+        # Local paths (working_dir/py_modules) zip CLIENT-side and upload
+        # through the proxied KV — the client's files reach the cluster.
+        return _re.resolve_for_upload(merged, self.kv_op)
+
+    # -- placement groups -------------------------------------------------
+    def create_placement_group(self, bundles, strategy):
+        b = self._call("create_pg", {"bundles": bundles,
+                                          "strategy": strategy}, timeout=60)
+        return PlacementGroupID(b)
+
+    def remove_placement_group(self, pg_id):
+        self._call("remove_pg", pg_id.binary(), timeout=30)
+
+    def placement_group_state(self, pg_id):
+        return self._call("pg_state", pg_id.binary(), timeout=30)
+
+    def wait_placement_group_ready(self, pg_id, timeout=None) -> bool:
+        return self._call(
+            "pg_wait", {"pg_id": pg_id.binary(), "timeout": timeout},
+            timeout=None if timeout is None else timeout + 30)
+
+    # -- introspection ----------------------------------------------------
+    def cluster_resources(self) -> dict:
+        return self._call("cluster_resources", timeout=30)
+
+    def available_resources(self) -> dict:
+        return self._call("available_resources", timeout=30)
+
+    def list_nodes(self) -> list:
+        return self._call("list_nodes", timeout=30)
+
+    def list_placement_groups(self) -> list:
+        return self._call("list_pgs", timeout=30)
+
+    def cluster_state(self, include_events: bool = False,
+                      light: bool = False, tables=None,
+                      timeout: float = 10.0) -> dict:
+        return self._call(
+            "cluster_state", {"include_events": include_events,
+                              "light": light, "tables": tables,
+                              "timeout": timeout}, timeout=timeout + 30)
+
+    def cluster_logs(self, tail_bytes: int = 16_384,
+                     timeout: float = 15.0) -> dict:
+        return self._call(
+            "cluster_logs", {"tail_bytes": tail_bytes, "timeout": timeout},
+            timeout=timeout + 30)
+
+    def shutdown(self):
+        from . import context as context_mod
+
+        self._flush_decrefs()
+        try:
+            self._conn.close()
+        except Exception:  # noqa: BLE001
+            pass
+        if context_mod.get_context() is self:
+            context_mod.set_context(None)

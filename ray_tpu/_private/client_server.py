@@ -1,0 +1,191 @@
+"""rtpu:// client proxy server — remote drivers outside the trust
+domain of the cluster's processes.
+
+One TCP endpoint (started by `rtpu start --head`); each authenticated
+client connection gets its OWN session-host subprocess (client_host.py)
+— an isolated cluster-side driver. The proxy relays the client's
+context calls to its host and forwards the host's log pushes back; when
+the client disconnects, its host is killed, releasing every object the
+session held.
+
+Reference parity: the Ray Client server (`ray start --head` opens port
+10001; python/ray/util/client/server/server.py proxies each client to a
+dedicated "specific server" process; proto
+src/ray/protobuf/ray_client.proto:326,439,466).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import uuid
+
+
+class _Session:
+    def __init__(self, proc, host_conn, sock_path):
+        self.proc = proc
+        self.host_conn = host_conn
+        self.sock_path = sock_path
+
+
+class ClientProxy:
+    def __init__(self, head_addr: str, port: int = 0,
+                 host: str = "0.0.0.0"):
+        self.head_addr = head_addr
+        self.bind = (host, port)
+        self.sessions: dict = {}  # client ServerConn -> _Session
+        self.server = None
+
+    async def start(self):
+        from .rpc import DuplexServer
+
+        self.server = DuplexServer(self.bind, self._handle,
+                                   self._on_disconnect)
+        await self.server.start()
+        return self.server.address
+
+    async def _spawn_host(self, client_conn):
+        sock_path = os.path.join(
+            tempfile.gettempdir(), f"rtpu-client-{uuid.uuid4().hex[:10]}.sock")
+        env = dict(os.environ)
+        env["RT_ADDRESS"] = self.head_addr
+        env["RT_CLIENT_HOST_SOCK"] = sock_path
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.client_host"],
+            env=env, start_new_session=True)
+        # Host writes <sock>.ready once serving.
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + 60
+        while not os.path.exists(sock_path + ".ready"):
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"client session host died rc={proc.returncode}")
+            if loop.time() > deadline:
+                proc.kill()
+                raise RuntimeError("client session host startup timed out")
+            await asyncio.sleep(0.1)
+
+        from .rpc import async_connect
+
+        async def on_host_push(conn, method, payload):
+            # Log stream (and any future host pushes) -> the client.
+            try:
+                await client_conn.notify(method, payload)
+            except Exception:  # noqa: BLE001 - client gone; reaper handles
+                pass
+            return True
+
+        async def on_host_lost(conn):
+            await client_conn.close()  # host died: drop the client too
+
+        try:
+            host_conn = await async_connect(sock_path, on_host_push,
+                                            on_host_lost)
+            await host_conn.call("subscribe_logs")
+        except BaseException:
+            # The host process is already running: failing to wire it up
+            # must not strand it.
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            raise
+        return _Session(proc, host_conn, sock_path)
+
+    async def _handle(self, conn, method: str, payload):
+        if method == "new_session":
+            if conn in self.sessions:
+                raise RuntimeError("session already established")
+            sess = await self._spawn_host(conn)
+            if not conn.alive:
+                # Client vanished during the spawn: its disconnect event
+                # already fired (and found nothing) — reap NOW or the
+                # session host leaks forever.
+                await self._reap(sess)
+                raise RuntimeError("client disconnected during session "
+                                   "startup")
+            self.sessions[conn] = sess
+            return await sess.host_conn.call("session_info")
+        sess = self.sessions.get(conn)
+        if sess is None:
+            raise RuntimeError("no session (send new_session first)")
+        return await sess.host_conn.call(method, payload)
+
+    async def _on_disconnect(self, conn):
+        sess = self.sessions.pop(conn, None)
+        if sess is None:
+            return
+        await self._reap(sess)
+
+    async def _reap(self, sess: _Session):
+        try:
+            await sess.host_conn.close()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            os.killpg(sess.proc.pid, signal.SIGTERM)
+        except OSError:
+            pass
+        for p in (sess.sock_path, sess.sock_path + ".ready"):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        # Escalate if the host ignores SIGTERM.
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + 10
+        while sess.proc.poll() is None and loop.time() < deadline:
+            await asyncio.sleep(0.2)
+        if sess.proc.poll() is None:
+            try:
+                os.killpg(sess.proc.pid, signal.SIGKILL)
+            except OSError:
+                pass
+
+    async def stop(self):
+        for sess in list(self.sessions.values()):
+            await self._reap(sess)
+        self.sessions.clear()
+        if self.server is not None:
+            await self.server.stop()
+
+
+async def amain():
+    from . import rpc as _rpc
+
+    _rpc.discover_session_token()
+    proxy = ClientProxy(
+        os.environ["RT_ADDRESS"],
+        port=int(os.environ.get("RT_CLIENT_PORT", "0")),
+        host=os.environ.get("RT_CLIENT_HOST", "0.0.0.0"))
+    addr = await proxy.start()
+    addr_file = os.environ.get("RT_CLIENT_ADDR_FILE")
+    if addr_file:
+        tmp = addr_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(f"{addr[0]}:{addr[1]}")
+        os.replace(tmp, addr_file)
+    print(f"client server up at rtpu://{addr[0]}:{addr[1]}", flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await proxy.stop()
+
+
+def main():
+    try:
+        asyncio.run(amain())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -731,12 +731,36 @@ class HeadService:
             nid = self.actor_nodes.get(ActorID(payload))
             return nid.binary() if nid is not None else None
         if method == "worker_logs":
-            # Remote node streaming its workers' output: render on the
-            # driver (this head process) console.
+            # Remote node streaming its workers' output. Render here (the
+            # head console) AND push to every attached driver — with a
+            # detached head, the consoles users watch are the drivers'
+            # (incl. rtpu:// client session hosts), not this process's
+            # log file (reference: log_monitor publish + driver-side
+            # subscription).
             from .node_service import _print_worker_logs
 
-            _print_worker_logs(NodeID(payload["node_id"]).hex(),
-                               payload["entries"])
+            node_hex = NodeID(payload["node_id"]).hex()
+            _print_worker_logs(node_hex, payload["entries"])
+            from .rpc import _keep_task
+
+            for e in self.nodes.values():
+                if not (e.is_driver and e.state == ALIVE
+                        and e.conn is not None and e.conn.alive):
+                    continue
+                # Per-driver routing: each entry goes only to the driver
+                # whose tasks produced it (owner == that driver's node
+                # id); unattributed lines broadcast. Keeps one client
+                # session's output off other sessions' consoles
+                # (reference: per-job log subscription).
+                mine = e.node_id.binary()
+                text = "".join(
+                    f"(pid={entry['pid']}, node={node_hex[:8]}) {line}\n"
+                    for entry in payload["entries"]
+                    if entry.get("owner") in (None, mine)
+                    for line in entry["lines"])
+                if text:
+                    _keep_task(asyncio.ensure_future(
+                        e.conn.notify("log", text)))
             return True
         if method == "list_nodes":
             return [e.to_row() for e in self.nodes.values()]

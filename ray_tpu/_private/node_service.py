@@ -175,6 +175,9 @@ class WorkerHandle:
     # if the worker dies without dropping them.
     held_refs: collections.Counter = field(
         default_factory=collections.Counter)
+    # Node id (bytes) of the driver that owns the task this worker is
+    # (last) running: routes its log lines to that driver's console.
+    owner_node: Optional[bytes] = None
 
 
 @dataclass
@@ -794,6 +797,10 @@ class NodeService:
         if method == "node_dead":
             await self._on_node_dead(NodeID(payload["node_id"]),
                                      payload.get("cause", ""))
+        elif method == "log":
+            # Cluster worker output relayed via the head to this
+            # attached driver's console.
+            sys.stderr.write(payload)
         elif method == "reserve_bundle":
             self.reserve_bundle(PlacementGroupID(payload["pg_id"]),
                                 payload["bundle_index"], payload["resources"])
@@ -1475,6 +1482,7 @@ class NodeService:
         return w
 
     async def _run_on_worker(self, worker: WorkerHandle, spec: TaskSpec):
+        worker.owner_node = getattr(spec, "_owner_node", None)
         worker.inflight[spec.task_id] = spec
         self._event(spec, "RUNNING", worker=f"worker:{worker.proc.pid}")
         try:
@@ -2302,6 +2310,10 @@ class NodeService:
         freed once the reply ships."""
         spec: TaskSpec = payload["spec"]
         spec._remote = True
+        # Owner attribution for log routing: this spec's output belongs
+        # on the submitting driver's console (reference: per-job log
+        # subscription), not on every driver's.
+        spec._owner_node = payload.get("owner")
         # Large REF args arrive unresolved with their source addresses:
         # pull them chunked into the local store before/while the task is
         # queued (the dispatch path waits on local dep readiness).
@@ -2809,14 +2821,17 @@ class NodeService:
                     cut = len(data) - 1
                 w.log_offset += cut + 1
                 lines = data[:cut + 1].decode("utf-8", "replace").splitlines()
-                batch.append({"pid": w.proc.pid, "lines": lines})
+                batch.append({"pid": w.proc.pid, "lines": lines,
+                              "owner": w.owner_node})
             if not batch:
                 continue
-            if self.is_head_node or self.head is None \
-                    or getattr(self, "is_driver_node", False):
-                # Head AND attached drivers print their own workers'
-                # output locally — a driver's tasks belong on THAT
-                # driver's console, not the head's.
+            if (self.head is None
+                    or getattr(self, "is_driver_node", False)
+                    or not hasattr(self.head, "push_worker_logs")):
+                # Drivers (attached or fused-head LocalHeadClient) print
+                # their own workers' output locally — a driver's tasks
+                # belong on THAT driver's console. Daemon nodes forward
+                # to the head, which relays to every attached driver.
                 _print_worker_logs(self.node_id.hex(), batch)
             else:
                 try:

@@ -39,6 +39,19 @@ def init(num_cpus=None, num_tpus=None, resources=None, system_config=None,
 
     if address is None:
         address = os.environ.get("RT_ADDRESS") or None
+    if isinstance(address, str) and address.startswith("rtpu://"):
+        # Out-of-trust-domain client session: every context call proxies
+        # to a dedicated cluster-side session host (reference: Ray
+        # Client, ray://host:10001).
+        if num_cpus is not None or num_tpus is not None or resources:
+            raise ValueError(
+                "num_cpus/num_tpus/resources don't apply to rtpu:// "
+                "client sessions — the client contributes no capacity")
+        from ._private.client_runtime import ClientRuntime
+
+        crt = ClientRuntime(address, runtime_env=runtime_env)
+        context_mod.set_context(crt)
+        return crt
     rt = Runtime(num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
                  system_config=system_config, address=address,
                  runtime_env=runtime_env)
@@ -52,8 +65,8 @@ def is_initialized() -> bool:
 
 def shutdown():
     ctx = context_mod.get_context()
-    if isinstance(ctx, Runtime):
-        ctx.shutdown()
+    if ctx is not None and hasattr(ctx, "shutdown"):
+        ctx.shutdown()  # Runtime or ClientRuntime (closes the session)
     context_mod.set_context(None)
 
 

@@ -149,6 +149,18 @@ def _start_head(args):
     node_args.address = addr
     _start_worker_node(node_args, env=env)
 
+    # rtpu:// client proxy (reference: the Ray Client server on 10001).
+    cenv = dict(env)
+    cenv["RT_ADDRESS"] = addr
+    cenv["RT_CLIENT_PORT"] = str(getattr(args, "client_port", 0) or 0)
+    cenv["RT_CLIENT_ADDR_FILE"] = os.path.join(_temp_dir(args),
+                                               "client_address")
+    clog = open(os.path.join(_temp_dir(args), "client_server.log"), "ab")
+    cproc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.client_server"],
+        env=cenv, stdout=clog, stderr=clog, start_new_session=True)
+    _record_pid(args, cproc.pid)
+
     print(f"head started at {addr} (pid {head_proc.pid})")
     print(f"attach with: ray_tpu.init(address=\"{addr}\") or "
           f"RT_ADDRESS={addr}")
@@ -489,6 +501,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--num-cpus", type=int, default=os.cpu_count() or 1)
     sp.add_argument("--num-tpus", type=int, default=None)
     sp.add_argument("--resources", default=None, help="JSON dict")
+    sp.add_argument("--client-port", type=int, default=0,
+                    help="rtpu:// client server port (0 = ephemeral; "
+                         "written to <temp>/client_address)")
     sp.add_argument("--block", action="store_true",
                     help="run in the foreground")
     sp.set_defaults(fn=cmd_start)

@@ -1,0 +1,157 @@
+"""rtpu:// client sessions: out-of-trust-domain remote drivers.
+
+Parity model: Ray Client (/root/reference/python/ray/util/client/,
+src/ray/protobuf/ray_client.proto:326 RayletDriver, :466 LogStreamer;
+server python/ray/util/client/server/server.py). VERDICT r3 item 6's
+"Done": a client process sharing NOTHING with the cluster but a TCP
+address + credential (separate process, no shared tmp files) runs
+tasks/actors end-to-end, with isolated per-client sessions and log
+streaming.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    temp = str(tmp_path_factory.mktemp("rtpu-cluster"))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("RT_SESSION_TOKEN", None)
+    cli = [sys.executable, "-m", "ray_tpu.scripts.cli", "--temp-dir", temp]
+    subprocess.run(cli + ["start", "--head", "--num-cpus", "2"],
+                   env=env, check=True, timeout=90)
+    deadline = time.time() + 30
+    caddr_file = os.path.join(temp, "client_address")
+    while not os.path.exists(caddr_file) and time.time() < deadline:
+        time.sleep(0.2)
+    assert os.path.exists(caddr_file), "client server never came up"
+    with open(caddr_file) as f:
+        caddr = f.read().strip()
+    with open(os.path.join(temp, "session_token")) as f:
+        token = f.read().strip()
+    yield {"addr": caddr, "token": token, "env": env, "temp": temp}
+    subprocess.run(cli + ["stop"], env=env, timeout=60)
+
+
+def _client(cluster, code, timeout=120):
+    """Run `code` in a process that shares NOTHING with the cluster
+    except the rtpu:// address and the credential: its tmp is elsewhere
+    and it holds no cluster files."""
+    import tempfile
+
+    own_tmp = tempfile.mkdtemp(prefix="client-own-")
+    env = dict(cluster["env"],
+               RT_SESSION_TOKEN=cluster["token"],
+               RT_CLIENT_ADDR=f"rtpu://{cluster['addr']}",
+               TMPDIR=own_tmp)
+    env.pop("RT_TOKEN_FILE", None)
+    env.pop("RT_ADDRESS", None)
+    return subprocess.run([sys.executable, "-u", "-c", code], env=env,
+                          capture_output=True, text=True, timeout=timeout)
+
+
+_E2E = """
+import os
+import ray_tpu
+ray_tpu.init(address=os.environ["RT_CLIENT_ADDR"])
+
+# tasks
+@ray_tpu.remote
+def sq(x): return x * x
+assert ray_tpu.get(sq.remote(7)) == 49
+refs = [sq.remote(i) for i in range(8)]
+assert ray_tpu.get(refs) == [i * i for i in range(8)]
+
+# chained refs as args
+@ray_tpu.remote
+def add(a, b): return a + b
+assert ray_tpu.get(add.remote(sq.remote(3), 1)) == 10
+
+# put / get / wait
+big = ray_tpu.put(list(range(50_000)))
+assert len(ray_tpu.get(big)) == 50_000
+ready, not_ready = ray_tpu.wait([sq.remote(2)], num_returns=1, timeout=30)
+assert len(ready) == 1 and not not_ready
+
+# actors: state, ordering, named lookup
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start): self.v = start
+    def inc(self, k=1): self.v += k; return self.v
+    def get(self): return self.v
+c = Counter.options(name="client-counter").remote(100)
+assert ray_tpu.get(c.inc.remote()) == 101
+assert ray_tpu.get(c.inc.remote(9)) == 110
+c2 = ray_tpu.get_actor("client-counter")
+assert ray_tpu.get(c2.get.remote()) == 110
+ray_tpu.kill(c)
+
+# logs stream back to the client (worker print -> driver -> proxy)
+@ray_tpu.remote
+def shout():
+    print("CLIENT_LOG_MARKER_XYZ")
+    return "ok"
+assert ray_tpu.get(shout.remote()) == "ok"
+import time; time.sleep(2.0)  # log pump latency
+
+# cluster introspection through the proxy
+assert ray_tpu.cluster_resources().get("CPU", 0) >= 2
+print("CLIENT_E2E_OK", flush=True)
+ray_tpu.shutdown()
+"""
+
+
+def test_client_end_to_end(cluster):
+    out = _client(cluster, _E2E)
+    assert out.returncode == 0, out.stderr[-3000:]
+    assert "CLIENT_E2E_OK" in out.stdout
+    assert "CLIENT_LOG_MARKER_XYZ" in out.stderr, (
+        "worker log line did not stream to the client")
+
+
+def test_client_sessions_isolated(cluster):
+    """Two clients get distinct session hosts (pids, job ids)."""
+    code = """
+import os
+import ray_tpu
+rt = ray_tpu.init(address=os.environ["RT_CLIENT_ADDR"])
+print("SESSION", rt.session_id, rt.job_id.hex())
+ray_tpu.shutdown()
+"""
+    a = _client(cluster, code)
+    b = _client(cluster, code)
+    assert a.returncode == 0 and b.returncode == 0, (a.stderr[-1000:],
+                                                     b.stderr[-1000:])
+    sa = a.stdout.split("SESSION")[1].split()
+    sb = b.stdout.split("SESSION")[1].split()
+    assert sa != sb, "client sessions must be isolated"
+
+
+def test_client_bad_token_rejected(cluster):
+    code = """
+import os
+import ray_tpu
+try:
+    ray_tpu.init(address=os.environ["RT_CLIENT_ADDR"])
+    print("CONNECTED")
+except Exception as e:
+    print("REJECTED", type(e).__name__)
+"""
+    import tempfile
+
+    env = dict(cluster["env"], RT_SESSION_TOKEN="wrong-token",
+               RT_CLIENT_ADDR=f"rtpu://{cluster['addr']}",
+               TMPDIR=tempfile.mkdtemp(prefix="client-bad-"))
+    env.pop("RT_TOKEN_FILE", None)
+    out = subprocess.run([sys.executable, "-u", "-c", code], env=env,
+                         capture_output=True, text=True, timeout=60)
+    assert "REJECTED" in out.stdout, out.stdout + out.stderr[-500:]
