@@ -3085,6 +3085,16 @@ class NodeService:
             op, key, val = payload
             return await self.head.kv_op(op, key, val)
 
+        if method == "list_nodes":
+            # Workers can see cluster membership (reference: ray.nodes()
+            # works from tasks/actors) — e.g. the serve controller actor
+            # reconciling its per-node proxy fleet. Head-less must RAISE,
+            # not return []: "no membership info" and "zero nodes" have
+            # very different consequences for reconcilers.
+            if self.head is None:
+                raise RuntimeError("cluster head is not reachable")
+            return await self.head.list_nodes()
+
         if method == "kill_actor":
             await self.kill_actor_anywhere(ActorID(payload))
             return True

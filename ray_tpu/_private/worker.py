@@ -253,6 +253,9 @@ class WorkerContext:
     def kv_op(self, op, key, val=None):
         return self.client.call("kv", (op, key, val))
 
+    def list_nodes(self):
+        return self.client.call("list_nodes", None)
+
     def resolve_runtime_env(self, env, device_lane: bool = False):
         """Nested submissions from inside a worker: children inherit this
         worker's (already-resolved) environment by default, with the

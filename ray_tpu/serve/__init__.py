@@ -17,7 +17,9 @@ from .api import (  # noqa: F401
     start,
     start_grpc,
     status,
+    status_proxies,
 )
+from .asgi import ingress  # noqa: F401
 from .batching import batch  # noqa: F401
 from .deployment import (  # noqa: F401
     Application,

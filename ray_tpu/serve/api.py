@@ -62,10 +62,27 @@ _grpc_proxy = None
 
 
 def start(*, http_host: str = "127.0.0.1", http_port: int = 8000,
-          detached: bool = True, request_timeout_s: float = 60.0):
-    """Start the HTTP ingress (handles work without it)."""
+          detached: bool = True, request_timeout_s: float = 60.0,
+          proxy_location: str = "local"):
+    """Start the HTTP ingress (handles work without it).
+
+    ``proxy_location``: "local" runs one aiohttp proxy in this process
+    (dev/simple mode); "every_node" delegates to the controller, which
+    keeps one proxy ACTOR per cluster node with route broadcast
+    (reference: ProxyActor fleet, serve/_private/proxy.py:1097,
+    `serve.start(proxy_location="EveryNode")`). Fleet ports:
+    serve.status_proxies().
+    """
     global _proxy
-    _get_controller()
+    controller = _get_controller()
+    if proxy_location == "every_node":
+        import ray_tpu
+
+        ray_tpu.get(controller.start_proxy_fleet.remote(
+            http_host="0.0.0.0" if http_host == "127.0.0.1" else http_host,
+            http_port=http_port,
+            request_timeout_s=request_timeout_s), timeout=60)
+        return None
     if _proxy is not None:
         # Settings are fixed at first start (same contract as start_grpc):
         # silently returning a differently-configured proxy misleads.
@@ -77,9 +94,18 @@ def start(*, http_host: str = "127.0.0.1", http_port: int = 8000,
         return _proxy
     _proxy = HTTPProxy(_ProxyClient(), http_host, http_port,
                        request_timeout_s=request_timeout_s)
-    for app_name, prefix in _routes.items():
-        _proxy.add_route(prefix, app_name)
+    for app_name, (prefix, asgi) in _routes.items():
+        _proxy.add_route(prefix, app_name, asgi)
     return _proxy
+
+
+def status_proxies() -> list:
+    """[{node_id, port}] of the per-node proxy fleet (empty in local
+    proxy mode)."""
+    import ray_tpu
+
+    controller = _get_controller(create=False)
+    return ray_tpu.get(controller.list_proxies.remote(), timeout=30)
 
 
 def start_grpc(*, grpc_host: str = "127.0.0.1", grpc_port: int = 0,
@@ -120,9 +146,16 @@ def run(app: Application, *, name: str = "default",
         controller.deploy_application.remote(app, name), timeout=120)
     _ingress_cache[name] = ingress
     if route_prefix is not None:
-        _routes[name] = route_prefix
+        from .asgi import is_asgi
+
+        asgi = is_asgi(app.deployment.func_or_class)
+        _routes[name] = (route_prefix, asgi)
         if _proxy is not None:
-            _proxy.add_route(route_prefix, name)
+            _proxy.add_route(route_prefix, name, asgi)
+        # Route table source of truth lives in the controller: the
+        # per-node proxy fleet learns it by broadcast.
+        ray_tpu.get(controller.set_route.remote(name, route_prefix, asgi),
+                    timeout=30)
     handle = DeploymentHandle(ingress)
     handle._router.maybe_refresh(force=True)
     return handle

@@ -1,0 +1,207 @@
+"""ASGI app ingress: `@serve.ingress(asgi_app)`.
+
+Parity target: the reference's FastAPI integration
+(/root/reference/python/ray/serve/api.py `@serve.ingress` wrapping a
+deployment class around an ASGI app; replica-side ASGI dispatch in
+serve/_private/http_util.py ASGIAppReplicaWrapper). Ours speaks the
+ASGI3 protocol directly, so ANY ASGI app works — a raw callable, an
+aiohttp-free microframework, or FastAPI/Starlette when installed; the
+image this framework ships in has no FastAPI, so nothing here imports
+one.
+
+Request flow: the HTTP proxy recognises ASGI apps from the route table
+and forwards the FULL request envelope (method/path/headers/query/body)
+instead of a parsed JSON body; the replica runs one ASGI
+request-response cycle on a persistent event loop (lifespan startup ran
+once at replica init) and returns {status, headers, body}.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Any, Optional
+
+ASGI_MARKER = "__rtpu_asgi__"
+
+
+class _ASGILoop:
+    """A persistent event loop thread hosting one ASGI app instance.
+
+    The lifespan protocol runs as ONE long-lived coroutine for the
+    replica's whole life: startup is fed at init and the app then parks
+    in ``receive()`` until real teardown — feeding shutdown right after
+    startup (the naive per-phase shape) would close the app's resources
+    (DB pools, clients) before the first request.
+    """
+
+    def __init__(self, app):
+        self.app = app
+        self.loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._main, daemon=True,
+                                        name="serve-asgi")
+        self._thread.start()
+        self._started.wait(30)
+        self._ls_queue: Optional[asyncio.Queue] = None
+        self._ls_started = None
+        self._ls_stopped = None
+        self._start_lifespan()
+
+    def _main(self):
+        asyncio.set_event_loop(self.loop)
+        self._started.set()
+        self.loop.run_forever()
+
+    def _start_lifespan(self):
+        """Kick the persistent lifespan coroutine and wait for startup
+        to complete (best-effort: apps without lifespan are fine)."""
+
+        async def setup():
+            self._ls_queue = asyncio.Queue()
+            loop = asyncio.get_running_loop()
+            self._ls_started = loop.create_future()
+            self._ls_stopped = loop.create_future()
+
+            async def receive():
+                return await self._ls_queue.get()
+
+            def _resolve(fut):
+                if fut is not None and not fut.done():
+                    fut.set_result(None)
+
+            async def send(msg):
+                t = msg.get("type", "")
+                if t.startswith("lifespan.startup"):
+                    _resolve(self._ls_started)
+                elif t.startswith("lifespan.shutdown"):
+                    _resolve(self._ls_stopped)
+
+            async def main():
+                try:
+                    await self.app(
+                        {"type": "lifespan", "asgi": {"version": "3.0"}},
+                        receive, send)
+                except Exception:  # noqa: BLE001 - lifespan unsupported
+                    pass
+                finally:
+                    _resolve(self._ls_started)
+                    _resolve(self._ls_stopped)
+
+            asyncio.ensure_future(main())
+            await self._ls_queue.put({"type": "lifespan.startup"})
+            try:
+                await asyncio.wait_for(asyncio.shield(self._ls_started), 15)
+            except asyncio.TimeoutError:
+                pass
+
+        try:
+            asyncio.run_coroutine_threadsafe(setup(), self.loop).result(20)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _finish_lifespan(self):
+        async def teardown():
+            if self._ls_queue is None:
+                return
+            await self._ls_queue.put({"type": "lifespan.shutdown"})
+            try:
+                await asyncio.wait_for(asyncio.shield(self._ls_stopped), 10)
+            except asyncio.TimeoutError:
+                pass
+
+        try:
+            asyncio.run_coroutine_threadsafe(teardown(), self.loop).result(15)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def handle(self, req: dict, timeout: float = 120.0) -> dict:
+        """One ASGI HTTP request-response cycle."""
+
+        async def run():
+            scope = {
+                "type": "http",
+                "asgi": {"version": "3.0", "spec_version": "2.3"},
+                "http_version": "1.1",
+                "method": req["method"],
+                "scheme": "http",
+                "path": req["path"],
+                "raw_path": req["path"].encode(),
+                "query_string": req.get("query_string", b"") or b"",
+                "root_path": req.get("root_path", ""),
+                "headers": [(k.lower().encode(), v.encode())
+                            for k, v in req.get("headers", [])],
+                "client": ("127.0.0.1", 0),
+                "server": ("127.0.0.1", 80),
+            }
+            body = req.get("body", b"") or b""
+            sent_body = {"done": False}
+
+            async def receive():
+                if not sent_body["done"]:
+                    sent_body["done"] = True
+                    return {"type": "http.request", "body": body,
+                            "more_body": False}
+                return {"type": "http.disconnect"}
+
+            out = {"status": 500, "headers": [], "chunks": []}
+
+            async def send(msg):
+                if msg["type"] == "http.response.start":
+                    out["status"] = msg["status"]
+                    out["headers"] = [
+                        (k.decode("latin1"), v.decode("latin1"))
+                        for k, v in msg.get("headers", [])]
+                elif msg["type"] == "http.response.body":
+                    out["chunks"].append(bytes(msg.get("body", b"")))
+
+            await self.app(scope, receive, send)
+            return {"status": out["status"], "headers": out["headers"],
+                    "body": b"".join(out["chunks"])}
+
+        return asyncio.run_coroutine_threadsafe(run(), self.loop).result(
+            timeout)
+
+    def shutdown(self):
+        self._finish_lifespan()
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(timeout=5)
+
+
+def ingress(asgi_app):
+    """Decorator: make a deployment class serve an ASGI app. The class's
+    __init__ still runs (model loading etc.); HTTP requests dispatch
+    into the app. Usable on a bare class or stacked under
+    @serve.deployment."""
+
+    def deco(cls):
+        class ASGIIngress(cls):
+            def __init__(self, *args, **kwargs):
+                super().__init__(*args, **kwargs)
+                self._asgi = _ASGILoop(asgi_app)
+
+            def __call__(self, request: dict) -> dict:
+                return self._asgi.handle(request)
+
+            def __del__(self):
+                try:
+                    self._asgi.shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        ASGIIngress.__name__ = getattr(cls, "__name__", "ASGIIngress")
+        ASGIIngress.__qualname__ = ASGIIngress.__name__
+        # Adopt the wrapped class's module: cloudpickle must treat the
+        # wrapper exactly like the user's class (pickle BY VALUE for
+        # script/test modules) — with __module__ left pointing here it
+        # would try a by-reference lookup that no worker can resolve.
+        ASGIIngress.__module__ = getattr(cls, "__module__",
+                                         ASGIIngress.__module__)
+        setattr(ASGIIngress, ASGI_MARKER, True)
+        return ASGIIngress
+
+    return deco
+
+
+def is_asgi(target: Any) -> bool:
+    return bool(getattr(target, ASGI_MARKER, False))

@@ -71,6 +71,14 @@ class ServeController:
         self._lock = threading.RLock()
         self._deployments: dict[str, DeploymentState] = {}
         self._apps: dict[str, str] = {}  # app name -> ingress deployment
+        # Route table (source of truth for the proxy fleet):
+        # app name -> {"prefix": str, "asgi": bool}
+        self._routes: dict[str, dict] = {}
+        # Per-node proxy fleet (reference: ProxyActor per node,
+        # proxy.py:1097). None = fleet mode off (driver-local proxy).
+        self._proxy_cfg: Optional[dict] = None
+        self._proxies: dict[bytes, Any] = {}   # node_id -> ActorHandle
+        self._proxy_ports: dict[bytes, int] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # Scale-down victims mid-drain, persisted so a controller crash
@@ -92,6 +100,8 @@ class ServeController:
             with self._lock:
                 blob = cloudpickle.dumps({
                     "apps": dict(self._apps),
+                    "routes": dict(self._routes),
+                    "proxy_cfg": self._proxy_cfg,
                     "draining": sorted(self._draining),
                     "deployments": {
                         name: {"deployment": s.deployment,
@@ -112,6 +122,11 @@ class ServeController:
         ckpt = cloudpickle.loads(blob)
         with self._lock:
             self._apps = dict(ckpt["apps"])
+            self._routes = dict(ckpt.get("routes", {}))
+            # Fleet mode survives a controller restart: the reconcile
+            # thread re-ATTACHES to the still-running named proxy actors
+            # (and replaces any that died with their node).
+            self._proxy_cfg = ckpt.get("proxy_cfg")
             for name, d in ckpt["deployments"].items():
                 state = DeploymentState(deployment=d["deployment"],
                                         target_replicas=d["target"])
@@ -136,6 +151,8 @@ class ServeController:
                 self._reconcile_one(state)
             if self._deployments:
                 self._ensure_loop()
+        if self._proxy_cfg is not None:
+            self._ensure_proxy_thread()
         # Replacement replicas spawned just now must be persisted — a
         # second crash before any later checkpoint would orphan them.
         self._checkpoint()
@@ -324,6 +341,141 @@ class ServeController:
         with self._lock:
             return len(self._deployments[name].replicas)
 
+    # -- routes + per-node proxy fleet ----------------------------------
+    def set_route(self, app_name: str, prefix: str, asgi: bool = False):
+        with self._lock:
+            self._routes[app_name] = {"prefix": prefix, "asgi": asgi}
+        self._broadcast_routes()
+        self._checkpoint()
+        return True
+
+    def get_routes(self) -> dict:
+        with self._lock:
+            return dict(self._routes)
+
+    def start_proxy_fleet(self, http_host: str = "0.0.0.0",
+                          http_port: int = 8000,
+                          request_timeout_s: float = 60.0) -> bool:
+        """Enable one-HTTP-proxy-per-node mode; a dedicated thread
+        reconciles the fleet against live membership (NOT the 250ms
+        control loop — a slow node's 30s actor-start must never stall
+        replica health checks)."""
+        cfg = {"http_host": http_host, "http_port": http_port,
+               "request_timeout_s": request_timeout_s}
+        with self._lock:
+            if self._proxy_cfg is not None and self._proxy_cfg != cfg:
+                raise RuntimeError(
+                    "proxy fleet already running with different settings "
+                    f"({self._proxy_cfg}); serve.shutdown() first")
+            self._proxy_cfg = cfg
+        self._reconcile_proxies()
+        self._ensure_proxy_thread()
+        return True
+
+    def _ensure_proxy_thread(self):
+        with self._lock:
+            t = getattr(self, "_proxy_thread", None)
+            if t is not None and t.is_alive():
+                return
+            t = threading.Thread(target=self._proxy_loop, daemon=True,
+                                 name="serve-proxy-fleet")
+            self._proxy_thread = t
+            t.start()
+
+    def _proxy_loop(self):
+        while not self._stop.wait(2.0):
+            with self._lock:
+                if self._proxy_cfg is None:
+                    return
+            try:
+                self._reconcile_proxies()
+            except Exception:  # noqa: BLE001 - next tick retries
+                pass
+
+    def list_proxies(self) -> list:
+        """[{node_id, port}] for every live fleet proxy."""
+        with self._lock:
+            return [{"node_id": nid, "port": port}
+                    for nid, port in self._proxy_ports.items()]
+
+    def _routes_for_broadcast(self) -> dict:
+        return {r["prefix"]: (app, r["asgi"])
+                for app, r in self._routes.items()}
+
+    def _broadcast_routes(self):
+        import ray_tpu
+
+        with self._lock:
+            proxies = list(self._proxies.values())
+            table = self._routes_for_broadcast()
+        for p in proxies:
+            try:
+                ray_tpu.get(p.set_routes.remote(table), timeout=10)
+            except Exception:  # noqa: BLE001 - dead proxy: reconcile replaces
+                pass
+
+    def _reconcile_proxies(self):
+        """One proxy per ALIVE non-driver node; drop handles for dead
+        nodes. Runs from the control loop and on fleet start."""
+        import ray_tpu
+        from ray_tpu._private.task_spec import SchedulingStrategy
+
+        from .proxy_actor import ProxyActor
+
+        with self._lock:
+            cfg = self._proxy_cfg
+        if cfg is None:
+            return
+        try:
+            nodes = ray_tpu.nodes()
+        except Exception as e:  # noqa: BLE001 - head briefly unreachable
+            import sys
+
+            sys.stderr.write(f"serve: proxy fleet node query failed: "
+                             f"{e!r}\n")
+            return
+        # State rows carry hex node ids; the scheduling strategy wants
+        # the binary form.
+        alive = {n["node_id"] for n in nodes
+                 if n["state"] == "ALIVE" and not n.get("is_driver")}
+        with self._lock:
+            for nid in [n for n in self._proxies if n not in alive]:
+                self._proxies.pop(nid, None)
+                self._proxy_ports.pop(nid, None)
+            missing = [n for n in alive if n not in self._proxies]
+        for nid in missing:
+            # NAMED per-node actor: a restarted controller re-attaches
+            # to the still-running proxy instead of spawning a duplicate
+            # that would fight over the port (old proxies outlive the
+            # controller — there is no parent fate-sharing).
+            pname = f"SERVE_PROXY:{nid[:16]}"
+            try:
+                actor = None
+                try:
+                    actor = ray_tpu.get_actor(pname)
+                    ray_tpu.get(actor.ping.remote(), timeout=10)
+                except Exception:  # noqa: BLE001 - none/dead: create
+                    actor = ray_tpu.remote(ProxyActor).options(
+                        name=pname, num_cpus=0,
+                        scheduling_strategy=SchedulingStrategy(
+                            kind="node", node_id=bytes.fromhex(nid)),
+                    ).remote(**cfg)
+                port = ray_tpu.get(actor.port.remote(), timeout=30)
+            except Exception as e:  # noqa: BLE001 - node busy/dying
+                import sys
+
+                sys.stderr.write(f"serve: proxy start failed on node "
+                                 f"{nid[:8]}: {e!r}\n")
+                continue
+            with self._lock:
+                self._proxies[nid] = actor
+                self._proxy_ports[nid] = port
+                table = self._routes_for_broadcast()
+            try:
+                ray_tpu.get(actor.set_routes.remote(table), timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+
     def ping(self) -> bool:
         return True
 
@@ -345,5 +497,19 @@ class ServeController:
                         pass
             self._deployments.clear()
             self._apps.clear()
+            self._routes.clear()
+            proxies = list(self._proxies.values())
+            self._proxies.clear()
+            self._proxy_ports.clear()
+            self._proxy_cfg = None
+        for p in proxies:
+            try:
+                ray_tpu.get(p.shutdown.remote(), timeout=10)
+            except Exception:
+                pass
+            try:
+                ray_tpu.kill(p)
+            except Exception:
+                pass
         ray_tpu.kv_del(CHECKPOINT_KEY)
         return True

@@ -68,14 +68,27 @@ class HTTPProxy:
     async def _handle(self, request):
         from aiohttp import web
 
-        app = self.resolve(request.path)
-        if app is None:
+        route = self.resolve(request.path)
+        if route is None:
             return web.json_response({"error": "no route"}, status=404)
+        app, is_asgi = route
         raw = await request.read()
-        try:
-            body = json.loads(raw) if raw else None
-        except json.JSONDecodeError:
-            body = raw.decode()
+        if is_asgi:
+            # ASGI apps get the FULL request envelope; the replica runs
+            # one ASGI cycle and returns {status, headers, body}
+            # (serve/asgi.py).
+            body = {
+                "method": request.method,
+                "path": request.path,
+                "query_string": request.query_string.encode(),
+                "headers": [(k, v) for k, v in request.headers.items()],
+                "body": raw,
+            }
+        else:
+            try:
+                body = json.loads(raw) if raw else None
+            except json.JSONDecodeError:
+                body = raw.decode()
 
         loop = asyncio.get_running_loop()
         try:
@@ -98,6 +111,18 @@ class HTTPProxy:
 
         if isinstance(result, dict) and STREAM_MARKER in result:
             return await self._stream(request, resp)
+        if is_asgi and isinstance(result, dict) and "status" in result:
+            from multidict import CIMultiDict
+
+            # Pair-list, not dict: duplicate names (Set-Cookie!) must
+            # all reach the client.
+            hdrs = CIMultiDict(
+                (k, v) for k, v in result.get("headers", [])
+                if k.lower() not in ("content-length",
+                                     "transfer-encoding"))
+            return web.Response(status=result["status"],
+                                body=result.get("body", b""),
+                                headers=hdrs)
         return web.json_response(result)
 
     async def _stream(self, request, resp):
@@ -158,18 +183,24 @@ class HTTPProxy:
         await sr.write_eof()
         return sr
 
-    def add_route(self, prefix: str, app_name: str):
-        self.routes[prefix.rstrip("/") or "/"] = app_name
+    def add_route(self, prefix: str, app_name: str, asgi: bool = False):
+        self.routes[prefix.rstrip("/") or "/"] = (app_name, asgi)
 
-    def resolve(self, path: str) -> Optional[str]:
+    def set_routes(self, routes: dict):
+        """Replace the whole table: {prefix: (app_name, asgi)} — the
+        controller's broadcast to the proxy fleet."""
+        self.routes = {p.rstrip("/") or "/": tuple(v)
+                       for p, v in routes.items()}
+
+    def resolve(self, path: str) -> Optional[tuple]:
         path = path.split("?")[0].rstrip("/") or "/"
         best = None
-        for prefix, app in self.routes.items():
+        for prefix, route in self.routes.items():
             if path == prefix or path.startswith(
                     prefix if prefix.endswith("/") else prefix + "/") or \
                     prefix == "/":
                 if best is None or len(prefix) > len(best[0]):
-                    best = (prefix, app)
+                    best = (prefix, route)
         return best[1] if best else None
 
     def shutdown(self):

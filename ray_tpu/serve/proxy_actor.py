@@ -1,0 +1,42 @@
+"""Per-node HTTP proxy actor — the production ingress topology.
+
+Parity target: the reference's ProxyActor fleet
+(/root/reference/python/ray/serve/_private/proxy.py:1097): `serve.start
+(proxy_location="EveryNode")` runs one HTTP proxy ON EVERY cluster
+node, each receiving the controller's route-table broadcast, so any
+node's port serves any app — put a TCP load balancer in front and no
+single process is a bottleneck or single point of failure.
+
+Ours is the existing aiohttp HTTPProxy hosted inside a node-pinned
+actor; the controller reconciles the fleet against live membership
+(new node -> proxy created there; dead node -> handle dropped) and
+pushes `set_routes` on every change.
+"""
+
+from __future__ import annotations
+
+
+class ProxyActor:
+    """Runs in a CPU-lane worker on its pinned node."""
+
+    def __init__(self, http_host: str = "0.0.0.0", http_port: int = 8000,
+                 request_timeout_s: float = 60.0):
+        from .api import _ProxyClient
+        from .http_proxy import HTTPProxy
+
+        self._proxy = HTTPProxy(_ProxyClient(), http_host, http_port,
+                                request_timeout_s=request_timeout_s)
+
+    def port(self) -> int:
+        return self._proxy.port
+
+    def set_routes(self, routes: dict) -> bool:
+        self._proxy.set_routes(routes)
+        return True
+
+    def ping(self) -> str:
+        return "pong"
+
+    def shutdown(self) -> bool:
+        self._proxy.shutdown()
+        return True

@@ -21,11 +21,11 @@ from .._private import context as context_mod
 Filter = tuple  # (key, "=" | "!=", value)
 
 
-def _runtime():
+def _runtime(capability: str = "cluster_state"):
     rt = context_mod.get_context()
     if rt is None:
         raise RuntimeError("ray_tpu.init() has not been called")
-    if not hasattr(rt, "cluster_state"):
+    if not hasattr(rt, capability):
         raise RuntimeError(
             "the state API is driver-only (call it from the process that "
             "ran ray_tpu.init(), not from inside a task/actor)")
@@ -110,7 +110,7 @@ def list_nodes(filters: Optional[Sequence[Filter]] = None,
              "resources": n["resources"], "available": n["available"],
              "is_head_node": n["is_head_node"],
              "is_driver": n.get("is_driver", False)}
-            for n in _runtime().list_nodes()]  # head-only, no node fan-out
+            for n in _runtime("list_nodes").list_nodes()]  # head-only
     return _apply_filters(rows, filters, limit)
 
 
