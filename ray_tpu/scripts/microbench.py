@@ -166,10 +166,98 @@ def run(include_cluster: bool = True, results: Optional[list] = None) -> list:
 
     timeit("pg_create_remove", pg_cycle, results=results)
 
+    # ---------------- envelope: bulk queue drain ----------------
+    # (reference envelope: 1M queued tasks, release/benchmarks/README.md
+    # — here the drain RATE of a big burst; CI runs a smaller burst.)
+    results.append(_queued_burst(
+        int(os.environ.get("RT_MB_QUEUED", "50000"))))
+
+    # ---------------- envelope: membership churn ----------------
+    results.append(_membership_churn(
+        int(os.environ.get("RT_MB_NODES", "100"))))
+
     # ---------------- cross-node object plane ----------------
     if include_cluster:
         results.append(_cross_node_fetch())
     return results
+
+
+def _queued_burst(n: int) -> dict:
+    """Submit n device-lane tasks in one burst and drain them —
+    the queue-depth envelope (tasks/s through submit+dispatch+retire)."""
+    import ray_tpu
+
+    @ray_tpu.remote(scheduling_strategy="device")
+    def unit(i):
+        return i
+
+    ray_tpu.get([unit.remote(i) for i in range(200)])  # warm
+    t0 = time.perf_counter()
+    refs = [unit.remote(i) for i in range(n)]
+    out = ray_tpu.get(refs, timeout=600)
+    dt = time.perf_counter() - t0
+    assert out[-1] == n - 1
+    row = {"name": f"queued_{n // 1000}k_tasks", "per_s": round(n / dt, 2),
+           "sd": 0.0, "n": n}
+    print(f"{row['name']}: {row['per_s']:,.1f} /s", flush=True)
+    return row
+
+
+def _membership_churn(n_nodes: int) -> dict:
+    """Simulated membership churn against a real HeadService: register
+    n nodes, heartbeat them all, kill a third, re-register — the
+    control-plane membership envelope in events/s (reference:
+    many_nodes release suite, scaled; node daemons are simulated at the
+    RPC-handler level so one box can exercise 100+ nodes)."""
+    import asyncio
+
+    from ray_tpu._private.head import HeadService
+    from ray_tpu._private.head_store import InMemoryHeadStore
+    from ray_tpu._private.ids import NodeID
+
+    loop = asyncio.new_event_loop()
+    try:
+        # Explicit in-memory store: the default would read
+        # RT_HEAD_PERSIST and replay the LIVE cluster's state into the
+        # simulated head on persistence-enabled deployments.
+        head = HeadService("mb-churn", loop, store=InMemoryHeadStore())
+        node_ids = [NodeID.from_random() for _ in range(n_nodes)]
+
+        async def churn():
+            events = 0
+            for i, nid in enumerate(node_ids):
+                head.register_node(nid, ("127.0.0.1", 20000 + i),
+                                   {"CPU": 4}, None)
+                events += 1
+            for _ in range(5):
+                for nid in node_ids:
+                    head.heartbeat(nid, {"CPU": 3})
+                    events += 1
+            for nid in node_ids[::3]:
+                e = head.nodes[nid]
+                await head._mark_node_dead(e, "churn")
+                events += 1
+            for i, nid in enumerate(node_ids[::3]):
+                head.register_node(nid, ("127.0.0.1", 20000 + i),
+                                   {"CPU": 4}, None)
+                events += 1
+            return events
+
+        # Repeat cycles until >=0.5s elapsed: a single churn pass is
+        # ~0.1s of pure python, far too short to measure stably.
+        t0 = time.perf_counter()
+        events = 0
+        while time.perf_counter() - t0 < 0.5:
+            events += loop.run_until_complete(churn())
+        dt = time.perf_counter() - t0
+        alive = sum(1 for e in head.nodes.values() if e.state == "ALIVE")
+        assert alive == n_nodes, (alive, n_nodes)
+    finally:
+        loop.close()
+    row = {"name": f"membership_{n_nodes}_nodes_events",
+           "per_s": round(events / dt, 2), "sd": 0.0, "nodes": n_nodes}
+    print(f"{row['name']}: {row['per_s']:,.1f} /s", flush=True)
+    return row
 
 
 def _cross_node_fetch(payload_mb: int = 64) -> dict:

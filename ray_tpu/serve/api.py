@@ -61,7 +61,7 @@ _routes: dict[str, str] = {}
 _grpc_proxy = None
 
 
-def start(*, http_host: str = "127.0.0.1", http_port: int = 8000,
+def start(*, http_host: Optional[str] = None, http_port: int = 8000,
           detached: bool = True, request_timeout_s: float = 60.0,
           proxy_location: str = "local"):
     """Start the HTTP ingress (handles work without it).
@@ -72,6 +72,9 @@ def start(*, http_host: str = "127.0.0.1", http_port: int = 8000,
     (reference: ProxyActor fleet, serve/_private/proxy.py:1097,
     `serve.start(proxy_location="EveryNode")`). Fleet ports:
     serve.status_proxies().
+
+    ``http_host`` defaults per mode (loopback locally, all interfaces
+    for the fleet); an EXPLICIT value is honored verbatim in both.
     """
     global _proxy
     controller = _get_controller()
@@ -79,10 +82,12 @@ def start(*, http_host: str = "127.0.0.1", http_port: int = 8000,
         import ray_tpu
 
         ray_tpu.get(controller.start_proxy_fleet.remote(
-            http_host="0.0.0.0" if http_host == "127.0.0.1" else http_host,
+            http_host=http_host if http_host is not None else "0.0.0.0",
             http_port=http_port,
             request_timeout_s=request_timeout_s), timeout=60)
         return None
+    if http_host is None:
+        http_host = "127.0.0.1"
     if _proxy is not None:
         # Settings are fixed at first start (same contract as start_grpc):
         # silently returning a differently-configured proxy misleads.

@@ -115,8 +115,13 @@ class _ASGILoop:
         except Exception:  # noqa: BLE001
             pass
 
-    def handle(self, req: dict, timeout: float = 120.0) -> dict:
-        """One ASGI HTTP request-response cycle."""
+    def handle(self, req: dict, timeout: Optional[float] = None) -> dict:
+        """One ASGI HTTP request-response cycle. The deadline rides the
+        request envelope (the proxy's request_timeout_s) so a hung
+        endpoint frees the replica slot when the proxy has already
+        504'd, instead of pinning it for a fixed 120s."""
+        if timeout is None:
+            timeout = float(req.get("timeout_s") or 120.0)
 
         async def run():
             scope = {

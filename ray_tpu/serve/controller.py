@@ -408,10 +408,19 @@ class ServeController:
         with self._lock:
             proxies = list(self._proxies.values())
             table = self._routes_for_broadcast()
+        # Fan out first, collect afterwards: N proxies cost one shared
+        # deadline, not N serial RTTs on the deploy path (a dead proxy
+        # is the reconcile thread's problem, not serve.run's).
+        refs = []
         for p in proxies:
             try:
-                ray_tpu.get(p.set_routes.remote(table), timeout=10)
-            except Exception:  # noqa: BLE001 - dead proxy: reconcile replaces
+                refs.append(p.set_routes.remote(table))
+            except Exception:  # noqa: BLE001 - dead handle
+                pass
+        if refs:
+            try:
+                ray_tpu.wait(refs, num_returns=len(refs), timeout=10)
+            except Exception:  # noqa: BLE001
                 pass
 
     def _reconcile_proxies(self):

@@ -83,6 +83,7 @@ class HTTPProxy:
                 "query_string": request.query_string.encode(),
                 "headers": [(k, v) for k, v in request.headers.items()],
                 "body": raw,
+                "timeout_s": self.request_timeout_s,
             }
         else:
             try:

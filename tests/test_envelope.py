@@ -23,13 +23,15 @@ def test_many_queued_tasks_drain(rt):
     def unit(i):
         return i
 
-    n = 3000
+    n = 10_000
     t0 = time.monotonic()
     refs = [unit.remote(i) for i in range(n)]
     out = ray_tpu.get(refs, timeout=300)
     dt = time.monotonic() - t0
     assert out == list(range(n))
-    assert dt < 120, f"{n} tasks took {dt:.1f}s"
+    # Recorded drain rate is ~6k tasks/s (MICROBENCH queued_50k_tasks);
+    # 10s gives 6x headroom on a loaded box.
+    assert dt < 10, f"{n} tasks took {dt:.1f}s"
 
 
 def test_many_refs_single_get(rt):

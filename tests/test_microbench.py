@@ -3,10 +3,12 @@ floors (parity: the reference's release microbenchmark pipeline keeps
 thresholds out-of-tree; ours are committed here so a control-plane
 regression fails CI).
 
-Floors are deliberately ~5-10x below the recorded MICROBENCH.json numbers:
-CI boxes are noisy and share one core with other tests — the gate catches
-order-of-magnitude regressions (an accidental O(n^2), a sleep in the hot
-path), not few-percent drift.
+Floors sit at 70% of the LOWER of two recorded means (full-scale
+MICROBENCH.json run and a CI-scale run on the same 1-core box,
+2026-07-30) — VERDICT r3 weak 10 asked for floors tight enough that a
+sub-2x regression fails CI, not just order-of-magnitude breaks. The
+noisiest metric (task_cpu_async: subprocess workers on one core) keeps
+the extra slack its own variance demonstrated.
 """
 
 import os
@@ -17,20 +19,23 @@ import ray_tpu
 from ray_tpu.scripts import microbench
 
 # name -> minimum acceptable per_s at CI scale
+# (= 0.7 x min(recorded full-scale mean, recorded CI-scale mean))
 FLOORS = {
-    "get_small_ops": 2000,
-    "put_small_ops": 1000,
-    "put_gigabytes_gb": 0.2,      # GB/s into the local store
-    "get_gigabytes_gb": 0.2,
-    "task_device_sync": 100,
-    "task_device_async": 200,
-    "task_cpu_sync": 20,
-    "task_cpu_async": 50,
-    "actor_call_sync": 20,
-    "actor_call_async": 50,
-    "actor_call_concurrent": 50,
-    "wait_1k_refs": 500,          # refs resolved/s
-    "pg_create_remove": 2,
+    "get_small_ops": 8500,        # recorded 12,233 / 20,385
+    "put_small_ops": 14900,       # recorded 21,351 / 32,108
+    "put_gigabytes_gb": 0.45,     # GB/s into the local store (0.65/0.71)
+    "get_gigabytes_gb": 1290,     # zero-copy read (1848/1877)
+    "task_device_sync": 3650,     # recorded 5,272 / 5,221
+    "task_device_async": 5100,    # recorded 7,336 / 7,559
+    "task_cpu_sync": 1890,        # recorded 2,703 / 2,768
+    "task_cpu_async": 680,        # recorded 2,444 / 971 (high variance)
+    "actor_call_sync": 1750,      # recorded 2,509 / 2,948
+    "actor_call_async": 2430,     # recorded 3,481 / 4,145
+    "actor_call_concurrent": 1900,  # recorded 2,719 / 4,094
+    "wait_1k_refs": 4200,         # recorded 6,008 / 7,361
+    "pg_create_remove": 2800,     # recorded 4,036 / 5,517
+    "queued_5k_tasks": 4950,      # recorded 6,215 (50k) / 7,116 (5k)
+    "membership_100_nodes_events": 580000,  # recorded 834-881k (0.5s windows)
 }
 
 
@@ -39,6 +44,8 @@ def quick_scale():
     os.environ["RT_MB_TRIALS"] = "1"
     os.environ["RT_MB_TRIAL_S"] = "0.4"
     os.environ["RT_MB_WARMUP_S"] = "0.2"
+    os.environ["RT_MB_QUEUED"] = "5000"
+    os.environ["RT_MB_NODES"] = "100"
     # module reads these at import; refresh
     microbench.TRIALS = 1
     microbench.TRIAL_S = 0.4
@@ -64,6 +71,8 @@ def test_microbench_floors():
 def test_cross_node_fetch_floor():
     os.environ["RT_MB_FETCH_MB"] = "16"
     row = microbench._cross_node_fetch()
-    # 16 MB across the loopback object plane: anything under 20 MB/s means
-    # the transfer path is broken (e.g. chunking regressed to per-byte).
-    assert row["per_s"] > 20, row
+    # 16 MB across the loopback object plane: recorded 63-67 MB/s at
+    # THIS payload size (the 64 MB full-scale run records 187 MB/s —
+    # the small CI payload pays fixed per-transfer costs). Floor at 70%
+    # of the same-scale mean.
+    assert row["per_s"] > 44, row
