@@ -9,6 +9,7 @@ DDP), and env runners are CPU actors feeding the TPU learner.
 """
 
 from .algorithm import DQN, PPO, Algorithm, AlgorithmConfig  # noqa: F401
+from .apex import ApexDQN, ReplayShard  # noqa: F401
 from .connectors import (  # noqa: F401
     CastObs,
     ClipActions,

@@ -39,6 +39,8 @@ class AlgorithmConfig:
         self.num_env_runners = 0
         self.num_envs_per_runner = 1
         self.rollout_fragment_length = 64
+        self.env_to_module_connector = None  # factory or pipeline spec
+        self.module_to_env_connector = None
         # training (shared)
         self.lr = 3e-4
         self.gamma = 0.99
@@ -61,6 +63,15 @@ class AlgorithmConfig:
         self.target_update_freq = 100
         self.epsilon = (1.0, 0.05, 10_000)  # start, end, decay steps
         self.learning_starts = 1_000
+        # Ape-X (distributed prioritized replay)
+        self.num_replay_shards = 2
+        self.priority_alpha = 0.6
+        self.priority_beta = 0.4
+        self.apex_epsilon_base = 0.4
+        self.weight_sync_freq = 8  # learner updates between broadcasts
+        # Cross-runner connector/filter stat sync cadence in train()
+        # iterations; 0 disables (reference: FilterManager.synchronize).
+        self.sync_filters_every = 1
         # SAC
         self.tau = 0.005  # polyak coefficient for the target critic
         self.target_entropy = None  # None => -act_dim (the SAC default)
@@ -85,13 +96,25 @@ class AlgorithmConfig:
 
     def env_runners(self, *, num_env_runners: Optional[int] = None,
                     num_envs_per_env_runner: Optional[int] = None,
-                    rollout_fragment_length: Optional[int] = None, **_):
+                    num_envs_per_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None,
+                    env_to_module_connector=None,
+                    module_to_env_connector=None, **_):
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
         if num_envs_per_env_runner is not None:
             self.num_envs_per_runner = num_envs_per_env_runner
+        if num_envs_per_runner is not None:
+            self.num_envs_per_runner = num_envs_per_runner
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        # Connector FACTORIES (zero-arg callables returning a pipeline
+        # spec): each runner builds its OWN stateful instances
+        # (reference: env_to_module_connector(env) factories).
+        if env_to_module_connector is not None:
+            self.env_to_module_connector = env_to_module_connector
+        if module_to_env_connector is not None:
+            self.module_to_env_connector = module_to_env_connector
         return self
 
     def training(self, **kwargs):
@@ -145,6 +168,8 @@ class AlgorithmConfig:
             "num_envs_per_runner": self.num_envs_per_runner,
             "model_config": self.model_config,
             "seed": self.seed,
+            "env_to_module_connector": self.env_to_module_connector,
+            "module_to_env_connector": self.module_to_env_connector,
         }
 
 
@@ -207,6 +232,15 @@ class Algorithm:
         t0 = time.time()
         metrics = self.training_step()
         self.iteration += 1
+        if (self.remote_runners and self.config.sync_filters_every
+                and self.iteration % self.config.sync_filters_every == 0):
+            # Cross-runner connector-stat sync (reference:
+            # FilterManager.synchronize, rllib/utils/filter_manager.py):
+            # merge each runner's running statistics and broadcast the
+            # aggregate so normalization converges cluster-wide.
+            from .connectors import sync_connector_states
+
+            sync_connector_states(self.local_runner, self.remote_runners)
         rets = list(self._episode_returns)
         return {
             "training_iteration": self.iteration,
@@ -360,8 +394,9 @@ class DQN(Algorithm):
         if self._env_steps >= cfg.learning_starts:
             for _ in range(cfg.num_epochs):
                 sample = self.buffer.sample(cfg.train_batch_size)
-                metrics.update(
-                    self.learner_group.learner.update_from_batch(sample))
+                m = self.learner_group.learner.update_from_batch(sample)
+                m.pop("td_abs", None)  # per-sample aux (Ape-X priorities)
+                metrics.update(m)
             runner.set_state(self.learner_group.get_weights())
         metrics["num_env_steps_sampled"] = self._env_steps
         return metrics

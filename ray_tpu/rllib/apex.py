@@ -1,0 +1,156 @@
+"""Ape-X DQN: distributed prioritized replay.
+
+Parity target: /root/reference/rllib/algorithms/apex_dqn/apex_dqn.py —
+many ε-greedy env runners (each at its OWN fixed exploration rate)
+feed sharded prioritized replay ACTORS; the learner samples from the
+shards, trains, and pushes new priorities back; weights broadcast to
+runners on a cadence decoupled from learning.
+
+TPU-native shape: the learner's update is one jitted function on the
+driver's device lane (batched TD backprop belongs on the chip); runners
+and replay shards are CPU actors. Transition batches move runner-node →
+shard-node BY REF (the driver forwards ObjectRefs, never block bytes) —
+the object plane does the transfer, exactly like Data's driver-free
+exchanges.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .algorithm import DQN
+from .replay import PrioritizedReplayBuffer
+
+
+class ReplayShard:
+    """One prioritized replay shard, hosted as a CPU actor (reference:
+    the ReplayActor fleet in apex_dqn)."""
+
+    def __init__(self, capacity: int, alpha: float = 0.6,
+                 beta: float = 0.4, seed: int = 0):
+        self.buf = PrioritizedReplayBuffer(capacity, alpha=alpha,
+                                           beta=beta, seed=seed)
+
+    def add_batch(self, columns: dict) -> int:
+        self.buf.add_batch(**columns)
+        return len(self.buf)
+
+    def sample(self, batch_size: int):
+        if len(self.buf) < batch_size:
+            return None
+        return self.buf.sample(batch_size)
+
+    def update_priorities(self, idx, priorities) -> bool:
+        self.buf.update_priorities(np.asarray(idx),
+                                   np.asarray(priorities))
+        return True
+
+    def size(self) -> int:
+        return len(self.buf)
+
+
+class ApexDQN(DQN):
+    """DQN whose replay lives in a sharded actor fleet and whose
+    exploration is spread across parallel runners."""
+
+    def setup(self, config):
+        if config.num_env_runners < 1:
+            raise ValueError(
+                "ApexDQN is the DISTRIBUTED replay architecture — use >=1 "
+                "env runners (plain DQN for the single-process shape)")
+        # Skip DQN.setup's local-only guard; Algorithm.setup builds the
+        # runner fleet.
+        super(DQN, self).setup(config)
+        import ray_tpu
+
+        shard_cls = ray_tpu.remote(ReplayShard)
+        per_shard = max(
+            1000, config.replay_buffer_capacity // config.num_replay_shards)
+        self.shards = [
+            shard_cls.options(num_cpus=0).remote(
+                per_shard, alpha=config.priority_alpha,
+                beta=config.priority_beta, seed=(config.seed or 0) + i)
+            for i in range(config.num_replay_shards)]
+        self._shard_rr = 0
+        self._env_steps = 0
+        self._updates_since_sync = 0
+        # Ape-X exploration ladder: eps_i = eps^(1 + i/(N-1) * alpha_exp)
+        n = config.num_env_runners
+        base, alpha_exp = config.apex_epsilon_base, 7.0
+        self._epsilons = [
+            base ** (1.0 + (i / max(1, n - 1)) * alpha_exp)
+            for i in range(n)]
+
+    def training_step(self) -> dict:
+        import ray_tpu
+
+        cfg = self.config
+        learner = self.learner_group.learner
+
+        # 1. Parallel ε-greedy rollouts, one ε per runner; each batch
+        # flows runner → shard by REF (no driver transit).
+        rollout_refs = [
+            r.rollout_epsilon_greedy.remote(
+                cfg.rollout_fragment_length, self._epsilons[i])
+            for i, r in enumerate(self.remote_runners)]
+        add_refs = []
+        for ref in rollout_refs:
+            shard = self.shards[self._shard_rr % len(self.shards)]
+            self._shard_rr += 1
+            add_refs.append(shard.add_batch.remote(ref))
+        ray_tpu.get(add_refs, timeout=120)  # barrier: adds landed
+        sizes = ray_tpu.get([s.size.remote() for s in self.shards],
+                            timeout=60)
+        self._env_steps += (cfg.rollout_fragment_length
+                            * len(self.remote_runners))
+        for rets in ray_tpu.get(
+                [r.episode_returns.remote()
+                 for r in self.remote_runners], timeout=60):
+            self._record_episodes(rets)
+
+        metrics = {"buffer_size": int(sum(sizes)),
+                   "epsilons": list(np.round(self._epsilons, 4))}
+
+        # 2. Learn from the shards (round-robin), push priorities back.
+        if self._env_steps >= cfg.learning_starts:
+            # Pipelined: next shard's sample request is in flight while
+            # the learner trains on the current batch.
+            pending = None
+            trained = 0
+            for k in range(cfg.num_epochs + 1):
+                if k < cfg.num_epochs:
+                    shard = self.shards[(self._shard_rr + k)
+                                        % len(self.shards)]
+                    nxt = (shard, shard.sample.remote(cfg.train_batch_size))
+                else:
+                    nxt = None
+                if pending is not None:
+                    shard, ref = pending
+                    sample = ray_tpu.get(ref, timeout=60)
+                    if sample is not None:
+                        idx = sample.pop("batch_indexes")
+                        m = learner.update_from_batch(sample)
+                        # New priorities come from the TRAINING pass's
+                        # per-sample TD errors — no extra forward pass.
+                        shard.update_priorities.remote(
+                            idx, m.pop("td_abs"))
+                        metrics.update(m)
+                        trained += 1
+                pending = nxt
+            metrics["learner_updates"] = trained
+            self._updates_since_sync += trained
+            if self._updates_since_sync >= cfg.weight_sync_freq:
+                self._updates_since_sync = 0
+                self._sync_weights()
+        metrics["num_env_steps_sampled"] = self._env_steps
+        return metrics
+
+    def stop(self):
+        import ray_tpu
+
+        for s in self.shards:
+            try:
+                ray_tpu.kill(s)
+            except Exception:  # noqa: BLE001
+                pass
+        super().stop()

@@ -50,6 +50,11 @@ class ConnectorPipeline(Connector):
         return {str(i): c.get_state()
                 for i, c in enumerate(self.connectors)}
 
+    def pop_delta(self) -> dict:
+        return {str(i): c.pop_delta()
+                for i, c in enumerate(self.connectors)
+                if hasattr(c, "pop_delta")}
+
     def set_state(self, state: dict) -> None:
         for i, c in enumerate(self.connectors):
             if str(i) in state:
@@ -94,18 +99,34 @@ class NormalizeObs(Connector):
         self._count = 0.0
         self._mean: Optional[np.ndarray] = None
         self._m2: Optional[np.ndarray] = None
+        # DELTA buffer: samples accumulated since the last cross-runner
+        # sync (reference: MeanStdFilter's flushable buffer — syncing
+        # absolute states would double-count every round).
+        self._buf_count = 0.0
+        self._buf_mean: Optional[np.ndarray] = None
+        self._buf_m2: Optional[np.ndarray] = None
+
+    def _welford(self, row, which: str):
+        count = getattr(self, f"_{which}count") + 1.0
+        mean = getattr(self, f"_{which}mean")
+        m2 = getattr(self, f"_{which}m2")
+        delta = row - mean
+        mean += delta / count
+        m2 += delta * (row - mean)
+        setattr(self, f"_{which}count", count)
 
     def __call__(self, obs):
         obs = np.asarray(obs, dtype=np.float64)
         if self._mean is None:
             self._mean = np.zeros(obs.shape[1:], np.float64)
             self._m2 = np.ones(obs.shape[1:], np.float64)
+        if self._buf_mean is None:
+            self._buf_mean = np.zeros(obs.shape[1:], np.float64)
+            self._buf_m2 = np.zeros(obs.shape[1:], np.float64)
         if not self.frozen:
             for row in obs.reshape(-1, *self._mean.shape):
-                self._count += 1.0
-                delta = row - self._mean
-                self._mean += delta / self._count
-                self._m2 += delta * (row - self._mean)
+                self._welford(row, "")
+                self._welford(row, "buf_")
         var = self._m2 / max(1.0, self._count)
         out = (obs - self._mean) / np.sqrt(var + self.eps)
         if self.clip is not None:
@@ -117,10 +138,26 @@ class NormalizeObs(Connector):
                 "mean": None if self._mean is None else self._mean.copy(),
                 "m2": None if self._m2 is None else self._m2.copy()}
 
+    def pop_delta(self) -> dict:
+        """Samples since the last sync/set_state; clears the buffer."""
+        out = {"count": self._buf_count,
+               "mean": (None if self._buf_mean is None
+                        else self._buf_mean.copy()),
+               "m2": (None if self._buf_m2 is None
+                      else self._buf_m2.copy())}
+        self._buf_count = 0.0
+        self._buf_mean = None
+        self._buf_m2 = None
+        return out
+
     def set_state(self, state: dict) -> None:
         self._count = state["count"]
         self._mean = state["mean"]
         self._m2 = state["m2"]
+        # A broadcast state supersedes anything buffered locally.
+        self._buf_count = 0.0
+        self._buf_mean = None
+        self._buf_m2 = None
 
 
 # -- module -> env (actions) -------------------------------------------------
@@ -142,6 +179,74 @@ class UnsquashActions(Connector):
     def __call__(self, actions):
         a = np.clip(actions, -1.0, 1.0)
         return self.low + (a + 1.0) * 0.5 * (self.high - self.low)
+
+
+def merge_normalizer_states(states: list) -> Optional[dict]:
+    """Chan et al. parallel-Welford merge of NormalizeObs running stats
+    (reference: MeanStdFilter.apply_changes via
+    FilterManager.synchronize). States with no data are skipped."""
+    live = [s for s in states
+            if s and s.get("mean") is not None and s.get("count", 0) > 0]
+    if not live:
+        return None
+    count = live[0]["count"]
+    mean = live[0]["mean"].astype(np.float64).copy()
+    m2 = live[0]["m2"].astype(np.float64).copy()
+    for s in live[1:]:
+        cb, mb, m2b = s["count"], s["mean"], s["m2"]
+        delta = mb - mean
+        tot = count + cb
+        mean = mean + delta * (cb / tot)
+        m2 = m2 + m2b + (delta ** 2) * (count * cb / tot)
+        count = tot
+    return {"count": count, "mean": mean, "m2": m2}
+
+
+def _merge_pipeline_states(states: list) -> dict:
+    """Positional merge of pipeline states: NormalizeObs-shaped entries
+    (count/mean/m2) Welford-merge; everything else keeps the first
+    runner's value."""
+    if not states:
+        return {}
+    merged = {}
+    for key in states[0]:
+        slots = [s.get(key, {}) for s in states]
+        if slots and isinstance(slots[0], dict) and "count" in slots[0] \
+                and "m2" in slots[0]:
+            m = merge_normalizer_states(slots)
+            merged[key] = m if m is not None else slots[0]
+        else:
+            merged[key] = slots[0]
+    return merged
+
+
+def sync_connector_states(local_runner, remote_runners) -> None:
+    """Delta-merge every runner's connector stats and broadcast the new
+    global (reference: rllib/utils/filter_manager.py
+    FilterManager.synchronize + MeanStdFilter.apply_changes).
+
+    Remote runners contribute their DELTA buffers (samples since the
+    previous sync); the local runner's absolute state — which already
+    holds the last broadcast plus its own samples — is the base the
+    deltas merge into. Broadcasting clears every buffer, so nothing is
+    ever counted twice."""
+    import ray_tpu
+
+    base = local_runner.get_connector_state()
+    local_runner.pop_connector_deltas()  # folded into `base` already
+    deltas = ray_tpu.get(
+        [r.pop_connector_deltas.remote() for r in remote_runners],
+        timeout=60)
+    merged = {
+        key: _merge_pipeline_states(
+            [base.get(key, {})] + [d.get(key, {}) for d in deltas])
+        for key in ("obs", "act")
+    }
+    if not (merged["obs"] or merged["act"]):
+        return
+    local_runner.set_connector_state(merged)
+    ray_tpu.get([r.set_connector_state.remote(merged)
+                 for r in remote_runners], timeout=60)
 
 
 def build_pipeline(spec) -> Optional[ConnectorPipeline]:

@@ -39,12 +39,17 @@ class SingleAgentEnvRunner:
         # actions before they hit the env. Each raw observation passes the
         # pipeline exactly ONCE (self._obs always holds the transformed
         # current obs) — a stateful normalizer must never double-count.
-        from .connectors import build_pipeline
+        from .connectors import Connector, build_pipeline
 
-        self._obs_connector = build_pipeline(
-            config.get("env_to_module_connector"))
-        self._act_connector = build_pipeline(
-            config.get("module_to_env_connector"))
+        def _build(spec):
+            # A zero-arg FACTORY (not itself a Connector) is called so
+            # each runner gets its own stateful instances.
+            if callable(spec) and not isinstance(spec, Connector):
+                spec = spec()
+            return build_pipeline(spec)
+
+        self._obs_connector = _build(config.get("env_to_module_connector"))
+        self._act_connector = _build(config.get("module_to_env_connector"))
         self._obs = self._obs_in(self.vec.reset())
 
     def _obs_in(self, obs) -> np.ndarray:
@@ -60,13 +65,23 @@ class SingleAgentEnvRunner:
 
     def get_connector_state(self) -> dict:
         """Per-runner connector statistics (e.g. NormalizeObs running
-        mean/var) for checkpointing. NOTE: stats are per-runner — the
-        reference's periodic cross-worker filter sync is not implemented."""
+        mean/var) for checkpointing; cross-runner sync merges DELTAS via
+        pop_connector_deltas (connectors.sync_connector_states)."""
         return {
             "obs": (self._obs_connector.get_state()
                     if self._obs_connector else {}),
             "act": (self._act_connector.get_state()
                     if self._act_connector else {}),
+        }
+
+    def pop_connector_deltas(self) -> dict:
+        """Stateful connectors' samples since the last sync (cleared);
+        feeds FilterManager-style delta merging."""
+        return {
+            "obs": (self._obs_connector.pop_delta()
+                    if self._obs_connector is not None else {}),
+            "act": (self._act_connector.pop_delta()
+                    if self._act_connector is not None else {}),
         }
 
     def set_connector_state(self, state: dict):
@@ -149,6 +164,29 @@ class SingleAgentEnvRunner:
         return {"obs": cat(obs_b), "actions": cat(act_b),
                 "rewards": cat(rew_b), "next_obs": cat(next_b),
                 "dones": cat(done_b)}
+
+    def rollout_epsilon_greedy(self, num_steps: int,
+                               epsilon: float) -> dict:
+        """ε-greedy transition rollout with the runner's OWN params —
+        actor-callable (no function shipping), the Ape-X worker shape
+        where each runner explores at its own fixed ε (reference:
+        apex_dqn per-worker exploration schedules)."""
+        import numpy as np
+
+        # Persistent rng: reseeding per call would replay one fixed
+        # exploration pattern every fragment.
+        if not hasattr(self, "_eps_rng"):
+            self._eps_rng = np.random.default_rng(
+                (self.config.get("seed", 0) or 0) + 7)
+        rng = self._eps_rng
+        n_act = self.module.n_actions
+
+        def act(obs):
+            if rng.random() < epsilon:
+                return rng.integers(0, n_act, len(obs))
+            return self.module.forward_inference(self.params, obs)
+
+        return self.rollout_transitions(num_steps, act)
 
     def episode_returns(self, clear: bool = True) -> list[float]:
         out = list(self._completed)

@@ -53,7 +53,11 @@ class Learner:
         batch = jax.tree_util.tree_map(jnp.asarray, batch)
         self.params, self.opt_state, metrics = self._update_fn(
             self.params, self.opt_state, batch)
-        return {k: float(v) for k, v in metrics.items()}
+        # Scalars become floats; per-sample aux outputs (e.g. DQN's
+        # td_abs priorities) come back as numpy arrays.
+        return {k: (float(v) if getattr(v, "ndim", 0) == 0 else
+                    np.asarray(v))
+                for k, v in metrics.items()}
 
     def get_state(self):
         return self.params
@@ -132,10 +136,19 @@ class DQNLearner(Learner):
         target = batch["rewards"] + self.gamma * nonterminal * \
             jax.lax.stop_gradient(q_next)
         td = q_taken - target
-        loss = jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2,
-                         jnp.abs(td) - 0.5).mean()  # Huber
+        per_sample = jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2,
+                               jnp.abs(td) - 0.5)  # Huber
+        if "weights" in batch:
+            # Prioritized replay importance weights (Ape-X): correct the
+            # sampling bias before reducing.
+            per_sample = per_sample * batch["weights"]
+        loss = per_sample.mean()
+        # Per-sample |TD| rides the aux dict: prioritized replay takes
+        # its new priorities from the TRAINING pass itself — no second
+        # forward (reference apex shape).
         return loss, {"td_error_mean": jnp.abs(td).mean(),
-                      "q_mean": q_taken.mean()}
+                      "q_mean": q_taken.mean(),
+                      "td_abs": jax.lax.stop_gradient(jnp.abs(td))}
 
     def update_from_batch(self, batch: dict) -> dict:
         batch = dict(batch)
