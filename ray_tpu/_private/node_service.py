@@ -2137,6 +2137,13 @@ class NodeService:
             return await self._remote_execute(payload)
         if method == "stacks":
             return await self.collect_stacks()
+        if method == "profile":
+            p = payload if isinstance(payload, dict) else {}
+            return await self.collect_profile(
+                float(p.get("duration_s", 5.0)), float(p.get("hz", 99.0)))
+        if method == "heap":
+            p = payload if isinstance(payload, dict) else {}
+            return await self.collect_heap(int(p.get("top_n", 25)))
         if method == "logs":
             return self.collect_logs(payload.get("tail_bytes", 16_384)
                                      if isinstance(payload, dict) else 16_384)
@@ -2718,6 +2725,67 @@ class NodeService:
             # Node-qualified keys: pids are per-host, so bare pids from
             # different machines would collide in the merged view.
             out[f"worker:{node}:{w.proc.pid}"] = text
+        return out
+
+    async def collect_profile(self, duration_s: float = 5.0,
+                              hz: float = 99.0) -> dict:
+        """Sampled CPU profiles (folded stacks) of this node process and
+        every live worker, concurrently (reference: dashboard
+        CpuProfilingManager fanning py-spy over workers)."""
+        from .profiler import sample_profile
+
+        loop = self.loop
+
+        async def me():
+            # Node's own sampler runs off-loop (it sleeps).
+            return await loop.run_in_executor(
+                None, lambda: sample_profile(duration_s, hz))
+
+        targets = [w for w in self.workers.values()
+                   if w.state in ("IDLE", "BUSY") and w.conn is not None
+                   and w.conn.alive]
+
+        async def ask(w):
+            try:
+                return await asyncio.wait_for(
+                    w.conn.call("profile", {"duration_s": duration_s,
+                                            "hz": hz}),
+                    timeout=duration_s + 10)
+            except Exception as e:  # noqa: BLE001 - best effort
+                return {"folded": "", "error": str(e)}
+
+        results = await asyncio.gather(me(), *(ask(w) for w in targets))
+        node = self.node_id.hex()[:8]
+        out = {f"node:{self.node_id.hex()[:12]}": results[0]}
+        for w, prof in zip(targets, results[1:]):
+            out[f"worker:{node}:{w.proc.pid}"] = prof
+        return out
+
+    async def collect_heap(self, top_n: int = 25) -> dict:
+        """tracemalloc heap snapshots of this node + workers (reference:
+        MemoryProfilingManager / memray attach)."""
+        from .profiler import heap_snapshot
+
+        targets = [w for w in self.workers.values()
+                   if w.state in ("IDLE", "BUSY") and w.conn is not None
+                   and w.conn.alive]
+
+        async def ask(w):
+            try:
+                return await asyncio.wait_for(
+                    w.conn.call("heap", {"top_n": top_n}), timeout=15)
+            except Exception as e:  # noqa: BLE001
+                return {"error": str(e)}
+
+        # Local snapshot off-loop: take_snapshot over a busy heap can
+        # cost seconds and must not freeze scheduling/heartbeats.
+        mine = self.loop.run_in_executor(None,
+                                         lambda: heap_snapshot(top_n))
+        dumps = await asyncio.gather(mine, *(ask(w) for w in targets))
+        node = self.node_id.hex()[:8]
+        out = {f"node:{self.node_id.hex()[:12]}": dumps[0]}
+        for w, h in zip(targets, dumps[1:]):
+            out[f"worker:{node}:{w.proc.pid}"] = h
         return out
 
     # -- memory pressure (reference: src/ray/common/memory_monitor.h:52 +

@@ -1,0 +1,174 @@
+"""Sampling CPU profiler + heap snapshots, py-spy/memray-shaped.
+
+Capability parity target: the reference's on-demand profiling surface
+(/root/reference/dashboard/modules/reporter/profile_manager.py:79
+CpuProfilingManager — py-spy flamegraphs of a live worker — and :188
+MemoryProfilingManager — memray heap). Neither tool ships in this
+image, and both need ptrace; instead processes SELF-sample:
+
+  * CPU: a daemon thread walks ``sys._current_frames()`` at ``hz`` for
+    ``duration_s`` and aggregates FOLDED stacks ("a;b;c count" — the
+    flamegraph interchange format Brendan Gregg's tooling and
+    speedscope read). The in-process sampler sees exactly what py-spy
+    would, minus native frames — the right trade for a pure-asyncio
+    runtime where the question is "which Python path is hot/stuck".
+  * Flamegraph: folded stacks render to a self-contained SVG here — no
+    external tooling on the box.
+  * Heap: tracemalloc top allocation sites (started on first request;
+    subsequent snapshots see everything allocated since).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import Counter
+
+
+def sample_profile(duration_s: float = 5.0, hz: float = 99.0,
+                   include_idle: bool = False) -> dict:
+    """Self-sample every thread of THIS process. Returns
+    {"folded": str, "samples": int, "duration_s": float}."""
+    interval = 1.0 / max(1.0, hz)
+    counts: Counter = Counter()
+    me = threading.get_ident()
+    samples = 0
+    deadline = time.monotonic() + duration_s
+    while time.monotonic() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            stack = []
+            f = frame
+            while f is not None:
+                code = f.f_code
+                stack.append(f"{code.co_name} "
+                             f"({code.co_filename.rsplit('/', 1)[-1]}"
+                             f":{f.f_lineno})")
+                f = f.f_back
+            if not stack:
+                continue
+            folded = ";".join(reversed(stack))
+            if not include_idle and (
+                    "wait (threading.py" in stack[0]
+                    or "select (selectors.py" in stack[0]
+                    or "_recv (" in stack[0]
+                    or "accept (socket.py" in stack[0]):
+                folded = "[idle];" + folded
+            counts[folded] += 1
+        samples += 1
+        time.sleep(interval)
+    lines = [f"{k} {v}" for k, v in counts.most_common()]
+    return {"folded": "\n".join(lines), "samples": samples,
+            "duration_s": duration_s}
+
+
+def merge_folded(parts: list[str]) -> str:
+    counts: Counter = Counter()
+    for text in parts:
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            stack, _, n = line.rpartition(" ")
+            try:
+                counts[stack] += int(n)
+            except ValueError:
+                continue
+    return "\n".join(f"{k} {v}" for k, v in counts.most_common())
+
+
+# ---------------------------------------------------------------------------
+# Flamegraph SVG (self-contained renderer for folded stacks)
+# ---------------------------------------------------------------------------
+_PALETTE = ["#d97757", "#e0906f", "#c96442", "#e8a87c", "#b85c3e",
+            "#d4845f", "#cc7352"]
+
+
+def render_flamegraph_svg(folded: str, title: str = "rtpu flamegraph",
+                          width: int = 1200) -> str:
+    """Folded stacks -> a self-contained SVG flamegraph (hover shows the
+    frame + sample share)."""
+    root: dict = {"children": {}, "value": 0}
+    for line in folded.splitlines():
+        stack, _, n = line.rpartition(" ")
+        try:
+            n = int(n)
+        except ValueError:
+            continue
+        node = root
+        node["value"] += n
+        for frame in stack.split(";"):
+            child = node["children"].setdefault(
+                frame, {"children": {}, "value": 0})
+            child["value"] += n
+            node = child
+
+    total = root["value"] or 1
+    row_h, font = 17, 11
+    rects: list[str] = []
+    max_depth = [0]
+
+    def esc(s: str) -> str:
+        return (s.replace("&", "&amp;").replace("<", "&lt;")
+                .replace(">", "&gt;").replace('"', "&quot;"))
+
+    def layout(node, x0: float, depth: int):
+        x = x0
+        for i, (name, child) in enumerate(sorted(node["children"].items())):
+            w = width * child["value"] / total
+            if w < 0.5:
+                continue
+            y = depth * row_h
+            max_depth[0] = max(max_depth[0], depth + 1)
+            color = _PALETTE[(hash(name) ^ depth) % len(_PALETTE)]
+            pct = 100.0 * child["value"] / total
+            label = esc(name) if w > 40 else ""
+            rects.append(
+                f'<g><title>{esc(name)} — {child["value"]} samples '
+                f'({pct:.1f}%)</title>'
+                f'<rect x="{x:.1f}" y="{y}" width="{max(w - 0.5, 0.5):.1f}"'
+                f' height="{row_h - 1}" fill="{color}" rx="1"/>'
+                f'<text x="{x + 3:.1f}" y="{y + row_h - 5}" '
+                f'font-size="{font}" font-family="monospace" '
+                f'clip-path="inset(0)" fill="#1a1a18">'
+                f'{label[:int(w // 7)]}</text></g>')
+            layout(child, x, depth + 1)
+            x += w
+
+    layout(root, 0.0, 1)
+    height = (max_depth[0] + 1) * row_h + 24
+    header = (f'<text x="4" y="14" font-size="13" font-family="monospace" '
+              f'fill="#3d3d3a">{esc(title)} — {total} samples</text>')
+    return (f'<svg xmlns="http://www.w3.org/2000/svg" width="{width}" '
+            f'height="{height}" viewBox="0 0 {width} {height}" '
+            f'style="background:#faf9f5">{header}'
+            + "".join(rects) + "</svg>")
+
+
+# ---------------------------------------------------------------------------
+# Heap snapshots (tracemalloc)
+# ---------------------------------------------------------------------------
+def heap_snapshot(top_n: int = 25) -> dict:
+    """Top allocation sites of THIS process. tracemalloc starts on the
+    first call (a second snapshot sees allocations since then; the
+    reference's memray attach has the same 'from now on' semantics)."""
+    import tracemalloc
+
+    if not tracemalloc.is_tracing():
+        tracemalloc.start(10)
+        return {"started": True, "top": [],
+                "note": "tracemalloc just started — snapshot again to "
+                        "see allocations from this point on"}
+    snap = tracemalloc.take_snapshot()
+    stats = snap.statistics("traceback")[:top_n]
+    top = []
+    for st in stats:
+        frames = [f"{f.filename.rsplit('/', 1)[-1]}:{f.lineno}"
+                  for f in st.traceback[-4:]]
+        top.append({"size_kb": round(st.size / 1024, 1),
+                    "count": st.count, "trace": " < ".join(frames)})
+    current, peak = tracemalloc.get_traced_memory()
+    return {"started": False, "top": top,
+            "current_kb": round(current / 1024, 1),
+            "peak_kb": round(peak / 1024, 1)}

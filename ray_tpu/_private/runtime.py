@@ -590,6 +590,25 @@ class Runtime:
         return self._node_fanout(
             "stacks", None, self.node.collect_stacks, timeout)
 
+    def cluster_profile(self, duration_s: float = 5.0, hz: float = 99.0,
+                        timeout: float = 60.0) -> dict:
+        """Sampled CPU profiles (folded stacks) of every node + worker
+        cluster-wide (reference: dashboard py-spy flamegraphs,
+        profile_manager.py:79). Render with
+        profiler.render_flamegraph_svg / `rtpu stack --flame`."""
+        payload = {"duration_s": duration_s, "hz": hz}
+        return self._node_fanout(
+            "profile", payload,
+            lambda: self.node.collect_profile(duration_s, hz),
+            max(timeout, duration_s + 15))
+
+    def cluster_heap(self, top_n: int = 25, timeout: float = 30.0) -> dict:
+        """tracemalloc heap snapshots cluster-wide (reference: memray
+        heap profiles from the dashboard agent)."""
+        return self._node_fanout(
+            "heap", {"top_n": top_n},
+            lambda: self.node.collect_heap(top_n), timeout)
+
     def resolve_runtime_env(self, env: dict | None,
                             device_lane: bool = False):
         """Merge the job default with a per-task env and upload any local

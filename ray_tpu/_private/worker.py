@@ -330,6 +330,16 @@ class WorkerContext:
             from .stack_dump import format_stacks
 
             return format_stacks()
+        if method == "profile":
+            from .profiler import sample_profile
+
+            return sample_profile(
+                duration_s=float((payload or {}).get("duration_s", 5.0)),
+                hz=float((payload or {}).get("hz", 99.0)))
+        if method == "heap":
+            from .profiler import heap_snapshot
+
+            return heap_snapshot(int((payload or {}).get("top_n", 25)))
         if method == "cancel_task":
             return self._cancel_running(TaskID(payload))
         if method == "shutdown":

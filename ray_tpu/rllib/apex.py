@@ -48,6 +48,16 @@ class ReplayShard:
     def size(self) -> int:
         return len(self.buf)
 
+    def priority_stats(self) -> dict:
+        """min/max/mean of live priorities (observability + tests: a
+        trained shard's priorities spread away from the uniform init)."""
+        n = len(self.buf)
+        if n == 0:
+            return {"min": 0.0, "max": 0.0, "mean": 0.0, "n": 0}
+        p = self.buf._prio[:n]
+        return {"min": float(p.min()), "max": float(p.max()),
+                "mean": float(p.mean()), "n": n}
+
 
 class ApexDQN(DQN):
     """DQN whose replay lives in a sharded actor fleet and whose

@@ -106,14 +106,17 @@ class NormalizeObs(Connector):
         self._buf_mean: Optional[np.ndarray] = None
         self._buf_m2: Optional[np.ndarray] = None
 
-    def _welford(self, row, which: str):
-        count = getattr(self, f"_{which}count") + 1.0
-        mean = getattr(self, f"_{which}mean")
-        m2 = getattr(self, f"_{which}m2")
-        delta = row - mean
-        mean += delta / count
-        m2 += delta * (row - mean)
-        setattr(self, f"_{which}count", count)
+    @staticmethod
+    def _chan_merge(count, mean, m2, cb, mb, m2b):
+        """Merge batch stats (cb, mb, m2b) into running (count, mean,
+        m2) — Chan et al. parallel Welford, vectorized."""
+        if cb == 0:
+            return count, mean, m2
+        tot = count + cb
+        delta = mb - mean
+        mean = mean + delta * (cb / tot)
+        m2 = m2 + m2b + (delta ** 2) * (count * cb / tot)
+        return tot, mean, m2
 
     def __call__(self, obs):
         obs = np.asarray(obs, dtype=np.float64)
@@ -124,9 +127,17 @@ class NormalizeObs(Connector):
             self._buf_mean = np.zeros(obs.shape[1:], np.float64)
             self._buf_m2 = np.zeros(obs.shape[1:], np.float64)
         if not self.frozen:
-            for row in obs.reshape(-1, *self._mean.shape):
-                self._welford(row, "")
-                self._welford(row, "buf_")
+            # Batch stats once (vectorized), Chan-merged into both the
+            # running and the sync-delta accumulators.
+            flat = obs.reshape(-1, *self._mean.shape)
+            cb = float(len(flat))
+            mb = flat.mean(axis=0)
+            m2b = ((flat - mb) ** 2).sum(axis=0)
+            self._count, self._mean, self._m2 = self._chan_merge(
+                self._count, self._mean, self._m2, cb, mb, m2b)
+            self._buf_count, self._buf_mean, self._buf_m2 = \
+                self._chan_merge(self._buf_count, self._buf_mean,
+                                 self._buf_m2, cb, mb, m2b)
         var = self._m2 / max(1.0, self._count)
         out = (obs - self._mean) / np.sqrt(var + self.eps)
         if self.clip is not None:
@@ -233,20 +244,35 @@ def sync_connector_states(local_runner, remote_runners) -> None:
     import ray_tpu
 
     base = local_runner.get_connector_state()
+    if not any(isinstance(slot, dict) and "m2" in slot
+               for pipe in base.values() for slot in pipe.values()):
+        return  # no stateful connectors: skip the cluster round entirely
     local_runner.pop_connector_deltas()  # folded into `base` already
-    deltas = ray_tpu.get(
-        [r.pop_connector_deltas.remote() for r in remote_runners],
-        timeout=60)
+    refs = [r.pop_connector_deltas.remote() for r in remote_runners]
+    # Per-runner tolerance: merge whoever answered; a hung runner KEEPS
+    # its delta buffer (pop never ran to completion for the driver) and
+    # contributes at the next sync instead of losing samples.
+    ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=30)
+    ready_set = {r.id.binary() for r in ready}
+    answered = []
+    deltas = []
+    for runner, ref in zip(remote_runners, refs):
+        if ref.id.binary() not in ready_set:
+            continue
+        try:
+            deltas.append(ray_tpu.get(ref, timeout=5))
+            answered.append(runner)
+        except Exception:  # noqa: BLE001 - runner died mid-sync
+            pass
     merged = {
         key: _merge_pipeline_states(
             [base.get(key, {})] + [d.get(key, {}) for d in deltas])
         for key in ("obs", "act")
     }
-    if not (merged["obs"] or merged["act"]):
-        return
     local_runner.set_connector_state(merged)
-    ray_tpu.get([r.set_connector_state.remote(merged)
-                 for r in remote_runners], timeout=60)
+    bcast = [r.set_connector_state.remote(merged) for r in answered]
+    if bcast:
+        ray_tpu.wait(bcast, num_returns=len(bcast), timeout=30)
 
 
 def build_pipeline(spec) -> Optional[ConnectorPipeline]:

@@ -406,9 +406,53 @@ def cmd_stack(args):
     from ray_tpu._private import context as context_mod
 
     rt = context_mod.require_context()
+    if getattr(args, "flame", False):
+        # Sampling profiler -> flamegraph (reference: `ray stack` is a
+        # py-spy dump; the dashboard's profile_manager adds --flame).
+        from ray_tpu._private.profiler import (merge_folded,
+                                               render_flamegraph_svg)
+
+        profs = rt.cluster_profile(duration_s=args.duration)
+        folded = merge_folded([p.get("folded", "") for p in profs.values()
+                               if isinstance(p, dict)])
+        if not folded:
+            sys.exit("no samples collected (cluster idle or unreachable)")
+        out = args.out or "rtpu-flame.svg"
+        with open(out, "w") as f:
+            f.write(render_flamegraph_svg(
+                folded, title=f"rtpu cluster profile "
+                              f"({args.duration:.0f}s @ 99Hz)"))
+        folded_path = out.rsplit(".", 1)[0] + ".folded"
+        with open(folded_path, "w") as f:
+            f.write(folded)
+        print(f"wrote {out} (+ {folded_path} for external tooling)")
+        return
     for name, text in sorted(rt.cluster_stacks().items()):
         print(f"===== {name} =====")
         print(text)
+        print()
+
+
+def cmd_heap(args):
+    """Per-process tracemalloc top allocation sites (reference: memray
+    heap profiles via the dashboard agent)."""
+    _attach(args)
+    from ray_tpu._private import context as context_mod
+
+    rt = context_mod.require_context()
+    for name, snap in sorted(rt.cluster_heap(top_n=args.top).items()):
+        print(f"===== {name} =====")
+        if not isinstance(snap, dict):
+            print(snap)
+            continue
+        if snap.get("note"):
+            print(snap["note"])
+        if "current_kb" in snap:
+            print(f"traced: current={snap['current_kb']:.0f}KB "
+                  f"peak={snap['peak_kb']:.0f}KB")
+        for row in snap.get("top", []):
+            print(f"  {row['size_kb']:>10.1f} KB x{row['count']:<6} "
+                  f"{row['trace']}")
         print()
 
 
@@ -543,7 +587,19 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("stack",
                         help="thread stacks of every node/worker process")
     sp.add_argument("--address", default=None)
+    sp.add_argument("--flame", action="store_true",
+                    help="sample a CPU profile and write a flamegraph SVG")
+    sp.add_argument("--duration", type=float, default=5.0,
+                    help="sampling window seconds (with --flame)")
+    sp.add_argument("--out", default=None,
+                    help="flamegraph output path (default rtpu-flame.svg)")
     sp.set_defaults(fn=cmd_stack)
+
+    sp = sub.add_parser("heap",
+                        help="tracemalloc heap snapshot per process")
+    sp.add_argument("--top", type=int, default=25)
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_heap)
 
     svp = sub.add_parser("serve", help="model serving")
     ssub = svp.add_subparsers(dest="serve_cmd", required=True)

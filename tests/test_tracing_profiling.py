@@ -142,3 +142,71 @@ def test_memory_cli_shape(rt, capsys):
     out = capsys.readouterr().out
     assert "object(s) cluster-wide" in out
     assert "node " in out
+
+
+# ---------------------------------------------------------------------------
+# Sampling profiler + flamegraph (reference: dashboard profile_manager
+# py-spy/memray surface — VERDICT r3 item 10)
+# ---------------------------------------------------------------------------
+def test_sample_profile_catches_hot_function():
+    import threading
+
+    from ray_tpu._private.profiler import (render_flamegraph_svg,
+                                           sample_profile)
+
+    stop = threading.Event()
+
+    def hot_spin_loop_xyz():
+        while not stop.wait(0.0005):
+            sum(i * i for i in range(200))
+
+    t = threading.Thread(target=hot_spin_loop_xyz, daemon=True)
+    t.start()
+    try:
+        prof = sample_profile(duration_s=0.8, hz=200)
+    finally:
+        stop.set()
+        t.join()
+    assert prof["samples"] > 50
+    assert "hot_spin_loop_xyz" in prof["folded"], prof["folded"][:500]
+    svg = render_flamegraph_svg(prof["folded"])
+    assert svg.startswith("<svg") and "hot_spin_loop_xyz" in svg
+
+
+def test_cluster_profile_covers_workers(rt):
+    import ray_tpu
+
+    @ray_tpu.remote
+    def busy_worker_fn_abc(sec):
+        import time
+        t0 = time.monotonic()
+        x = 0
+        while time.monotonic() - t0 < sec:
+            x += sum(i for i in range(500))
+        return x
+
+    ref = busy_worker_fn_abc.remote(4.0)
+    import time
+    time.sleep(1.0)  # the worker is mid-task
+    from ray_tpu._private import context as context_mod
+
+    profs = context_mod.require_context().cluster_profile(duration_s=1.5)
+    ray_tpu.get(ref, timeout=60)
+    worker_keys = [k for k in profs if k.startswith("worker:")]
+    assert worker_keys, profs.keys()
+    merged = "\n".join(p.get("folded", "") for p in profs.values()
+                       if isinstance(p, dict))
+    assert "busy_worker_fn_abc" in merged, merged[:800]
+
+
+def test_heap_snapshot_reports_allocations():
+    from ray_tpu._private.profiler import heap_snapshot
+
+    first = heap_snapshot()
+    keep = [bytearray(256_000) for _ in range(20)]  # ~5MB live
+    snap = heap_snapshot(top_n=10)
+    del keep
+    assert not snap.get("started", False) or first["started"]
+    if not snap.get("started"):
+        assert snap["current_kb"] > 1000
+        assert snap["top"], snap
