@@ -33,6 +33,8 @@ import subprocess
 import sys
 import time
 
+import numpy as np
+
 A100_GPT2S_TOKENS_PER_SEC = 55_000.0  # reference-stack per-accelerator ballpark
 
 ATTEMPTS = 5            # TPU attempts before falling back to CPU smoke
@@ -118,8 +120,19 @@ def run_bench(use_flash: bool) -> dict:
         f"batch={batch} seq={seq} mesh={spec.shape} "
         f"step={dt/iters*1000:.0f}ms loss={final_loss:.3f} "
         f"MFU={mfu*100:.1f}%", file=sys.stderr)
+    per_op = None
+    if on_tpu or os.environ.get("RT_BENCH_PROFILE_OPS"):
+        # Committed kernel-level breakdown (VERDICT r3 item 1): where the
+        # step's wall time actually goes at the bench shapes, so the MFU
+        # ceiling argument rests on measured per-op numbers in the bench
+        # artifact, not notes.
+        try:
+            per_op = profile_ops(cfg, mesh, batch, step, state, tokens,
+                                 dt / iters * 1000.0)
+        except Exception as e:  # noqa: BLE001 - profiling must not cost
+            print(f"per-op profile failed: {e!r}", file=sys.stderr)
     if on_tpu:
-        return {
+        out = {
             "metric": "gpt2_small_train_tokens_per_sec_per_chip",
             "value": round(per_chip, 1),
             "unit": "tokens/s/chip",
@@ -127,12 +140,108 @@ def run_bench(use_flash: bool) -> dict:
             "mfu": round(mfu, 4),
             "flash": use_flash,
         }
+        if per_op is not None:
+            out["per_op_ms"] = per_op
+        return out
     return {
         "metric": "gpt_tiny_cpu_smoke_tokens_per_sec",
         "value": round(per_chip, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": 0.0,
     }
+
+
+def profile_ops(cfg, mesh, batch, step, state, tokens,
+                step_ms_ref: float) -> dict:
+    """Per-component wall times at the EXACT bench shapes: attention
+    stack vs MLP stack vs embedding/unembed vs optimizer, each timed as
+    its own jitted program. Differences from whole-step time reflect
+    XLA's cross-op fusion/overlap, so the table brackets (not exactly
+    partitions) the step. Emitted into the bench JSON as provenance for
+    the MFU ceiling analysis (MFU_ANALYSIS.md)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import gpt
+
+    def timeit(fn, *args, iters=8):
+        out = fn(*args)
+        jax.tree_util.tree_map(
+            lambda x: x.block_until_ready()
+            if hasattr(x, "block_until_ready") else x, out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.tree_util.tree_map(
+            lambda x: x.block_until_ready()
+            if hasattr(x, "block_until_ready") else x, out)
+        return (time.perf_counter() - t0) / iters * 1000.0
+
+    table = {}
+    # Full loss forward / forward+backward on the real sharded state.
+    params = state["params"]
+    fwd = jax.jit(lambda p, t: gpt.loss_fn(p, t, cfg, mesh))
+    table["loss_forward"] = timeit(fwd, params, tokens)
+    grad = jax.jit(jax.grad(lambda p, t: gpt.loss_fn(p, t, cfg, mesh)))
+    table["loss_fwd_bwd"] = timeit(grad, params, tokens)
+    table["optimizer_and_rest"] = max(0.0, step_ms_ref
+                                      - table["loss_fwd_bwd"])
+
+    # Attention-only and MLP-only stacks at PER-SHARD layer shapes (per
+    # layer x n_layer) on one device: a data shard's slice of the step,
+    # comparable to whole_step regardless of mesh size (the bench box
+    # has one real chip, where per-shard == global).
+    n_shards = max(1, mesh.devices.size // max(
+        1, int(np.prod([mesh.shape.get(a, 1) for a in ("sp", "tp", "pp")]))
+    )) if hasattr(mesh, "shape") else 1
+    B = max(1, tokens.shape[0] // n_shards)
+    S, D, H = cfg.max_seq, cfg.d_model, cfg.n_head
+    hd = D // H
+    k1, k2 = jax.random.split(jax.random.key(2))
+    q = jax.random.normal(k1, (B, H, S, hd), jnp.bfloat16)
+    x = jax.random.normal(k2, (B, S, D), jnp.bfloat16)
+
+    if cfg.use_flash:
+        from ray_tpu.ops.flash_attention import flash_attention
+
+        att = jax.jit(lambda q: flash_attention(
+            q, q, q, causal=True, block_size=cfg.flash_block,
+            layout="bhsd"))
+    else:
+        def dense_att(q):
+            w = jnp.einsum("bhsd,bhtd->bhst", q, q) / (hd ** 0.5)
+            mask = jnp.tril(jnp.ones((S, S), bool))
+            w = jnp.where(mask, w, -1e9)
+            return jnp.einsum("bhst,bhtd->bhsd",
+                              jax.nn.softmax(w, axis=-1), q)
+
+        att = jax.jit(dense_att)
+    table["attention_fwd_per_layer"] = timeit(att, q)
+    att_grad = jax.jit(jax.grad(lambda q: att(q).astype(jnp.float32).sum()))
+    table["attention_fwd_bwd_per_layer"] = timeit(att_grad, q)
+    table["attention_fwd_bwd_all_layers"] = (
+        table["attention_fwd_bwd_per_layer"] * cfg.n_layer)
+
+    w1 = jax.random.normal(k1, (D, 4 * D), jnp.bfloat16)
+    w2 = jax.random.normal(k2, (4 * D, D), jnp.bfloat16)
+    mlp = jax.jit(lambda x, w1, w2: jax.nn.gelu(x @ w1) @ w2)
+    table["mlp_fwd_per_layer"] = timeit(mlp, x, w1, w2)
+    mlp_grad = jax.jit(jax.grad(
+        lambda x, w1, w2: (jax.nn.gelu(x @ w1) @ w2)
+        .astype(jnp.float32).sum()))
+    table["mlp_fwd_bwd_per_layer"] = timeit(mlp_grad, x, w1, w2)
+    table["mlp_fwd_bwd_all_layers"] = (
+        table["mlp_fwd_bwd_per_layer"] * cfg.n_layer)
+
+    # Unembedding projection (the single biggest matmul: D x vocab).
+    wv = jax.random.normal(k1, (D, cfg.vocab_size), jnp.bfloat16)
+    unemb = jax.jit(lambda x, wv: x @ wv)
+    table["unembed_matmul"] = timeit(unemb, x, wv)
+
+    table = {k: round(v, 2) for k, v in table.items()}
+    table["whole_step_ms"] = round(step_ms_ref, 2)
+    print(f"per-op table (ms): {json.dumps(table)}", file=sys.stderr)
+    return table
 
 
 def run_bench_framework() -> dict:

@@ -920,68 +920,96 @@ class LocalHeadClient:
 
 class RemoteHeadClient:
     """Head access for worker nodes: TCP duplex connection; the same
-    connection carries head→node pushes (node_dead, reserve_bundle)."""
+    connection carries head→node pushes (node_dead, reserve_bundle).
+
+    Idempotent READS carry systematic deadlines + bounded retry
+    (rpc.call_with_retry — reference: client_call.h deadline/retry
+    plumbing); mutations get a deadline only, so a slow head surfaces
+    as RpcTimeout instead of an indefinitely blocked caller."""
+
+    READ_TIMEOUT_S = 15.0
+    MUTATE_TIMEOUT_S = 60.0
 
     def __init__(self, conn: ServerConn):
         self.conn = conn
 
+    def _read(self, method, payload=None):
+        from .rpc import call_with_retry
+
+        return call_with_retry(self.conn, method, payload,
+                               timeout=self.READ_TIMEOUT_S, retries=2)
+
     async def kv_op(self, op, key, val=None):
-        return await self.conn.call("kv", (op, key, val))
+        if op in ("get", "exists", "keys"):
+            return await self._read("kv", (op, key, val))
+        # Mutations (put/del) are deadline-bounded, not retried: a retry
+        # after an ambiguous timeout could reorder against later writes.
+        return await self.conn.call("kv", (op, key, val),
+                                    timeout=self.MUTATE_TIMEOUT_S)
 
     async def export_function(self, fid, blob):
-        return await self.conn.call("export_function", (fid, blob))
+        return await self.conn.call("export_function", (fid, blob),
+                                    timeout=self.MUTATE_TIMEOUT_S)
 
     async def fetch_function(self, fid):
-        return await self.conn.call("fetch_function", fid)
+        return await self._read("fetch_function", fid)
 
     async def schedule(self, resources, strategy="default", exclude=()):
         return await self.conn.call(
             "schedule", {"resources": resources, "strategy": strategy,
-                         "exclude": [bytes(b) for b in exclude]})
+                         "exclude": [bytes(b) for b in exclude]},
+            timeout=self.MUTATE_TIMEOUT_S)
 
     async def register_named_actor(self, name, actor_id, node_id, methods):
         return await self.conn.call(
             "register_named_actor",
             {"name": name, "actor_id": actor_id.binary(),
-             "node_id": node_id.binary(), "methods": methods})
+             "node_id": node_id.binary(), "methods": methods},
+            timeout=self.MUTATE_TIMEOUT_S)
 
     async def unregister_named_actor(self, name, actor_id):
         return await self.conn.call(
             "unregister_named_actor",
-            {"name": name, "actor_id": actor_id.binary()})
+            {"name": name, "actor_id": actor_id.binary()},
+            timeout=self.MUTATE_TIMEOUT_S)
 
     async def get_actor_by_name(self, name):
-        return await self.conn.call("get_actor_by_name", name)
+        return await self._read("get_actor_by_name", name)
 
     async def record_actor_node(self, actor_id, node_id):
         return await self.conn.call(
             "record_actor_node",
-            {"actor_id": actor_id.binary(), "node_id": node_id.binary()})
+            {"actor_id": actor_id.binary(), "node_id": node_id.binary()},
+            timeout=self.MUTATE_TIMEOUT_S)
 
     async def actor_node(self, actor_id):
-        return await self.conn.call("actor_node", actor_id.binary())
+        return await self._read("actor_node", actor_id.binary())
 
     async def heartbeat(self, node_id, available, load=None):
         return await self.conn.call(
             "heartbeat", {"node_id": node_id.binary(),
-                          "available": available, "load": load})
+                          "available": available, "load": load},
+            timeout=self.READ_TIMEOUT_S)
 
     async def push_worker_logs(self, payload):
-        return await self.conn.call("worker_logs", payload)
+        return await self.conn.call("worker_logs", payload,
+                                    timeout=self.READ_TIMEOUT_S)
 
     async def list_nodes(self):
-        return await self.conn.call("list_nodes", None)
+        return await self._read("list_nodes", None)
 
     async def create_pg(self, pg_id, bundles, strategy):
         return await self.conn.call(
             "create_pg", {"pg_id": pg_id.binary(), "bundles": bundles,
-                          "strategy": strategy})
+                          "strategy": strategy},
+            timeout=self.MUTATE_TIMEOUT_S)
 
     async def remove_pg(self, pg_id):
-        return await self.conn.call("remove_pg", pg_id.binary())
+        return await self.conn.call("remove_pg", pg_id.binary(),
+                                    timeout=self.MUTATE_TIMEOUT_S)
 
     async def pg_state(self, pg_id):
-        return await self.conn.call("pg_state", pg_id.binary())
+        return await self._read("pg_state", pg_id.binary())
 
     async def list_pgs(self):
-        return await self.conn.call("list_pgs", None)
+        return await self._read("list_pgs", None)

@@ -51,7 +51,8 @@ from .exceptions import (
 )
 from .ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
 from .object_store import SharedMemoryStore
-from .rpc import ConnectionLost, DuplexServer, ServerConn, async_connect
+from .rpc import (ConnectionLost, DuplexServer, ServerConn, async_connect,
+                  call_stats as rpc_call_stats)
 from .task_spec import REF, VAL, SchedulingStrategy, TaskSpec
 
 PENDING, READY, ERROR = "PENDING", "READY", "ERROR"
@@ -417,6 +418,9 @@ class NodeService:
             "num_workers": len(self.workers),
             "num_actors": len(self.actors),
             "metrics": self._metrics_rows(),
+            # Per-method RPC latency/error/timeout counters (reference:
+            # client_call.h per-call metrics surfaced via stats).
+            "rpc": rpc_call_stats(),
         }
         if light:
             return snap

@@ -44,7 +44,9 @@ import os
 import socket
 import struct
 import threading
+import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FuturesTimeout
 from typing import Any, Awaitable, Callable, Union
 
 import cloudpickle
@@ -81,6 +83,73 @@ Address = Union[str, tuple]  # unix path | (host, port)
 # at startup; empty means "no cluster running yet" (unit tests of this
 # module; the handshake still runs and both sides must agree).
 _session_token = os.environ.get("RT_SESSION_TOKEN", "")
+
+
+# ---------------------------------------------------------------------------
+# Per-call metrics (reference: src/ray/rpc/client_call.h ClientCallManager
+# counting calls/replies/failures per method; grpc_client.h latency).
+# One process-wide table; cheap enough for every call on the hot path.
+# ---------------------------------------------------------------------------
+class _CallStat:
+    __slots__ = ("count", "errors", "timeouts", "total_s", "max_s")
+
+    def __init__(self):
+        self.count = 0
+        self.errors = 0
+        self.timeouts = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+
+
+_call_stats: dict[str, _CallStat] = {}
+_call_stats_lock = threading.Lock()
+
+
+def _record_call(method: str, dt: float, error: bool = False,
+                 timeout: bool = False):
+    with _call_stats_lock:
+        st = _call_stats.get(method)
+        if st is None:
+            st = _call_stats[method] = _CallStat()
+        st.count += 1
+        st.errors += error
+        st.timeouts += timeout
+        st.total_s += dt
+        st.max_s = max(st.max_s, dt)
+
+
+def call_stats() -> dict:
+    """Per-method RPC stats for this process: {method: {count, errors,
+    timeouts, mean_ms, max_ms}} — surfaced by the state snapshot /
+    Prometheus export."""
+    with _call_stats_lock:
+        return {
+            m: {"count": st.count, "errors": st.errors,
+                "timeouts": st.timeouts,
+                "mean_ms": round(st.total_s / st.count * 1000, 3)
+                if st.count else 0.0,
+                "max_ms": round(st.max_s * 1000, 3)}
+            for m, st in _call_stats.items()
+        }
+
+
+async def call_with_retry(conn, method: str, payload: Any = None, *,
+                          timeout: float = 10.0, retries: int = 2,
+                          backoff_s: float = 0.25):
+    """Deadline + bounded retry for IDEMPOTENT control-plane calls
+    (reference: client_call.h retry plumbing). Retries fire only on
+    deadline expiry — a lost CONNECTION propagates immediately, because
+    retrying on a dead socket cannot succeed and the caller owns
+    redialing."""
+    attempt = 0
+    while True:
+        try:
+            return await conn.call(method, payload, timeout=timeout)
+        except RpcTimeout:
+            if attempt >= retries:
+                raise
+            await asyncio.sleep(backoff_s * (2 ** attempt))
+            attempt += 1
 
 
 # asyncio holds only WEAK references to tasks: a fire-and-forget
@@ -140,6 +209,10 @@ class RpcError(Exception):
 
 class ConnectionLost(RpcError):
     pass
+
+
+class RpcTimeout(RpcError):
+    """A call exceeded its deadline (reference: gRPC DEADLINE_EXCEEDED)."""
 
 
 class AuthError(RpcError):
@@ -258,8 +331,21 @@ class DuplexClient:
             seq = self._seq
         fut: Future = Future()
         self._pending[seq] = fut
-        self._send(REQ, _req_enc(method), seq, (method, payload))
-        return fut.result(timeout=timeout)
+        t0 = time.perf_counter()
+        try:
+            self._send(REQ, _req_enc(method), seq, (method, payload))
+            out = fut.result(timeout=timeout)
+        except (TimeoutError, FuturesTimeout):
+            # Both spellings: concurrent.futures.TimeoutError is only an
+            # alias of the builtin from 3.11; 3.10 is supported.
+            self._pending.pop(seq, None)
+            _record_call(method, time.perf_counter() - t0, timeout=True)
+            raise
+        except BaseException:
+            _record_call(method, time.perf_counter() - t0, error=True)
+            raise
+        _record_call(method, time.perf_counter() - t0)
+        return out
 
     def notify(self, method: str, payload: Any = None):
         """Fire-and-forget (seqno 0 never gets a response)."""
@@ -346,13 +432,36 @@ class ServerConn:
         self.alive = True
         self.meta: dict = {}  # filled by registration (worker id etc.)
 
-    async def call(self, method: str, payload: Any = None) -> Any:
+    async def call(self, method: str, payload: Any = None,
+                   timeout: float | None = None) -> Any:
+        """``timeout`` is a per-call DEADLINE (reference:
+        client_call.h's method timeouts): on expiry the pending slot is
+        dropped and RpcTimeout raises — a late reply is discarded."""
         self._seq += 1
         seq = self._seq
         fut = asyncio.get_running_loop().create_future()
         self._pending[seq] = fut
-        await self._write(REQ, _req_enc(method), seq, (method, payload))
-        return await fut
+        t0 = time.perf_counter()
+        try:
+            await self._write(REQ, _req_enc(method), seq, (method, payload))
+            if timeout is None:
+                out = await fut
+            else:
+                try:
+                    out = await asyncio.wait_for(fut, timeout)
+                except asyncio.TimeoutError:
+                    self._pending.pop(seq, None)
+                    _record_call(method, time.perf_counter() - t0,
+                                 timeout=True)
+                    raise RpcTimeout(
+                        f"{method} exceeded its {timeout:.1f}s deadline")
+        except RpcTimeout:
+            raise
+        except BaseException:
+            _record_call(method, time.perf_counter() - t0, error=True)
+            raise
+        _record_call(method, time.perf_counter() - t0)
+        return out
 
     async def notify(self, method: str, payload: Any = None):
         await self._write(REQ, _req_enc(method), 0, (method, payload))

@@ -9,8 +9,9 @@ Env-to-module connectors consume a batched observation array [N, ...];
 module-to-env connectors consume a batched action array. Stateful
 connectors (running normalization) expose get_state/set_state —
 SingleAgentEnvRunner surfaces them via get/set_connector_state for
-checkpointing. Statistics are PER RUNNER (the reference's periodic
-cross-worker filter synchronization is not implemented).
+checkpointing, and delta buffers feed the periodic cross-runner
+synchronization (sync_connector_states — the FilterManager
+equivalent).
 """
 
 from __future__ import annotations
@@ -86,6 +87,19 @@ class ClipObs(Connector):
         return np.clip(obs, self.low, self.high)
 
 
+def _chan_merge(count, mean, m2, cb, mb, m2b):
+    """Chan et al. parallel-Welford merge of (count, mean, m2) stats —
+    THE single implementation, used by per-batch updates and the
+    cross-runner state merge alike."""
+    if cb == 0:
+        return count, mean, m2
+    tot = count + cb
+    delta = mb - mean
+    mean = mean + delta * (cb / tot)
+    m2 = m2 + m2b + (delta ** 2) * (count * cb / tot)
+    return tot, mean, m2
+
+
 class NormalizeObs(Connector):
     """Running mean/std normalization (Welford), the
     MeanStdObservationFilter equivalent. ``frozen=True`` stops updating
@@ -106,18 +120,6 @@ class NormalizeObs(Connector):
         self._buf_mean: Optional[np.ndarray] = None
         self._buf_m2: Optional[np.ndarray] = None
 
-    @staticmethod
-    def _chan_merge(count, mean, m2, cb, mb, m2b):
-        """Merge batch stats (cb, mb, m2b) into running (count, mean,
-        m2) — Chan et al. parallel Welford, vectorized."""
-        if cb == 0:
-            return count, mean, m2
-        tot = count + cb
-        delta = mb - mean
-        mean = mean + delta * (cb / tot)
-        m2 = m2 + m2b + (delta ** 2) * (count * cb / tot)
-        return tot, mean, m2
-
     def __call__(self, obs):
         obs = np.asarray(obs, dtype=np.float64)
         if self._mean is None:
@@ -133,11 +135,11 @@ class NormalizeObs(Connector):
             cb = float(len(flat))
             mb = flat.mean(axis=0)
             m2b = ((flat - mb) ** 2).sum(axis=0)
-            self._count, self._mean, self._m2 = self._chan_merge(
+            self._count, self._mean, self._m2 = _chan_merge(
                 self._count, self._mean, self._m2, cb, mb, m2b)
             self._buf_count, self._buf_mean, self._buf_m2 = \
-                self._chan_merge(self._buf_count, self._buf_mean,
-                                 self._buf_m2, cb, mb, m2b)
+                _chan_merge(self._buf_count, self._buf_mean,
+                            self._buf_m2, cb, mb, m2b)
         var = self._m2 / max(1.0, self._count)
         out = (obs - self._mean) / np.sqrt(var + self.eps)
         if self.clip is not None:
@@ -204,12 +206,8 @@ def merge_normalizer_states(states: list) -> Optional[dict]:
     mean = live[0]["mean"].astype(np.float64).copy()
     m2 = live[0]["m2"].astype(np.float64).copy()
     for s in live[1:]:
-        cb, mb, m2b = s["count"], s["mean"], s["m2"]
-        delta = mb - mean
-        tot = count + cb
-        mean = mean + delta * (cb / tot)
-        m2 = m2 + m2b + (delta ** 2) * (count * cb / tot)
-        count = tot
+        count, mean, m2 = _chan_merge(count, mean, m2,
+                                      s["count"], s["mean"], s["m2"])
     return {"count": count, "mean": mean, "m2": m2}
 
 
@@ -231,6 +229,12 @@ def _merge_pipeline_states(states: list) -> dict:
     return merged
 
 
+# Deltas whose pop was dispatched but whose reply missed the sync window:
+# kept (refs pin the data) and merged at the NEXT sync, so a slow runner
+# loses nothing. Keyed by runner handle id; entries die with the handles.
+_late_deltas: dict = {}
+
+
 def sync_connector_states(local_runner, remote_runners) -> None:
     """Delta-merge every runner's connector stats and broadcast the new
     global (reference: rllib/utils/filter_manager.py
@@ -248,20 +252,28 @@ def sync_connector_states(local_runner, remote_runners) -> None:
                for pipe in base.values() for slot in pipe.values()):
         return  # no stateful connectors: skip the cluster round entirely
     local_runner.pop_connector_deltas()  # folded into `base` already
-    refs = [r.pop_connector_deltas.remote() for r in remote_runners]
-    # Per-runner tolerance: merge whoever answered; a hung runner KEEPS
-    # its delta buffer (pop never ran to completion for the driver) and
-    # contributes at the next sync instead of losing samples.
+    pairs = [(r, r.pop_connector_deltas.remote()) for r in remote_runners]
+    # Plus any deltas popped in a PREVIOUS round whose replies were late:
+    # the refs pinned them, merge them now.
+    for rid, (runner, late_refs) in list(_late_deltas.items()):
+        pairs.extend((runner, ref) for ref in late_refs)
+        del _late_deltas[rid]
+    refs = [ref for _, ref in pairs]
     ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=30)
     ready_set = {r.id.binary() for r in ready}
     answered = []
     deltas = []
-    for runner, ref in zip(remote_runners, refs):
+    for runner, ref in pairs:
         if ref.id.binary() not in ready_set:
+            # The pop already ran (or will) on the runner; losing the
+            # reply would lose the samples — carry the ref to the next
+            # sync instead.
+            _late_deltas.setdefault(id(runner), (runner, []))[1].append(ref)
             continue
         try:
             deltas.append(ray_tpu.get(ref, timeout=5))
-            answered.append(runner)
+            if runner not in answered:
+                answered.append(runner)
         except Exception:  # noqa: BLE001 - runner died mid-sync
             pass
     merged = {

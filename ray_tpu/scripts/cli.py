@@ -422,7 +422,8 @@ def cmd_stack(args):
             f.write(render_flamegraph_svg(
                 folded, title=f"rtpu cluster profile "
                               f"({args.duration:.0f}s @ 99Hz)"))
-        folded_path = out.rsplit(".", 1)[0] + ".folded"
+        root, _ext = os.path.splitext(out)
+        folded_path = root + ".folded"
         with open(folded_path, "w") as f:
             f.write(folded)
         print(f"wrote {out} (+ {folded_path} for external tooling)")

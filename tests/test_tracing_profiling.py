@@ -200,13 +200,21 @@ def test_cluster_profile_covers_workers(rt):
 
 
 def test_heap_snapshot_reports_allocations():
+    import tracemalloc
+
     from ray_tpu._private.profiler import heap_snapshot
 
-    first = heap_snapshot()
-    keep = [bytearray(256_000) for _ in range(20)]  # ~5MB live
-    snap = heap_snapshot(top_n=10)
-    del keep
-    assert not snap.get("started", False) or first["started"]
-    if not snap.get("started"):
-        assert snap["current_kb"] > 1000
-        assert snap["top"], snap
+    try:
+        first = heap_snapshot()
+        keep = [bytearray(256_000) for _ in range(20)]  # ~5MB live
+        snap = heap_snapshot(top_n=10)
+        del keep
+        assert not snap.get("started", False) or first["started"]
+        if not snap.get("started"):
+            assert snap["current_kb"] > 1000
+            assert snap["top"], snap
+    finally:
+        # tracemalloc taxes every later allocation in this process —
+        # never leave it on for the rest of the suite (the perf-floor
+        # gate runs in the same interpreter).
+        tracemalloc.stop()

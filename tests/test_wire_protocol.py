@@ -175,3 +175,47 @@ def test_oversized_hello_rejected_before_read():
         s.close()
     finally:
         _stop(server, loop)
+
+
+def test_call_deadline_and_metrics():
+    """Per-call deadlines (reference: gRPC DEADLINE_EXCEEDED via
+    client_call.h) + per-method call stats."""
+    calls = {"n": 0}
+
+    async def handler(conn, method, payload):
+        if method == "sleepy":
+            calls["n"] += 1
+            if calls["n"] == 1:
+                await asyncio.sleep(3.0)  # first call blows the deadline
+            return "awake"
+        return "pong"
+
+    server, loop = _run_server(handler, token="tok-dl")
+    try:
+        rpc.set_session_token("tok-dl")
+        out = {}
+
+        async def scenario():
+            conn = await rpc.async_connect(tuple(server.address),
+                                           lambda c, m, p: None)
+            t0 = asyncio.get_running_loop().time()
+            try:
+                await conn.call("sleepy", timeout=0.5)
+                out["raised"] = False
+            except rpc.RpcTimeout:
+                out["raised"] = True
+            out["took"] = asyncio.get_running_loop().time() - t0
+            # Bounded retry succeeds once the handler behaves.
+            out["retried"] = await rpc.call_with_retry(
+                conn, "sleepy", timeout=1.0, retries=2)
+            await conn.close()
+
+        asyncio.run_coroutine_threadsafe(scenario(), loop).result(30)
+        assert out["raised"] and out["took"] < 2.0
+        assert out["retried"] == "awake"
+        stats = rpc.call_stats()
+        assert stats["sleepy"]["timeouts"] >= 1
+        assert stats["sleepy"]["count"] >= 2
+        assert stats["sleepy"]["mean_ms"] > 0
+    finally:
+        _stop(server, loop)
