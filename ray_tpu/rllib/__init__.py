@@ -30,6 +30,8 @@ from .multi_agent import (  # noqa: F401
 )
 from .offline import (  # noqa: F401
     BC,
+    CQL,
+    CQLLearner,
     MARWIL,
     BCLearner,
     load_offline_data,

@@ -82,7 +82,8 @@ class AlgorithmConfig:
         self.policies: Optional[dict] = None
         self.policy_mapping_fn: Callable = lambda agent_id: "default"
         # offline
-        self.input_: Optional[str] = None  # dataset path (BC/MARWIL)
+        self.input_: Optional[str] = None  # dataset path (BC/MARWIL/CQL)
+        self.cql_alpha = 1.0  # CQL conservative-gap coefficient
         self.evaluation_interval: int = 5
 
     # -- builder steps ------------------------------------------------------

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .algorithm import Algorithm
-from .learner import Learner, LearnerGroup
+from .learner import DQNLearner, Learner, LearnerGroup
 
 
 # ---------------------------------------------------------------------------
@@ -65,11 +65,13 @@ def load_offline_data(path: str, gamma: float = 0.99) -> dict:
         raise FileNotFoundError(f"no offline shards under {path!r}")
     cols: dict = {k: [] for k in ("obs", "actions", "rewards", "dones")}
     returns = []
+    shard_breaks = []
     for f in files:
         with np.load(f) as z:
             shard = {k: z[k] for k in cols}
             breaks = set(z["episode_breaks"].tolist()
                          if "episode_breaks" in z else [0])
+        shard_breaks.append(breaks)
         for k, v in shard.items():
             cols[k].append(v)
         # Return-to-go per SHARD, resetting at env boundaries: a shard
@@ -84,6 +86,29 @@ def load_offline_data(path: str, gamma: float = 0.99) -> dict:
         returns.append(rtg)
     out = {k: np.concatenate(v) for k, v in cols.items()}
     out["returns"] = np.concatenate(returns)
+    # TD-learning view (CQL): successor observations within each
+    # trajectory, with fragment ends treated as terminals so no TD
+    # target ever bootstraps across an episode/fragment boundary.
+    next_obs = []
+    terminals = []
+    offset = 0
+    for breaks, rtg in zip(shard_breaks, returns):
+        n = len(rtg)
+        obs = out["obs"][offset:offset + n]
+        dones = out["dones"][offset:offset + n].astype(bool)
+        nxt = np.concatenate([obs[1:], obs[-1:]], axis=0)
+        term = dones.copy()
+        # Fragment ends (vectorized): the step BEFORE each break, plus
+        # the shard's last step, never bootstraps across the boundary.
+        ends = np.asarray([b - 1 for b in breaks if 0 < b <= n] + [n - 1],
+                          dtype=np.int64)
+        term[ends] = True
+        nxt[ends] = obs[ends]  # masked by term anyway
+        next_obs.append(nxt)
+        terminals.append(term)
+        offset += n
+    out["next_obs"] = np.concatenate(next_obs)
+    out["terminals"] = np.concatenate(terminals)
     return out
 
 
@@ -151,27 +176,36 @@ class MARWIL(Algorithm):
         self._rng = np.random.default_rng(config.seed)
         self._eval_every = config.evaluation_interval
 
+    def _minibatch(self, idx) -> dict:
+        """Override seam: which dataset columns one update consumes."""
+        return {"obs": self.dataset["obs"][idx],
+                "actions": self.dataset["actions"][idx],
+                "returns": self.dataset["returns"][idx]}
+
+    def _evaluate(self, cfg) -> None:
+        """Sample until at least one episode COMPLETES (a well-cloned
+        policy's episodes outlast one fragment), bounded."""
+        for _ in range(20):
+            self.local_runner.sample(cfg.rollout_fragment_length)
+            rets = self.local_runner.episode_returns()
+            if rets:
+                self._record_episodes(rets)
+                break
+
     def training_step(self) -> dict:
         cfg = self.config
         n = len(self.dataset["actions"])
         metrics: dict = {}
         for _ in range(cfg.num_epochs):
             idx = self._rng.integers(0, n, cfg.train_batch_size)
-            mb = {"obs": self.dataset["obs"][idx],
-                  "actions": self.dataset["actions"][idx],
-                  "returns": self.dataset["returns"][idx]}
-            metrics = self.learner_group.learner.update_from_batch(mb)
+            m = self.learner_group.learner.update_from_batch(
+                self._minibatch(idx))
+            m.pop("td_abs", None)  # per-sample aux, not a metric
+            metrics = m
         metrics["num_steps_trained"] = cfg.num_epochs * cfg.train_batch_size
         if self._eval_every and self.iteration % self._eval_every == 0:
             self._sync_weights()
-            # Sample until at least one episode COMPLETES (a well-cloned
-            # policy's episodes outlast one fragment), bounded.
-            for _ in range(20):
-                self.local_runner.sample(cfg.rollout_fragment_length)
-                rets = self.local_runner.episode_returns()
-                if rets:
-                    self._record_episodes(rets)
-                    break
+            self._evaluate(cfg)
         return metrics
 
 
@@ -179,3 +213,64 @@ class BC(MARWIL):
     """Plain behavior cloning (parity: rllib/algorithms/bc)."""
 
     beta = 0.0
+
+
+class CQLLearner(DQNLearner):
+    """Discrete CQL(H): the Double-DQN TD loss plus a conservative
+    regularizer alpha * (logsumexp_a Q(s,a) - Q(s, a_data)) that pushes
+    down out-of-distribution action values — the offline-RL guard
+    against bootstrapping from actions the dataset never took (parity:
+    rllib/algorithms/cql/cql_torch_policy.py, discrete branch)."""
+
+    def __init__(self, module, *, cql_alpha: float = 1.0, **kw):
+        self.cql_alpha = cql_alpha
+        super().__init__(module, **kw)
+
+    def loss(self, params, batch):
+        td_loss, aux = super().loss(params, batch)
+        q = self.module.logits(params, batch["obs"])
+        q_data = jnp.take_along_axis(
+            q, batch["actions"][:, None].astype(jnp.int32), axis=1)[:, 0]
+        gap = (jax.scipy.special.logsumexp(q, axis=-1) - q_data).mean()
+        total = td_loss + self.cql_alpha * gap
+        return total, {**aux, "cql_gap": gap, "td_loss": td_loss}
+
+
+class CQL(MARWIL):
+    """Conservative Q-Learning from a logged dataset (parity:
+    rllib/algorithms/cql/cql.py): MARWIL's offline driver skeleton
+    (dataset setup, epoch loop, periodic eval) with TD minibatches
+    through CQLLearner and GREEDY evaluation (a Q policy evaluates by
+    argmax, not by sampling the cloned distribution)."""
+
+    def _make_learner_group(self):
+        learner = CQLLearner(
+            self._make_module(),
+            cql_alpha=self.config.cql_alpha,
+            gamma=self.config.gamma,
+            target_update_freq=self.config.target_update_freq,
+            lr=self.config.lr,
+            grad_clip=self.config.grad_clip,
+            seed=self.config.seed or 0,
+        )
+        return LearnerGroup(learner)
+
+    def _minibatch(self, idx) -> dict:
+        return {"obs": self.dataset["obs"][idx],
+                "actions": self.dataset["actions"][idx],
+                "rewards": self.dataset["rewards"][idx],
+                "next_obs": self.dataset["next_obs"][idx],
+                "dones": self.dataset["terminals"][idx]}
+
+    def _evaluate(self, cfg) -> None:
+        runner = self.local_runner
+
+        def greedy(obs):
+            return runner.module.forward_inference(runner.params, obs)
+
+        for _ in range(20):
+            runner.rollout_transitions(cfg.rollout_fragment_length, greedy)
+            rets = runner.episode_returns()
+            if rets:
+                self._record_episodes(rets)
+                break

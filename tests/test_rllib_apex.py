@@ -122,3 +122,47 @@ def test_filter_sync_converges_across_runners(rt):
         assert local["count"] == s0["count"]
     finally:
         algo.stop()
+
+
+def test_cql_offline_training(rt, tmp_path):
+    """CQL (parity: rllib/algorithms/cql): conservative Q-learning from
+    a logged dataset — TD loss + the logsumexp-vs-data-action gap —
+    with greedy online evaluation."""
+    from ray_tpu.rllib import CQL
+    from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
+    from ray_tpu.rllib.offline import load_offline_data, write_offline_data
+
+    # Log a behavior dataset with a random-ish policy.
+    runner = SingleAgentEnvRunner({"env": "CartPole-v1",
+                                   "num_envs_per_runner": 2, "seed": 5})
+    batches = [runner.sample(64) for _ in range(3)]
+    path = str(tmp_path / "logs")
+    assert write_offline_data(batches, path) == 3 * 64 * 2
+
+    data = load_offline_data(path)
+    # TD view invariants: successor obs shift within fragments; every
+    # fragment end is terminal (no cross-boundary bootstrap).
+    assert data["next_obs"].shape == data["obs"].shape
+    assert data["terminals"][-1]
+    np.testing.assert_array_equal(data["next_obs"][0], data["obs"][1])
+
+    config = (CQL.get_default_config()
+              .environment("CartPole-v1")
+              .offline_data(input_=path)
+              .training(train_batch_size=128, num_epochs=4,
+                        cql_alpha=1.0, lr=1e-3)
+              .evaluation(evaluation_interval=2)
+              .debugging(seed=11))
+    algo = config.build()
+    try:
+        gaps = []
+        for _ in range(6):
+            out = algo.train()
+            gaps.append(out["cql_gap"])
+        assert "td_loss" in out and "total_loss" in out
+        # The conservative regularizer is being optimized: the gap
+        # shrinks from its initial value.
+        assert gaps[-1] < gaps[0], gaps
+        assert out["num_steps_trained"] == 4 * 128
+    finally:
+        algo.stop()
