@@ -213,12 +213,14 @@ def merge(base: Optional[dict], override: Optional[dict]) -> dict:
 def _fetch_package(uri: str, kv_get: Callable, cache_dir: str) -> str:
     """Materialize a kv:// package into the node-local cache; returns the
     extracted directory. Content-addressed, so concurrent extractions
-    race benignly (os.replace is atomic)."""
+    race benignly (os.replace is atomic). Every use touches the entry's
+    mtime (the LRU clock for eviction)."""
     assert uri.startswith(URI_SCHEME), uri
     key = uri[len(URI_SCHEME):]
     sha = key.rsplit("/", 1)[-1]
     dest = os.path.join(cache_dir, sha)
     if os.path.isdir(dest):
+        _touch(dest)
         return dest
     blob = kv_get(key)
     if blob is None:
@@ -233,7 +235,115 @@ def _fetch_package(uri: str, kv_get: Callable, cache_dir: str) -> str:
         # Lost the race to another worker: theirs is identical.
         import shutil
         shutil.rmtree(tmp, ignore_errors=True)
+    _touch(dest)
     return dest
+
+
+def _touch(path: str) -> None:
+    try:
+        os.utime(path)
+    except OSError:
+        pass
+
+
+def _entry_size(path: str) -> int:
+    total = 0
+    for root, _, fs in os.walk(path):
+        for f in fs:
+            try:
+                total += os.lstat(os.path.join(root, f)).st_size
+            except OSError:
+                pass  # concurrently evicted / dangling symlink
+    return total
+
+
+def _evict_cache(cache_dir: str,
+                 keep: Optional[set] = None,
+                 max_bytes: Optional[int] = None,
+                 min_idle_s: float = 3600.0) -> int:
+    """Bounded package cache (reference: runtime_env/uri_cache.py — a
+    size-limited URI cache evicting unused entries): when the cache
+    exceeds ``max_bytes`` (RT_PKG_CACHE_MAX_MB, default 1024), delete
+    least-recently-USED entries until under the limit. Entries in
+    ``keep`` or touched within ``min_idle_s`` are never evicted — the
+    per-node approximation of the reference agent's in-use refcounts
+    (apply() keeps a heartbeat re-touching its live dirs, so a
+    long-running worker's working_dir never goes idle). Orphaned
+    ``.tmp-*`` extraction dirs older than min_idle_s are removed
+    regardless of the budget. Returns the number of entries evicted."""
+    import shutil
+    import time as _time
+
+    if max_bytes is None:
+        try:
+            max_bytes = int(os.environ.get(
+                "RT_PKG_CACHE_MAX_MB", "1024")) * 1024 * 1024
+        except ValueError:
+            # Malformed operator env: run with the default, never crash
+            # env setup over it.
+            max_bytes = 1024 * 1024 * 1024
+    keep = keep or set()
+    now = _time.time()
+    entries = []
+    total = 0
+    try:
+        names = os.listdir(cache_dir)
+    except OSError:
+        return 0
+    for name in names:
+        p = os.path.join(cache_dir, name)
+        if not os.path.isdir(p):
+            continue
+        try:
+            mtime = os.path.getmtime(p)
+        except OSError:
+            continue
+        if ".tmp-" in name:
+            # Crashed-extraction leftovers would leak unboundedly.
+            if now - mtime > min_idle_s:
+                shutil.rmtree(p, ignore_errors=True)
+            continue
+        size = _entry_size(p)
+        entries.append((mtime, size, p))
+        total += size
+    if total <= max_bytes:
+        return 0
+    evicted = 0
+    for mtime, size, p in sorted(entries):  # oldest first
+        if total <= max_bytes:
+            break
+        if p in keep:
+            continue
+        # Re-stat RIGHT before deleting: a cache hit may have touched
+        # this entry since the scan (TOCTOU window).
+        try:
+            if now - os.path.getmtime(p) < min_idle_s:
+                continue
+        except OSError:
+            continue
+        shutil.rmtree(p, ignore_errors=True)
+        total -= size
+        evicted += 1
+    return evicted
+
+
+def _start_touch_heartbeat(paths: list, interval_s: float = 1200.0) -> None:
+    """Keep THIS process's applied package dirs warm: periodic utime so
+    eviction's idle test never fires on a live worker's working_dir /
+    py_modules (the reference tracks in-use URIs by refcount in the
+    agent; a touch heartbeat is the per-process equivalent)."""
+    import threading
+    import time as _time
+
+    def beat():
+        while True:
+            _time.sleep(interval_s)
+            for p in paths:
+                _touch(p)
+
+    t = threading.Thread(target=beat, daemon=True,
+                         name="rt-pkg-cache-touch")
+    t.start()
 
 
 def _check_pip(requirements: List[str]) -> None:
@@ -275,16 +385,25 @@ def apply(resolved: Optional[dict], kv_get: Callable,
         for k, v in resolved.get("env_vars", {}).items():
             os.environ[k] = v
         os.makedirs(cache_dir, exist_ok=True)
+        fetched = []
         for uri in resolved.get("py_modules", []):
             path = _fetch_package(uri, kv_get, cache_dir)
+            fetched.append(path)
             if path not in sys.path:
                 sys.path.insert(0, path)
         wd = resolved.get("working_dir")
         if wd:
             path = _fetch_package(wd, kv_get, cache_dir)
+            fetched.append(path)
             os.chdir(path)
             if path not in sys.path:
                 sys.path.insert(0, path)
+        if fetched:
+            # One eviction pass per env application (not per package),
+            # never evicting what this worker just materialized; a
+            # heartbeat keeps the dirs warm for the worker's lifetime.
+            _evict_cache(cache_dir, keep=set(fetched))
+            _start_touch_heartbeat(fetched)
         if resolved.get("pip"):
             _check_pip(resolved["pip"])
         for name, plugin in _PLUGINS.items():

@@ -249,3 +249,39 @@ def test_job_level_default_env(tmp_path):
         assert ray_tpu.get(override.remote(), timeout=60) == "t1"
     finally:
         ray_tpu.shutdown()
+
+
+def test_package_cache_evicts_lru(tmp_path):
+    """Bounded URI cache (reference: uri_cache.py): over the size limit,
+    the least-recently-used idle entries evict; kept/recent ones stay."""
+    import os
+    import time
+
+    from ray_tpu.runtime_env import _evict_cache
+
+    cache = str(tmp_path / "cache")
+    os.makedirs(cache)
+    now = time.time()
+    for i, age_s in enumerate((7200, 5400, 10)):  # two idle, one fresh
+        d = os.path.join(cache, f"sha{i}")
+        os.makedirs(d)
+        with open(os.path.join(d, "blob"), "wb") as f:
+            f.write(b"x" * 1000)
+        os.utime(d, (now - age_s, now - age_s))
+
+    # Limit of ~1.5 entries: the two old ones are eligible, the fresh
+    # one is protected by min_idle_s.
+    n = _evict_cache(cache, max_bytes=1500, min_idle_s=3600)
+    left = sorted(os.listdir(cache))
+    assert n >= 1
+    assert "sha2" in left          # fresh entry survives
+    assert "sha0" not in left      # oldest idle entry evicted first
+
+    # keep= protects an entry regardless of age.
+    d = os.path.join(cache, "sha9")
+    os.makedirs(d)
+    with open(os.path.join(d, "blob"), "wb") as f:
+        f.write(b"x" * 2000)
+    os.utime(d, (now - 9000, now - 9000))
+    n = _evict_cache(cache, keep={d}, max_bytes=100, min_idle_s=3600)
+    assert os.path.isdir(d)
