@@ -404,8 +404,13 @@ def _reap_stale_chip_claimants():
 def _run_child(args: list[str], extra_env: dict, timeout_s: float):
     """Run `bench.py <args>` in its own session; return (rc, stdout, stderr).
     rc None = timeout. The whole process group is killed on timeout so a
-    wedged backend handshake can't leak a chip-holding grandchild."""
+    wedged backend handshake can't leak a chip-holding grandchild.
+    An extra_env value of None REMOVES the variable — the CPU fallback
+    must strip the chip-tunnel bootstrap vars, because the site hook
+    force-prepends the tunnel platform at jax import regardless of
+    JAX_PLATFORMS (r3's CPU fallback timed out exactly this way)."""
     env = {**os.environ, **extra_env}
+    env = {k: v for k, v in env.items() if v is not None}
     proc = subprocess.Popen(
         [sys.executable, "-u", os.path.abspath(__file__)] + args,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
@@ -483,6 +488,10 @@ def supervise() -> int:
     rc, out, err = _run_child(
         ["--child"],
         {"JAX_PLATFORMS": "cpu",
+         # Strip the tunnel bootstrap entirely: the site hook otherwise
+         # force-dials the (dead) chip at jax import even on "cpu".
+         "PALLAS_AXON_POOL_IPS": None,
+         "PALLAS_AXON_REMOTE_COMPILE": None,
          "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "")
                        + " --xla_force_host_platform_device_count=1").strip()},
         CHILD_TIMEOUT_S)

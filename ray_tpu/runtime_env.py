@@ -210,15 +210,35 @@ def merge(base: Optional[dict], override: Optional[dict]) -> dict:
 # ---------------------------------------------------------------------------
 # Setup (worker side)
 # ---------------------------------------------------------------------------
+# Shared-flock fds pinning cache entries THIS process uses: the kernel
+# holds the lock until process death, so the evictor's LOCK_EX probe
+# gives true in-use detection (the reference agent's URI refcounts,
+# without an agent) — no heuristic idle windows, crash-safe.
+_inuse_locks: list = []
+
+
+def _pin_entry(dest: str) -> None:
+    import fcntl
+
+    try:
+        fd = os.open(dest + ".lock", os.O_CREAT | os.O_RDWR, 0o644)
+        fcntl.flock(fd, fcntl.LOCK_SH)
+        _inuse_locks.append(fd)  # held for this process's lifetime
+    except OSError:
+        pass  # unpinned worst case: eviction falls back to mtime grace
+
+
 def _fetch_package(uri: str, kv_get: Callable, cache_dir: str) -> str:
     """Materialize a kv:// package into the node-local cache; returns the
     extracted directory. Content-addressed, so concurrent extractions
-    race benignly (os.replace is atomic). Every use touches the entry's
-    mtime (the LRU clock for eviction)."""
+    race benignly (os.replace is atomic). The entry is PINNED with a
+    shared flock for this process's lifetime (eviction skips locked
+    entries) and touched for LRU ordering."""
     assert uri.startswith(URI_SCHEME), uri
     key = uri[len(URI_SCHEME):]
     sha = key.rsplit("/", 1)[-1]
     dest = os.path.join(cache_dir, sha)
+    _pin_entry(dest)
     if os.path.isdir(dest):
         _touch(dest)
         return dest
@@ -229,8 +249,14 @@ def _fetch_package(uri: str, kv_get: Callable, cache_dir: str) -> str:
     os.makedirs(tmp, exist_ok=True)
     with zipfile.ZipFile(io.BytesIO(blob)) as zf:
         zf.extractall(tmp)
+    # Sidecar size: entries are immutable (content-addressed), so the
+    # recursive walk happens once at extraction, not on every eviction
+    # scan at every worker boot.
+    size = _entry_size(tmp)
     try:
         os.replace(tmp, dest)
+        with open(dest + ".size", "w") as f:
+            f.write(str(size))
     except OSError:
         # Lost the race to another worker: theirs is identical.
         import shutil
@@ -264,13 +290,21 @@ def _evict_cache(cache_dir: str,
     """Bounded package cache (reference: runtime_env/uri_cache.py — a
     size-limited URI cache evicting unused entries): when the cache
     exceeds ``max_bytes`` (RT_PKG_CACHE_MAX_MB, default 1024), delete
-    least-recently-USED entries until under the limit. Entries in
-    ``keep`` or touched within ``min_idle_s`` are never evicted — the
-    per-node approximation of the reference agent's in-use refcounts
-    (apply() keeps a heartbeat re-touching its live dirs, so a
-    long-running worker's working_dir never goes idle). Orphaned
-    ``.tmp-*`` extraction dirs older than min_idle_s are removed
-    regardless of the budget. Returns the number of entries evicted."""
+    least-recently-used entries until under the limit.
+
+    In-use safety: every process using an entry holds a SHARED flock on
+    ``<entry>.lock`` (pinned at fetch, kernel-released at death); the
+    evictor takes an EXCLUSIVE non-blocking flock before deleting, so a
+    live user's directory can never vanish from under it, and the
+    rename-aside before rmtree means concurrent fetchers see either a
+    complete entry or none (then re-extract — entries are
+    content-addressed and immutable). ``keep`` and ``min_idle_s``
+    protect entries whose users predate the lock scheme. Orphaned
+    ``.tmp-*`` dirs older than min_idle_s are removed regardless of the
+    budget. Entry sizes come from the ``.size`` sidecar written at
+    extraction (a full walk would cost every worker boot O(cache
+    files)). Returns the number of entries evicted."""
+    import fcntl
     import shutil
     import time as _time
 
@@ -292,7 +326,7 @@ def _evict_cache(cache_dir: str,
         return 0
     for name in names:
         p = os.path.join(cache_dir, name)
-        if not os.path.isdir(p):
+        if name.endswith((".lock", ".size")) or not os.path.isdir(p):
             continue
         try:
             mtime = os.path.getmtime(p)
@@ -303,7 +337,16 @@ def _evict_cache(cache_dir: str,
             if now - mtime > min_idle_s:
                 shutil.rmtree(p, ignore_errors=True)
             continue
-        size = _entry_size(p)
+        try:
+            with open(p + ".size") as f:
+                size = int(f.read())
+        except (OSError, ValueError):
+            size = _entry_size(p)  # pre-sidecar entry: walk once
+            try:
+                with open(p + ".size", "w") as f:
+                    f.write(str(size))
+            except OSError:
+                pass
         entries.append((mtime, size, p))
         total += size
     if total <= max_bytes:
@@ -312,38 +355,38 @@ def _evict_cache(cache_dir: str,
     for mtime, size, p in sorted(entries):  # oldest first
         if total <= max_bytes:
             break
-        if p in keep:
+        if p in keep or now - mtime < min_idle_s:
             continue
-        # Re-stat RIGHT before deleting: a cache hit may have touched
-        # this entry since the scan (TOCTOU window).
+        # Exclusive-lock probe: ANY live process pinning this entry
+        # (shared flock held since its fetch) makes this fail — true
+        # in-use detection, no timing windows.
         try:
-            if now - os.path.getmtime(p) < min_idle_s:
-                continue
+            lfd = os.open(p + ".lock", os.O_CREAT | os.O_RDWR, 0o644)
         except OSError:
             continue
-        shutil.rmtree(p, ignore_errors=True)
-        total -= size
-        evicted += 1
+        try:
+            try:
+                fcntl.flock(lfd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                continue  # in use
+            # Rename aside THEN delete: fetchers never see a half-dead
+            # dir (isdir goes false atomically; they re-extract).
+            trash = f"{p}.tmp-evict-{os.getpid()}"
+            try:
+                os.rename(p, trash)
+            except OSError:
+                continue  # someone else won
+            shutil.rmtree(trash, ignore_errors=True)
+            for side in (p + ".size",):
+                try:
+                    os.unlink(side)
+                except OSError:
+                    pass
+            total -= size
+            evicted += 1
+        finally:
+            os.close(lfd)
     return evicted
-
-
-def _start_touch_heartbeat(paths: list, interval_s: float = 1200.0) -> None:
-    """Keep THIS process's applied package dirs warm: periodic utime so
-    eviction's idle test never fires on a live worker's working_dir /
-    py_modules (the reference tracks in-use URIs by refcount in the
-    agent; a touch heartbeat is the per-process equivalent)."""
-    import threading
-    import time as _time
-
-    def beat():
-        while True:
-            _time.sleep(interval_s)
-            for p in paths:
-                _touch(p)
-
-    t = threading.Thread(target=beat, daemon=True,
-                         name="rt-pkg-cache-touch")
-    t.start()
 
 
 def _check_pip(requirements: List[str]) -> None:
@@ -399,11 +442,11 @@ def apply(resolved: Optional[dict], kv_get: Callable,
             if path not in sys.path:
                 sys.path.insert(0, path)
         if fetched:
-            # One eviction pass per env application (not per package),
-            # never evicting what this worker just materialized; a
-            # heartbeat keeps the dirs warm for the worker's lifetime.
+            # One eviction pass per env application (not per package).
+            # This process's entries are protected twice over: the keep
+            # set here, and the shared flocks pinned at fetch (held
+            # until process death) that make ANY evictor skip them.
             _evict_cache(cache_dir, keep=set(fetched))
-            _start_touch_heartbeat(fetched)
         if resolved.get("pip"):
             _check_pip(resolved["pip"])
         for name, plugin in _PLUGINS.items():

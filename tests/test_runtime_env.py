@@ -285,3 +285,23 @@ def test_package_cache_evicts_lru(tmp_path):
     os.utime(d, (now - 9000, now - 9000))
     n = _evict_cache(cache, keep={d}, max_bytes=100, min_idle_s=3600)
     assert os.path.isdir(d)
+
+    # An entry PINNED by a live process's shared flock survives even
+    # when idle and over budget (the in-use contract).
+    import fcntl
+
+    d2 = os.path.join(cache, "shaA")
+    os.makedirs(d2)
+    with open(os.path.join(d2, "blob"), "wb") as f:
+        f.write(b"x" * 2000)
+    os.utime(d2, (now - 9000, now - 9000))
+    fd = os.open(d2 + ".lock", os.O_CREAT | os.O_RDWR)
+    fcntl.flock(fd, fcntl.LOCK_SH)
+    try:
+        _evict_cache(cache, max_bytes=100, min_idle_s=0)
+        assert os.path.isdir(d2), "pinned entry was evicted"
+    finally:
+        os.close(fd)
+    # Unpinned now: the same eviction succeeds.
+    _evict_cache(cache, max_bytes=100, min_idle_s=0)
+    assert not os.path.isdir(d2)
