@@ -15,6 +15,7 @@ from .api import (  # noqa: F401
     available_resources,
     cancel,
     cluster_resources,
+    free,
     get,
     get_actor,
     get_runtime_context,
@@ -39,6 +40,7 @@ from ._private.exceptions import (  # noqa: F401
     ActorDiedError,
     ActorUnavailableError,
     GetTimeoutError,
+    ObjectFreedError,
     ObjectLostError,
     OutOfMemoryError,
     RayTpuError,

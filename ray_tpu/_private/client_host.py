@@ -136,6 +136,15 @@ class SessionHost:
                         drop.append(self.registry.pop(b)[0])
             del drop  # host ObjectRefs release their cluster counts here
             return True
+        if method == "free":
+            # Client-initiated eager value release (ray_tpu.free via an
+            # rtpu:// session): forward to the session runtime's node.
+            with self._reg_lock:
+                ent = self.registry.get(payload)
+            ref = ent[0] if ent is not None else None
+            if ref is not None:
+                rt.free(ref.id, ref.owner_addr)
+            return True
         if method == "kill_actor":
             rt.kill_actor(ActorID(payload["actor_id"]),
                           payload.get("no_restart", True))

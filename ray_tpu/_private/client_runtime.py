@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import sys
 import threading
+from concurrent import futures as _futures
 from typing import Any, Optional, Sequence
 
 import cloudpickle
@@ -107,6 +108,12 @@ class ClientRuntime:
         except Exception:  # noqa: BLE001 - conn gone; session cleans up
             pass
 
+    def free(self, oid: ObjectID, owner_addr=None):
+        try:
+            self._conn.notify("free", oid.binary())
+        except Exception:  # noqa: BLE001 - conn gone; session cleans up
+            pass
+
     def decref(self, oid: ObjectID, owner_addr=None):
         # Batched: ref churn (comprehensions over many refs) must not
         # pay one proxy round per release. Releases coalesce for 50ms
@@ -163,7 +170,10 @@ class ClientRuntime:
                 "get", {"ids": [r.id.binary() for r in items],
                         "timeout": timeout, "is_list": not single},
                 timeout=None if timeout is None else timeout + 30)
-        except TimeoutError as e:
+        except (TimeoutError, _futures.TimeoutError) as e:
+            # Both spellings: on Python 3.10 DuplexClient.call raises
+            # concurrent.futures.TimeoutError, which is NOT the builtin
+            # there (they merged in 3.11) — ADVICE r4.
             raise GetTimeoutError(str(e)) from None
         values = [cloudpickle.loads(b) for b in blobs]
         return values[0] if single else values

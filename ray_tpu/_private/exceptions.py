@@ -69,6 +69,12 @@ class ObjectLostError(RayTpuError):
     """Object data was lost and could not be reconstructed from lineage."""
 
 
+class ObjectFreedError(ObjectLostError):
+    """The object's value was explicitly released via ``ray_tpu.free`` —
+    dangling refs resolve to this error instead of hanging (reference:
+    ray._private.internal_api.free / `ray.exceptions.ObjectFreedError`)."""
+
+
 class GetTimeoutError(RayTpuError, TimeoutError):
     """`get` exceeded its timeout."""
 

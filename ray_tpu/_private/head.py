@@ -33,7 +33,7 @@ from typing import Any, Optional
 
 from .config import get_config
 from .ids import ActorID, NodeID, PlacementGroupID
-from .rpc import ConnectionLost, DuplexServer, ServerConn
+from .rpc import ConnectionLost, DuplexServer, RpcTimeout, ServerConn
 
 ALIVE, DEAD = "ALIVE", "DEAD"
 
@@ -396,7 +396,7 @@ class HeadService:
                                 "release_bundle",
                                 {"pg_id": pg.pg_id.binary(),
                                  "bundle_index": idx})
-                        except (ConnectionLost, OSError):
+                        except (ConnectionLost, RpcTimeout, OSError):
                             pass
             if pg.state == "CREATED":
                 pg.state = "PENDING"
@@ -423,7 +423,7 @@ class HeadService:
             if entry.conn is not None and entry.state == ALIVE:
                 try:
                     await entry.conn.notify(method, payload)
-                except (ConnectionLost, OSError):
+                except (ConnectionLost, RpcTimeout, OSError):
                     pass
 
     # ------------------------------------------------------------------
@@ -583,7 +583,7 @@ class HeadService:
                     "reserve_bundle",
                     {"pg_id": pg_id.binary(), "bundle_index": idx,
                      "resources": res})
-            except (ConnectionLost, OSError):
+            except (ConnectionLost, RpcTimeout, OSError):
                 pass
 
     async def remove_placement_group(self, pg_id: PlacementGroupID):
@@ -608,7 +608,7 @@ class HeadService:
                         await entry.conn.notify(
                             "release_bundle",
                             {"pg_id": pg_id.binary(), "bundle_index": idx})
-                    except (ConnectionLost, OSError):
+                    except (ConnectionLost, RpcTimeout, OSError):
                         pass
         # Freed bundles are a capacity event heartbeats can't see (the
         # head pre-credits entry.available, so the node's next heartbeat

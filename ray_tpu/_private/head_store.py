@@ -14,6 +14,7 @@ from __future__ import annotations
 import os
 import pickle
 import threading
+import time
 from typing import Any, Dict, Optional
 
 
@@ -107,6 +108,7 @@ class AppendLogHeadStore(HeadStore):
         self._lock = threading.Lock()
         self._seq = 0
         self._log_f = None
+        self._last_fsync = 0.0
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
 
     supports_append = True
@@ -199,6 +201,16 @@ class AppendLogHeadStore(HeadStore):
                 self._log_f = open(self.log_path, "ab")
             self._log_f.write(len(body).to_bytes(4, "little") + body)
             self._log_f.flush()
+            # Durability against MACHINE crashes, not just process death
+            # (ADVICE r4): fsync at most once per second, Redis
+            # appendfsync-everysec style — a power loss may drop up to
+            # the last second of acknowledged mutations, which the
+            # docstring contract documents; a kill -9 loses nothing
+            # (the page cache survives the process).
+            now = time.monotonic()
+            if now - self._last_fsync >= 1.0:
+                os.fsync(self._log_f.fileno())
+                self._last_fsync = now
 
     def save(self, tables):
         """Full snapshot + log truncation (compaction)."""

@@ -42,6 +42,7 @@ from . import serialization
 from .config import get_config
 from .exceptions import (
     ActorDiedError,
+    ObjectFreedError,
     ObjectLostError,
     OutOfMemoryError,
     RuntimeEnvSetupError,
@@ -51,8 +52,8 @@ from .exceptions import (
 )
 from .ids import ActorID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID
 from .object_store import SharedMemoryStore
-from .rpc import (ConnectionLost, DuplexServer, ServerConn, async_connect,
-                  call_stats as rpc_call_stats)
+from .rpc import (ConnectionLost, DuplexServer, RpcTimeout, ServerConn,
+                  async_connect, call_stats as rpc_call_stats)
 from .task_spec import REF, VAL, SchedulingStrategy, TaskSpec
 
 PENDING, READY, ERROR = "PENDING", "READY", "ERROR"
@@ -110,6 +111,23 @@ async def attach_node_to_head(node: "NodeService", head_addr: tuple,
     node.register_cb = register
     await register()
     return conn
+
+
+def raise_stored(err):
+    """Raise a table-stored exception WITHOUT mutating it. ``raise
+    st.error`` attaches the caller's traceback to the stored instance,
+    chaining node.objects -> error -> frame objects -> every local
+    ObjectRef in those frames — which pins refs (their __del__ never
+    runs) and leaks the very entries an errored/freed object should
+    release. A shallow copy raises with a fresh traceback instead."""
+    import copy
+
+    try:
+        clone = copy.copy(err)
+        clone.__traceback__ = None
+    except Exception:  # noqa: BLE001 - uncopyable custom error
+        clone = err
+    raise clone
 
 
 @dataclass
@@ -546,7 +564,7 @@ class NodeService:
                 if ok is False:
                     # Head lost track of us (restart/expiry): re-register.
                     await self._register_with_head()
-            except (ConnectionLost, OSError):
+            except (ConnectionLost, RpcTimeout, OSError):
                 pass
             await asyncio.sleep(self.cfg.heartbeat_interval_s)
 
@@ -625,7 +643,7 @@ class NodeService:
             conn = await self._addr_conn(owner_addr)
             res = await conn.call("fetch_meta",
                                   {"oid": oid.binary(), "timeout": timeout})
-        except (ConnectionLost, OSError) as e:
+        except (ConnectionLost, RpcTimeout, OSError) as e:
             self.mark_error(oid, ObjectLostError(
                 f"owner of {oid.hex()[:16]} unreachable: {e}"))
             return
@@ -666,7 +684,7 @@ class NodeService:
                     res = await conn.call(
                         "fetch_meta",
                         {"oid": oid.binary(), "timeout": timeout})
-                except (ConnectionLost, OSError) as e:
+                except (ConnectionLost, RpcTimeout, OSError) as e:
                     self.mark_error(oid, ObjectLostError(
                         f"owner of {oid.hex()[:16]} unreachable: {e}"))
                     return
@@ -698,7 +716,7 @@ class NodeService:
                 "addr": list(self.peer_address),
                 "node_id": self.node_id.binary(),
             })
-        except (ConnectionLost, OSError):
+        except (ConnectionLost, RpcTimeout, OSError):
             pass
 
     async def _pull_chunks(self, oid: ObjectID, addr: tuple,
@@ -736,7 +754,7 @@ class NodeService:
             finally:
                 try:
                     await src.notify("fetch_end", oid.binary())
-                except (ConnectionLost, OSError):
+                except (ConnectionLost, RpcTimeout, OSError):
                     pass
             self.counters["object_bytes_pulled"] += size
             return buf
@@ -994,7 +1012,7 @@ class NodeService:
                 "addr": list(self.peer_address),
                 "node_id": self.node_id.binary(),
             })
-        except (ConnectionLost, OSError):
+        except (ConnectionLost, RpcTimeout, OSError):
             return  # owner gone: fetches will surface the loss
         st = self.objects.get(oid)
         if st is None:
@@ -1011,6 +1029,61 @@ class NodeService:
             return
         st.refcount -= n
         self._maybe_free(oid, st)
+
+    def free_object(self, oid: ObjectID) -> bool:
+        """Eagerly release a READY object's VALUE, now, regardless of
+        outstanding refcounts (``ray_tpu.free`` — reference:
+        ray._private.internal_api.free + streaming_executor.py:242's
+        eager consumed-block release). The entry becomes a tombstone:
+        late readers get ObjectFreedError instead of a hang, dropped
+        refs still pop it via the normal _maybe_free path, and lineage
+        is severed (a freed object is not reconstructable — matching
+        the reference, where free'd objects are gone for good).
+
+        Skips (returns False) when the object is PENDING, errored, or
+        has live waiters — freeing under an active reader would turn a
+        caller's in-flight ``get`` into an error it didn't ask for.
+        Loop thread only."""
+        st = self.objects.get(oid)
+        if st is None or st.status != READY or st.waiters:
+            return False
+        self._tombstone_freed(oid, st)
+        # Copy-holders elsewhere release their bytes too — otherwise the
+        # freed block lingers exactly on the node that materialized it,
+        # and a late get there would return the value instead of the
+        # tombstone error.
+        for addr in list(st.holders or ()):
+            self.spawn(self._notify_free_peer(oid, tuple(addr)))
+        st.holders = None
+        return True
+
+    def _tombstone_freed(self, oid: ObjectID, st: ObjectState) -> None:
+        """The shared freed-state transition (owner side and borrowed
+        copies): value gone, transitive pins released, lineage severed,
+        ObjectFreedError for any late reader. Loop thread only."""
+        if st.location == "shm":
+            self.shm.unpin(oid)
+            self.shm.delete(oid)
+        # A freed container releases what it transitively pinned.
+        for oid_b, _owner in (st.inner_refs or ()):
+            self.decref(ObjectID(oid_b))
+        st.inner_refs = None
+        st.value = None
+        st.size = 0
+        st.location = "memory"
+        st.creating_spec = None
+        st.status = ERROR
+        st.error = ObjectFreedError(
+            f"object {oid.hex()[:16]} was explicitly freed "
+            f"(ray_tpu.free)")
+        self.counters["objects_freed"] += 1
+
+    async def _notify_free_peer(self, oid: ObjectID, addr: tuple) -> None:
+        try:
+            conn = await self._addr_conn(addr)
+            await conn.notify("free_object", oid.binary())
+        except (ConnectionLost, RpcTimeout, OSError):
+            pass  # peer gone; its copy died with it
 
     def _maybe_free(self, oid: ObjectID, st: ObjectState):
         # PENDING entries are kept alive awaiting production — EXCEPT pure
@@ -1047,7 +1120,7 @@ class NodeService:
             conn = await self._addr_conn(owner_addr)
             await conn.notify("borrow_release", {
                 "oid": oid.binary(), "addr": list(self.peer_address)})
-        except (ConnectionLost, OSError):
+        except (ConnectionLost, RpcTimeout, OSError):
             pass
 
     async def _notify_copy_removed(self, oid: ObjectID, owner_addr: tuple):
@@ -1055,8 +1128,28 @@ class NodeService:
             conn = await self._addr_conn(owner_addr)
             await conn.notify("copy_removed", {
                 "oid": oid.binary(), "addr": list(self.peer_address)})
-        except (ConnectionLost, OSError):
+        except (ConnectionLost, RpcTimeout, OSError):
             pass
+
+    async def _notify_free_remote(self, oid: ObjectID, owner_addr: tuple):
+        """Forward an eager free to the object's owner; also RELEASE (not
+        tombstone) any local pulled copy. The owner is the arbiter — it
+        may skip the free (active waiters), so the local copy must only
+        drop its bytes and become re-pullable: a later local get then
+        re-fetches from the owner and observes whatever the owner
+        decided (value, or ObjectFreedError)."""
+        st = self.objects.get(oid)
+        if st is not None and st.status == READY and not st.waiters:
+            if st.location == "shm":
+                self.shm.unpin(oid)
+                self.shm.delete(oid)
+            st.value, st.size = None, 0
+            st.location = "memory"
+            st.status = PENDING
+            if st.pulled_from is not None:
+                self.spawn(self._notify_copy_removed(oid, st.pulled_from))
+                st.pulled_from = None
+        await self._notify_free_peer(oid, owner_addr)
 
     def materialize_for_ipc(self, oid: ObjectID) -> tuple:
         """Return ("bytes", blob) | ("shm",) | ("err", e) for a READY object,
@@ -1089,7 +1182,7 @@ class NodeService:
         lane fast path."""
         st = self.objects[oid]
         if st.status == ERROR:
-            raise st.error
+            raise_stored(st.error)
         if st.location == "shm":
             mv = self.shm.get(oid)
             if mv is None:
@@ -1208,7 +1301,7 @@ class NodeService:
         """Placement-group tasks run where their bundle is reserved."""
         try:
             info = await self.head.pg_state(spec.strategy.pg_id)
-        except (ConnectionLost, OSError):
+        except (ConnectionLost, RpcTimeout, OSError):
             info = None
         if info is None or info["state"] != "CREATED":
             self._fail_task(spec, TaskError(
@@ -1234,7 +1327,7 @@ class NodeService:
         if self.head is not None:
             try:
                 node_b = await self.head.actor_node(spec.actor_id)
-            except (ConnectionLost, OSError):
+            except (ConnectionLost, RpcTimeout, OSError):
                 node_b = None
         if node_b is None:
             self._fail_task(spec, ActorDiedError(
@@ -1278,7 +1371,7 @@ class NodeService:
         for dep in spec.dependencies():
             st = self._obj(dep)
             if st.status == ERROR:
-                raise st.error
+                raise_stored(st.error)
             if st.status == PENDING:
                 # _wake() on any object completion re-kicks the dispatcher,
                 # so parking needs no per-spec waiter future.
@@ -1352,7 +1445,7 @@ class NodeService:
         try:
             placed = await self.head.schedule(
                 spec.resources, "spill", [self.node_id.binary()])
-        except (ConnectionLost, OSError):
+        except (ConnectionLost, RpcTimeout, OSError):
             placed = None
         spec._spill_inflight = False
         if placed is None:
@@ -1519,7 +1612,7 @@ class NodeService:
             if a[0] == REF:
                 st = self.objects[a[1]]
                 if st.status == ERROR:
-                    raise st.error
+                    raise_stored(st.error)
                 mat = self.materialize_for_ipc(a[1])
                 if mat[0] == "bytes":
                     return ("v", mat[1])
@@ -1607,7 +1700,7 @@ class NodeService:
     async def _send_cancel(self, w: WorkerHandle, task_id: TaskID):
         try:
             await w.conn.call("cancel_task", task_id.binary())
-        except (ConnectionLost, OSError):
+        except (ConnectionLost, RpcTimeout, OSError):
             pass
 
     def _retry_or_fail(self, spec: TaskSpec, err: TaskError):
@@ -1759,7 +1852,7 @@ class NodeService:
         for dep in spec.dependencies():
             st = await self.wait_object(dep)
             if st.status == ERROR:
-                raise st.error
+                raise_stored(st.error)
 
     def _resolved_copy(self, spec: TaskSpec) -> tuple:
         """(spec copy, ref_sources): small REF args resolve to inline value
@@ -1777,7 +1870,7 @@ class NodeService:
                 return a
             st = self.objects[a[1]]
             if st.status == ERROR:
-                raise st.error
+                raise_stored(st.error)
             form = self.materialize_for_ipc(a[1])
             if (form[0] == "shm" and st.size >
                     self.cfg.object_transfer_min_chunked_bytes):
@@ -1836,7 +1929,7 @@ class NodeService:
         if blob is not None:
             try:
                 await self.head.export_function(spec.func_id, blob)
-            except (ConnectionLost, OSError):
+            except (ConnectionLost, RpcTimeout, OSError):
                 pass
 
         while True:
@@ -1857,7 +1950,7 @@ class NodeService:
                     placed = await self.head.schedule(
                         spec.resources, spec.strategy.kind,
                         [n.binary() for n in exclude])
-                except (ConnectionLost, OSError):
+                except (ConnectionLost, RpcTimeout, OSError):
                     placed = None
                 if placed is None:
                     # Nothing feasible right now: park and retry (nodes may
@@ -1876,10 +1969,13 @@ class NodeService:
                             worker=f"node:{target.hex()[:8]}")
                 reply = await conn.call("remote_execute", {
                     "spec": payload_spec,
-                    "owner": self.node_id.binary(),
+                    # Log-routing owner: inherit the originating driver's
+                    # node for re-forwarded / nested specs (ADVICE r4).
+                    "owner": getattr(spec, "_owner_node", None)
+                    or self.node_id.binary(),
                     "ref_sources": ref_sources,
                 })
-            except (ConnectionLost, OSError):
+            except (ConnectionLost, RpcTimeout, OSError):
                 self.counters["remote_forward_failures"] += 1
                 if spec.actor_id is not None and not spec.is_actor_creation:
                     # Actor call: restart is the actor FSM's job.
@@ -1924,7 +2020,7 @@ class NodeService:
                 try:
                     conn = await self._addr_conn(exec_addr)
                     await conn.notify("decref", rid.binary())
-                except (ConnectionLost, OSError):
+                except (ConnectionLost, RpcTimeout, OSError):
                     pass
             else:
                 self._ingest_result_blob(rid, blob)
@@ -1963,7 +2059,7 @@ class NodeService:
         if blob is not None:
             try:
                 await self.head.export_function(spec.func_id, blob)
-            except (ConnectionLost, OSError):
+            except (ConnectionLost, RpcTimeout, OSError):
                 pass
         pin = (NodeID(spec.strategy.node_id)
                if spec.strategy.kind == "node" and spec.strategy.node_id
@@ -1986,7 +2082,7 @@ class NodeService:
                     placed = await self.head.schedule(
                         spec.resources, spec.strategy.kind,
                         [n.binary() for n in exclude])
-                except (ConnectionLost, OSError):
+                except (ConnectionLost, RpcTimeout, OSError):
                     placed = None
                 if placed is None:
                     await asyncio.sleep(0.25)
@@ -2003,9 +2099,11 @@ class NodeService:
             try:
                 conn = await self._peer_conn(target, placed["address"])
                 reply = await conn.call("remote_execute", {
-                    "spec": payload_spec, "owner": self.node_id.binary(),
+                    "spec": payload_spec,
+                    "owner": getattr(spec, "_owner_node", None)
+                    or self.node_id.binary(),
                     "ref_sources": ref_sources})
-            except (ConnectionLost, OSError):
+            except (ConnectionLost, RpcTimeout, OSError):
                 exclude.add(target)
                 # A pinned target stays the same next iteration (it is
                 # ALIVE at the head until the heartbeat monitor rules);
@@ -2034,7 +2132,7 @@ class NodeService:
                 self._release_deps(spec)
             try:
                 await self.head.record_actor_node(entry.actor_id, target)
-            except (ConnectionLost, OSError):
+            except (ConnectionLost, RpcTimeout, OSError):
                 pass
             self._pump_remote_actor(entry)
             return
@@ -2077,9 +2175,11 @@ class NodeService:
                 try:
                     conn = await self._peer_conn(entry.node_id, entry.address)
                     fut = asyncio.ensure_future(conn.call("remote_execute", {
-                        "spec": payload_spec, "owner": self.node_id.binary(),
+                        "spec": payload_spec,
+                        "owner": getattr(spec, "_owner_node", None)
+                        or self.node_id.binary(),
                         "ref_sources": ref_sources}))
-                except (ConnectionLost, OSError):
+                except (ConnectionLost, RpcTimeout, OSError):
                     self._fail_task(spec, ActorDiedError(
                         "actor node unreachable", task_name=spec.name))
                     continue
@@ -2097,7 +2197,7 @@ class NodeService:
                                         spec: TaskSpec, fut):
         try:
             reply = await fut
-        except (ConnectionLost, OSError):
+        except (ConnectionLost, RpcTimeout, OSError):
             self._fail_task(spec, ActorDiedError(
                 "actor node died mid-call", task_name=spec.name))
             return
@@ -2128,7 +2228,7 @@ class NodeService:
                 try:
                     await self.head.unregister_named_actor(
                         spec.actor_name, entry.actor_id)
-                except (ConnectionLost, OSError):
+                except (ConnectionLost, RpcTimeout, OSError):
                     pass
             self._fail_remote_actor_queue(entry)
 
@@ -2303,6 +2403,11 @@ class NodeService:
             if self._result_pins.pop(ObjectID(payload), None) is not None:
                 self.decref(ObjectID(payload))
             return True
+        if method == "free_object":
+            # A consumer elsewhere finished with an object WE own:
+            # eager-release the value (ray_tpu.free across nodes).
+            self.free_object(ObjectID(payload))
+            return True
         if method == "kill_actor":
             self.kill_actor(ActorID(payload))
             return True
@@ -2417,7 +2522,7 @@ class NodeService:
             try:
                 ok = await self.head.register_named_actor(
                     spec.actor_name, aid, self.node_id, meths)
-            except (ConnectionLost, OSError):
+            except (ConnectionLost, RpcTimeout, OSError):
                 ok = False
             if not ok:
                 self._actor_creation_failed(
@@ -2428,7 +2533,7 @@ class NodeService:
         elif self.head is not None:
             try:
                 await self.head.record_actor_node(aid, self.node_id)
-            except (ConnectionLost, OSError):
+            except (ConnectionLost, RpcTimeout, OSError):
                 pass
         await self._start_actor(actor)
 
@@ -2553,7 +2658,7 @@ class NodeService:
                 if actor.name:
                     await self.head.unregister_named_actor(
                         actor.name, actor.actor_id)
-            except (ConnectionLost, OSError):
+            except (ConnectionLost, RpcTimeout, OSError):
                 pass
 
         self.spawn(do())
@@ -2675,7 +2780,7 @@ class NodeService:
             try:
                 conn = await self._peer_conn(entry.node_id, entry.address)
                 await conn.call("kill_actor", aid.binary())
-            except (ConnectionLost, OSError):
+            except (ConnectionLost, RpcTimeout, OSError):
                 pass
             return
         # Unknown here: resolve the home node through the head.
@@ -2687,7 +2792,7 @@ class NodeService:
                     try:
                         conn = await self._peer_conn(NodeID(node_b), addr)
                         await conn.call("kill_actor", aid.binary())
-                    except (ConnectionLost, OSError):
+                    except (ConnectionLost, RpcTimeout, OSError):
                         pass
 
     def _kill_worker(self, worker: WorkerHandle, force: bool = False):
@@ -2910,7 +3015,7 @@ class NodeService:
                     await self.head.push_worker_logs(
                         {"node_id": self.node_id.binary(),
                          "entries": batch})
-                except (ConnectionLost, OSError):
+                except (ConnectionLost, RpcTimeout, OSError):
                     pass  # head restarting; lines already in the file
 
     def collect_logs(self, tail_bytes: int = 16_384) -> dict:
@@ -3038,7 +3143,22 @@ class NodeService:
             return fid in self.functions
 
         if method == "submit_task":
-            spec: TaskSpec = payload
+            spec: TaskSpec = payload["spec"]
+            # Nested submission: the child's worker logs belong on the
+            # console of the driver that owns the SUBMITTING task, not
+            # on this (possibly daemon) node's — inherit the owner
+            # stamp from the PARENT TASK (per-task, not per-worker: a
+            # concurrent actor serves several drivers at once).
+            # (ADVICE r4; reference: per-job log subscription.)
+            if getattr(spec, "_owner_node", None) is None:
+                w = conn.meta.get("worker")
+                parent_b = payload.get("parent")
+                if w is not None:
+                    parent = (w.inflight.get(TaskID(parent_b))
+                              if parent_b else None)
+                    spec._owner_node = (
+                        getattr(parent, "_owner_node", None)
+                        or w.owner_node)
             rids = self.submit(spec)
             return [r.binary() for r in rids]
 
@@ -3146,6 +3266,18 @@ class NodeService:
         if method == "decref":
             for b in payload:
                 self.decref(ObjectID(b))
+            return True
+
+        if method == "free_objects":
+            # Worker-initiated eager free (Data executors running inside
+            # actors): local-owned frees happen here; foreign-owned are
+            # forwarded to the owner.
+            for oid_b, owner in payload:
+                oid = ObjectID(oid_b)
+                if owner and tuple(owner) != tuple(self.peer_address):
+                    self.spawn(self._notify_free_remote(oid, tuple(owner)))
+                else:
+                    self.free_object(oid)
             return True
 
         if method == "get_actor_by_name":

@@ -28,6 +28,134 @@ from .ids import ObjectID
 
 SHM_DIR = os.environ.get("RT_SHM_DIR", "/dev/shm")
 
+# How old an UNSTAMPED session dir must be before the reaper treats it as
+# debris (a dir mid-creation has no .owner for a few microseconds).
+_ORPHAN_UNSTAMPED_AGE_S = 300.0
+
+
+def _proc_start_time(pid: int) -> Optional[int]:
+    """Kernel start tick of `pid` (field 22 of /proc/<pid>/stat) — pid
+    liveness alone is reuse-prone; pid+starttime identifies a process."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read()
+        # comm may contain spaces/parens: fields are after the LAST ')'.
+        return int(stat[stat.rindex(b")") + 2:].split()[19])
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def _stamp_owner(prefix: str) -> None:
+    """First creator of a session dir records its identity so crashed
+    sessions (kill -9 leaves no atexit) can be reaped by the next init.
+    Reference: the raylet cleans up leftover plasma/session dirs of dead
+    sessions on startup (services.py session cleanup)."""
+    path = os.path.join(prefix, ".owner")
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o644)
+    except FileExistsError:
+        return  # a peer process of the same session got here first
+    except OSError:
+        return
+    pid = os.getpid()
+    with os.fdopen(fd, "w") as f:
+        f.write(f"{pid} {_proc_start_time(pid) or 0}")
+
+
+def _owner_alive(prefix: str) -> Optional[bool]:
+    """True/False = owner known alive/dead; None = no stamp."""
+    try:
+        with open(os.path.join(prefix, ".owner")) as f:
+            parts = f.read().split()
+        pid, start = int(parts[0]), int(parts[1])
+    except (OSError, ValueError, IndexError):
+        return None
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # someone else's live process
+    if start:
+        now_start = _proc_start_time(pid)
+        if now_start is not None and now_start != start:
+            return False  # pid reused by a different process
+    return True
+
+
+def reap_orphan_sessions() -> list[str]:
+    """Remove session object-store dirs (and their spill dirs) whose
+    owning process is gone — kill -9'd daemons, crashed drivers, chaos
+    tests. Swept on every ``init()`` so debris from dead sessions never
+    accumulates in /dev/shm (which is RAM!). Returns reaped dir names."""
+    import shutil
+
+    def read_spill_sidecar(prefix):
+        try:
+            with open(os.path.join(prefix, ".spill")) as f:
+                return f.read().strip() or None
+        except OSError:
+            return None
+
+    reaped = []
+    try:
+        names = os.listdir(SHM_DIR)
+    except OSError:
+        return reaped
+    # Pass 1 — classify sessions and collect every spill path a LIVE
+    # session references: a shared custom RT_SPILL_DIR must never be
+    # removed out from under a running cluster.
+    dead, live_spills = [], set()
+    for name in names:
+        if not name.startswith("rtpu-"):
+            continue
+        prefix = os.path.join(SHM_DIR, name)
+        if not os.path.isdir(prefix):
+            continue
+        alive = _owner_alive(prefix)
+        if alive is None:
+            try:
+                age = time.time() - os.stat(prefix).st_mtime
+            except OSError:
+                continue
+            alive = age < _ORPHAN_UNSTAMPED_AGE_S  # mid-creation grace
+        spill = read_spill_sidecar(prefix)
+        if alive:
+            if spill:
+                live_spills.add(os.path.realpath(spill))
+        else:
+            dead.append((name, prefix, spill))
+    # Pass 2 — reap dead sessions + their spill dirs (sidecar path when
+    # recorded and unshared, plus the default /tmp location).
+    for name, prefix, spill in dead:
+        shutil.rmtree(prefix, ignore_errors=True)
+        session = name[len("rtpu-"):]
+        if spill and os.path.realpath(spill) not in live_spills:
+            shutil.rmtree(spill, ignore_errors=True)
+        shutil.rmtree(os.path.join("/tmp", "rtpu-spill-" + session),
+                      ignore_errors=True)
+        reaped.append(name)
+    # Spill dirs whose session dir is already gone (clean shutdown paths
+    # that never reached destroy(), chaos kills): sweep stale ones.
+    try:
+        spills = os.listdir("/tmp")
+    except OSError:
+        spills = []
+    for name in spills:
+        if not name.startswith("rtpu-spill-"):
+            continue
+        session = name[len("rtpu-spill-"):]
+        if os.path.isdir(os.path.join(SHM_DIR, "rtpu-" + session)):
+            continue  # session still live (or pending its own reap rules)
+        path = os.path.join("/tmp", name)
+        try:
+            if time.time() - os.stat(path).st_mtime < _ORPHAN_UNSTAMPED_AGE_S:
+                continue
+        except OSError:
+            continue
+        shutil.rmtree(path, ignore_errors=True)
+    return reaped
+
 
 class SharedMemoryStore:
     """Client for the per-node segment store.
@@ -40,6 +168,7 @@ class SharedMemoryStore:
         self.session_id = session_id
         self.prefix = os.path.join(SHM_DIR, f"rtpu-{session_id}")
         os.makedirs(self.prefix, exist_ok=True)
+        _stamp_owner(self.prefix)
         # Keep mmaps alive while memoryviews of them circulate.
         self._mmaps: dict[ObjectID, tuple[mmap.mmap, memoryview]] = {}
 
@@ -191,6 +320,13 @@ class NativeObjectStore(SharedMemoryStore):
                 "RT_SPILL_DIR", f"/tmp/rtpu-spill-{session_id}")
         self.capacity_bytes = capacity_bytes
         self.spill_dir = spill_dir
+        # Record where this session spills so the orphan reaper can
+        # remove it even under a custom RT_SPILL_DIR.
+        try:
+            with open(os.path.join(self.prefix, ".spill"), "w") as f:
+                f.write(spill_dir)
+        except OSError:
+            pass
         self._ctypes = ctypes
         self._h = self._lib.rt_store_open(
             self.prefix.encode(), capacity_bytes, spill_dir.encode())

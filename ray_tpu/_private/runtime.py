@@ -23,10 +23,41 @@ from . import serialization
 from .config import get_config
 from .exceptions import GetTimeoutError
 from .ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
-from .node_service import ERROR, PENDING, NodeService
+from .node_service import ERROR, PENDING, NodeService, raise_stored
 from .object_ref import ObjectRef
 from .object_store import make_store
 from .task_spec import TaskSpec, export_function
+
+
+def _tune_malloc():
+    """Pin glibc's mmap threshold (default: pinned at 128KiB, override
+    with RT_MALLOC_MMAP_THRESHOLD bytes, 0 = leave the allocator alone).
+
+    Why: glibc's threshold is DYNAMIC — after a few multi-MB
+    malloc/free cycles it ratchets up (to 32MB), after which
+    block-sized numpy buffers are served from the main heap and freed
+    memory stays resident (RSS high-water ≈ everything ever alive at
+    once, ~2x the true working set for streaming Data). Pinning keeps
+    large buffers mmap-backed so frees return pages to the OS
+    immediately. Workers inherit via MALLOC_MMAP_THRESHOLD_."""
+    raw = os.environ.get("RT_MALLOC_MMAP_THRESHOLD", "131072")
+    try:
+        threshold = int(raw)
+    except ValueError:
+        return
+    if threshold <= 0:
+        return
+    # Subprocesses (CPU-lane workers, node/head daemons) inherit the
+    # same pin through glibc's tunable env var.
+    os.environ.setdefault("MALLOC_MMAP_THRESHOLD_", str(threshold))
+    try:
+        import ctypes
+
+        libc = ctypes.CDLL(None, use_errno=True)
+        m_mmap_threshold = -3  # glibc malloc.h M_MMAP_THRESHOLD
+        libc.mallopt(m_mmap_threshold, threshold)
+    except (OSError, AttributeError):
+        pass  # non-glibc platform: the env var still covers children
 
 
 def _detect_resources(num_cpus=None, num_tpus=None, resources=None) -> dict:
@@ -101,6 +132,16 @@ class Runtime:
             address = (host, int(port))
         self._attach_addr = tuple(address) if address else None
 
+        # Sweep /dev/shm debris of dead sessions (kill -9'd daemons,
+        # crashed drivers) before claiming more of it, and pin glibc's
+        # dynamic mmap threshold so block-sized numpy buffers return to
+        # the OS on free (streaming Data would otherwise ratchet RSS to
+        # its high-water mark — the reference leans on jemalloc for the
+        # same reason).
+        from .object_store import reap_orphan_sessions
+
+        reap_orphan_sessions()
+        _tune_malloc()
         self.shm = make_store(self.session_id)
         sock_dir = os.environ.get("RT_SOCK_DIR", "/tmp")
         self.sock_path = os.path.join(sock_dir, f"rtpu-{self.session_id}.sock")
@@ -285,6 +326,20 @@ class Runtime:
             except RuntimeError:
                 pass  # interpreter shutdown
 
+    def free(self, oid: ObjectID, owner_addr=None):
+        """Eagerly release an object's value (``ray_tpu.free``): local
+        objects free on the loop thread now; foreign-owned are dropped
+        locally and the free is forwarded to the owner."""
+        if not self.loop.is_running():
+            return
+        if owner_addr is not None and \
+                tuple(owner_addr) != tuple(self.node.peer_address):
+            self._call_soon(
+                lambda: self.node.spawn(
+                    self.node._notify_free_remote(oid, tuple(owner_addr))))
+        else:
+            self._call_soon(self.node.free_object, oid)
+
     def export_function(self, fn) -> str:
         fid, blob = export_function(fn)
         if fid not in self.node.functions:
@@ -415,7 +470,7 @@ class Runtime:
         for _ in range(1 + self.cfg.max_object_reconstructions):
             st = self.node.objects[r.id]
             if st.status == ERROR:
-                raise st.error
+                raise_stored(st.error)
             if st.location != "shm":
                 kind, val = st.value
                 return (serialization.deserialize(val) if kind == "bytes"

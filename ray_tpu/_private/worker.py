@@ -150,6 +150,16 @@ class WorkerContext:
         with self._decref_lock:
             self._decref_buf.append(oid.binary())
 
+    def free(self, oid: ObjectID, owner_addr=None):
+        """Eager value release from a worker (Data executors inside
+        actors): the node service frees local objects and forwards
+        foreign-owned frees to their owner."""
+        try:
+            self.client.notify("free_objects", [
+                (oid.binary(), list(owner_addr) if owner_addr else None)])
+        except Exception:
+            pass  # connection gone; worker is dying
+
     def _next_put_id(self) -> ObjectID:
         task = _running_task.get()
         key = task.binary() if task else b"driverless"
@@ -217,7 +227,15 @@ class WorkerContext:
             not_ready + ready[num_returns:]
 
     def submit_spec(self, spec: TaskSpec) -> list[ObjectRef]:
-        rids = self.client.call("submit_task", spec)
+        # The submitting task's id rides along so the node can inherit
+        # the RIGHT owner stamp for log routing — a concurrent actor
+        # serves tasks from several drivers, so a per-worker slot is
+        # not enough.
+        parent = _running_task.get()
+        rids = self.client.call(
+            "submit_task",
+            {"spec": spec,
+             "parent": parent.binary() if parent else None})
         return [ObjectRef(ObjectID(b), _register=False,
                           owner_addr=self.node_addr) for b in rids]
 

@@ -113,6 +113,20 @@ def cancel(ref: ObjectRef, *, force: bool = False):
     _ensure().cancel(ref, force=force)
 
 
+def free(refs: Union[ObjectRef, Sequence[ObjectRef]]):
+    """Eagerly release the VALUE of objects this process is done with,
+    without waiting for every outstanding ref to be dropped (reference:
+    ray._private.internal_api.free). The streaming Data executor uses
+    this to evict consumed blocks the moment their consumer task
+    finishes — the larger-than-RAM contract. A later ``get`` on a freed
+    ref raises ObjectFreedError rather than hanging."""
+    ctx = _ensure()
+    if isinstance(refs, ObjectRef):
+        refs = [refs]
+    for r in refs:
+        ctx.free(r.id, r.owner_addr)
+
+
 def get_runtime_context():
     return context_mod.RuntimeContext(context_mod.require_context())
 

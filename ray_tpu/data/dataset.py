@@ -290,7 +290,8 @@ class Dataset:
     def __init__(self, source: Optional[Callable[[], Iterator[B.Block]]] = None,
                  stages: Optional[list[_Stage]] = None,
                  ref_source: Optional[Callable[[], Iterator]] = None,
-                 read_plan: Optional[tuple] = None):
+                 read_plan: Optional[tuple] = None,
+                 owns_blocks: bool = True):
         if sum(x is not None
                for x in (source, ref_source, read_plan)) != 1:
             raise ValueError(
@@ -299,12 +300,20 @@ class Dataset:
         self._ref_source = ref_source
         self._read_plan = read_plan  # (files, kind): fusable read tasks
         self._stages = stages or []
+        # Block ownership (reference: BlockMetadata.exec_stats is not None
+        # <=> the plan owns its blocks and streaming may eagerly free
+        # them). The ``ref_source`` contract is that each call yields
+        # FRESH refs (the generator re-executes per iteration), so the
+        # pipeline owns them by default; pass ``owns_blocks=False`` when
+        # wrapping long-lived refs the caller keeps.
+        self._owns_blocks = owns_blocks
 
     # -- transforms (lazy) -------------------------------------------------
     def _with(self, stage: _Stage) -> "Dataset":
         return Dataset(self._source, self._stages + [stage],
                        ref_source=self._ref_source,
-                       read_plan=self._read_plan)
+                       read_plan=self._read_plan,
+                       owns_blocks=self._owns_blocks)
 
     def map(self, fn) -> "Dataset":
         return self._with(_Stage("map_rows", fn))
@@ -604,10 +613,25 @@ class Dataset:
             return
         from .execution import StreamingExecutor
 
-        yield from StreamingExecutor(source, specs).run()
+        yield from StreamingExecutor(
+            source, specs, owns_input_blocks=self._owns_blocks).run()
+
+    def _frees_consumed_blocks(self) -> bool:
+        """May iter_blocks eagerly free a block ref once its VALUE has
+        been handed to the consumer? Yes whenever the ref is a pipeline
+        product (any stage / read ran) or the dataset owns its source
+        blocks."""
+        return (bool(self._stages) or self._read_plan is not None
+                or self._owns_blocks)
 
     def iter_blocks(self) -> Iterator[B.Block]:
-        """Streaming execution with bounded in-flight transform tasks."""
+        """Streaming execution with bounded in-flight transform tasks.
+
+        Consumed blocks are eagerly freed (``ray_tpu.free``) the moment
+        their value is in hand — with the executor's consumed-input
+        freeing this is what keeps peak held bytes O(backpressure knobs)
+        for datasets far larger than RAM (reference: eager block-ref
+        release as the consumer advances, streaming_executor.py:242)."""
         if self._source is not None and not self._stages:
             # Driver-local source, no transforms: no task round trip.
             yield from (b for b in self._source() if B.block_len(b))
@@ -615,8 +639,12 @@ class Dataset:
 
         import ray_tpu
 
+        free_ok = self._frees_consumed_blocks()
         for ref in self.iter_refs():
             out = ray_tpu.get(ref)
+            if free_ok:
+                ray_tpu.free(ref)
+            del ref  # drop the handle before the consumer runs
             if B.block_len(out):
                 yield out
 

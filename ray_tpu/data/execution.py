@@ -87,6 +87,15 @@ class _OpState:
         self.index = index
         self.inq: collections.deque = collections.deque()  # (seq, ref)
         self.inflight: dict[Any, int] = {}                  # out_ref -> seq
+        self.input_of: dict[Any, Any] = {}                  # out_ref -> in ref
+        # Eager consumed-block release (reference: streaming_executor.py:242
+        # freeing generator block refs as the consumer advances): once the
+        # task that consumed an input block finishes, that block can never
+        # be read again by this pipeline — free it NOW instead of waiting
+        # for deferred refcount churn. Ops past the first always own their
+        # inputs (upstream operator outputs); the first op's flag is set by
+        # the executor from the dataset's block ownership.
+        self.free_inputs = index > 0
         self.outbuf: dict[int, Any] = {}                    # seq -> ref
         self.next_emit = 0         # next seq owed downstream (ordering)
         self.submitted = 0
@@ -129,13 +138,20 @@ class _OpState:
             out = self._actors[i].apply.remote(ref)
             self._ref_actor[out] = i
         self.inflight[out] = seq
+        self.input_of[out] = ref
         self.submitted += 1
 
     def complete(self, out_ref) -> None:
+        import ray_tpu
+
         seq = self.inflight.pop(out_ref)
         i = self._ref_actor.pop(out_ref, None)
         if i is not None:
             self._actor_load[i] -= 1
+        consumed = self.input_of.pop(out_ref, None)
+        if (self.free_inputs
+                and isinstance(consumed, ray_tpu.ObjectRef)):
+            ray_tpu.free(consumed)
         self.outbuf[seq] = out_ref
 
     def pop_ready(self) -> Optional[tuple[int, Any]]:
@@ -180,11 +196,17 @@ class StreamingExecutor:
     """
 
     def __init__(self, source: Iterator, specs: list,
-                 ctx: Optional[DataContext] = None):
+                 ctx: Optional[DataContext] = None,
+                 owns_input_blocks: bool = True):
         self._source = source
         self._ctx = ctx or DataContext.get_current()
         self._ops = [_OpState(s, i, self._ctx)
                      for i, s in enumerate(specs)]
+        if self._ops:
+            # First-op inputs are the SOURCE blocks: only freeable when
+            # the dataset owns them (fresh refs per iteration), never
+            # when the caller retains handles (Dataset(owns_blocks=False)).
+            self._ops[0].free_inputs = owns_input_blocks
         self._source_done = False
         self._pulled = 0
         self.stats: dict = {"ops": [getattr(s, "name", "?") for s in specs]}

@@ -220,10 +220,25 @@ _inuse_locks: list = []
 def _pin_entry(dest: str) -> None:
     import fcntl
 
+    path = dest + ".lock"
     try:
-        fd = os.open(dest + ".lock", os.O_CREAT | os.O_RDWR, 0o644)
-        fcntl.flock(fd, fcntl.LOCK_SH)
-        _inuse_locks.append(fd)  # held for this process's lifetime
+        # Open→flock→VERIFY INODE: the evictor unlinks the lock file
+        # while holding it exclusively, so a pinner can win its SH flock
+        # on an already-orphaned inode (opened just before the unlink).
+        # An orphaned lock protects nothing — the next evictor creates a
+        # fresh inode and its EX probe succeeds. Re-open until the flock
+        # is held on the file that is actually at `path`.
+        for _ in range(16):
+            fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
+            fcntl.flock(fd, fcntl.LOCK_SH)
+            try:
+                same = os.fstat(fd).st_ino == os.stat(path).st_ino
+            except OSError:
+                same = False  # unlinked between flock and verify
+            if same:
+                _inuse_locks.append(fd)  # held for process lifetime
+                return
+            os.close(fd)  # orphaned inode: retry on the new file
     except OSError:
         pass  # unpinned worst case: eviction falls back to mtime grace
 
@@ -377,7 +392,10 @@ def _evict_cache(cache_dir: str,
             except OSError:
                 continue  # someone else won
             shutil.rmtree(trash, ignore_errors=True)
-            for side in (p + ".size",):
+            # Unlink the .lock while STILL holding it exclusively (safe:
+            # a new pinner re-creates the file and finds the entry gone)
+            # — otherwise lock sidecars accumulate forever (ADVICE r4).
+            for side in (p + ".size", p + ".lock"):
                 try:
                     os.unlink(side)
                 except OSError:

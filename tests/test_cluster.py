@@ -293,3 +293,36 @@ def test_placement_group_infeasible_shape(cluster):
     cluster.wait_for_nodes(1)
     with pytest.raises(ValueError, match="infeasible"):
         ray_tpu.placement_group([{"CPU": 64_000}])
+
+
+def test_heartbeat_loop_survives_rpc_timeout(rt):
+    """A single slow head reply (RpcTimeout) must be a MISSED BEAT, not
+    a dead heartbeat loop (ADVICE r4 high: RpcTimeout is an RpcError,
+    not a ConnectionLost/OSError, and used to escape every handler —
+    the node would be declared dead and never recover)."""
+    import asyncio
+
+    from ray_tpu._private.rpc import RpcTimeout
+
+    node = rt.node
+    hb_task = next(t for t in node._bg_tasks
+                   if "heartbeat" in repr(t.get_coro()))
+    real = node.head.heartbeat
+    calls = {"n": 0}
+
+    async def flaky(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise RpcTimeout("deadline exceeded (synthetic)")
+        return await real(*a, **kw)
+
+    node.head.heartbeat = flaky
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and calls["n"] < 4:
+            time.sleep(0.1)
+        # The loop outlived two timeouts and kept beating.
+        assert calls["n"] >= 4
+        assert not hb_task.done(), hb_task
+    finally:
+        node.head.heartbeat = real
