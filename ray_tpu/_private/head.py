@@ -57,6 +57,9 @@ class NodeEntry:
     # from the node's last heartbeat (reference: LoadMetrics).
     node_type: Optional[str] = None
     load: list = field(default_factory=list)
+    # Node labels for label-selector scheduling (reference: the node
+    # labels of node_manager.cc / NodeLabelSchedulingStrategy).
+    labels: dict = field(default_factory=dict)
 
     def to_row(self) -> dict:
         """Wire/dict shape shared by every list_nodes surface."""
@@ -64,7 +67,8 @@ class NodeEntry:
                 "state": self.state, "resources": self.resources,
                 "available": self.available,
                 "is_head_node": self.is_head_node,
-                "is_driver": self.is_driver}
+                "is_driver": self.is_driver,
+                "labels": self.labels}
 
 
 @dataclass
@@ -245,12 +249,13 @@ class HeadService:
                       is_driver: bool = False,
                       node_type: Optional[str] = None,
                       sync: Optional[dict] = None,
-                      is_head_node: bool = False) -> dict:
+                      is_head_node: bool = False,
+                      labels: Optional[dict] = None) -> dict:
         entry = NodeEntry(
             node_id=node_id, address=tuple(address),
             resources=dict(resources), available=dict(resources), conn=conn,
             is_driver=is_driver, node_type=node_type,
-            is_head_node=is_head_node)
+            is_head_node=is_head_node, labels=dict(labels or {}))
         self.nodes[node_id] = entry
         if conn is not None:
             conn.meta["node_id"] = node_id
@@ -437,24 +442,58 @@ class HeadService:
         return all(entry.available.get(k, 0) >= v
                    for k, v in resources.items())
 
+    @staticmethod
+    def _label_match(labels: dict, selectors: dict) -> int:
+        """How many selectors match (-1 = a selector FAILED). Values:
+        "v" equals, "!v" not-equals, list membership (reference:
+        node_label_scheduling_policy.h label_in/label_not_in)."""
+        hits = 0
+        for key, want in (selectors or {}).items():
+            have = labels.get(key)
+            if isinstance(want, (list, tuple, set)):
+                ok = have in want
+            elif isinstance(want, str) and want.startswith("!"):
+                ok = have != want[1:]
+            else:
+                ok = have == want
+            if not ok:
+                return -1
+            hits += 1
+        return hits
+
     def schedule(self, resources: dict, strategy_kind: str = "default",
-                 exclude: Optional[set] = None) -> Optional[NodeID]:
+                 exclude: Optional[set] = None,
+                 labels_hard: Optional[dict] = None,
+                 labels_soft: Optional[dict] = None) -> Optional[NodeID]:
         """Pick a node for a task/actor with the given resource demand.
 
         Hybrid policy (reference: hybrid_scheduling_policy.h:50): pack onto
         the busiest node that still has availability while utilization is
         below the spread threshold, else spread to the least utilized.
-        "spread" forces least-utilized.
-        """
+        "spread" forces least-utilized. ``labels_hard`` filters the
+        candidate set (no match => None: the task waits like any
+        infeasible demand); ``labels_soft`` ranks survivors by matched
+        selector count (node_label_scheduling_policy.h). Accelerator
+        demands additionally tie-break BEST-FIT on remaining device
+        capacity, steering gang members onto the least-fragmented TPU
+        hosts (reference: scorer.h NodeScorer, least-resource)."""
         exclude = exclude or set()
         candidates = [e for e in self.nodes.values()
                       if e.node_id not in exclude
                       and self._feasible(e, resources)]
+        if labels_hard:
+            candidates = [e for e in candidates
+                          if self._label_match(e.labels, labels_hard) >= 0]
         if not candidates:
             return None
         with_room = [e for e in candidates
                      if self._has_available(e, resources)]
         pool = with_room or candidates
+        if labels_soft:
+            best = max(self._label_match(e.labels, labels_soft)
+                       for e in pool)
+            pool = [e for e in pool
+                    if self._label_match(e.labels, labels_soft) == best]
 
         def utilization(e: NodeEntry) -> float:
             scores = []
@@ -463,7 +502,20 @@ class HeadService:
                     scores.append(1.0 - e.available.get(k, 0) / total)
             return max(scores) if scores else 0.0
 
-        if strategy_kind == "spread":
+        device_demand = max(resources.get("TPU", 0.0),
+                            resources.get("device", 0.0))
+        if device_demand > 0:
+            # Least-fragmentation scorer: of the feasible hosts, take the
+            # one whose leftover device capacity after this placement is
+            # smallest (best fit) — large contiguous hosts stay free for
+            # gangs that need them whole.
+            def leftover(e: NodeEntry) -> tuple:
+                avail = max(e.available.get("TPU", 0.0),
+                            e.available.get("device", 0.0))
+                return (avail - device_demand, utilization(e))
+
+            chosen = min(pool, key=leftover)
+        elif strategy_kind == "spread":
             chosen = min(pool, key=utilization)
         else:
             # hybrid: pack (most utilized under threshold) else spread
@@ -744,7 +796,8 @@ class HeadService:
                 is_driver=bool(payload.get("is_driver")),
                 node_type=payload.get("node_type"),
                 sync=payload.get("sync"),
-                is_head_node=bool(payload.get("is_head")))
+                is_head_node=bool(payload.get("is_head")),
+                labels=payload.get("labels"))
         if method == "heartbeat":
             # Capacity-growth detection inside heartbeat() schedules the
             # coalesced PG retry; no per-heartbeat rescan.
@@ -762,7 +815,9 @@ class HeadService:
         if method == "schedule":
             nid = self.schedule(payload["resources"],
                                 payload.get("strategy", "default"),
-                                {NodeID(b) for b in payload.get("exclude", [])})
+                                {NodeID(b) for b in payload.get("exclude", [])},
+                                labels_hard=payload.get("labels_hard"),
+                                labels_soft=payload.get("labels_soft"))
             if nid is None:
                 return None
             return {"node_id": nid.binary(),
@@ -866,13 +921,16 @@ class LocalHeadClient:
     async def fetch_function(self, fid):
         return self.head.functions.get(fid)
 
-    async def schedule(self, resources, strategy="default", exclude=()):
+    async def schedule(self, resources, strategy="default", exclude=(),
+                       labels_hard=None, labels_soft=None):
         # Exclusion is NodeID-keyed inside the head; callers hand us raw
         # bytes (same wire shape as the RPC path) — normalize or the
         # membership test silently never matches.
         ex = {NodeID(b) if isinstance(b, (bytes, bytearray)) else b
               for b in exclude}
-        nid = self.head.schedule(resources, strategy, ex)
+        nid = self.head.schedule(resources, strategy, ex,
+                                 labels_hard=labels_hard,
+                                 labels_soft=labels_soft)
         if nid is None:
             return None
         return {"node_id": nid.binary(),
@@ -954,10 +1012,13 @@ class RemoteHeadClient:
     async def fetch_function(self, fid):
         return await self._read("fetch_function", fid)
 
-    async def schedule(self, resources, strategy="default", exclude=()):
+    async def schedule(self, resources, strategy="default", exclude=(),
+                       labels_hard=None, labels_soft=None):
         return await self.conn.call(
             "schedule", {"resources": resources, "strategy": strategy,
-                         "exclude": [bytes(b) for b in exclude]},
+                         "exclude": [bytes(b) for b in exclude],
+                         "labels_hard": labels_hard,
+                         "labels_soft": labels_soft},
             timeout=self.MUTATE_TIMEOUT_S)
 
     async def register_named_actor(self, name, actor_id, node_id, methods):

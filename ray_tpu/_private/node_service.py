@@ -98,6 +98,7 @@ async def attach_node_to_head(node: "NodeService", head_addr: tuple,
             "is_driver": is_driver,
             "is_head": is_head_node,
             "node_type": node_type,
+            "labels": node.labels,
             # Live state for head-restart reconciliation (reference:
             # raylet resync after NotifyGCSRestart).
             "sync": node.directory_sync(),
@@ -111,6 +112,24 @@ async def attach_node_to_head(node: "NodeService", head_addr: tuple,
     node.register_cb = register
     await register()
     return conn
+
+
+def _auto_node_labels(node_id: NodeID, resources: dict) -> dict:
+    """Default label set every node advertises (reference: the default
+    ray.io/* node labels), merged with RT_NODE_LABELS ("k=v,k2=v2")."""
+    import socket
+
+    labels = {
+        "rt.io/node-id": node_id.hex(),
+        "rt.io/hostname": socket.gethostname(),
+        "rt.io/accelerator": ("tpu" if resources.get("TPU", 0) > 0
+                              else "cpu"),
+    }
+    for part in os.environ.get("RT_NODE_LABELS", "").split(","):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            labels[k.strip()] = v.strip()
+    return labels
 
 
 def raise_stored(err):
@@ -271,6 +290,11 @@ class NodeService:
         self.is_head_node = is_head_node
         self.total_resources = dict(resources)
         self.available = dict(resources)
+        # Node labels for label-selector scheduling: auto labels + the
+        # RT_NODE_LABELS env ("k=v,k2=v2" — cluster launchers/operators
+        # tag slices) + per-process extras via set_labels(). Reference:
+        # node labels in node_manager.cc / NodeLabelSchedulingStrategy.
+        self.labels = _auto_node_labels(self.node_id, resources)
         # Worker stdout/stderr capture directory (reference: the session
         # log dir tailed by log_monitor.py).
         self.log_dir = os.path.join("/tmp", f"rtpu-{session_id}-logs")
@@ -1262,6 +1286,9 @@ class NodeService:
             self.spawn(self._route_pg_task(spec))
             return
         needs_placement = (strat.kind == "spread"
+                           # Label selectors are head-evaluated: this
+                           # node's own labels may not match.
+                           or strat.kind == "labels"
                            or not self._locally_feasible(spec)
                            # Actors reserve lifetime resources: if this node
                            # lacks availability, let the head place them on
@@ -1934,14 +1961,20 @@ class NodeService:
 
         while True:
             if pin_node is not None:
-                if pin_node in self.dead_nodes:
+                gone = pin_node in self.dead_nodes
+                addr = None
+                if not gone:
+                    addr = (self.peer_address if pin_node == self.node_id
+                            else await self._node_address(pin_node))
+                if gone or addr is None:
+                    if spec.strategy.kind == "node" and spec.strategy.soft:
+                        # Soft affinity: preferred node is gone — fall
+                        # back to normal placement (reference:
+                        # node_affinity_scheduling_policy.h soft).
+                        pin_node = None
+                        continue
                     self._fail_task(spec, WorkerCrashedError(
-                        task_name=spec.name))
-                    return
-                addr = (self.peer_address if pin_node == self.node_id
-                        else await self._node_address(pin_node))
-                if addr is None:
-                    self._fail_task(spec, TaskError(
+                        task_name=spec.name) if gone else TaskError(
                         f"node {pin_node.hex()[:12]} is not in the cluster"))
                     return
                 target, address = pin_node, addr
@@ -1949,7 +1982,9 @@ class NodeService:
                 try:
                     placed = await self.head.schedule(
                         spec.resources, spec.strategy.kind,
-                        [n.binary() for n in exclude])
+                        [n.binary() for n in exclude],
+                        labels_hard=spec.strategy.labels_hard,
+                        labels_soft=spec.strategy.labels_soft)
                 except (ConnectionLost, RpcTimeout, OSError):
                     placed = None
                 if placed is None:
@@ -2068,6 +2103,11 @@ class NodeService:
             if pin is not None:
                 addr = await self._node_address(pin)
                 if addr is None:
+                    if spec.strategy.soft:
+                        # Soft affinity: preferred node is gone — place
+                        # the actor like any other creation.
+                        pin = None
+                        continue
                     err = ActorDiedError(
                         f"actor pinned to node {pin.hex()[:12]}, which is "
                         f"not in the cluster", task_name=spec.name)
@@ -2081,7 +2121,9 @@ class NodeService:
                 try:
                     placed = await self.head.schedule(
                         spec.resources, spec.strategy.kind,
-                        [n.binary() for n in exclude])
+                        [n.binary() for n in exclude],
+                        labels_hard=spec.strategy.labels_hard,
+                        labels_soft=spec.strategy.labels_soft)
                 except (ConnectionLost, RpcTimeout, OSError):
                     placed = None
                 if placed is None:

@@ -222,7 +222,7 @@ class Runtime:
             node_id=self.node_id, address=self.node.peer_address,
             resources=dict(self._resources),
             available=dict(self._resources),  # refreshed by heartbeats
-            is_head_node=True)
+            is_head_node=True, labels=dict(self.node.labels))
         self.head.attach_local_node(self.node, entry)
 
     async def _attach(self):

@@ -45,7 +45,10 @@ class SchedulingStrategy:
       "device"   — the node's device-owner executor (runs in the process that
                    owns the TPU chips; jax work must land here)
       "spread"   — force spread
-      "node"     — pin to node_id
+      "node"     — pin to node_id (soft=True: prefer, fall back to
+                   normal placement if the node is gone — reference:
+                   node_affinity_scheduling_policy.h)
+      "labels"   — label-selector placement (labels_hard/labels_soft)
       "pg"       — inside a placement-group bundle
     """
 
@@ -54,6 +57,13 @@ class SchedulingStrategy:
     soft: bool = False
     pg_id: Optional[PlacementGroupID] = None
     pg_bundle_index: int = -1
+    # Label selectors (kind "labels"; reference:
+    # src/ray/raylet/scheduling/policy/node_label_scheduling_policy.h).
+    # hard: node must match every selector; soft: prefer nodes matching
+    # more selectors. Values: str (exact), "!val" (not-equal), or a
+    # list (membership).
+    labels_hard: Optional[dict] = None
+    labels_soft: Optional[dict] = None
 
 
 @dataclass

@@ -56,10 +56,16 @@ class Cluster:
         return self.runtime.head_address
 
     def add_node(self, num_cpus: int = 1, resources: dict | None = None,
-                 wait: bool = True, timeout: float = 30.0) -> ClusterNode:
+                 wait: bool = True, timeout: float = 30.0,
+                 labels: dict | None = None) -> ClusterNode:
         res = {"CPU": float(num_cpus), **(resources or {})}
         node_id = NodeID.from_random()
         env = dict(os.environ)
+        if labels:
+            env["RT_NODE_LABELS"] = ",".join(
+                f"{k}={v}" for k, v in labels.items())
+        else:
+            env.pop("RT_NODE_LABELS", None)
         host, port = self.head_address
         env.update({
             "RT_HEAD_ADDR": f"{host}:{port}",

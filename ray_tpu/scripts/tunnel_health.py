@@ -1,0 +1,80 @@
+"""Tunnel-health probe artifact (VERDICT r4 item 2).
+
+Writes TUNNEL_HEALTH.json recording whether the TPU chip tunnel was
+reachable at probe time — so "bench fell back to CPU because infra was
+down" vs "bench regressed" is machine-distinguishable in the round's
+committed artifacts. Uses the same bounded out-of-process probe as
+``ray_tpu.init`` (backend_probe.py): a wedged tunnel HANGS at backend
+init, so the probe must never run in-process.
+
+Run: python -m ray_tpu.scripts.tunnel_health [--out TUNNEL_HEALTH.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+PROBE_TIMEOUT_S = float(os.environ.get("RT_BACKEND_PROBE_TIMEOUT_S", "60"))
+
+_PROBE_SRC = """
+import jax
+devs = jax.devices()
+print("PROBE", [(d.platform, str(d)) for d in devs])
+"""
+
+
+def probe() -> dict:
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+        )
+        rc, out, err = proc.returncode, proc.stdout, proc.stderr
+        timed_out = False
+    except subprocess.TimeoutExpired as e:
+        rc, out, err = None, (e.stdout or ""), (e.stderr or "")
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        if isinstance(err, bytes):
+            err = err.decode(errors="replace")
+        timed_out = True
+    took = time.time() - t0
+    devices = []
+    if "PROBE" in out:
+        try:
+            devices = eval(out.split("PROBE", 1)[1].strip())  # noqa: S307
+        except Exception:  # noqa: BLE001 - diagnostic only
+            pass
+    platforms = {p for p, _ in devices}
+    healthy = rc == 0 and bool(platforms - {"cpu"})
+    return {
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "healthy": healthy,
+        "timed_out": timed_out,
+        "probe_seconds": round(took, 1),
+        "jax_platforms_env": os.environ.get("JAX_PLATFORMS", ""),
+        "devices": [str(d) for _, d in devices],
+        "platforms": sorted(platforms),
+        "stderr_tail": "\n".join((err or "").strip().splitlines()[-3:]),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="TUNNEL_HEALTH.json")
+    args = ap.parse_args()
+    result = probe()
+    print(json.dumps(result))
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

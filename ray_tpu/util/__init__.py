@@ -8,7 +8,12 @@ actor_pool.py, queue.py, metrics.py). The state API lives in
 
 from . import metrics  # noqa: F401
 from . import queue  # noqa: F401
+from . import scheduling_strategies  # noqa: F401
 from . import state  # noqa: F401
 from . import tracing  # noqa: F401
 from .actor_pool import ActorPool  # noqa: F401
+from .scheduling_strategies import (  # noqa: F401
+    NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+)
 from .prometheus import list_metrics, prometheus_text, serve_metrics  # noqa: F401

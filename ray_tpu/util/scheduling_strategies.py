@@ -1,0 +1,58 @@
+"""Placement strategies for ``@remote(scheduling_strategy=...)``.
+
+Capability parity target: ray.util.scheduling_strategies
+(/root/reference/python/ray/util/scheduling_strategies.py:37
+NodeAffinitySchedulingStrategy, :91 NodeLabelSchedulingStrategy) over
+the head's policy set (/root/reference/src/ray/raylet/scheduling/policy/
+node_affinity_scheduling_policy.h, node_label_scheduling_policy.h).
+
+Both helpers return the core ``SchedulingStrategy`` record the task
+spec carries; the head's scheduler interprets it (head.py:schedule).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+from .._private.ids import NodeID
+from .._private.task_spec import SchedulingStrategy
+
+__all__ = [
+    "NodeAffinitySchedulingStrategy",
+    "NodeLabelSchedulingStrategy",
+]
+
+
+def _node_id_bytes(node_id: Union[str, bytes, NodeID]) -> bytes:
+    if isinstance(node_id, NodeID):
+        return node_id.binary()
+    if isinstance(node_id, str):
+        return bytes.fromhex(node_id)
+    return bytes(node_id)
+
+
+def NodeAffinitySchedulingStrategy(node_id: Union[str, bytes, "NodeID"],
+                                   soft: bool = False) -> SchedulingStrategy:
+    """Run on the given node. ``soft=False``: the task fails if the node
+    is gone. ``soft=True``: prefer the node, fall back to normal
+    placement when it is dead or unknown (reference semantics:
+    scheduling_strategies.py:37)."""
+    return SchedulingStrategy(kind="node",
+                              node_id=_node_id_bytes(node_id),
+                              soft=soft)
+
+
+def NodeLabelSchedulingStrategy(
+        hard: Optional[dict] = None,
+        soft: Optional[dict] = None) -> SchedulingStrategy:
+    """Place by node labels. ``hard`` selectors must ALL match (no
+    matching node => the task waits for one, like any infeasible
+    demand); ``soft`` selectors rank the feasible candidates. Selector
+    values: ``"v"`` (equals), ``"!v"`` (not equals), or ``["a", "b"]``
+    (in). Auto-labels every node carries: ``rt.io/node-id``,
+    ``rt.io/hostname``, ``rt.io/accelerator`` ("tpu"/"cpu")."""
+    if not hard and not soft:
+        raise ValueError("at least one of hard/soft selectors required")
+    return SchedulingStrategy(kind="labels",
+                              labels_hard=dict(hard or {}),
+                              labels_soft=dict(soft or {}))

@@ -1,0 +1,160 @@
+"""Scheduling-policy breadth: node labels, node affinity (hard + soft),
+label selectors, and the least-fragmentation device scorer.
+
+Parity model: /root/reference/src/ray/raylet/scheduling/policy/
+node_label_scheduling_policy.h, node_affinity_scheduling_policy.h,
+scorer.h and python/ray/util/scheduling_strategies.py (VERDICT r4
+item 7)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.ids import NodeID
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import (NodeAffinitySchedulingStrategy,
+                          NodeLabelSchedulingStrategy)
+
+
+def _where():
+    import os as _os
+
+    return _os.environ.get("RT_NODE_ID", "head")
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(init_args={"num_cpus": 1})
+    try:
+        yield c
+    finally:
+        c.shutdown()
+
+
+def test_node_labels_visible_in_membership(cluster):
+    cluster.add_node(num_cpus=1, labels={"pool": "ingest", "zone": "a"})
+    rows = {r.get("labels", {}).get("pool")
+            for r in ray_tpu.nodes()}
+    assert "ingest" in rows
+    # Auto labels are stamped on every node.
+    for r in ray_tpu.nodes():
+        labels = r.get("labels") or {}
+        if r.get("is_driver"):
+            continue
+        assert labels.get("rt.io/node-id") == NodeID(r["node_id"]).hex()
+        assert labels.get("rt.io/accelerator") in ("cpu", "tpu")
+
+
+def test_label_selector_places_on_matching_node(cluster):
+    n = cluster.add_node(num_cpus=1, labels={"pool": "gpu-sim"})
+
+    @ray_tpu.remote(scheduling_strategy=NodeLabelSchedulingStrategy(
+        hard={"pool": "gpu-sim"}))
+    def where():
+        return _where()
+
+    got = {ray_tpu.get(where.remote(), timeout=60) for _ in range(3)}
+    assert got == {n.node_id.hex()}
+
+
+def test_label_selector_not_equals_and_membership(cluster):
+    a = cluster.add_node(num_cpus=1, labels={"zone": "a"})
+    b = cluster.add_node(num_cpus=1, labels={"zone": "b"})
+
+    @ray_tpu.remote(scheduling_strategy=NodeLabelSchedulingStrategy(
+        hard={"zone": "!a", "rt.io/accelerator": ["cpu", "tpu"]}))
+    def where():
+        return _where()
+
+    got = {ray_tpu.get(where.remote(), timeout=60) for _ in range(3)}
+    assert got == {b.node_id.hex()}, (a.node_id.hex(), got)
+
+
+def test_hard_selector_waits_for_matching_node(cluster):
+    """No matching node => the task PARKS (reference: infeasible tasks
+    queue, they don't fail) and runs the moment a matching node joins."""
+    @ray_tpu.remote(scheduling_strategy=NodeLabelSchedulingStrategy(
+        hard={"pool": "late"}))
+    def where():
+        return _where()
+
+    ref = where.remote()
+    ready, _ = ray_tpu.wait([ref], timeout=1.5)
+    assert not ready, "must park while no node matches"
+    n = cluster.add_node(num_cpus=1, labels={"pool": "late"})
+    assert ray_tpu.get(ref, timeout=60) == n.node_id.hex()
+
+
+def test_soft_selector_prefers_but_falls_back(cluster):
+    """Soft selectors rank candidates; with no matching node the task
+    still places."""
+    @ray_tpu.remote(scheduling_strategy=NodeLabelSchedulingStrategy(
+        soft={"pool": "nonexistent"}))
+    def anywhere():
+        return _where()
+
+    assert ray_tpu.get(anywhere.remote(), timeout=60) is not None
+
+
+def test_node_affinity_hard_and_soft(cluster):
+    n1 = cluster.add_node(num_cpus=1)
+    n2 = cluster.add_node(num_cpus=1)
+
+    @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        n2.node_id.hex()))
+    def where():
+        return _where()
+
+    assert ray_tpu.get(where.remote(), timeout=60) == n2.node_id.hex()
+
+    # Soft affinity to a node that never existed: falls back to normal
+    # placement instead of failing.
+    ghost = NodeID.from_random()
+
+    @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        ghost, soft=True))
+    def soft_where():
+        return _where()
+
+    assert ray_tpu.get(soft_where.remote(), timeout=60) in {
+        n1.node_id.hex(), n2.node_id.hex(), "head"}
+
+    # Hard affinity to the ghost fails loudly.
+    @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        ghost, soft=False))
+    def hard_where():
+        return _where()
+
+    with pytest.raises(ray_tpu.RayTpuError):
+        ray_tpu.get(hard_where.remote(), timeout=60)
+
+
+def test_device_scorer_prefers_least_fragmented(rt):
+    """Unit-level: among feasible hosts the scorer best-fits device
+    demands, keeping large contiguous hosts free for gangs
+    (reference: scorer.h least-resource NodeScorer)."""
+    from ray_tpu._private.head import NodeEntry
+
+    head = rt.head
+    small = NodeEntry(node_id=NodeID.from_random(), address=("x", 1),
+                      resources={"CPU": 1.0, "device": 4.0},
+                      available={"CPU": 1.0, "device": 1.0})
+    big = NodeEntry(node_id=NodeID.from_random(), address=("x", 2),
+                    resources={"CPU": 1.0, "device": 4.0},
+                    available={"CPU": 1.0, "device": 4.0})
+    head.nodes[small.node_id] = small
+    head.nodes[big.node_id] = big
+    try:
+        # Demand 1 device: small (leftover 0) beats big (leftover 3)
+        # and beats the local head node.
+        chosen = head.schedule({"device": 1.0},
+                               exclude={rt.node_id})
+        assert chosen == small.node_id
+        # Demand 4: only big fits with room.
+        chosen = head.schedule({"device": 4.0},
+                               exclude={rt.node_id})
+        assert chosen == big.node_id
+    finally:
+        head.nodes.pop(small.node_id, None)
+        head.nodes.pop(big.node_id, None)
