@@ -443,23 +443,28 @@ class HeadService:
                    for k, v in resources.items())
 
     @staticmethod
-    def _label_match(labels: dict, selectors: dict) -> int:
-        """How many selectors match (-1 = a selector FAILED). Values:
-        "v" equals, "!v" not-equals, list membership (reference:
+    def _selector_ok(labels: dict, key, want) -> bool:
+        """One selector. Values: "v" equals, "!v" not-equals (matches
+        unlabeled nodes too), list membership (reference:
         node_label_scheduling_policy.h label_in/label_not_in)."""
-        hits = 0
-        for key, want in (selectors or {}).items():
-            have = labels.get(key)
-            if isinstance(want, (list, tuple, set)):
-                ok = have in want
-            elif isinstance(want, str) and want.startswith("!"):
-                ok = have != want[1:]
-            else:
-                ok = have == want
-            if not ok:
-                return -1
-            hits += 1
-        return hits
+        have = labels.get(key)
+        if isinstance(want, (list, tuple, set)):
+            return have in want
+        if isinstance(want, str) and want.startswith("!"):
+            return have != want[1:]
+        return have == want
+
+    @classmethod
+    def _labels_all(cls, labels: dict, selectors: dict) -> bool:
+        return all(cls._selector_ok(labels, k, w)
+                   for k, w in (selectors or {}).items())
+
+    @classmethod
+    def _labels_hits(cls, labels: dict, selectors: dict) -> int:
+        """Matched-selector COUNT for soft ranking: partial matches
+        score partially (a failed selector simply doesn't count)."""
+        return sum(1 for k, w in (selectors or {}).items()
+                   if cls._selector_ok(labels, k, w))
 
     def schedule(self, resources: dict, strategy_kind: str = "default",
                  exclude: Optional[set] = None,
@@ -483,17 +488,17 @@ class HeadService:
                       and self._feasible(e, resources)]
         if labels_hard:
             candidates = [e for e in candidates
-                          if self._label_match(e.labels, labels_hard) >= 0]
+                          if self._labels_all(e.labels, labels_hard)]
         if not candidates:
             return None
         with_room = [e for e in candidates
                      if self._has_available(e, resources)]
         pool = with_room or candidates
         if labels_soft:
-            best = max(self._label_match(e.labels, labels_soft)
+            best = max(self._labels_hits(e.labels, labels_soft)
                        for e in pool)
             pool = [e for e in pool
-                    if self._label_match(e.labels, labels_soft) == best]
+                    if self._labels_hits(e.labels, labels_soft) == best]
 
         def utilization(e: NodeEntry) -> float:
             scores = []
@@ -504,7 +509,11 @@ class HeadService:
 
         device_demand = max(resources.get("TPU", 0.0),
                             resources.get("device", 0.0))
-        if device_demand > 0:
+        if strategy_kind == "spread":
+            # Explicit spread always wins — fault isolation trumps the
+            # fragmentation scorer even for accelerator demands.
+            chosen = min(pool, key=utilization)
+        elif device_demand > 0:
             # Least-fragmentation scorer: of the feasible hosts, take the
             # one whose leftover device capacity after this placement is
             # smallest (best fit) — large contiguous hosts stay free for
@@ -515,8 +524,6 @@ class HeadService:
                 return (avail - device_demand, utilization(e))
 
             chosen = min(pool, key=leftover)
-        elif strategy_kind == "spread":
-            chosen = min(pool, key=utilization)
         else:
             # hybrid: pack (most utilized under threshold) else spread
             under = [e for e in pool

@@ -47,10 +47,12 @@ def probe() -> dict:
     took = time.time() - t0
     devices = []
     if "PROBE" in out:
+        import ast
+
         try:
-            devices = eval(out.split("PROBE", 1)[1].strip())  # noqa: S307
-        except Exception:  # noqa: BLE001 - diagnostic only
-            pass
+            devices = ast.literal_eval(out.split("PROBE", 1)[1].strip())
+        except (ValueError, SyntaxError):
+            pass  # diagnostic only
     platforms = {p for p, _ in devices}
     healthy = rc == 0 and bool(platforms - {"cpu"})
     return {

@@ -109,7 +109,8 @@ def list_nodes(filters: Optional[Sequence[Filter]] = None,
              "address": tuple(n["address"]), "state": n["state"],
              "resources": n["resources"], "available": n["available"],
              "is_head_node": n["is_head_node"],
-             "is_driver": n.get("is_driver", False)}
+             "is_driver": n.get("is_driver", False),
+             "labels": n.get("labels", {})}
             for n in _runtime("list_nodes").list_nodes()]  # head-only
     return _apply_filters(rows, filters, limit)
 

@@ -6,8 +6,6 @@ node_label_scheduling_policy.h, node_affinity_scheduling_policy.h,
 scorer.h and python/ray/util/scheduling_strategies.py (VERDICT r4
 item 7)."""
 
-import time
-
 import pytest
 
 import ray_tpu
@@ -16,11 +14,9 @@ from ray_tpu.cluster_utils import Cluster
 from ray_tpu.util import (NodeAffinitySchedulingStrategy,
                           NodeLabelSchedulingStrategy)
 
-
-def _where():
-    import os as _os
-
-    return _os.environ.get("RT_NODE_ID", "head")
+# NOTE: every remote fn below inlines its node probe — referencing a
+# test-module global would make cloudpickle import this module on
+# worker nodes.
 
 
 @pytest.fixture
@@ -42,7 +38,7 @@ def test_node_labels_visible_in_membership(cluster):
         labels = r.get("labels") or {}
         if r.get("is_driver"):
             continue
-        assert labels.get("rt.io/node-id") == NodeID(r["node_id"]).hex()
+        assert labels.get("rt.io/node-id") == r["node_id"]
         assert labels.get("rt.io/accelerator") in ("cpu", "tpu")
 
 
@@ -52,7 +48,9 @@ def test_label_selector_places_on_matching_node(cluster):
     @ray_tpu.remote(scheduling_strategy=NodeLabelSchedulingStrategy(
         hard={"pool": "gpu-sim"}))
     def where():
-        return _where()
+        import os as _os
+
+        return _os.environ.get("RT_NODE_ID", "head")
 
     got = {ray_tpu.get(where.remote(), timeout=60) for _ in range(3)}
     assert got == {n.node_id.hex()}
@@ -65,10 +63,25 @@ def test_label_selector_not_equals_and_membership(cluster):
     @ray_tpu.remote(scheduling_strategy=NodeLabelSchedulingStrategy(
         hard={"zone": "!a", "rt.io/accelerator": ["cpu", "tpu"]}))
     def where():
-        return _where()
+        import os as _os
 
-    got = {ray_tpu.get(where.remote(), timeout=60) for _ in range(3)}
-    assert got == {b.node_id.hex()}, (a.node_id.hex(), got)
+        return _os.environ.get("RT_NODE_ID", "head")
+
+    # "!a" matches every node NOT labeled zone=a — including unlabeled
+    # nodes (the head), matching the reference's label_not_in semantics.
+    got = {ray_tpu.get(where.remote(), timeout=60) for _ in range(6)}
+    assert a.node_id.hex() not in got, got
+    assert got, got
+
+    @ray_tpu.remote(scheduling_strategy=NodeLabelSchedulingStrategy(
+        hard={"zone": ["b"]}))
+    def where_b():
+        import os as _os
+
+        return _os.environ.get("RT_NODE_ID", "head")
+
+    got_b = {ray_tpu.get(where_b.remote(), timeout=60) for _ in range(3)}
+    assert got_b == {b.node_id.hex()}, got_b
 
 
 def test_hard_selector_waits_for_matching_node(cluster):
@@ -77,7 +90,9 @@ def test_hard_selector_waits_for_matching_node(cluster):
     @ray_tpu.remote(scheduling_strategy=NodeLabelSchedulingStrategy(
         hard={"pool": "late"}))
     def where():
-        return _where()
+        import os as _os
+
+        return _os.environ.get("RT_NODE_ID", "head")
 
     ref = where.remote()
     ready, _ = ray_tpu.wait([ref], timeout=1.5)
@@ -92,7 +107,9 @@ def test_soft_selector_prefers_but_falls_back(cluster):
     @ray_tpu.remote(scheduling_strategy=NodeLabelSchedulingStrategy(
         soft={"pool": "nonexistent"}))
     def anywhere():
-        return _where()
+        import os as _os
+
+        return _os.environ.get("RT_NODE_ID", "head")
 
     assert ray_tpu.get(anywhere.remote(), timeout=60) is not None
 
@@ -104,7 +121,9 @@ def test_node_affinity_hard_and_soft(cluster):
     @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
         n2.node_id.hex()))
     def where():
-        return _where()
+        import os as _os
+
+        return _os.environ.get("RT_NODE_ID", "head")
 
     assert ray_tpu.get(where.remote(), timeout=60) == n2.node_id.hex()
 
@@ -115,7 +134,9 @@ def test_node_affinity_hard_and_soft(cluster):
     @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
         ghost, soft=True))
     def soft_where():
-        return _where()
+        import os as _os
+
+        return _os.environ.get("RT_NODE_ID", "head")
 
     assert ray_tpu.get(soft_where.remote(), timeout=60) in {
         n1.node_id.hex(), n2.node_id.hex(), "head"}
@@ -124,7 +145,9 @@ def test_node_affinity_hard_and_soft(cluster):
     @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
         ghost, soft=False))
     def hard_where():
-        return _where()
+        import os as _os
+
+        return _os.environ.get("RT_NODE_ID", "head")
 
     with pytest.raises(ray_tpu.RayTpuError):
         ray_tpu.get(hard_where.remote(), timeout=60)
@@ -158,3 +181,51 @@ def test_device_scorer_prefers_least_fragmented(rt):
     finally:
         head.nodes.pop(small.node_id, None)
         head.nodes.pop(big.node_id, None)
+
+
+def test_soft_ranking_counts_partial_matches(rt):
+    """Soft selectors rank by matched COUNT: a node matching 1 of 2
+    selectors beats one matching 0."""
+    from ray_tpu._private.head import NodeEntry
+
+    head = rt.head
+    partial = NodeEntry(node_id=NodeID.from_random(), address=("x", 1),
+                        resources={"CPU": 2.0}, available={"CPU": 2.0},
+                        labels={"zone": "a"})
+    none_ = NodeEntry(node_id=NodeID.from_random(), address=("x", 2),
+                      resources={"CPU": 2.0}, available={"CPU": 2.0},
+                      labels={"zone": "c"})
+    head.nodes[partial.node_id] = partial
+    head.nodes[none_.node_id] = none_
+    try:
+        chosen = head.schedule(
+            {"CPU": 1.0}, exclude={rt.node_id},
+            labels_soft={"zone": "a", "disk": "ssd"})
+        assert chosen == partial.node_id
+    finally:
+        head.nodes.pop(partial.node_id, None)
+        head.nodes.pop(none_.node_id, None)
+
+
+def test_spread_overrides_device_scorer(rt):
+    """Explicit spread keeps fault isolation even for device demands:
+    back-to-back placements land on different hosts."""
+    from ray_tpu._private.head import NodeEntry
+
+    head = rt.head
+    ids = []
+    for i in range(2):
+        e = NodeEntry(node_id=NodeID.from_random(), address=("x", i),
+                      resources={"CPU": 1.0, "device": 4.0},
+                      available={"CPU": 1.0, "device": 4.0})
+        head.nodes[e.node_id] = e
+        ids.append(e.node_id)
+    try:
+        first = head.schedule({"device": 1.0}, "spread",
+                              exclude={rt.node_id})
+        second = head.schedule({"device": 1.0}, "spread",
+                               exclude={rt.node_id})
+        assert {first, second} == set(ids), (first, second)
+    finally:
+        for nid in ids:
+            head.nodes.pop(nid, None)
