@@ -69,6 +69,52 @@ class SessionHost:
                                        thread_name_prefix="client-host")
         self._log_conns: set = set()
         self._server_loop = None
+        # Client pubsub: (id(conn), channel) -> sub_id of the fn sink
+        # registered on the session runtime's node.
+        self._client_subs: dict = {}
+
+    # -- client pubsub (session-host side of the proxy) -------------------
+    async def client_pubsub_subscribe(self, conn, channel: str):
+        import uuid as _uuid
+
+        key = (id(conn), channel)
+        if key in self._client_subs:
+            return
+        sub_id = "client:" + _uuid.uuid4().hex
+        self._client_subs[key] = sub_id
+        loop = self._server_loop
+
+        def forward(message, _ch=channel):
+            # Called on the runtime's loop thread; the conn belongs to
+            # the server loop — hop threads, fire-and-forget.
+            def send():
+                from .rpc import _keep_task
+
+                _keep_task(asyncio.ensure_future(conn.notify(
+                    "pubsub_msg", {"channel": _ch, "message": message})))
+            try:
+                loop.call_soon_threadsafe(send)
+            except RuntimeError:
+                pass  # server shutting down
+
+        rt = self.rt
+        await asyncio.wrap_future(asyncio.run_coroutine_threadsafe(
+            rt.node.pubsub_subscribe(channel, sub_id, ("fn", forward)),
+            rt.loop))
+
+    async def client_pubsub_unsubscribe(self, conn, channel: str):
+        sub_id = self._client_subs.pop((id(conn), channel), None)
+        if sub_id is None:
+            return
+        rt = self.rt
+        await asyncio.wrap_future(asyncio.run_coroutine_threadsafe(
+            rt.node.pubsub_unsubscribe(channel, sub_id), rt.loop))
+
+    async def client_pubsub_drop_conn(self, conn):
+        """A disconnected client can never unsubscribe: sweep its sinks."""
+        for (cid, channel) in [k for k in self._client_subs
+                               if k[0] == id(conn)]:
+            await self.client_pubsub_unsubscribe(conn, channel)
 
     # -- registry ---------------------------------------------------------
     def _track(self, ref: ObjectRef) -> bytes:
@@ -187,6 +233,12 @@ class SessionHost:
                     "node_id": rt.node_id.binary(),
                     "worker_id": rt.worker_id.binary(),
                     "pid": os.getpid()}
+        if method == "pubsub_publish":
+            if payload["channel"].startswith("__"):
+                raise ValueError(
+                    f"channel {payload['channel']!r} is reserved")
+            return rt.pubsub_publish(payload["channel"],
+                                     payload["message"])
         if method == "ping":
             return "pong"
         raise ValueError(f"unknown client method {method!r}")
@@ -219,6 +271,20 @@ async def _serve(host: SessionHost, sock_path: str):
         if method == "subscribe_logs":
             host._log_conns.add(conn)
             return True
+        if method == "pubsub_subscribe":
+            # Registered here (not via host.handle) because delivery
+            # needs THIS conn: a per-channel fn sink on the session
+            # runtime's node forwards messages to the client.
+            channel = payload["channel"]
+            if channel.startswith("__"):
+                return ("err", cloudpickle.dumps(ValueError(
+                    f"channel {channel!r} is reserved")))
+            await host.client_pubsub_subscribe(conn, channel)
+            return ("ok", True)
+        if method == "pubsub_unsubscribe":
+            await host.client_pubsub_unsubscribe(conn,
+                                                 payload["channel"])
+            return ("ok", True)
         # Exception FIDELITY across the proxy: the raw RPC layer
         # flattens exceptions to strings, so client code could never
         # `except GetTimeoutError` / catch its own task errors. Ship the
@@ -235,7 +301,11 @@ async def _serve(host: SessionHost, sock_path: str):
                 blob = cloudpickle.dumps(RuntimeError(repr(e)))
             return ("err", blob)
 
-    server = DuplexServer(sock_path, handler)
+    async def on_disconnect(conn):
+        host._log_conns.discard(conn)
+        await host.client_pubsub_drop_conn(conn)
+
+    server = DuplexServer(sock_path, handler, on_disconnect)
     await server.start()
     # Parent (the proxy) watches this marker to know we are up.
     with open(sock_path + ".ready", "w") as f:

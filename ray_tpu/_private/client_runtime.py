@@ -73,12 +73,63 @@ class ClientRuntime:
         self._decref_buf: list[bytes] = []
         self._decref_lock = threading.Lock()
         self._decref_timer: Optional[threading.Timer] = None
+        self._pubsub_queues: dict = {}  # channel -> sub_id -> queue
+        self._pubsub_lock = threading.Lock()
 
     # -- pushes from the session host ------------------------------------
     def _on_push(self, method: str, payload):
         if method == "log" and self._show_logs:
             sys.stderr.write(f"(client) {payload}\n")
+        elif method == "pubsub_msg":
+            with self._pubsub_lock:
+                sinks = list(self._pubsub_queues.get(
+                    payload["channel"], {}).values())
+            for q in sinks:
+                try:
+                    q.put_nowait(payload["message"])
+                except Exception:  # noqa: BLE001 - bounded queue: drop
+                    pass
         return True
+
+    # -- pubsub (proxied through the session host) ------------------------
+    def pubsub_subscribe(self, channel: str, sub_id: str, q) -> None:
+        with self._pubsub_lock:
+            chan = self._pubsub_queues.setdefault(channel, {})
+            first = not chan
+            chan[sub_id] = q
+        if first:
+            try:
+                self._call("pubsub_subscribe", {"channel": channel},
+                           timeout=30)
+            except BaseException:
+                with self._pubsub_lock:
+                    chan = self._pubsub_queues.get(channel)
+                    if chan is not None:
+                        chan.pop(sub_id, None)
+                        if not chan:
+                            self._pubsub_queues.pop(channel, None)
+                raise
+
+    def pubsub_unsubscribe(self, channel: str, sub_id: str) -> None:
+        last = False
+        with self._pubsub_lock:
+            chan = self._pubsub_queues.get(channel)
+            if chan is not None:
+                chan.pop(sub_id, None)
+                if not chan:
+                    del self._pubsub_queues[channel]
+                    last = True
+        if last:
+            try:
+                self._conn.notify("pubsub_unsubscribe",
+                                  {"channel": channel})
+            except Exception:  # noqa: BLE001 - conn gone
+                pass
+
+    def pubsub_publish(self, channel: str, message) -> int:
+        return self._call("pubsub_publish",
+                          {"channel": channel, "message": message},
+                          timeout=30)
 
     def _call(self, method: str, payload=None, timeout=None):
         """Proxied call with exception fidelity: the session host ships

@@ -37,6 +37,10 @@ from .rpc import ConnectionLost, DuplexServer, RpcTimeout, ServerConn
 
 ALIVE, DEAD = "ALIVE", "DEAD"
 
+# Internal pubsub channel carrying worker log batches to attached
+# drivers (per-job filtering happens subscriber-side).
+WORKER_LOG_CHANNEL = "__worker_logs__"
+
 
 @dataclass
 class NodeEntry:
@@ -98,6 +102,11 @@ class HeadService:
         self.named_actors: dict[str, dict] = {}  # name -> {actor_id, node_id, methods}
         self.actor_nodes: dict[ActorID, NodeID] = {}
         self.placement_groups: dict[PlacementGroupID, PGEntry] = {}
+        # General pubsub broker: channel -> node_ids with >=1 local
+        # subscriber (reference: the GCS-based publisher of
+        # src/ray/pubsub/publisher.h:307 — node-level fanout here,
+        # per-subscriber delivery at each node service).
+        self.pubsub: dict[str, set] = {}
         self._local_node_service = None  # driver node (in-process)
         if store is None:
             path = os.environ.get("RT_HEAD_PERSIST")
@@ -370,6 +379,9 @@ class HeadService:
         for aid in [a for a, n in self.actor_nodes.items()
                     if n == entry.node_id]:
             del self.actor_nodes[aid]
+        for channel in [c for c, subs in self.pubsub.items()
+                        if entry.node_id in subs]:
+            self.pubsub_unsub(channel, entry.node_id)
         for pg in self.placement_groups.values():
             lost = [i for i, nid in pg.placement.items()
                     if nid == entry.node_id]
@@ -430,6 +442,50 @@ class HeadService:
                     await entry.conn.notify(method, payload)
                 except (ConnectionLost, RpcTimeout, OSError):
                     pass
+
+    # ------------------------------------------------------------------
+    # Pubsub broker (reference: src/ray/pubsub/publisher.h:307)
+    # ------------------------------------------------------------------
+    def pubsub_sub(self, channel: str, node_id: NodeID) -> bool:
+        self.pubsub.setdefault(channel, set()).add(node_id)
+        return True
+
+    def pubsub_unsub(self, channel: str, node_id: NodeID) -> bool:
+        subs = self.pubsub.get(channel)
+        if subs is not None:
+            subs.discard(node_id)
+            if not subs:
+                del self.pubsub[channel]
+        return True
+
+    async def pubsub_pub(self, channel: str, message) -> int:
+        """Fan one message out to every node with a subscriber on the
+        channel. At-most-once: a node that is down misses the message
+        (parity with the reference's pubsub, which replays nothing).
+        Remote sends are fire-and-forget and CONCURRENT — one stalled
+        subscriber connection must not delay healthy nodes or block
+        the publisher."""
+        from .rpc import _keep_task
+
+        targets = list(self.pubsub.get(channel, ()))
+        payload = {"channel": channel, "message": message}
+        delivered = 0
+        for node_id in targets:
+            entry = self.nodes.get(node_id)
+            local = (self._local_node_service is not None
+                     and self._local_node_service.node_id == node_id)
+            if local:
+                await self._local_node_service.on_head_push(
+                    "pubsub_msg", payload)
+                delivered += 1
+            elif (entry is not None and entry.state == ALIVE
+                    and entry.conn is not None):
+                _keep_task(asyncio.ensure_future(
+                    entry.conn.notify("pubsub_msg", payload)))
+                delivered += 1
+            else:
+                self.pubsub_unsub(channel, node_id)
+        return delivered
 
     # ------------------------------------------------------------------
     # Scheduling policy (cluster-wide placement)
@@ -832,6 +888,15 @@ class HeadService:
         if method == "node_address":
             addr = self.node_address(NodeID(payload))
             return addr
+        if method == "pubsub_sub":
+            return self.pubsub_sub(payload["channel"],
+                                   NodeID(payload["node_id"]))
+        if method == "pubsub_unsub":
+            return self.pubsub_unsub(payload["channel"],
+                                     NodeID(payload["node_id"]))
+        if method == "pubsub_pub":
+            return await self.pubsub_pub(payload["channel"],
+                                         payload["message"])
         if method == "register_named_actor":
             ok = self.register_named_actor(
                 payload["name"], ActorID(payload["actor_id"]),
@@ -861,26 +926,23 @@ class HeadService:
 
             node_hex = NodeID(payload["node_id"]).hex()
             _print_worker_logs(node_hex, payload["entries"])
-            from .rpc import _keep_task
-
-            for e in self.nodes.values():
-                if not (e.is_driver and e.state == ALIVE
-                        and e.conn is not None and e.conn.alive):
-                    continue
-                # Per-driver routing: each entry goes only to the driver
-                # whose tasks produced it (owner == that driver's node
-                # id); unattributed lines broadcast. Keeps one client
-                # session's output off other sessions' consoles
-                # (reference: per-job log subscription).
-                mine = e.node_id.binary()
-                text = "".join(
-                    f"(pid={entry['pid']}, node={node_hex[:8]}) {line}\n"
-                    for entry in payload["entries"]
-                    if entry.get("owner") in (None, mine)
-                    for line in entry["lines"])
-                if text:
-                    _keep_task(asyncio.ensure_future(
-                        e.conn.notify("log", text)))
+            # Fan out to attached drivers over the GENERAL pubsub plane
+            # on PER-OWNER channels: each driver subscribes to
+            # __worker_logs__:<its-node-hex> plus the unattributed
+            # broadcast __worker_logs__:* — so one session's output
+            # never reaches another session's process (the reference's
+            # per-job log subscription), and a chatty job's volume
+            # ships only to its own driver.
+            by_owner: dict = {}
+            for e in payload["entries"]:
+                by_owner.setdefault(e.get("owner"), []).append(e)
+            for owner, entries in by_owner.items():
+                suffix = (owner.hex() if isinstance(owner, (bytes,
+                                                            bytearray))
+                          else "*")
+                await self.pubsub_pub(
+                    f"{WORKER_LOG_CHANNEL}:{suffix}",
+                    {"node_hex": node_hex, "entries": entries})
             return True
         if method == "list_nodes":
             return [e.to_row() for e in self.nodes.values()]
@@ -927,6 +989,15 @@ class LocalHeadClient:
 
     async def fetch_function(self, fid):
         return self.head.functions.get(fid)
+
+    async def pubsub_sub(self, channel, node_id):
+        return self.head.pubsub_sub(channel, node_id)
+
+    async def pubsub_unsub(self, channel, node_id):
+        return self.head.pubsub_unsub(channel, node_id)
+
+    async def pubsub_pub(self, channel, message):
+        return await self.head.pubsub_pub(channel, message)
 
     async def schedule(self, resources, strategy="default", exclude=(),
                        labels_hard=None, labels_soft=None):
@@ -1018,6 +1089,23 @@ class RemoteHeadClient:
 
     async def fetch_function(self, fid):
         return await self._read("fetch_function", fid)
+
+    async def pubsub_sub(self, channel, node_id):
+        return await self.conn.call(
+            "pubsub_sub", {"channel": channel,
+                           "node_id": node_id.binary()},
+            timeout=self.MUTATE_TIMEOUT_S)
+
+    async def pubsub_unsub(self, channel, node_id):
+        return await self.conn.call(
+            "pubsub_unsub", {"channel": channel,
+                             "node_id": node_id.binary()},
+            timeout=self.MUTATE_TIMEOUT_S)
+
+    async def pubsub_pub(self, channel, message):
+        return await self.conn.call(
+            "pubsub_pub", {"channel": channel, "message": message},
+            timeout=self.MUTATE_TIMEOUT_S)
 
     async def schedule(self, resources, strategy="default", exclude=(),
                        labels_hard=None, labels_soft=None):

@@ -109,6 +109,16 @@ async def attach_node_to_head(node: "NodeService", head_addr: tuple,
             node.release_bundle(PlacementGroupID(row["pg_id"]),
                                 row["bundle_index"])
 
+        # Re-establish this node's pubsub channel registrations after a
+        # head restart (subscriber-side re-sync: subscriber.h:329).
+        node._pubsub_head_ok.clear()
+        for channel in list(node.pubsub_local):
+            try:
+                await node.head.pubsub_sub(channel, node.node_id)
+                node._pubsub_head_ok.add(channel)
+            except Exception:  # noqa: BLE001 - next register retries
+                pass
+
     node.register_cb = register
     await register()
     return conn
@@ -183,14 +193,21 @@ class ObjectState:
     inner_refs: Optional[list] = None
 
 
+def format_worker_logs(node_hex: str, entries: list) -> str:
+    """THE console format for streamed worker output — shared by the
+    head console and every driver-side pubsub sink so the prefixes
+    can't diverge (reference: the (pid=…, ip=…) prefixes the log
+    monitor prints)."""
+    return "".join(
+        f"(pid={e['pid']}, node={node_hex[:8]}) {line}\n"
+        for e in entries for line in e.get("lines", ()))
+
+
 def _print_worker_logs(node_hex: str, entries: list):
-    """Driver-console rendering of streamed worker output (reference:
-    the (pid=…, ip=…) prefixes the log monitor prints)."""
-    for e in entries:
-        prefix = f"(pid={e['pid']}, node={node_hex[:8]})"
-        for line in e["lines"]:
-            sys.stderr.write(f"{prefix} {line}\n")
-    sys.stderr.flush()
+    text = format_worker_logs(node_hex, entries)
+    if text:
+        sys.stderr.write(text)
+        sys.stderr.flush()
 
 
 @dataclass
@@ -332,6 +349,17 @@ class NodeService:
 
         # (pg_id, bundle_index) -> BundlePool reserved on this node.
         self.bundles: dict[tuple, BundlePool] = {}
+
+        # General pubsub: channel -> {sub_id: sink}. Sinks are
+        # ("q", queue.Queue) for in-process subscribers (driver threads),
+        # ("fn", callable) for internal consumers (log rendering), or
+        # ("worker", WorkerHandle) for worker-process subscribers
+        # (delivered over the worker's duplex conn). Reference:
+        # src/ray/pubsub/subscriber.h:329 — the node service is the
+        # per-process subscriber that multiplexes local subscriptions
+        # over ONE head registration per channel.
+        self.pubsub_local: dict[str, dict] = {}
+        self._pubsub_head_ok: set[str] = set()  # registered at the head
 
         # Peer plumbing: node_id -> ServerConn (lazily dialed).
         self.peer_conns: dict[NodeID, ServerConn] = {}
@@ -837,16 +865,87 @@ class NodeService:
         self.peer_conns[node_id] = conn
         return conn
 
+    # ------------------------------------------------------------------
+    # Pubsub (node-local subscriber registry + head registration)
+    # ------------------------------------------------------------------
+    async def pubsub_subscribe(self, channel: str, sub_id: str, sink):
+        """Register a local sink; the FIRST local subscriber on a channel
+        registers this node with the head broker. A transient head
+        failure must not poison the channel (insert-then-give-up would
+        make every later subscriber see "already registered"): a
+        background retry keeps trying until registered or the channel
+        empties. Loop thread only."""
+        subs = self.pubsub_local.setdefault(channel, {})
+        first = not subs
+        subs[sub_id] = sink
+        if first and self.head is not None:
+            try:
+                await self.head.pubsub_sub(channel, self.node_id)
+                self._pubsub_head_ok.add(channel)
+            except (ConnectionLost, RpcTimeout, OSError):
+                self.spawn(self._pubsub_head_retry(channel))
+
+    async def _pubsub_head_retry(self, channel: str):
+        while (not self._closing
+               and self.pubsub_local.get(channel)
+               and channel not in self._pubsub_head_ok
+               and self.head is not None):
+            try:
+                await self.head.pubsub_sub(channel, self.node_id)
+                self._pubsub_head_ok.add(channel)
+                return
+            except (ConnectionLost, RpcTimeout, OSError):
+                await asyncio.sleep(1.0)
+
+    async def pubsub_unsubscribe(self, channel: str, sub_id: str):
+        subs = self.pubsub_local.get(channel)
+        if subs is None:
+            return
+        subs.pop(sub_id, None)
+        if not subs:
+            del self.pubsub_local[channel]
+            self._pubsub_head_ok.discard(channel)
+            if self.head is not None:
+                try:
+                    await self.head.pubsub_unsub(channel, self.node_id)
+                except (ConnectionLost, RpcTimeout, OSError):
+                    pass
+
+    async def pubsub_publish(self, channel: str, message) -> int:
+        if self.head is None:
+            self.pubsub_dispatch(channel, message)
+            return 1
+        return await self.head.pubsub_pub(channel, message)
+
+    def pubsub_dispatch(self, channel: str, message):
+        """Deliver one inbound message to every local sink. A sink that
+        throws loses THIS message only (at-most-once contract) — a
+        transient failure (e.g. a briefly-full stderr pipe in an fn
+        sink) must not silently unsubscribe the consumer forever."""
+        for _sub_id, sink in list(self.pubsub_local.get(channel,
+                                                        {}).items()):
+            kind = sink[0]
+            try:
+                if kind == "q":
+                    sink[1].put_nowait(message)
+                elif kind == "fn":
+                    sink[1](message)
+                else:  # worker
+                    w = sink[1]
+                    self.spawn(w.conn.notify(
+                        "pubsub_msg", {"channel": channel,
+                                       "message": message}))
+            except Exception:  # noqa: BLE001 - drop message, keep sink
+                self.counters["pubsub_sink_errors"] += 1
+
     async def on_head_push(self, method: str, payload):
         """Pushes from the head (over the node's head connection, or direct
         calls for the head node itself)."""
         if method == "node_dead":
             await self._on_node_dead(NodeID(payload["node_id"]),
                                      payload.get("cause", ""))
-        elif method == "log":
-            # Cluster worker output relayed via the head to this
-            # attached driver's console.
-            sys.stderr.write(payload)
+        elif method == "pubsub_msg":
+            self.pubsub_dispatch(payload["channel"], payload["message"])
         elif method == "reserve_bundle":
             self.reserve_bundle(PlacementGroupID(payload["pg_id"]),
                                 payload["bundle_index"], payload["resources"])
@@ -3310,6 +3409,31 @@ class NodeService:
                 self.decref(ObjectID(b))
             return True
 
+        if method == "pubsub_subscribe":
+            if payload["channel"].startswith("__"):
+                # Internal channels (worker-log fanout etc.) are not
+                # worker-subscribable: one session's console output
+                # must not be readable from another session's tasks.
+                raise ValueError(
+                    f"channel {payload['channel']!r} is reserved")
+            w = conn.meta.get("worker")
+            if w is not None:
+                await self.pubsub_subscribe(
+                    payload["channel"], payload["sub_id"], ("worker", w))
+            return True
+
+        if method == "pubsub_unsubscribe":
+            await self.pubsub_unsubscribe(payload["channel"],
+                                          payload["sub_id"])
+            return True
+
+        if method == "pubsub_publish":
+            if payload["channel"].startswith("__"):
+                raise ValueError(
+                    f"channel {payload['channel']!r} is reserved")
+            return await self.pubsub_publish(payload["channel"],
+                                             payload["message"])
+
         if method == "free_objects":
             # Worker-initiated eager free (Data executors running inside
             # actors): local-owned frees happen here; foreign-owned are
@@ -3369,6 +3493,11 @@ class NodeService:
         for oid, n in w.held_refs.items():
             self.decref(oid, n)
         w.held_refs.clear()
+        # ...nor its pubsub unsubscribes.
+        for channel in list(self.pubsub_local):
+            for sub_id, sink in list(self.pubsub_local[channel].items()):
+                if sink[0] == "worker" and sink[1] is w:
+                    await self.pubsub_unsubscribe(channel, sub_id)
         # Plain task workers: inflight tasks handled by ConnectionLost in
         # _run_on_worker (retry path). Actor workers: restart FSM.
         if w.actor_id is not None:

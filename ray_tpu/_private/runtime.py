@@ -290,6 +290,26 @@ class Runtime:
                                   self._resources, is_driver=True,
                                   on_lost=on_head_lost)
 
+        # Cluster worker logs reach attached drivers over the general
+        # pubsub plane on per-owner channels: the head publishes OUR
+        # job's lines on __worker_logs__:<our-node-hex> and unattributed
+        # lines on __worker_logs__:* — so another session's output never
+        # reaches this process (the reference's per-job log
+        # subscription via GCS pubsub).
+        from .head import WORKER_LOG_CHANNEL
+        from .node_service import format_worker_logs
+
+        def render_logs(payload):
+            text = format_worker_logs(payload.get("node_hex", ""),
+                                      payload.get("entries", ()))
+            if text:
+                sys.stderr.write(text)
+
+        for chan in (f"{WORKER_LOG_CHANNEL}:{self.node_id.hex()}",
+                     f"{WORKER_LOG_CHANNEL}:*"):
+            await node.pubsub_subscribe(chan, "driver-console",
+                                        ("fn", render_logs))
+
     @property
     def head_address(self) -> tuple:
         if self._attach_addr is not None:
@@ -345,6 +365,16 @@ class Runtime:
         if fid not in self.node.functions:
             self._call_soon(self.node.functions.__setitem__, fid, blob)
         return fid
+
+    # -- pubsub --------------------------------------------------------
+    def pubsub_subscribe(self, channel: str, sub_id: str, q) -> None:
+        self._run(self.node.pubsub_subscribe(channel, sub_id, ("q", q)))
+
+    def pubsub_unsubscribe(self, channel: str, sub_id: str) -> None:
+        self._run(self.node.pubsub_unsubscribe(channel, sub_id))
+
+    def pubsub_publish(self, channel: str, message) -> int:
+        return self._run(self.node.pubsub_publish(channel, message))
 
     @property
     def node_addr(self) -> tuple:

@@ -57,6 +57,8 @@ class WorkerContext:
         self._put_lock = threading.Lock()
         self._decref_buf: list[bytes] = []
         self._decref_lock = threading.Lock()
+        self._pubsub_queues: dict[str, dict] = {}  # channel -> sub_id -> q
+        self._pubsub_lock = threading.Lock()
         from .interrupt import TaskInterruptRegistry
 
         self._interrupts = TaskInterruptRegistry()
@@ -159,6 +161,64 @@ class WorkerContext:
                 (oid.binary(), list(owner_addr) if owner_addr else None)])
         except Exception:
             pass  # connection gone; worker is dying
+
+    # -- pubsub --------------------------------------------------------
+    # The worker registers with its node ONCE per channel (first local
+    # subscriber) and fans inbound messages out to local queues itself —
+    # the node-side sink is the worker process, not each subscription.
+    def pubsub_subscribe(self, channel: str, sub_id: str, q) -> None:
+        with self._pubsub_lock:
+            chan = self._pubsub_queues.setdefault(channel, {})
+            first = not chan
+            chan[sub_id] = q
+        if first:
+            try:
+                self.client.call(
+                    "pubsub_subscribe",
+                    {"channel": channel,
+                     "sub_id": "w:" + self.worker_id.hex()})
+            except BaseException:
+                # Roll back so a RETRY re-attempts the node registration
+                # (leaving the entry would make every later subscribe
+                # see first=False and silently never register).
+                with self._pubsub_lock:
+                    chan = self._pubsub_queues.get(channel)
+                    if chan is not None:
+                        chan.pop(sub_id, None)
+                        if not chan:
+                            self._pubsub_queues.pop(channel, None)
+                raise
+
+    def pubsub_unsubscribe(self, channel: str, sub_id: str) -> None:
+        last = False
+        with self._pubsub_lock:
+            chan = self._pubsub_queues.get(channel)
+            if chan is not None:
+                chan.pop(sub_id, None)
+                if not chan:
+                    del self._pubsub_queues[channel]
+                    last = True
+        if last:
+            try:
+                self.client.notify(
+                    "pubsub_unsubscribe",
+                    {"channel": channel,
+                     "sub_id": "w:" + self.worker_id.hex()})
+            except Exception:
+                pass  # connection gone; worker is dying
+
+    def pubsub_publish(self, channel: str, message) -> int:
+        return self.client.call("pubsub_publish",
+                                {"channel": channel, "message": message})
+
+    def _pubsub_deliver(self, channel: str, message) -> None:
+        with self._pubsub_lock:
+            sinks = list(self._pubsub_queues.get(channel, {}).values())
+        for q in sinks:
+            try:
+                q.put_nowait(message)
+            except Exception:  # noqa: BLE001 - full bounded queue: drop
+                pass
 
     def _next_put_id(self) -> ObjectID:
         task = _running_task.get()
@@ -360,6 +420,9 @@ class WorkerContext:
             return heap_snapshot(int((payload or {}).get("top_n", 25)))
         if method == "cancel_task":
             return self._cancel_running(TaskID(payload))
+        if method == "pubsub_msg":
+            self._pubsub_deliver(payload["channel"], payload["message"])
+            return True
         if method == "shutdown":
             threading.Thread(target=lambda: os._exit(0), daemon=True).start()
             return True

@@ -7,6 +7,7 @@ actor_pool.py, queue.py, metrics.py). The state API lives in
 """
 
 from . import metrics  # noqa: F401
+from . import pubsub  # noqa: F401
 from . import queue  # noqa: F401
 from . import scheduling_strategies  # noqa: F401
 from . import state  # noqa: F401

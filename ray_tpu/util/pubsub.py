@@ -1,0 +1,134 @@
+"""General pubsub channels: ``publish(channel, msg)`` anywhere,
+``subscribe(channel)`` anywhere — drivers, tasks, and actors all see
+the same channel namespace, with push delivery (no polling).
+
+Capability parity target: the reference's GCS pubsub
+(/root/reference/src/ray/pubsub/publisher.h:307, subscriber.h:329,
+python/ray/_private/gcs_pubsub.py:68). Topology: the head is the
+broker and fans each message out ONCE per subscribed node; each node
+service re-fans to its local subscribers (driver threads via queues,
+workers over their duplex conns) — so a channel with N subscribers on
+one node costs one head->node hop, not N.
+
+Delivery is at-most-once, in publish order per publisher; there is no
+replay for late subscribers (same contract as the reference).
+
+    from ray_tpu.util import pubsub
+
+    sub = pubsub.subscribe("jobs")
+    pubsub.publish("jobs", {"event": "started"})
+    msg = sub.get(timeout=5)     # -> {"event": "started"}
+    for msg in sub:              # blocking iterator (until close())
+        ...
+    sub.close()
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import uuid
+from typing import Any, Iterator, Optional
+
+from .._private import context as _context
+
+__all__ = ["publish", "subscribe", "Subscriber"]
+
+# Bounded per-subscriber buffer: a stuck consumer drops the OLDEST
+# messages rather than growing without limit (reference: publisher-side
+# bounded buffers, publisher.h mailbox caps).
+_MAX_BUFFERED = 10_000
+
+
+class Subscriber:
+    """One subscription's message stream. Thread-safe; close() is
+    idempotent and unblocks any waiting get()."""
+
+    _CLOSED = object()
+
+    def __init__(self, channel: str):
+        self.channel = channel
+        self._sub_id = uuid.uuid4().hex
+        self._q: _queue.Queue = _queue.Queue(maxsize=_MAX_BUFFERED)
+        self._closed = False
+        ctx = _context.require_context()
+        ctx.pubsub_subscribe(channel, self._sub_id, _DroppingQueue(self._q))
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        """Next message; raises queue.Empty on timeout, EOFError if
+        closed."""
+        if self._closed:
+            raise EOFError("subscriber is closed")
+        msg = self._q.get(timeout=timeout)
+        if msg is Subscriber._CLOSED:
+            raise EOFError("subscriber is closed")
+        return msg
+
+    def __iter__(self) -> Iterator[Any]:
+        while True:
+            try:
+                yield self.get()
+            except EOFError:
+                return
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        ctx = _context.get_context()
+        if ctx is not None:
+            try:
+                ctx.pubsub_unsubscribe(self.channel, self._sub_id)
+            except Exception:  # noqa: BLE001 - runtime shutting down
+                pass
+        try:
+            self._q.put_nowait(Subscriber._CLOSED)
+        except _queue.Full:
+            pass
+
+    def __enter__(self) -> "Subscriber":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class _DroppingQueue:
+    """put_nowait sink that sheds the OLDEST message when full (a slow
+    subscriber lags, it doesn't wedge the dispatch path)."""
+
+    def __init__(self, q: _queue.Queue):
+        self._q = q
+
+    def put_nowait(self, msg):
+        while True:
+            try:
+                self._q.put_nowait(msg)
+                return
+            except _queue.Full:
+                try:
+                    self._q.get_nowait()
+                except _queue.Empty:
+                    pass
+
+
+def _check_channel(channel: str) -> None:
+    if channel.startswith("__"):
+        raise ValueError(
+            f"channel {channel!r} is reserved (names starting with __ "
+            f"carry internal traffic like per-session worker logs)")
+
+
+def subscribe(channel: str) -> Subscriber:
+    """Subscribe to a channel from any process (driver, task, actor)."""
+    _check_channel(channel)
+    return Subscriber(channel)
+
+
+def publish(channel: str, message: Any) -> int:
+    """Publish to every current subscriber of ``channel``. Returns the
+    number of NODES the message was delivered to (0 = no subscribers).
+    ``message`` must be serializable (msgpack/pickle — same rules as
+    task args)."""
+    _check_channel(channel)
+    ctx = _context.require_context()
+    return ctx.pubsub_publish(channel, message)

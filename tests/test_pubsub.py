@@ -1,0 +1,172 @@
+"""General pubsub channels: worker/driver subscribe + publish, push
+delivery (no polling), node-level fanout, unsubscribe, bounded buffers.
+
+Parity model: /root/reference/src/ray/pubsub/publisher.h:307,
+subscriber.h:329, python/ray/_private/gcs_pubsub.py:68 (VERDICT r4
+item 9)."""
+
+import queue as _stdlib_queue
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import pubsub
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.shutdown()
+    rt = ray_tpu.init(num_cpus=2)
+    yield rt
+    ray_tpu.shutdown()
+
+
+def test_driver_subscribe_publish_roundtrip(rt):
+    with pubsub.subscribe("events") as sub:
+        n = pubsub.publish("events", {"k": 1})
+        assert n == 1  # delivered to this node
+        assert sub.get(timeout=5) == {"k": 1}
+        # In-order delivery per publisher.
+        for i in range(10):
+            pubsub.publish("events", i)
+        got = [sub.get(timeout=5) for _ in range(10)]
+        assert got == list(range(10))
+
+
+def test_publish_without_subscribers_is_zero(rt):
+    assert pubsub.publish("nobody-home", "x") == 0
+
+
+def test_unsubscribe_stops_delivery(rt):
+    sub = pubsub.subscribe("stop")
+    pubsub.publish("stop", 1)
+    assert sub.get(timeout=5) == 1
+    sub.close()
+    assert pubsub.publish("stop", 2) == 0  # no node subscribed anymore
+    with pytest.raises(EOFError):
+        sub.get(timeout=1)
+
+
+def test_workers_receive_published_events_no_polling(rt):
+    """N workers each receive all M events pushed to their channel; the
+    driver publishes AFTER the workers subscribe, and the workers just
+    block on their subscriber — no polling loop (VERDICT r4 item 9's
+    Done criterion)."""
+    @ray_tpu.remote
+    class Listener:
+        def __init__(self):
+            from ray_tpu.util import pubsub as ps
+
+            self.sub = ps.subscribe("fanout")
+
+        def ready(self):
+            return True
+
+        def collect(self, m):
+            return [self.sub.get(timeout=20) for _ in range(m)]
+
+    listeners = [Listener.remote() for _ in range(2)]
+    ray_tpu.get([l.ready.remote() for l in listeners], timeout=60)
+
+    M = 5
+    # Collect concurrently (max_concurrency=1 actors: the collect call
+    # blocks until all M arrive, so publish from the driver meanwhile).
+    futs = [l.collect.remote(M) for l in listeners]
+    time.sleep(0.3)  # let the collect calls park on sub.get
+    for i in range(M):
+        pubsub.publish("fanout", {"seq": i})
+    for got in ray_tpu.get(futs, timeout=60):
+        assert got == [{"seq": i} for i in range(M)]
+
+
+def test_worker_publishes_driver_receives(rt):
+    @ray_tpu.remote
+    def announce(x):
+        from ray_tpu.util import pubsub as ps
+
+        return ps.publish("from-worker", {"x": x})
+
+    with pubsub.subscribe("from-worker") as sub:
+        delivered = ray_tpu.get(announce.remote(42), timeout=60)
+        assert delivered >= 1
+        assert sub.get(timeout=10) == {"x": 42}
+
+
+def test_two_subscribers_same_channel_both_receive(rt):
+    with pubsub.subscribe("dup") as a, pubsub.subscribe("dup") as b:
+        pubsub.publish("dup", "m")
+        assert a.get(timeout=5) == "m"
+        assert b.get(timeout=5) == "m"
+
+
+def test_slow_subscriber_drops_oldest_not_wedges(rt):
+    from ray_tpu.util.pubsub import _DroppingQueue
+
+    q = _stdlib_queue.Queue(maxsize=3)
+    dq = _DroppingQueue(q)
+    for i in range(10):
+        dq.put_nowait(i)
+    got = [q.get_nowait() for _ in range(3)]
+    assert got == [7, 8, 9]  # oldest shed, newest kept
+
+
+def test_cross_node_fanout():
+    """A subscriber on a worker NODE receives events published from the
+    head driver: one head->node hop, re-fanned locally."""
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    c = Cluster(init_args={"num_cpus": 1})
+    try:
+        c.add_node(num_cpus=1)
+
+        @ray_tpu.remote(num_cpus=1)
+        class RemoteListener:
+            def __init__(self):
+                from ray_tpu.util import pubsub as ps
+
+                self.sub = ps.subscribe("xnode")
+
+            def where(self):
+                import os as _os
+
+                return _os.environ.get("RT_SESSION_ID", "driver")
+
+            def take(self, m):
+                return [self.sub.get(timeout=20) for _ in range(m)]
+
+        # Spread forces the listener off the (busy) head node when
+        # capacity allows; either way the path exercises pubsub.
+        l = RemoteListener.options(
+            scheduling_strategy="spread").remote()
+        ray_tpu.get(l.where.remote(), timeout=60)
+        fut = l.take.remote(3)
+        time.sleep(0.3)
+        for i in range(3):
+            pubsub.publish("xnode", i)
+        assert ray_tpu.get(fut, timeout=60) == [0, 1, 2]
+    finally:
+        c.shutdown()
+
+
+def test_reserved_channels_rejected(rt):
+    with pytest.raises(ValueError):
+        pubsub.subscribe("__worker_logs__:*")
+    with pytest.raises(ValueError):
+        pubsub.publish("__anything", 1)
+
+    # Workers can't read internal channels either (one session's
+    # console output must not be readable from another's tasks).
+    @ray_tpu.remote
+    def sneak():
+        from ray_tpu._private import context as _c
+
+        try:
+            _c.get_context().pubsub_subscribe(
+                "__worker_logs__:*", "spy", None)
+            return "subscribed"
+        except Exception as e:  # noqa: BLE001
+            return type(e).__name__
+
+    assert ray_tpu.get(sneak.remote(), timeout=60) != "subscribed"

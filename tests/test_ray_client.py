@@ -155,3 +155,23 @@ except Exception as e:
     out = subprocess.run([sys.executable, "-u", "-c", code], env=env,
                          capture_output=True, text=True, timeout=60)
     assert "REJECTED" in out.stdout, out.stdout + out.stderr[-500:]
+
+
+def test_client_pubsub_roundtrip(cluster):
+    """pubsub.subscribe/publish work over an rtpu:// session: the
+    session host registers a forwarding sink and pushes messages to
+    the client connection."""
+    out = _client(cluster, """
+import ray_tpu
+from ray_tpu.util import pubsub
+import os
+ray_tpu.init(address=os.environ["RT_CLIENT_ADDR"])
+with pubsub.subscribe("client-chan") as sub:
+    n = pubsub.publish("client-chan", {"hello": "client"})
+    assert n >= 1, n
+    got = sub.get(timeout=15)
+    assert got == {"hello": "client"}, got
+print("CLIENT_PUBSUB_OK")
+ray_tpu.shutdown()
+""")
+    assert "CLIENT_PUBSUB_OK" in out.stdout, (out.stdout, out.stderr)
