@@ -51,6 +51,11 @@ class Config:
     object_transfer_chunk_bytes: int = _cfg(4 * 1024 * 1024)
     object_transfer_min_chunked_bytes: int = _cfg(1024 * 1024)
     object_transfer_max_chunks_in_flight: int = _cfg(8)
+    # Parallel raw connections per bulk pull (sendfile lane); ranges of
+    # the object stream concurrently into disjoint slices of the
+    # destination segment (reference: PushManager multiplexing,
+    # object_manager.h:117).
+    object_transfer_bulk_conns: int = _cfg(2)
     # Owner-side concurrent outbound transfers per object before new
     # pullers are asked to wait for a peer copy (broadcast becomes a tree
     # instead of N pulls from the owner).

@@ -193,6 +193,32 @@ class SharedMemoryStore:
         os.close(fd)
         return memoryview(mm), _PendingSeal(self, oid, tmp, mm)
 
+    def put_parts(self, oid: ObjectID, parts) -> int:
+        """Vectored put: write serialize_parts output straight to the
+        segment — one kernel copy per part, no flatten of the (possibly
+        multi-GB) serialized form into an intermediate bytes."""
+        tmp = self._path(oid) + f".tmp.{os.getpid()}"
+        total = 0
+        try:
+            with open(tmp, "wb", buffering=0) as f:
+                for p in parts:
+                    mv = p if isinstance(p, memoryview) else memoryview(p)
+                    off = 0
+                    # Unbuffered FileIO.write may write SHORT (Linux caps
+                    # one write at ~2GiB): loop on the returned count or
+                    # a >2GiB part would silently corrupt the object.
+                    while off < len(mv):
+                        off += f.write(mv[off:])
+                    total += len(mv)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        os.rename(tmp, self._path(oid))  # atomic seal
+        return total
+
     # -- reader API --------------------------------------------------------
     def get(self, oid: ObjectID) -> Optional[memoryview]:
         """Zero-copy read; None if not present/sealed."""
@@ -354,6 +380,36 @@ class NativeObjectStore(SharedMemoryStore):
         mm = mmap.mmap(fd, size)
         os.close(fd)
         return memoryview(mm), _NativePendingSeal(self, oid, mm)
+
+    def put_parts(self, oid: ObjectID, parts) -> int:
+        """Vectored put into a reserved native segment: capacity-checked
+        create, then DIRECT fd writes (one kernel copy per part; no
+        mmap setup or msync page walk), then seal."""
+        total = sum(len(p) for p in parts)
+        fd = self._lib.rt_store_create(self._h, oid.hex().encode(), total)
+        if fd < 0:
+            from .exceptions import OutOfMemoryError
+
+            raise OutOfMemoryError(
+                f"cannot reserve {total} bytes in store "
+                f"(capacity {self.capacity_bytes})")
+        ok = False
+        try:
+            for p in parts:
+                mv = p if isinstance(p, memoryview) else memoryview(p)
+                off = 0
+                while off < len(mv):
+                    off += os.write(fd, mv[off:])
+            ok = True
+        finally:
+            os.close(fd)
+            if not ok:
+                # Release the reserved tmp segment (capacity + bytes) —
+                # a failed multi-GB put must not ratchet capacity down.
+                self._lib.rt_store_abort(self._h, oid.hex().encode())
+        if self._lib.rt_store_seal(self._h, oid.hex().encode()) != 0:
+            raise OSError(f"seal failed for {oid.hex()}")
+        return total
 
     # -- reader API ---------------------------------------------------------
     def get(self, oid: ObjectID) -> Optional[memoryview]:

@@ -53,7 +53,20 @@ import cloudpickle
 import msgpack
 
 REQ, RESP, ERR, HELLO, HELLO_OK = 0, 1, 2, 3, 4
-ENC_MSGPACK, ENC_PICKLE = 0, 1
+ENC_MSGPACK, ENC_PICKLE, ENC_RAW = 0, 1, 2
+
+
+class RawBytes:
+    """Async-handler return marker: ship ``data`` (bytes/memoryview) as
+    the RESP payload with NO serialization (ENC_RAW) and no concat with
+    the header — the object-plane chunk fast path. A 4MB chunk reply
+    costs one kernel copy out of the store mmap instead of msgpack pack
+    + frame concat + unpack (3 full copies)."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data):
+        self.data = data
 _HDR = struct.Struct("<BBQQ")  # kind, enc, payload_len, seqno
 
 MAGIC = "rtpu"
@@ -228,6 +241,8 @@ def _encode_body(enc: int, body: Any) -> bytes:
 def _decode_body(enc: int, payload: bytes) -> Any:
     if enc == ENC_MSGPACK:
         return msgpack.unpackb(payload, raw=False)
+    if enc == ENC_RAW:
+        return payload
     return cloudpickle.loads(payload)
 
 
@@ -472,6 +487,18 @@ class ServerConn:
         self._writer.write(_pack(kind, enc, seq, body))
         await self._writer.drain()
 
+    async def _write_raw(self, kind: int, seq: int, buf):
+        """Frame a raw buffer without serialization or concat. The two
+        write() calls are adjacent with no await between them, so no
+        other task can interleave a frame."""
+        if not self.alive:
+            raise ConnectionLost("peer gone")
+        mv = buf if isinstance(buf, (bytes, bytearray, memoryview)) \
+            else memoryview(buf)
+        self._writer.write(_HDR.pack(kind, ENC_RAW, len(mv), seq))
+        self._writer.write(mv)
+        await self._writer.drain()
+
     def _fail_pending(self):
         self.alive = False
         for fut in self._pending.values():
@@ -578,7 +605,10 @@ async def _peer_read_loop(conn: ServerConn, reader: asyncio.StreamReader,
         try:
             result = await handler(conn, method, payload)
             if seq:
-                await conn._write(RESP, _req_enc(method), seq, result)
+                if isinstance(result, RawBytes):
+                    await conn._write_raw(RESP, seq, result.data)
+                else:
+                    await conn._write(RESP, _req_enc(method), seq, result)
         except ConnectionLost:
             pass
         except BaseException as e:  # noqa: BLE001 - forwarded to peer

@@ -396,17 +396,21 @@ class Runtime:
         # Refs nested inside the value are pinned by the container for its
         # lifetime (attach below) — dropping the standalone handles can't
         # free what the container still points to.
-        blob, inner = serialization.serialize_with_refs(value)
+        parts, inner = serialization.serialize_with_refs_parts(value)
+        total = serialization.parts_len(parts)
         # incref strictly before mark_ready: a READY object with refcount 0
         # is freed on arrival.
         self._call_soon(self.node.incref, oid)
         if inner:
             self._call_soon(self.node._attach_inner_refs, oid, inner)
-        if len(blob) > self.cfg.max_inline_object_size:
-            self.shm.put(oid, blob)
-            self._call_soon(self.node.mark_ready_shm, oid, len(blob))
+        if total > self.cfg.max_inline_object_size:
+            # Vectored write: big numpy buffers go caller-memory ->
+            # segment in ONE copy (no flattened intermediate blob).
+            self.shm.put_parts(oid, parts)
+            self._call_soon(self.node.mark_ready_shm, oid, total)
         else:
-            self._call_soon(self.node.mark_ready_bytes, oid, bytes(blob))
+            self._call_soon(self.node.mark_ready_bytes, oid,
+                            b"".join(parts))
         return ObjectRef(oid, _register=False, owner_addr=self.node_addr)
 
     def _state_of(self, oid: ObjectID):

@@ -48,6 +48,15 @@ def serialize_with_refs(obj: Any) -> tuple:
         _ref_collector.refs = None
 
 
+def serialize_with_refs_parts(obj: Any) -> tuple:
+    """(parts, refs) — serialize_with_refs without the flatten copy."""
+    _ref_collector.refs = []
+    try:
+        return serialize_parts(obj), _ref_collector.refs
+    finally:
+        _ref_collector.refs = None
+
+
 def note_serialized_ref(oid_bytes: bytes, owner_addr):
     refs = getattr(_ref_collector, "refs", None)
     if refs is not None:
@@ -75,23 +84,30 @@ class _Pickler(cloudpickle.CloudPickler):
         return super().reducer_override(obj)
 
 
-def serialize(obj: Any) -> bytes:
+def serialize_parts(obj: Any) -> list:
+    """Serialize WITHOUT concatenating: [prefix, header, pickle_frame,
+    raw_buffer, ...]. Large zero-copy buffers (numpy arrays via pickle5
+    out-of-band) stay as views of the caller's memory; sinks that can do
+    vectored writes (the shm store's put_parts) skip the big flatten
+    copy entirely."""
     buffers: list[pickle.PickleBuffer] = []
     bio = io.BytesIO()
     p = _Pickler(bio, protocol=5, buffer_callback=buffers.append)
     p.dump(obj)
-    pbytes = bio.getvalue()
+    pbytes = bio.getbuffer()
     raws = [b.raw() for b in buffers]
     header = msgpack.packb(
         {"v": 1, "plen": len(pbytes), "blens": [len(r) for r in raws]}
     )
-    out = bytearray()
-    out += struct.pack("<I", len(header))
-    out += header
-    out += pbytes
-    for r in raws:
-        out += r
-    return bytes(out)
+    return [struct.pack("<I", len(header)), header, pbytes, *raws]
+
+
+def parts_len(parts: list) -> int:
+    return sum(len(p) for p in parts)
+
+
+def serialize(obj: Any) -> bytes:
+    return b"".join(serialize_parts(obj))
 
 
 def serialized_size(obj: Any) -> int:

@@ -284,6 +284,12 @@ def _cross_node_fetch(payload_mb: int = 64) -> dict:
         cluster.add_node(num_cpus=1, resources={"src": 1})
         cluster.wait_for_nodes(2)
         ray_tpu.get(noop.remote(), timeout=120)  # warm worker + paths
+        # Warm the TRANSFER lane too (bulk server accept, store create,
+        # worker big-arg mmap): the first large pull pays one-time setup
+        # that would otherwise skew trial 1 by ~2x.
+        warm = ray_tpu.put(np.ones(1024 * 1024, dtype=np.int64))
+        ray_tpu.get(consume.remote(warm), timeout=300)
+        del warm
         t0 = time.perf_counter()
         ray_tpu.get(noop.remote(), timeout=120)
         base = time.perf_counter() - t0

@@ -25,6 +25,14 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_collection_modifyitems(config, items):
+    """The solo perf gate (test_perf_gate.py) must run FIRST — its
+    floors assume no sibling test's workers/daemons are alive (VERDICT
+    r4 weak 6: a perf stage measured under suite load stops being a
+    regression detector)."""
+    items.sort(key=lambda it: 0 if "test_perf_gate" in it.nodeid else 1)
+
+
 @pytest.fixture
 def rt():
     """A fresh runtime per test."""
