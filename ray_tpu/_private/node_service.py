@@ -776,12 +776,13 @@ class NodeService:
             return
         if isinstance(buf, tuple) and buf[0] == "stored":
             # Bulk lane already landed the bytes in a sealed store
-            # segment (recv_into the mmap) — no ingest copy.
+            # segment (recv_into the mmap) — no ingest copy. (Its own
+            # counter was bumped in _pull_bulk.)
             self.mark_ready_shm(oid, buf[1])
         else:
             self._ingest_result_blob(oid, buf)
+            self.counters["objects_pulled_chunked"] += 1
         st.pulled_from = owner_addr
-        self.counters["objects_pulled_chunked"] += 1
         # Register our copy so later pullers can source from us.
         try:
             await conn.notify("copy_added", {
@@ -863,7 +864,12 @@ class NodeService:
         from .rpc import get_session_token
 
         loop = self.loop
-        mv, seal = self.shm.create(oid, size)
+        try:
+            mv, seal = self.shm.create(oid, size)
+        except Exception:  # noqa: BLE001 - e.g. store OutOfMemoryError
+            # Fall back to the chunked path, whose heap-buffer ingest
+            # goes through put() and its eviction machinery.
+            return None
         n_conns = max(1, self.cfg.object_transfer_bulk_conns)
         if size < 8 << 20:
             n_conns = 1
@@ -1247,22 +1253,21 @@ class NodeService:
             for addr in list(st.holders):
                 buf = await self._pull_chunks(oid, tuple(addr), force=True)
                 if buf is not None and buf != "busy":
-                    if isinstance(buf, tuple) and buf[0] == "stored":
+                    stored = isinstance(buf, tuple) and buf[0] == "stored"
+                    self.shm.unpin(oid)
+                    if stored:
                         # Bulk lane sealed a FRESH segment over the lost
-                        # path: drop the stale cached mmap (old inode)
-                        # and the old pin, then re-mark ready (re-pins).
-                        self.shm.unpin(oid)
+                        # path: drop only the stale cached mmap (old
+                        # inode) — deleting would unlink the new bytes.
                         self.shm.release(oid)
-                        st.status, st.location, st.value = \
-                            PENDING, "memory", None
-                        st.error = None
+                    else:
+                        self.shm.delete(oid)
+                    st.status, st.location, st.value = \
+                        PENDING, "memory", None
+                    st.error = None
+                    if stored:
                         self.mark_ready_shm(oid, buf[1])
                     else:
-                        self.shm.unpin(oid)
-                        self.shm.delete(oid)
-                        st.status, st.location, st.value = \
-                            PENDING, "memory", None
-                        st.error = None
                         self._ingest_result_blob(oid, buf)
                     self.counters["objects_recovered_from_copy"] += 1
                     return True
@@ -1590,6 +1595,12 @@ class NodeService:
 
     def _enqueue_local(self, spec: TaskSpec):
         if spec.is_actor_creation:
+            # Register the PENDING actor state SYNCHRONOUSLY: submission
+            # is fire-and-forget, so the creating client's very next
+            # call_soon may be a method call on this actor — it must
+            # find the entry (and queue behind ready_fut), not fall into
+            # the unknown-actor path.
+            self._register_actor_state(spec)
             self.spawn(self._create_actor(spec))
         elif spec.actor_id is not None:
             self._submit_actor_task(spec)
@@ -2823,13 +2834,50 @@ class NodeService:
     # ------------------------------------------------------------------
     # Actors
     # ------------------------------------------------------------------
+    def _register_actor_state(self, spec: TaskSpec) -> "ActorState":
+        """Idempotently insert the PENDING ActorState for a creation
+        spec. Split from _create_actor so _enqueue_local can do it
+        synchronously (method calls racing the creation must find the
+        entry). Loop thread only."""
+        actor = self.actors.get(spec.actor_id)
+        if actor is not None:
+            return actor
+        actor = ActorState(
+            actor_id=spec.actor_id,
+            creation_spec=spec,
+            is_device=self._is_device_task(spec),
+            name=spec.actor_name,
+            charged=None,
+        )
+        actor.ready_fut = self.loop.create_future()
+        self.actors[spec.actor_id] = actor
+        return actor
+
     async def _create_actor(self, spec: TaskSpec):
         aid = spec.actor_id
         if aid in self._killed_before_create:
             self._killed_before_create.discard(aid)
+            err = ActorDiedError("actor was killed")
+            placeholder = self.actors.pop(aid, None)
+            if placeholder is not None:
+                # Method calls may already be queued on the PENDING
+                # placeholder — fail them or their callers hang.
+                placeholder.state = "DEAD"
+                placeholder.death_cause = str(err)
+                for queued in placeholder.queue:
+                    self._fail_task(queued, err)
+                placeholder.queue.clear()
+            self._fail_task(spec, err)
+            return
+        actor = self._register_actor_state(spec)
+        if actor.state == "DEAD":
+            # kill_actor processed the placeholder between registration
+            # and this coroutine: charging resources / re-registering
+            # the name now would leak both (the kill path released a
+            # charge of None and already failed the queue).
             self._fail_task(spec, ActorDiedError("actor was killed"))
             return
-        is_device = self._is_device_task(spec)
+        is_device = actor.is_device
         need = {k: v for k, v in spec.resources.items() if v > 0}
         if not is_device:
             # Lifetime reservation: park until the node has availability
@@ -2840,15 +2888,7 @@ class NodeService:
                 return
             for k, v in need.items():
                 self.available[k] = self.available.get(k, 0) - v
-        actor = ActorState(
-            actor_id=aid,
-            creation_spec=spec,
-            is_device=is_device,
-            name=spec.actor_name,
-            charged=(need if not is_device else None),
-        )
-        actor.ready_fut = self.loop.create_future()
-        self.actors[aid] = actor
+            actor.charged = need
         if spec.actor_name and self.head is not None:
             meths = spec.actor_methods or []
             try:
@@ -3049,7 +3089,12 @@ class NodeService:
         try:
             reply = await worker.conn.call("execute_task", self._spec_for_ipc(spec))
             self._handle_task_reply(spec, reply)
-        except ConnectionLost:
+        except (ConnectionLost, OSError):
+            # OSError covers the conn dying mid-WRITE (a kill landing
+            # while the request frame is in flight raises
+            # ConnectionResetError, not ConnectionLost) — either way the
+            # worker is gone and callers' retry logic keys on
+            # ActorDiedError, not a generic TaskError.
             self._fail_task(spec, ActorDiedError("actor worker died mid-call",
                                                  task_name=spec.name))
             return  # restart handled by _on_disconnect

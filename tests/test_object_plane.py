@@ -61,7 +61,9 @@ def test_big_result_pulled_chunked(cluster):
     assert out.shape == (1_500_000,) and out[-1] == 1_499_999
     # The result came back as a reference + windowed chunk pull, not one
     # frame in the remote_execute reply.
-    assert _head_counters(cluster).get("objects_pulled_chunked", 0) >= 1
+    c = _head_counters(cluster)
+    assert (c.get("objects_pulled_chunked", 0)
+            + c.get("objects_pulled_bulk", 0)) >= 1
 
 
 def test_big_arg_forwarded_by_ref(cluster):
@@ -118,9 +120,11 @@ def test_broadcast_pulls_from_peers(cluster):
         f"owner served {served_by_owner}/{n_consumers} transfers — "
         f"peer copies were never used")
 
-    # Cluster-wide, the chunked path carried every transfer.
+    # Cluster-wide, the object plane (bulk or chunked) carried every
+    # transfer.
     metrics = state_api.cluster_metrics()
     pulled = sum(m["counters"].get("objects_pulled_chunked", 0)
+                 + m["counters"].get("objects_pulled_bulk", 0)
                  for m in metrics.values())
     assert pulled >= n_consumers
 

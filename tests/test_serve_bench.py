@@ -12,5 +12,5 @@ def test_serve_bench_floors():
     finally:
         ray_tpu.shutdown()
     assert doc["handle"]["rps"] > 50, doc
-    assert doc["http"]["rps"] > 25, doc
-    assert doc["http"]["p99_ms"] < 2000, doc
+    assert doc["http_local"]["rps"] > 25, doc
+    assert doc["http_local"]["p99_ms"] < 2000, doc
