@@ -95,3 +95,21 @@ def test_actor_call_throughput(rt):
     dt = time.monotonic() - t0
     assert max(out) == 2000
     assert dt < 120, f"2000 actor calls took {dt:.1f}s"
+
+
+@pytest.mark.skipif(not __import__("os").environ.get("RT_ENVELOPE"),
+                    reason="full-scale envelope: set RT_ENVELOPE=1 "
+                           "(the MICROBENCH artifact run exercises it "
+                           "every round at 500k/1000-node scale)")
+def test_full_scale_envelope_floors(rt):
+    """VERDICT r4 item 5 floors at artifact scale: 500k queued tasks
+    drain >= 3k/s; 1000 REAL NodeService objects churn >= 100k
+    membership events/s with PG placement under churn <= 50ms."""
+    from ray_tpu.scripts.microbench import _membership_churn, _queued_burst
+
+    row = _queued_burst(500_000)
+    assert row["per_s"] >= 3000, row
+    ray_tpu.shutdown()
+    row = _membership_churn(1000)
+    assert row["per_s"] >= 100_000, row
+    assert row["pg_place_under_churn_ms"] <= 50, row
