@@ -346,6 +346,7 @@ class NodeService:
         self._device_interrupts = TaskInterruptRegistry()
         self.pending_cpu: collections.deque[TaskSpec] = collections.deque()
         self.cancelled: set[TaskID] = set()
+        self._dispatch_misses = 0  # consecutive no-worker outcomes
 
         self.actors: dict[ActorID, ActorState] = {}
         self.remote_actors: dict[ActorID, RemoteActorEntry] = {}
@@ -1739,10 +1740,23 @@ class NodeService:
                 if self._should_spill(spec):
                     spec._spill_inflight = True
                     self.spawn(self._try_spill(spec))
-                else:
-                    still_pending.append(spec)
+                    continue
+                still_pending.append(spec)
+                self._dispatch_misses += 1
+                if self._dispatch_misses >= 4:
+                    # Deep-queue guard: re-scanning the whole burst on
+                    # EVERY completion is O(queue^2). A few consecutive
+                    # no-worker misses ⇒ the rest of the (mostly
+                    # homogeneous) queue can't run either; stop and
+                    # keep order. Heterogeneous smaller tasks still get
+                    # a chance within the first misses.
+                    still_pending.extend(self.pending_cpu)
+                    self.pending_cpu.clear()
+                    break
                 continue
+            self._dispatch_misses = 0
             self.spawn(self._run_on_worker(worker, spec))
+        self._dispatch_misses = 0
         self.pending_cpu = still_pending
         for actor in self.actors.values():
             if actor.queue:

@@ -621,11 +621,14 @@ class Runtime:
         return fut
 
     def cancel(self, ref: ObjectRef, force=False):
-        st = self._state_of(ref.id)
-        if st is None or st.creating_spec is None:
-            return
-
         def do():
+            # Table lookup ON the loop: submission is fire-and-forget,
+            # so a cancel issued right after .remote() must queue behind
+            # the submit (same FIFO) or it reads an absent entry and
+            # silently no-ops.
+            st = self.node.objects.get(ref.id)
+            if st is None or st.creating_spec is None:
+                return
             self.node.cancel_task(st.creating_spec.task_id, force=force)
 
         self._call_soon(do)

@@ -225,6 +225,7 @@ def _membership_churn(n_nodes: int) -> dict:
     from ray_tpu._private.object_store import SharedMemoryStore
 
     loop = asyncio.new_event_loop()
+    shm = None
     try:
         # Explicit in-memory store: the default would read
         # RT_HEAD_PERSIST and replay the LIVE cluster's state into the
@@ -290,9 +291,10 @@ def _membership_churn(n_nodes: int) -> dict:
         assert alive == n_nodes, (alive, n_nodes)
     finally:
         loop.close()
-        import shutil
+        if shm is not None:
+            import shutil
 
-        shutil.rmtree(shm.prefix, ignore_errors=True)
+            shutil.rmtree(shm.prefix, ignore_errors=True)
     row = {"name": f"membership_{n_nodes}_nodes_events",
            "per_s": round(events / dt, 2), "sd": 0.0, "nodes": n_nodes,
            "pg_place_under_churn_ms": round(

@@ -40,7 +40,10 @@ FLOORS = {
     "wait_1k_refs": 1500,         # recorded 6,008 solo / 3,006 worst in-suite
     "pg_create_remove": 1150,     # recorded 4,036 solo / 2,343 worst in-suite
     "queued_5k_tasks": 1500,      # recorded 7,116 solo / 3,084 worst in-suite
-    "membership_100_nodes_events": 175000,  # recorded 834k solo / 351k worst in-suite
+    "membership_100_nodes_events": 60000,  # r5 rewrite (REAL NodeService
+                                  # objects + PG placement mid-churn) is
+                                  # ~2.5x heavier: 338k solo recorded;
+                                  # worst-context quarter-speed => ~85k
 }
 
 

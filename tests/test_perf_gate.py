@@ -43,16 +43,18 @@ SOLO_FLOORS = {
     "put_gigabytes_gb": 2.0,
     "get_gigabytes_gb": 1050,
     "task_device_sync": 3300,
-    "task_device_async": 4600,
+    "task_device_async": 8500,  # r5 fire-and-forget submit: ~14k solo
     "task_cpu_sync": 1300,
-    "task_cpu_async": 500,       # short-trial noisiest metric
+    "task_cpu_async": 900,       # r5 dispatch guard: 1.3-1.7k solo; noisiest metric
     "actor_call_sync": 1400,
     "actor_call_async": 1700,
     "actor_call_concurrent": 1900,
     "wait_1k_refs": 4100,
     "pg_create_remove": 2700,
     "queued_5k_tasks": 4000,
-    "membership_100_nodes_events": 390000,
+    "membership_100_nodes_events": 230000,  # re-anchored after the r5
+                                            # real-NodeService rewrite
+                                            # (338k solo at gate scale)
 }
 SOLO_FETCH_FLOOR_MB_S = 420  # 0.7 x 600 recorded (16MB payload)
 
