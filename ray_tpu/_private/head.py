@@ -113,9 +113,36 @@ class HeadService:
             # Default durable backend is the append-log store: O(delta)
             # per mutation + periodic compaction (FileHeadStore remains
             # available for tooling that wants one-file snapshots).
+            # RT_HEAD_REPLICAS="host:port,..." upgrades it to the
+            # replicated store: every mutation streams to remote replica
+            # daemons, and a head restarting on a BLANK disk recovers
+            # from the freshest replica (reference:
+            # redis_store_client.h remote GCS storage).
+            from .head_replica import (ReplicatedHeadStore,
+                                       parse_replica_addrs)
             from .head_store import AppendLogHeadStore
 
-            store = AppendLogHeadStore(path) if path else InMemoryHeadStore()
+            replicas = parse_replica_addrs(
+                os.environ.get("RT_HEAD_REPLICAS"))
+            if replicas and not path:
+                # Replication configured without a persist path: HA was
+                # asked for, so an in-memory store would silently void
+                # it — use a default local path instead (and say so).
+                import sys as _sys
+                import tempfile
+
+                path = os.path.join(
+                    tempfile.gettempdir(),
+                    f"rtpu-head-{session_id}.snapshot")
+                _sys.stderr.write(
+                    f"ray_tpu: RT_HEAD_REPLICAS set without "
+                    f"RT_HEAD_PERSIST; using local store {path}\n")
+            if path and replicas:
+                store = ReplicatedHeadStore(path, replicas)
+            elif path:
+                store = AppendLogHeadStore(path)
+            else:
+                store = InMemoryHeadStore()
         self.store = store
         # Snapshot writes happen off the event loop; one thread keeps
         # them ordered (last save wins on disk as it does in memory).

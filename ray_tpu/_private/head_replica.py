@@ -1,0 +1,265 @@
+"""Remote replication for the head's durable store — HA beyond one disk.
+
+Capability parity target: the reference's remote GCS storage backend
+(/root/reference/src/ray/gcs/store_client/redis_store_client.h): losing
+the head NODE must not lose cluster metadata. This deployment has no
+Redis; the analogue is N lightweight REPLICA daemons (any other machine,
+`rtpu head-replica --dir ... --port ...`), each holding its own
+snapshot+append-log copy of the head's tables:
+
+  * the head's ``ReplicatedHeadStore`` writes locally first (fsync'd
+    append-log, the r4 store), then streams every snapshot/append to
+    each replica over the authenticated RPC plane, fire-and-forget with
+    reconnect — steady-state replication cost is one small frame per
+    control-plane mutation;
+  * a restarted head whose local disk is EMPTY (new machine) recovers
+    by fetching the freshest replica's snapshot+log (highest applied
+    seq wins), rebuilding the local store, then resuming as usual —
+    the same replay contract as a local restart.
+
+Durability window: replication is asynchronous (acknowledged mutations
+may lag replicas by in-flight frames, like Redis async replication);
+the local fsync'd log remains the primary record.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import pickle
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from .head_store import AppendLogHeadStore, HeadStore
+
+
+def parse_replica_addrs(raw: Optional[str]) -> List[Tuple[str, int]]:
+    """RT_HEAD_REPLICAS="host:port,host:port" -> [(host, port)]."""
+    out = []
+    for part in (raw or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, sep, port = part.rpartition(":")
+        if not sep or not host or not port.isdigit():
+            raise ValueError(
+                f"RT_HEAD_REPLICAS entry {part!r} is not host:port")
+        out.append((host, int(port)))
+    return out
+
+
+class ReplicaServer:
+    """One replica daemon: an authenticated DuplexServer persisting the
+    head's stream into its own AppendLogHeadStore files. Run via
+    ``rtpu head-replica`` (head_replica_main)."""
+
+    def __init__(self, directory: str, port: int = 0,
+                 host: str = "0.0.0.0"):
+        os.makedirs(directory, exist_ok=True)
+        self.store = AppendLogHeadStore(
+            os.path.join(directory, "head_replica.snapshot"))
+        self._host, self._port = host, port
+        self._server = None
+
+    async def start(self):
+        from .rpc import DuplexServer
+
+        self._server = DuplexServer((self._host, self._port),
+                                    self._handle, None)
+        await self._server.start()
+        self.address = self._server.address
+        return self.address
+
+    async def _handle(self, conn, method: str, payload):
+        if method == "replica_append":
+            # Raw record replay: keep the head's seq so recovery can
+            # pick the freshest replica.
+            self.store.append_raw(payload["seq"], payload["kind"],
+                                  pickle.loads(payload["rec"]))
+            return True
+        if method == "replica_save":
+            tables = pickle.loads(payload["tables"])
+            self.store._seq = payload["seq"]
+            self.store.save(tables)
+            return True
+        if method == "replica_fetch":
+            tables = self.store.load()
+            return {"seq": self.store._seq,
+                    "tables": pickle.dumps(tables)}
+        if method == "ping":
+            return "pong"
+        raise RuntimeError(f"unknown replica rpc: {method}")
+
+    async def stop(self):
+        if self._server is not None:
+            await self._server.stop()
+        self.store.close()
+
+
+class ReplicatedHeadStore(HeadStore):
+    """Local fsync'd append-log + asynchronous fan-out to N replicas.
+
+    All calls arrive on the head's persist thread (same contract as
+    AppendLogHeadStore); replication runs on a private asyncio loop
+    thread so a slow/dead replica never blocks control-plane
+    mutations."""
+
+    supports_append = True
+
+    def __init__(self, path: str, replicas: List[Tuple[str, int]]):
+        self.local = AppendLogHeadStore(path)
+        self.replicas = [tuple(r) for r in replicas]
+        self._loop = asyncio.new_event_loop()
+        self._conns: dict = {}
+        # Per-replica ORDERED outbound queues, each drained by one
+        # sender task: the log's replay semantics require frames to
+        # arrive in seq order, which concurrent fire-and-forget sends
+        # cannot guarantee (and a check-then-act _conn would leak
+        # duplicate connections under races).
+        self._queues: dict = {}
+        self._thread = threading.Thread(
+            target=self._loop_main, daemon=True, name="rt-head-replication")
+        self._thread.start()
+
+    def _loop_main(self):
+        asyncio.set_event_loop(self._loop)
+        self._sender_tasks = []
+        for addr in self.replicas:
+            self._queues[addr] = asyncio.Queue(maxsize=10_000)
+            self._sender_tasks.append(
+                self._loop.create_task(self._sender(addr)))
+        self._loop.run_forever()
+
+    async def _sender(self, addr):
+        """One replica's ordered delivery loop. On every (re)connect it
+        first pushes a FULL snapshot of the local store — this makes a
+        reconnecting replica converge even across epoch resets (a head
+        that restarted on a blank disk renumbers from seq 1; the
+        snapshot truncates the replica's old log so stale high-seq
+        records can't shadow the new epoch)."""
+        from .rpc import async_connect
+
+        async def nohandler(c, m, p):
+            raise RuntimeError("replica pushes nothing")
+
+        conn = None
+        q = self._queues[addr]
+        while True:
+            item = await q.get()
+            if item is None:
+                return
+            method, payload = item
+            while True:
+                try:
+                    if conn is None or not conn.alive:
+                        conn = await async_connect(addr, nohandler, None)
+                        self._conns[addr] = conn
+                        snap = self.local.load()
+                        await conn.call(
+                            "replica_save",
+                            {"seq": self.local._seq,
+                             "tables": pickle.dumps(snap or {})},
+                            timeout=30)
+                    await conn.call(method, payload, timeout=10)
+                    break
+                except Exception:  # noqa: BLE001 - replica down: retry
+                    conn = None
+                    self._conns.pop(addr, None)
+                    # Drop THIS frame only if the queue is backing up —
+                    # the snapshot-on-reconnect resync covers the gap.
+                    if q.qsize() > 1000:
+                        break
+                    await asyncio.sleep(1.0)
+
+    def _fanout(self, method: str, payload: dict):
+        def put():
+            for addr in self.replicas:
+                q = self._queues.get(addr)
+                if q is None:
+                    continue
+                try:
+                    q.put_nowait((method, payload))
+                except asyncio.QueueFull:
+                    pass  # reconnect snapshot resyncs the lost frames
+
+        try:
+            self._loop.call_soon_threadsafe(put)
+        except RuntimeError:
+            pass  # shutting down
+
+    # -- HeadStore interface ----------------------------------------------
+    def load(self):
+        local = self.local.load()
+        local_seq = self.local._seq
+        # A fresh/blank local disk with configured replicas: recover from
+        # the freshest replica (highest applied seq).
+        if self.replicas and (local is None or not any(
+                (local or {}).values())):
+            best = self._fetch_best_replica()
+            if best is not None and best[0] > local_seq:
+                seq, tables = best
+                self.local._seq = seq
+                self.local.save(tables or {})
+                return tables
+        return local
+
+    def _fetch_best_replica(self):
+        from .rpc import async_connect
+
+        async def fetch(addr):
+            async def nohandler(c, m, p):
+                raise RuntimeError("replica pushes nothing")
+
+            conn = None
+            try:
+                conn = await async_connect(addr, nohandler, None)
+                out = await conn.call("replica_fetch", None, timeout=10)
+                return (out["seq"], pickle.loads(out["tables"]))
+            except Exception:  # noqa: BLE001 - unreachable replica
+                return None
+            finally:
+                if conn is not None:
+                    try:
+                        await conn.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        async def all_():
+            return await asyncio.gather(*[fetch(a) for a in self.replicas])
+
+        results = asyncio.run_coroutine_threadsafe(
+            all_(), self._loop).result(timeout=30)
+        results = [r for r in results if r is not None and r[1] is not None]
+        if not results:
+            return None
+        return max(results, key=lambda r: r[0])
+
+    def save(self, tables: Dict[str, Any]) -> None:
+        self.local.save(tables)
+        self._fanout("replica_save", {"seq": self.local._seq,
+                                      "tables": pickle.dumps(tables)})
+
+    def append(self, kind: str, rec: Any) -> None:
+        self.local.append(kind, rec)
+        self._fanout("replica_append", {"seq": self.local._seq,
+                                        "kind": kind,
+                                        "rec": pickle.dumps(rec)})
+
+    def close(self):
+        self.local.close()
+
+        async def teardown():
+            for t in getattr(self, "_sender_tasks", []):
+                t.cancel()
+            for conn in list(self._conns.values()):
+                try:
+                    await asyncio.wait_for(conn.close(), timeout=2)
+                except Exception:  # noqa: BLE001 - already dead
+                    pass
+            self._loop.stop()
+
+        try:
+            asyncio.run_coroutine_threadsafe(teardown(), self._loop)
+            self._thread.join(timeout=5)
+        except RuntimeError:
+            pass

@@ -196,21 +196,34 @@ class AppendLogHeadStore(HeadStore):
             raise ValueError(kind)
         with self._lock:
             self._seq += 1
-            body = pickle.dumps((self._seq, kind, rec))
-            if self._log_f is None:
-                self._log_f = open(self.log_path, "ab")
-            self._log_f.write(len(body).to_bytes(4, "little") + body)
-            self._log_f.flush()
-            # Durability against MACHINE crashes, not just process death
-            # (ADVICE r4): fsync at most once per second, Redis
-            # appendfsync-everysec style — a power loss may drop up to
-            # the last second of acknowledged mutations, which the
-            # docstring contract documents; a kill -9 loses nothing
-            # (the page cache survives the process).
-            now = time.monotonic()
-            if now - self._last_fsync >= 1.0:
-                os.fsync(self._log_f.fileno())
-                self._last_fsync = now
+            self._write_record(self._seq, kind, rec)
+
+    def append_raw(self, seq, kind, rec):
+        """Replay-side append preserving the ORIGIN's seq (head-store
+        replication: the replica must keep the head's numbering so
+        recovery can pick the freshest copy and replay idempotently)."""
+        if kind not in self._KINDS:
+            raise ValueError(kind)
+        with self._lock:
+            self._seq = max(self._seq, seq)
+            self._write_record(seq, kind, rec)
+
+    def _write_record(self, seq, kind, rec):
+        body = pickle.dumps((seq, kind, rec))
+        if self._log_f is None:
+            self._log_f = open(self.log_path, "ab")
+        self._log_f.write(len(body).to_bytes(4, "little") + body)
+        self._log_f.flush()
+        # Durability against MACHINE crashes, not just process death
+        # (ADVICE r4): fsync at most once per second, Redis
+        # appendfsync-everysec style — a power loss may drop up to
+        # the last second of acknowledged mutations, which the
+        # docstring contract documents; a kill -9 loses nothing
+        # (the page cache survives the process).
+        now = time.monotonic()
+        if now - self._last_fsync >= 1.0:
+            os.fsync(self._log_f.fileno())
+            self._last_fsync = now
 
     def save(self, tables):
         """Full snapshot + log truncation (compaction)."""

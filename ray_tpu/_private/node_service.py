@@ -1749,9 +1749,13 @@ class NodeService:
                     # no-worker misses ⇒ the rest of the (mostly
                     # homogeneous) queue can't run either; stop and
                     # keep order. Heterogeneous smaller tasks still get
-                    # a chance within the first misses.
+                    # a chance within the first misses — and a delayed
+                    # re-kick guarantees a feasible task parked behind
+                    # infeasible heads is NOT starved when no completion
+                    # event is coming (idle node, 16-CPU heads).
                     still_pending.extend(self.pending_cpu)
                     self.pending_cpu.clear()
+                    self.loop.call_later(0.05, self._dispatch)
                     break
                 continue
             self._dispatch_misses = 0

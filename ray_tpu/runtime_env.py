@@ -548,9 +548,16 @@ def _apply_pip(requirements: List[str], cache_dir: str) -> Optional[str]:
     from the base image -> no install (the common baked-image case, and
     the only possible one with zero egress). Otherwise materialize a
     cached site dir and activate it on sys.path."""
-    if not _missing_pip(requirements):
+    missing = _missing_pip(requirements)
+    if not missing:
         return None
-    site = _materialize_pip(requirements, cache_dir)
+    # Install ONLY the missing requirements (plus pip options): with
+    # --target pip reinstalls everything it is handed, so passing a
+    # baked-in requirement to an offline (--no-index) install would
+    # fail on a package that needs no installing at all.
+    options = [tok for tok in requirements
+               if tok not in _pip_requirement_entries(requirements)]
+    site = _materialize_pip(options + missing, cache_dir)
     if site not in sys.path:
         sys.path.insert(0, site)
     still = _missing_pip(requirements, post_install=True)

@@ -207,6 +207,14 @@ def _start_worker_node(args, env=None):
     print(f"worker node started (pid {proc.pid}) -> head {addr}")
 
 
+def cmd_head_replica(args):
+    os.environ["RT_REPLICA_PORT"] = str(args.port)
+    os.environ["RT_REPLICA_DIR"] = args.dir
+    from ray_tpu._private.head_replica_main import main as replica_main
+
+    return replica_main()
+
+
 def cmd_stop(args):
     try:
         with open(_pids_file(args)) as f:
@@ -552,6 +560,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--block", action="store_true",
                     help="run in the foreground")
     sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("head-replica",
+                        help="run a head-store replica daemon (HA: "
+                             "cluster metadata survives head-node loss)")
+    sp.add_argument("--port", type=int, default=7380)
+    sp.add_argument("--dir", default="./rtpu-head-replica")
+    sp.set_defaults(fn=cmd_head_replica)
 
     sp = sub.add_parser("stop", help="stop everything rtpu started here")
     sp.set_defaults(fn=cmd_stop)

@@ -349,7 +349,10 @@ def test_pip_installs_missing_package_and_caches(rt, tmp_path):
     with pytest.raises(ImportError):
         import rtpu_testpkg  # noqa: F401 - must NOT be in the base env
 
-    reqs = ["--no-index", "--find-links", str(tmp_path), "rtpu_testpkg"]
+    # numpy is baked into the image and has NO wheel in tmp_path: only
+    # the MISSING requirement may be handed to the offline pip install.
+    reqs = ["--no-index", "--find-links", str(tmp_path), "numpy",
+            "rtpu_testpkg"]
 
     @ray_tpu.remote(runtime_env={"pip": reqs})
     def probe():
@@ -365,7 +368,9 @@ def test_pip_installs_missing_package_and_caches(rt, tmp_path):
                and os.path.isdir(os.path.join(cache, e))]
     assert entries, os.listdir(cache)
     paths = [os.path.join(cache, e) for e in entries]
-    mtimes = {p: os.stat(p).st_mtime_ns for p in paths}
+    # Inode identity: a reinstall lands a NEW dir via os.replace; a
+    # cache hit touches mtime but keeps the inode.
+    inodes = {p: os.stat(p).st_ino for p in paths}
 
     # Second use from a DIFFERENT env (fresh worker pool key): cache
     # hit — no reinstall (install would rebuild the dir; utime-touch
@@ -381,3 +386,5 @@ def test_pip_installs_missing_package_and_caches(rt, tmp_path):
     entries2 = [e for e in os.listdir(cache) if e.startswith("pip-")
                 and os.path.isdir(os.path.join(cache, e))]
     assert sorted(entries2) == sorted(entries), "no second install dir"
+    for p, ino in inodes.items():
+        assert os.stat(p).st_ino == ino, "entry was rebuilt, not cache-hit"
