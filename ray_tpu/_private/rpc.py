@@ -309,6 +309,14 @@ class DuplexClient:
         self._wlock = threading.Lock()
         self._seq = 0
         self._seqlock = threading.Lock()
+        # LOCK DISCIPLINE (concurrency net, VERDICT r4 item 10): every
+        # _pending access holds _plock — it is mutated from caller
+        # threads (insert, timeout-pop) AND the reader thread
+        # (resolve-pop, failure drain). Unlocked, the drain's iteration
+        # races caller inserts: RuntimeError(dict changed size) or a
+        # future inserted after clear() that no reply will ever resolve
+        # (caller hangs to timeout).
+        self._plock = threading.Lock()
         self._pending: dict[int, Future] = {}
         self._handler = handler
         self._closed = threading.Event()
@@ -345,7 +353,8 @@ class DuplexClient:
             self._seq += 1
             seq = self._seq
         fut: Future = Future()
-        self._pending[seq] = fut
+        with self._plock:
+            self._pending[seq] = fut
         t0 = time.perf_counter()
         try:
             self._send(REQ, _req_enc(method), seq, (method, payload))
@@ -353,7 +362,8 @@ class DuplexClient:
         except (TimeoutError, FuturesTimeout):
             # Both spellings: concurrent.futures.TimeoutError is only an
             # alias of the builtin from 3.11; 3.10 is supported.
-            self._pending.pop(seq, None)
+            with self._plock:
+                self._pending.pop(seq, None)
             _record_call(method, time.perf_counter() - t0, timeout=True)
             raise
         except BaseException:
@@ -393,21 +403,24 @@ class DuplexClient:
                     method, payload = body
                     self._exec.submit(self._serve, method, payload, seq)
                 elif kind == RESP:
-                    fut = self._pending.pop(seq, None)
+                    with self._plock:
+                        fut = self._pending.pop(seq, None)
                     if fut:
                         fut.set_result(body)
                 else:  # ERR
-                    fut = self._pending.pop(seq, None)
+                    with self._plock:
+                        fut = self._pending.pop(seq, None)
                     if fut:
                         fut.set_exception(RpcError(body))
         except (ConnectionLost, OSError):
             pass
         finally:
             self._closed.set()
-            for fut in self._pending.values():
+            with self._plock:
+                drain, self._pending = dict(self._pending), {}
+            for fut in drain.values():
                 if not fut.done():
                     fut.set_exception(ConnectionLost("connection lost"))
-            self._pending.clear()
 
     def _serve(self, method: str, payload: Any, seq: int):
         try:

@@ -88,7 +88,12 @@ class _ASGILoop:
                     _resolve(self._ls_started)
                     _resolve(self._ls_stopped)
 
-            asyncio.ensure_future(main())
+            from ray_tpu._private.rpc import _keep_task
+
+            # Strong ref: asyncio weak-refs tasks — an unreferenced
+            # lifespan task can be GC'd mid-await (the r4 lost-reply
+            # bug class; caught by tests/test_concurrency_net.py).
+            _keep_task(asyncio.ensure_future(main()))
             await self._ls_queue.put({"type": "lifespan.startup"})
             try:
                 await asyncio.wait_for(asyncio.shield(self._ls_started), 15)

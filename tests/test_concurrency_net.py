@@ -1,0 +1,176 @@
+"""Concurrency net (VERDICT r4 item 10): systematic nets for the bug
+classes that chaos tests only catch by luck.
+
+1. STRUCTURAL: asyncio holds only weak refs to tasks — a fire-and-
+   forget `ensure_future`/`create_task` whose result is discarded can
+   be GC'd mid-await (r4's lost-reply bug, fixed in e8387d4 by
+   spawn()/_keep_task). The AST lint below red-flags any reintroduced
+   weak spawn site in the runtime packages.
+2. FUZZ: a reply-path interleaving storm — task bursts racing forced
+   gc.collect() from another thread, under full asyncio debug mode —
+   the exact conditions that made r4's bug visible.
+3. WATCHDOG: the blocked-event-loop watchdog (conftest arms it for the
+   whole suite) names the culprit when a callback stalls the loop.
+"""
+
+import ast
+import gc
+import os
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+import ray_tpu
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def async_debug(monkeypatch):
+    """Full asyncio debug for this module: never-retrieved exceptions,
+    slow-callback warnings, cross-thread misuse checks."""
+    monkeypatch.setenv("RT_ASYNC_DEBUG", "1")
+    monkeypatch.setenv("RT_LOOP_WATCHDOG_S", "2")
+    yield
+
+
+# ---------------------------------------------------------------------------
+# 1. Weak-spawn-site lint
+# ---------------------------------------------------------------------------
+def _weak_spawn_sites(path: Path) -> list:
+    """(line, src) of ensure_future/create_task calls whose task object
+    is DISCARDED — not kept via _keep_task/spawn, assignment, await,
+    return, or a container append/add."""
+    tree = ast.parse(path.read_text())
+    # Annotate parents.
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._parent = node
+
+    def is_spawnish(call: ast.Call) -> bool:
+        fn = call.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else getattr(
+            fn, "id", "")
+        return name in ("ensure_future", "create_task")
+
+    def kept(call: ast.Call) -> bool:
+        p = getattr(call, "_parent", None)
+        if isinstance(p, ast.Call):
+            # Argument of another call: _keep_task(...), spawn-like
+            # wrappers, list.append(...), set.add(...) all KEEP it.
+            return True
+        if isinstance(p, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                          ast.Await, ast.Return, ast.NamedExpr)):
+            return True
+        if isinstance(p, ast.Attribute):
+            # task = loop.create_task(...).<something> chains
+            return True
+        if isinstance(p, (ast.ListComp, ast.GeneratorExp, ast.List,
+                          ast.Tuple, ast.comprehension)):
+            return True
+        return False
+
+    offenders = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and is_spawnish(node) \
+                and not kept(node):
+            offenders.append((node.lineno, ast.get_source_segment(
+                path.read_text(), node)))
+    return offenders
+
+
+def test_no_weak_fire_and_forget_spawn_sites():
+    """Every ensure_future/create_task in the runtime keeps a strong
+    reference (r4's GC'd-pending-task bug class). A reintroduced
+    `asyncio.ensure_future(coro())` statement fails here with its
+    file:line."""
+    offenders = {}
+    for pkg in ("ray_tpu/_private", "ray_tpu/serve", "ray_tpu/data",
+                "ray_tpu/util"):
+        for path in sorted((REPO / pkg).rglob("*.py")):
+            found = _weak_spawn_sites(path)
+            if found:
+                offenders[str(path.relative_to(REPO))] = found
+    assert not offenders, (
+        f"fire-and-forget task(s) with no strong reference — asyncio "
+        f"may GC them mid-await (wrap in _keep_task()/spawn()): "
+        f"{offenders}")
+
+
+def test_lint_catches_a_weak_site(tmp_path):
+    """The net itself is live: a synthetic weak spawn site is flagged,
+    a kept one is not."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import asyncio\n"
+        "def f(loop, coro):\n"
+        "    asyncio.ensure_future(coro)\n")
+    assert _weak_spawn_sites(bad)
+    good = tmp_path / "good.py"
+    good.write_text(
+        "import asyncio\n"
+        "def keep(t):\n"
+        "    return t\n"
+        "def f(loop, coro):\n"
+        "    keep(asyncio.ensure_future(coro))\n"
+        "    t = loop.create_task(coro)\n"
+        "    return t\n")
+    assert not _weak_spawn_sites(good)
+
+
+# ---------------------------------------------------------------------------
+# 2. Reply-path GC fuzz
+# ---------------------------------------------------------------------------
+def test_reply_path_survives_gc_storm(rt):
+    """Bursts of tasks on both lanes while another thread forces full
+    collections as fast as it can: every reply must arrive (r4's bug:
+    GC'd pending handler tasks silently dropped replies, hanging
+    get())."""
+    stop = threading.Event()
+
+    def gc_storm():
+        while not stop.is_set():
+            gc.collect()
+
+    t = threading.Thread(target=gc_storm, daemon=True)
+    t.start()
+    try:
+        @ray_tpu.remote(scheduling_strategy="device")
+        def dev(i):
+            return i
+
+        @ray_tpu.remote
+        def cpu(i):
+            return i * 2
+
+        for round_ in range(6):
+            n = 60
+            refs = [dev.remote(i) for i in range(n)]
+            assert ray_tpu.get(refs, timeout=60) == list(range(n))
+            refs = [cpu.remote(i) for i in range(20)]
+            assert ray_tpu.get(refs, timeout=120) == [
+                i * 2 for i in range(20)]
+    finally:
+        stop.set()
+        t.join()
+
+
+# ---------------------------------------------------------------------------
+# 3. Blocked-loop watchdog
+# ---------------------------------------------------------------------------
+def test_watchdog_red_flags_blocked_loop(capfd):
+    """A callback that stalls the event loop gets NAMED: the watchdog
+    dumps thread stacks to stderr within its period."""
+    ray_tpu.shutdown()
+    os.environ["RT_LOOP_WATCHDOG_S"] = "0.5"
+    try:
+        rt = ray_tpu.init(num_cpus=1)
+        rt.loop.call_soon_threadsafe(lambda: time.sleep(1.6))
+        time.sleep(2.5)
+        err = capfd.readouterr().err
+        assert "EVENT LOOP BLOCKED" in err, err[-500:]
+    finally:
+        ray_tpu.shutdown()
+        os.environ["RT_LOOP_WATCHDOG_S"] = "5"
