@@ -354,6 +354,12 @@ class DuplexClient:
             seq = self._seq
         fut: Future = Future()
         with self._plock:
+            # The reader's failure drain sets _closed BEFORE draining
+            # (both under this lock): checking here closes the
+            # insert-after-drain window where a future would never be
+            # failed and the caller would hang forever.
+            if self._closed.is_set():
+                raise ConnectionLost("connection lost")
             self._pending[seq] = fut
         t0 = time.perf_counter()
         try:
@@ -367,6 +373,11 @@ class DuplexClient:
             _record_call(method, time.perf_counter() - t0, timeout=True)
             raise
         except BaseException:
+            # A request that never reached the wire (serialization
+            # error) has no reply to pop its entry: do it here or the
+            # map leaks on a healthy connection.
+            with self._plock:
+                self._pending.pop(seq, None)
             _record_call(method, time.perf_counter() - t0, error=True)
             raise
         _record_call(method, time.perf_counter() - t0)

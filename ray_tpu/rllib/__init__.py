@@ -34,10 +34,13 @@ from .offline import (  # noqa: F401
     CQLLearner,
     MARWIL,
     BCLearner,
+    JsonReader,
     load_offline_data,
     write_offline_data,
+    write_offline_json,
 )
 from .sac import SAC, SACLearner  # noqa: F401
+from .td3 import DDPG, TD3, TD3Learner  # noqa: F401
 from .env_runner import (  # noqa: F401
     SingleAgentEnvRunner,
     compute_gae,

@@ -75,6 +75,12 @@ class AlgorithmConfig:
         # SAC
         self.tau = 0.005  # polyak coefficient for the target critic
         self.target_entropy = None  # None => -act_dim (the SAC default)
+        # TD3 / DDPG (reference: td3.py defaults; DDPG's class override
+        # sets policy_delay=1 and target_noise=0)
+        self.policy_delay = 2
+        self.target_noise = 0.2
+        self.target_noise_clip = 0.5
+        self.exploration_noise = 0.1
         # APPO
         self.use_kl_loss = False
         self.kl_coeff = 0.2

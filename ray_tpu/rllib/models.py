@@ -165,6 +165,57 @@ class SquashedGaussianActorTwinQ:
         return q1, q2
 
 
+class DeterministicActorTwinQ:
+    """Continuous-control TD3/DDPG module: deterministic tanh policy and
+    (twin) Q critics (parity: the reference's DDPG/TD3 default models,
+    rllib/algorithms/ddpg/ddpg_torch_model.py — deterministic policy
+    net, twin_q option)."""
+
+    def __init__(self, obs_dim: int, act_dim: int, act_low, act_high,
+                 twin_q: bool = True,
+                 config: Optional[ModelConfig] = None):
+        self.obs_dim = obs_dim
+        self.act_dim = act_dim
+        self.twin_q = twin_q
+        self.config = config or ModelConfig(hidden=(256, 256),
+                                            activation="relu")
+        low = np.asarray(act_low, np.float32).reshape(act_dim)
+        high = np.asarray(act_high, np.float32).reshape(act_dim)
+        self.act_scale = (high - low) / 2.0
+        self.act_mid = (high + low) / 2.0
+
+    def init(self, key) -> dict:
+        kp, k1, k2 = jax.random.split(key, 3)
+        h = self.config.hidden
+        params = {
+            "pi": _mlp_init(kp, (self.obs_dim, *h, self.act_dim),
+                            scale_last=0.01),
+            "q1": _mlp_init(k1, (self.obs_dim + self.act_dim, *h, 1),
+                            scale_last=1.0),
+        }
+        if self.twin_q:
+            params["q2"] = _mlp_init(
+                k2, (self.obs_dim + self.act_dim, *h, 1), scale_last=1.0)
+        return params
+
+    def action(self, params, obs):
+        """Deterministic env-scaled action."""
+        obs = obs.reshape(obs.shape[0], -1)
+        out = _mlp_apply(params["pi"], obs, _act(self.config.activation))
+        return jnp.tanh(out) * self.act_scale + self.act_mid
+
+    def q_values(self, params, obs, action):
+        obs = obs.reshape(obs.shape[0], -1)
+        norm_act = (action - self.act_mid) / self.act_scale
+        x = jnp.concatenate([obs, norm_act], axis=-1)
+        act = _act(self.config.activation)
+        q1 = _mlp_apply(params["q1"], x, act)[..., 0]
+        if not self.twin_q:
+            return q1, q1
+        q2 = _mlp_apply(params["q2"], x, act)[..., 0]
+        return q1, q2
+
+
 def space_dims(obs_space, act_space) -> tuple[int, int]:
     obs_dim = int(np.prod(obs_space.shape))
     if hasattr(act_space, "n"):

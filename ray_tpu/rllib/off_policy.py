@@ -1,0 +1,69 @@
+"""Shared replay-driven training loop for continuous-control off-policy
+algorithms (SAC, TD3, DDPG).
+
+Reference shape: the common training_step of
+/root/reference/rllib/algorithms/{sac,ddpg,td3} — sample with an
+exploration policy, store into replay, update the learner
+``num_epochs`` times per iteration once ``learning_starts`` env steps
+exist. Subclasses provide the module/learner and the exploration
+policy; everything else (uniform warmup, buffer bookkeeping, episode
+metrics) lives here exactly once."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .algorithm import Algorithm
+from .replay import ReplayBuffer
+
+
+class OffPolicyAlgorithm(Algorithm):
+    def _make_module(self):  # pragma: no cover - subclass seam
+        raise NotImplementedError
+
+    def _exploration_policy(self, obs: np.ndarray) -> np.ndarray:
+        """Post-warmup behavior policy (stochastic sample for SAC,
+        deterministic + Gaussian noise for TD3/DDPG)."""
+        raise NotImplementedError
+
+    def setup(self, config):
+        if config.num_env_runners > 0:
+            raise ValueError(
+                f"{type(self).__name__} samples from its local runner "
+                f"(replay dominates) — set num_env_runners=0")
+        super().setup(config)
+        self.buffer = ReplayBuffer(config.replay_buffer_capacity,
+                                   seed=config.seed)
+        self._env_steps = 0
+        self._warmup_rng = np.random.default_rng((config.seed or 0) + 11)
+
+    def _sync_weights(self):
+        pass  # the local runner's discrete-policy params are unused
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        learner = self.learner_group.learner
+        module = learner.module
+
+        def policy(obs):
+            if self._env_steps < cfg.learning_starts:
+                # Uniform warmup (reference: initial random exploration).
+                return self._warmup_rng.uniform(
+                    module.act_mid - module.act_scale,
+                    module.act_mid + module.act_scale,
+                    (len(obs), module.act_dim)).astype(np.float32)
+            return self._exploration_policy(obs)
+
+        transitions = self.local_runner.rollout_transitions(
+            cfg.rollout_fragment_length, policy)
+        self.buffer.add_batch(**transitions)
+        self._env_steps += len(transitions["obs"])
+        self._record_episodes(self.local_runner.episode_returns())
+
+        metrics = {"buffer_size": len(self.buffer)}
+        if self._env_steps >= cfg.learning_starts:
+            for _ in range(cfg.num_epochs):
+                metrics.update(learner.update_from_batch(
+                    self.buffer.sample(cfg.train_batch_size)))
+        metrics["num_env_steps_sampled"] = self._env_steps
+        return metrics

@@ -57,11 +57,135 @@ def write_offline_data(batches: Union[dict, List[dict]], path: str) -> int:
     return total
 
 
+class JsonReader:
+    """Streaming reader for RLlib-style JSONL sample-batch files
+    (capability parity: /root/reference/rllib/offline/json_reader.py):
+    every line is one JSON object with list columns — at least
+    ``obs``/``actions``/``rewards``/``dones`` (``new_obs`` honored when
+    present). ``inputs`` is a path, a glob, a directory (reads *.json*
+    inside), or a list of those; ``next()`` cycles batches forever the
+    way the reference reader feeds training."""
+
+    COLUMNS = ("obs", "actions", "rewards", "dones")
+
+    def __init__(self, inputs):
+        if isinstance(inputs, (str, os.PathLike)):
+            inputs = [inputs]
+        files: list = []
+        for item in inputs:
+            item = str(item)
+            if os.path.isdir(item):
+                files.extend(sorted(
+                    glob.glob(os.path.join(item, "*.json"))
+                    + glob.glob(os.path.join(item, "*.jsonl"))))
+            else:
+                matched = sorted(glob.glob(item))
+                files.extend(matched or [item])
+        self.files = files
+        if not self.files:
+            raise FileNotFoundError(f"no offline json files in {inputs!r}")
+        import json as _json
+
+        # Parse ONCE: next() cycles these rows for the whole training
+        # run — re-paying JSON parse per epoch would be pure waste (the
+        # strings would be resident either way).
+        self._rows: list = []
+        for f in self.files:
+            with open(f) as fh:
+                for line in fh:
+                    if line.strip():
+                        self._rows.append(_json.loads(line))
+        if not self._rows:
+            raise ValueError(f"offline json files are empty: {self.files}")
+        self._cursor = 0
+
+    def next(self) -> dict:
+        """The next sample batch (numpy columns), cycling."""
+        row = self._rows[self._cursor % len(self._rows)]
+        self._cursor += 1
+        out = {k: np.asarray(row[k]) for k in self.COLUMNS}
+        if "new_obs" in row:
+            out["new_obs"] = np.asarray(row["new_obs"])
+        return out
+
+    def read_all(self) -> list:
+        """Every batch once (training-set materialization)."""
+        return [self.next() for _ in range(len(self._rows))]
+
+
+def write_offline_json(batches, path: str) -> int:
+    """Write episode batches as JSONL (one batch per line — the
+    reference json_writer's shape). Columns beyond the standard four
+    pass through."""
+    import json as _json
+
+    if isinstance(batches, dict):
+        batches = [batches]
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    total = 0
+    with open(path, "a") as f:
+        for b in batches:
+            row = {k: np.asarray(v).tolist() for k, v in b.items()}
+            f.write(_json.dumps(row) + "\n")
+            total += len(b["rewards"])
+    return total
+
+
+def _load_offline_json(files: list, gamma: float) -> dict:
+    """JSONL batches -> the standard offline columns (returns-to-go,
+    next_obs, terminals), treating every LINE as one independent
+    trajectory fragment."""
+    reader = JsonReader(files)
+    cols: dict = {k: [] for k in ("obs", "actions", "rewards", "dones")}
+    returns, next_obs, terminals = [], [], []
+    for b in reader.read_all():
+        n = len(b["rewards"])
+        for k in cols:
+            cols[k].append(np.asarray(b[k]))
+        rtg = np.zeros(n, dtype=np.float32)
+        acc = 0.0
+        for i in range(n - 1, -1, -1):
+            if b["dones"][i] or i + 1 == n:
+                acc = 0.0
+            acc = b["rewards"][i] + gamma * acc
+            rtg[i] = acc
+        returns.append(rtg)
+        obs = np.asarray(b["obs"])
+        if "new_obs" in b:
+            nxt = np.asarray(b["new_obs"]).astype(obs.dtype)
+        else:
+            nxt = np.concatenate([obs[1:], obs[-1:]], axis=0)
+        term = np.asarray(b["dones"]).astype(bool).copy()
+        term[-1] = True  # fragment end never bootstraps across lines
+        next_obs.append(nxt)
+        terminals.append(term)
+    out = {k: np.concatenate(v) for k, v in cols.items()}
+    out["returns"] = np.concatenate(returns)
+    out["next_obs"] = np.concatenate(next_obs)
+    out["terminals"] = np.concatenate(terminals)
+    return out
+
+
 def load_offline_data(path: str, gamma: float = 0.99) -> dict:
     """Load every shard; compute per-step discounted return-to-go
-    (episode boundaries from dones) for advantage weighting."""
+    (episode boundaries from dones) for advantage weighting. Accepts
+    .npz shard dirs (write_offline_data) AND RLlib-style JSONL files/
+    globs/dirs (JsonReader)."""
+    # npz shard dirs take precedence: a stray metadata.json dropped
+    # into a shard directory must not hijack loading.
     files = sorted(glob.glob(os.path.join(path, "shard-*.npz")))
     if not files:
+        json_files = []
+        if os.path.isdir(path):
+            json_files = (sorted(glob.glob(os.path.join(path, "*.json")))
+                          + sorted(glob.glob(os.path.join(path,
+                                                          "*.jsonl"))))
+        else:
+            matched = sorted(glob.glob(path)) or [path]
+            if all(m.endswith((".json", ".jsonl")) for m in matched):
+                json_files = [m for m in matched if os.path.exists(m)]
+        if json_files:
+            return _load_offline_json(json_files, gamma)
         raise FileNotFoundError(f"no offline shards under {path!r}")
     cols: dict = {k: [] for k in ("obs", "actions", "rewards", "dones")}
     returns = []

@@ -17,10 +17,9 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from .algorithm import Algorithm
 from .learner import LearnerGroup
 from .models import SquashedGaussianActorTwinQ, space_dims
-from .replay import ReplayBuffer
+from .off_policy import OffPolicyAlgorithm
 
 
 class SACLearner:
@@ -153,9 +152,10 @@ class SACLearner:
         self.opt = full["opt"]
 
 
-class SAC(Algorithm):
+class SAC(OffPolicyAlgorithm):
     """Replay-driven continuous control (reference: sac.py's
-    training_step — sample env, store, train on replay)."""
+    training_step — sample env, store, train on replay; the shared
+    replay loop lives in OffPolicyAlgorithm)."""
 
     def _make_module(self):
         vec = self.local_runner.vec
@@ -179,49 +179,14 @@ class SAC(Algorithm):
         return LearnerGroup(learner)
 
     def setup(self, config):
-        if config.num_env_runners > 0:
-            raise ValueError(
-                "SAC samples from its local runner (replay dominates) — "
-                "set num_env_runners=0")
         super().setup(config)
-        self.buffer = ReplayBuffer(config.replay_buffer_capacity,
-                                   seed=config.seed)
-        self._env_steps = 0
         self._act_key = jax.random.key((config.seed or 0) + 7)
-        self._warmup_rng = np.random.default_rng((config.seed or 0) + 11)
 
-    def _sync_weights(self):
-        pass  # the local runner's discrete-policy params are unused
-
-    def training_step(self) -> dict:
-        cfg = self.config
-        runner = self.local_runner
+    def _exploration_policy(self, obs):
         learner = self.learner_group.learner
         module = learner.module
-
-        def policy(obs):
-            if self._env_steps < cfg.learning_starts:
-                # Uniform warmup (reference: initial random exploration).
-                return self._warmup_rng.uniform(
-                    module.act_mid - module.act_scale,
-                    module.act_mid + module.act_scale,
-                    (len(obs), module.act_dim)).astype(np.float32)
-            self._act_key, sub = jax.random.split(self._act_key)
-            act, _ = module.sample_action(
-                {**learner.state["actor"], **learner.state["critic"]},
-                jnp.asarray(obs), sub)
-            return np.asarray(act)
-
-        transitions = runner.rollout_transitions(
-            cfg.rollout_fragment_length, policy)
-        self.buffer.add_batch(**transitions)
-        self._env_steps += len(transitions["obs"])
-        self._record_episodes(runner.episode_returns())
-
-        metrics = {"buffer_size": len(self.buffer)}
-        if self._env_steps >= cfg.learning_starts:
-            for _ in range(cfg.num_epochs):
-                metrics.update(learner.update_from_batch(
-                    self.buffer.sample(cfg.train_batch_size)))
-        metrics["num_env_steps_sampled"] = self._env_steps
-        return metrics
+        self._act_key, sub = jax.random.split(self._act_key)
+        act, _ = module.sample_action(
+            {**learner.state["actor"], **learner.state["critic"]},
+            jnp.asarray(obs), sub)
+        return np.asarray(act)

@@ -1,0 +1,198 @@
+"""TD3 / DDPG (deterministic continuous control) and the offline JSONL
+input pipeline.
+
+Parity model: /root/reference/rllib/algorithms/td3/td3.py,
+rllib/algorithms/ddpg/, rllib/offline/json_reader.py (VERDICT r4
+missing #7)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import BC, DDPG, TD3, JsonReader, write_offline_json
+from ray_tpu.rllib.models import DeterministicActorTwinQ
+from ray_tpu.rllib.td3 import TD3Learner
+
+
+# ---------------------------------------------------------------------------
+# Units
+# ---------------------------------------------------------------------------
+def _module(twin=True):
+    return DeterministicActorTwinQ(3, 1, [-2.0], [2.0], twin_q=twin)
+
+
+class TestTD3Learner:
+    def _batch(self, n=32, seed=0):
+        rng = np.random.default_rng(seed)
+        return {
+            "obs": rng.standard_normal((n, 3)).astype(np.float32),
+            "actions": rng.uniform(-2, 2, (n, 1)).astype(np.float32),
+            "rewards": rng.standard_normal(n).astype(np.float32),
+            "next_obs": rng.standard_normal((n, 3)).astype(np.float32),
+            "dones": (rng.random(n) < 0.1),
+        }
+
+    def test_update_moves_critic_every_step_actor_delayed(self):
+        import jax
+
+        learner = TD3Learner(_module(), policy_delay=2, seed=0)
+        a0 = jax.tree_util.tree_map(np.copy, learner.state["actor"])
+        c0 = jax.tree_util.tree_map(np.copy, learner.state["critic"])
+        m = learner.update_from_batch(self._batch())
+        assert np.isfinite(m["critic_loss"])
+        # Step 1 of delay 2: critic moved, actor frozen.
+        moved = jax.tree_util.tree_map(
+            lambda a, b: float(np.abs(a - b).max()),
+            c0, learner.state["critic"])
+        assert max(jax.tree_util.tree_leaves(moved)) > 0
+        frozen = jax.tree_util.tree_map(
+            lambda a, b: float(np.abs(a - b).max()),
+            a0, learner.state["actor"])
+        assert max(jax.tree_util.tree_leaves(frozen)) == 0
+        # Step 2: actor moves.
+        learner.update_from_batch(self._batch(seed=1))
+        moved_a = jax.tree_util.tree_map(
+            lambda a, b: float(np.abs(a - b).max()),
+            a0, learner.state["actor"])
+        assert max(jax.tree_util.tree_leaves(moved_a)) > 0
+
+    def test_single_q_ddpg_mode(self):
+        learner = TD3Learner(_module(twin=False), policy_delay=1,
+                             target_noise=0.0, seed=0)
+        m = learner.update_from_batch(self._batch())
+        assert "q2" not in learner.state["critic"]
+        assert np.isfinite(m["actor_loss"])
+
+    def test_actions_respect_bounds(self):
+        import jax.numpy as jnp
+
+        m = _module()
+        params = m.init(__import__("jax").random.key(0))
+        obs = jnp.asarray(np.random.default_rng(0)
+                          .standard_normal((64, 3)), jnp.float32)
+        act = np.asarray(m.action(params, obs))
+        assert (act >= -2.0 - 1e-5).all() and (act <= 2.0 + 1e-5).all()
+
+
+# ---------------------------------------------------------------------------
+# End-to-end learning
+# ---------------------------------------------------------------------------
+def test_td3_pendulum_improves():
+    config = (TD3.get_default_config()
+              .environment("Pendulum-v1")
+              .env_runners(num_envs_per_env_runner=1,
+                           rollout_fragment_length=200)
+              .training(lr=1e-3, train_batch_size=128, num_epochs=200,
+                        learning_starts=400, gamma=0.99, tau=0.01,
+                        exploration_noise=0.1)
+              .debugging(seed=0))
+    algo = config.build()
+    result, first = {}, None
+    for i in range(25):
+        result = algo.train()
+        if i == 4:
+            first = result["episode_return_mean"]
+    algo.stop()
+    assert result["episode_return_mean"] > first + 200, (first, result)
+    assert result["episode_return_mean"] > -950, result
+
+
+def test_ddpg_pendulum_runs_and_improves():
+    config = (DDPG.get_default_config()
+              .environment("Pendulum-v1")
+              .env_runners(num_envs_per_env_runner=1,
+                           rollout_fragment_length=200)
+              .training(lr=1e-3, train_batch_size=128, num_epochs=150,
+                        learning_starts=400, gamma=0.99, tau=0.01,
+                        exploration_noise=0.15)
+              .debugging(seed=0))
+    assert config.policy_delay == 1 and config.target_noise == 0.0
+    algo = config.build()
+    result, first = {}, None
+    for i in range(22):
+        result = algo.train()
+        if i == 4:
+            first = result["episode_return_mean"]
+    algo.stop()
+    # DDPG is less stable than TD3: require clear improvement only
+    # (config swept over seeds 0-2: first ~-1390, final -965..-1011).
+    assert result["episode_return_mean"] > first + 250, (first, result)
+
+
+# ---------------------------------------------------------------------------
+# Offline JSONL pipeline
+# ---------------------------------------------------------------------------
+def test_json_reader_roundtrip(tmp_path):
+    path = str(tmp_path / "episodes.jsonl")
+    eps = []
+    rng = np.random.default_rng(0)
+    for _ in range(3):
+        n = 5
+        eps.append({
+            "obs": rng.standard_normal((n, 4)).astype(np.float32),
+            "actions": rng.integers(0, 2, n),
+            "rewards": rng.standard_normal(n).astype(np.float32),
+            "dones": np.zeros(n, bool),
+        })
+    wrote = write_offline_json(eps, path)
+    assert wrote == 15
+    reader = JsonReader(path)
+    batches = reader.read_all()
+    assert len(batches) == 3
+    np.testing.assert_allclose(batches[0]["obs"], eps[0]["obs"],
+                               rtol=1e-6)
+    # next() cycles.
+    again = reader.next()
+    np.testing.assert_allclose(again["obs"], eps[0]["obs"], rtol=1e-6)
+
+
+def test_bc_trains_from_jsonl(tmp_path):
+    """BC consumes the JSONL format end-to-end (reference: offline algos
+    reading json_reader inputs): an expert that always picks action 1
+    is cloned."""
+    path = str(tmp_path / "expert.jsonl")
+    rng = np.random.default_rng(0)
+    eps = []
+    for _ in range(10):
+        n = 40
+        eps.append({
+            "obs": rng.standard_normal((n, 4)).astype(np.float32),
+            "actions": np.ones(n, np.int64),
+            "rewards": np.ones(n, np.float32),
+            "dones": np.zeros(n, bool),
+        })
+    write_offline_json(eps, path)
+
+    config = (BC.get_default_config()
+              .environment("CartPole-v1")
+              .offline_data(input_=path)
+              .training(lr=1e-2, train_batch_size=128, num_epochs=30)
+              .debugging(seed=0))
+    algo = config.build()
+    for _ in range(3):
+        algo.train()
+    import jax.numpy as jnp
+
+    learner = algo.learner_group.learner
+    obs = jnp.asarray(rng.standard_normal((64, 4)), jnp.float32)
+    logits = learner.module.logits(learner.params, obs)
+    assert (np.asarray(logits.argmax(-1)) == 1).mean() > 0.95
+    algo.stop()
+
+
+def test_json_reader_missing_files(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        JsonReader(str(tmp_path / "nope" / "*.jsonl"))
+
+
+def test_malformed_json_line_fails_loudly(tmp_path):
+    p = tmp_path / "bad.jsonl"
+    p.write_text('{"obs": [1], "actions": [0], "rewards": [0.5]}\n')
+    reader = JsonReader(str(p))
+    with pytest.raises(KeyError):
+        reader.next()  # dones column missing
+    p2 = tmp_path / "worse.jsonl"
+    p2.write_text("not json at all\n")
+    with pytest.raises(json.JSONDecodeError):
+        JsonReader(str(p2)).next()
