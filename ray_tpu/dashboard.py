@@ -46,6 +46,9 @@ _PAGE = """<!doctype html>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Jobs</h2><table id="jobs"></table>
 <h2>Object store</h2><table id="store"></table>
+<h2>Serve</h2><table id="serve"></table>
+<h2>RPC (top methods)</h2><table id="rpc"></table>
+<h2>Worker logs</h2><div id="logs" style="font-family:monospace;font-size:.75rem;white-space:pre-wrap;background:#fff;padding:.6rem;box-shadow:0 1px 2px #0002;max-height:20rem;overflow:auto"></div>
 <script>
 function esc(v){return String(v).replace(/[&<>"']/g,
   c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));}
@@ -86,6 +89,21 @@ async function refresh(){
       row(['node','objects','bytes used','capacity'],'th') +
       o.store.map(s=>row([s.node_id.slice(0,12), s.num_objects??'-',
         s.bytes_used??'-', s.capacity_bytes??'-'])).join('');
+    const sv = await (await fetch('api/serve')).json();
+    document.getElementById('serve').innerHTML =
+      row(['app','deployment','status','proxies'],'th') +
+      (sv.deployments.length ? sv.deployments.map(d=>row([esc(d.app),
+        esc(d.deployment), pill(d.status),
+        sv.proxies.map(p=>p.node_id.slice(0,8)+':'+p.port).join(' ')||'-'])).join('')
+        : row(['-','-','-','-']));
+    const rp = await (await fetch('api/rpc')).json();
+    document.getElementById('rpc').innerHTML =
+      row(['node','method','count','errors','timeouts','mean ms','max ms'],'th') +
+      rp.rpc.slice(0,15).map(r=>row([r.node_id.slice(0,8), esc(r.method),
+        r.count, r.errors, r.timeouts, r.mean_ms, r.max_ms])).join('');
+    const lg = await (await fetch('api/logs')).json();
+    document.getElementById('logs').textContent =
+      lg.logs.map(l=>'--- '+l.worker+' ---\n'+l.tail).join('\n') || '(no worker logs)';
     document.getElementById('updated').textContent =
       'updated ' + new Date().toLocaleTimeString();
   }catch(e){document.getElementById('updated').textContent='refresh failed: '+e;}
@@ -182,6 +200,58 @@ def _actors() -> dict:
     return {"actors": actors}
 
 
+def _rpc_stats() -> dict:
+    """Per-node per-method RPC stats (count/errors/timeouts/latency) —
+    the operator view of the control plane's health."""
+    snap = _snapshot()
+    rows = []
+    for sn in snap["snapshots"]:
+        for method, st in (sn.get("rpc") or {}).items():
+            rows.append({"node_id": sn["node_id"], "method": method, **st})
+    rows.sort(key=lambda r: -r.get("count", 0))
+    return {"rpc": rows[:60]}
+
+
+def _serve_status() -> dict:
+    try:
+        from ray_tpu import serve
+
+        st = serve.status()
+        apps = []
+        for name, app in (st.get("applications") or {}).items():
+            for dep, d in (app.get("deployments") or {}).items():
+                apps.append({"app": name, "deployment": dep,
+                             "status": d.get("status", "?"),
+                             "replicas": d.get("replica_states", d)})
+        proxies = []
+        try:
+            proxies = serve.status_proxies()
+        except Exception:  # noqa: BLE001 - no fleet running
+            pass
+        return {"deployments": apps,
+                "proxies": [{"node_id": (p["node_id"].hex()
+                                         if isinstance(p["node_id"], bytes)
+                                         else str(p["node_id"])),
+                             "port": p["port"]} for p in proxies]}
+    except Exception:  # noqa: BLE001 - serve not started
+        return {"deployments": [], "proxies": []}
+
+
+def _logs() -> dict:
+    """Recent worker log tails across the cluster (rtpu logs, as a
+    dashboard pane)."""
+    from ._private import context as context_mod
+
+    try:
+        rt = context_mod.require_context()
+        logs = rt.cluster_logs(tail_bytes=4096)
+        rows = [{"worker": k, "tail": v[-2000:]}
+                for k, v in sorted(logs.items())]
+        return {"logs": rows[:30]}
+    except Exception:  # noqa: BLE001
+        return {"logs": []}
+
+
 def _jobs() -> dict:
     try:
         from .job_submission import JOB_MANAGER_NAME
@@ -211,6 +281,9 @@ def start_dashboard(port: int = 0, host: str = "127.0.0.1"):
         "/api/tasks": _tasks,
         "/api/actors": _actors,
         "/api/jobs": _jobs,
+        "/api/rpc": _rpc_stats,
+        "/api/serve": _serve_status,
+        "/api/logs": _logs,
     }
 
     class Handler(http.server.BaseHTTPRequestHandler):

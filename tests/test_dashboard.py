@@ -63,3 +63,32 @@ def test_jobs_and_metrics_endpoints(dash):
     assert "jobs" in j  # empty without a JobManager — shape holds
     m = _get(dash + "/metrics")
     assert "rtpu_node_num_workers" in m
+
+
+def test_new_operator_panes(rt):
+    """Serve/RPC/logs endpoints feed the page's r5 panes."""
+    import json
+    import urllib.request
+
+    from ray_tpu import dashboard
+
+    @ray_tpu.remote
+    def chat():
+        print("pane test line")
+        return 1
+
+    ray_tpu.get(chat.remote(), timeout=60)
+    host, port = dashboard.start_dashboard()
+    base = f"http://{host}:{port}"
+    page = urllib.request.urlopen(base + "/").read().decode()
+    for pane in ("Serve", "RPC", "Worker logs"):
+        assert pane in page
+    rpc = json.loads(urllib.request.urlopen(base + "/api/rpc").read())
+    assert isinstance(rpc["rpc"], list)
+    serve = json.loads(urllib.request.urlopen(base + "/api/serve").read())
+    assert {"deployments", "proxies"} <= set(serve)
+    deadline = __import__("time").time() + 10
+    logs = {"logs": []}
+    while __import__("time").time() < deadline and not logs["logs"]:
+        logs = json.loads(urllib.request.urlopen(base + "/api/logs").read())
+    assert any("pane test line" in row["tail"] for row in logs["logs"]), logs
