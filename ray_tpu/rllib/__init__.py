@@ -40,6 +40,7 @@ from .offline import (  # noqa: F401
     write_offline_json,
 )
 from .sac import SAC, SACLearner  # noqa: F401
+from .dreamer import DreamerLearner, DreamerV3  # noqa: F401
 from .td3 import DDPG, TD3, TD3Learner  # noqa: F401
 from .env_runner import (  # noqa: F401
     SingleAgentEnvRunner,

@@ -81,6 +81,10 @@ class AlgorithmConfig:
         self.target_noise = 0.2
         self.target_noise_clip = 0.5
         self.exploration_noise = 0.1
+        # DreamerV3 (reference: dreamerv3.py defaults, sized down)
+        self.imagine_horizon = 15
+        self.actor_lr = 1e-4
+        self.sequence_length = 16
         # APPO
         self.use_kl_loss = False
         self.kl_coeff = 0.2

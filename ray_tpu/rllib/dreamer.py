@@ -1,0 +1,495 @@
+"""DreamerV3 (compact): model-based RL — learn a latent world model,
+then learn actor and critic entirely inside imagined rollouts.
+
+Capability parity target: /root/reference/rllib/algorithms/dreamerv3/
+(dreamerv3.py, torch/ world-model + actor-critic stacks). The essential
+DreamerV3 recipe is kept, sized for vector observations:
+
+  * RSSM world model: GRU deterministic path, DISCRETE stochastic
+    latents (groups x classes, straight-through gradients), posterior
+    from (h, embedding), prior from h alone;
+  * symlog-squashed decoder/reward regression, Bernoulli continue head,
+    KL balancing with free bits (beta_dyn/beta_rep — the V3 stability
+    trio);
+  * actor-critic trained on IMAGINED trajectories: lambda-returns with
+    continue-weighted discount, reinforce-style actor gradient with
+    entropy bonus, critic regression to sg(lambda-returns) with a
+    return-range normalizer (V3's percentile scale, simplified to a
+    running max-abs).
+
+TPU-native shape: the world-model update (scan over the sequence), the
+imagination rollout (scan over horizon) and both actor-critic losses
+are ONE jitted function per train step; replay supplies [B, L]
+sequence windows and is the only host<->device traffic.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .algorithm import Algorithm
+from .learner import LearnerGroup
+from .models import _mlp_apply, _mlp_init
+
+
+def symlog(x):
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x):
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+class SequenceReplay:
+    """Stores rollout fragments; samples [B, L] contiguous windows that
+    never cross fragment boundaries (reference: dreamerv3's episodic
+    replay with sequence sampling)."""
+
+    def __init__(self, capacity_steps: int, seq_len: int, seed=0):
+        self.capacity = capacity_steps
+        self.seq_len = seq_len
+        self.fragments: list = []
+        self.steps = 0
+        self.rng = np.random.default_rng(seed)
+
+    def add_fragment(self, **cols):
+        n = len(cols["rewards"])
+        if n < self.seq_len:
+            return
+        self.fragments.append({k: np.asarray(v) for k, v in cols.items()})
+        self.steps += n
+        while self.steps - len(self.fragments[0]["rewards"]) \
+                >= self.capacity and len(self.fragments) > 1:
+            self.steps -= len(self.fragments[0]["rewards"])
+            self.fragments.pop(0)
+
+    def __len__(self):
+        return self.steps
+
+    def sample(self, batch_size: int) -> dict:
+        out = {k: [] for k in self.fragments[0]}
+        for _ in range(batch_size):
+            frag = self.fragments[self.rng.integers(len(self.fragments))]
+            n = len(frag["rewards"])
+            start = int(self.rng.integers(0, n - self.seq_len + 1))
+            for k, v in frag.items():
+                out[k].append(v[start:start + self.seq_len])
+        return {k: np.stack(v) for k, v in out.items()}
+
+
+class DreamerModule:
+    """Parameters + pure functions of the world model and the
+    actor/critic heads. Discrete actions."""
+
+    def __init__(self, obs_dim: int, n_actions: int, *,
+                 deter: int = 256, groups: int = 8, classes: int = 8,
+                 hidden=(256, 256)):
+        self.obs_dim = obs_dim
+        self.n_actions = n_actions
+        self.deter = deter
+        self.groups = groups
+        self.classes = classes
+        self.stoch = groups * classes
+        self.hidden = hidden
+        self.feat_dim = deter + self.stoch
+
+    def init(self, key) -> dict:
+        ks = jax.random.split(key, 10)
+        h, d = self.hidden, self.deter
+        in_gru = self.stoch + self.n_actions
+        return {
+            "enc": _mlp_init(ks[0], (self.obs_dim, *h, h[-1])),
+            # GRU cell: one fused kernel for reset/update/candidate.
+            "gru_x": _mlp_init(ks[1], (in_gru, 3 * d), scale_last=1.0),
+            "gru_h": _mlp_init(ks[2], (d, 3 * d), scale_last=1.0),
+            "prior": _mlp_init(ks[3], (d, *h, self.stoch)),
+            "post": _mlp_init(ks[4], (d + h[-1], *h, self.stoch)),
+            "dec": _mlp_init(ks[5], (self.feat_dim, *h, self.obs_dim)),
+            "rew": _mlp_init(ks[6], (self.feat_dim, *h, 1),
+                             scale_last=0.01),
+            "cont": _mlp_init(ks[7], (self.feat_dim, *h, 1)),
+            "actor": _mlp_init(ks[8], (self.feat_dim, *h, self.n_actions),
+                               scale_last=0.01),
+            "critic": _mlp_init(ks[9], (self.feat_dim, *h, 1),
+                                scale_last=0.01),
+        }
+
+    # -- pieces -----------------------------------------------------------
+    def _gru(self, params, h, x):
+        gx = _mlp_apply(params["gru_x"], x, jax.nn.silu, final_act=False)
+        gh = _mlp_apply(params["gru_h"], h, jax.nn.silu, final_act=False)
+        xr, xu, xc = jnp.split(gx, 3, axis=-1)
+        hr, hu, hc = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        u = jax.nn.sigmoid(xu + hu)
+        c = jnp.tanh(xc + r * hc)
+        return u * h + (1 - u) * c
+
+    def _sample_latent(self, logits, key):
+        """Straight-through one-hot sample over each group."""
+        lg = logits.reshape(logits.shape[:-1] + (self.groups, self.classes))
+        idx = jax.random.categorical(key, lg)
+        one_hot = jax.nn.one_hot(idx, self.classes)
+        probs = jax.nn.softmax(lg)
+        st = one_hot + probs - jax.lax.stop_gradient(probs)
+        return st.reshape(logits.shape)
+
+    def _kl(self, lhs_logits, rhs_logits):
+        """KL(lhs || rhs) summed over groups, with V3 free bits."""
+        shape = lhs_logits.shape[:-1] + (self.groups, self.classes)
+        lp = jax.nn.log_softmax(lhs_logits.reshape(shape))
+        rp = jax.nn.log_softmax(rhs_logits.reshape(shape))
+        kl = (jnp.exp(lp) * (lp - rp)).sum(-1).sum(-1)
+        return jnp.maximum(kl, 1.0)  # free bits
+
+    def observe(self, params, obs_seq, act_seq, is_first, key):
+        """Scan the posterior over a [B, L] sequence. Returns features
+        [B, L, feat], prior/post logits for the KL terms."""
+        B, L = obs_seq.shape[:2]
+        emb = _mlp_apply(params["enc"], symlog(obs_seq), jax.nn.silu)
+        keys = jax.random.split(key, L)
+
+        def step(carry, inp):
+            h, z = carry
+            e_t, a_prev, first_t, k_t = inp
+            # Episode starts reset the recurrent state.
+            mask = (1.0 - first_t)[:, None]
+            h, z, a_prev = h * mask, z * mask, a_prev * mask
+            h = self._gru(params, h, jnp.concatenate([z, a_prev], -1))
+            prior_logits = _mlp_apply(params["prior"], h, jax.nn.silu)
+            post_in = jnp.concatenate([h, e_t], -1)
+            post_logits = _mlp_apply(params["post"], post_in, jax.nn.silu)
+            z = self._sample_latent(post_logits, k_t)
+            return (h, z), (h, z, prior_logits, post_logits)
+
+        h0 = jnp.zeros((B, self.deter))
+        z0 = jnp.zeros((B, self.stoch))
+        # Previous action at t is act[t-1] (zero at t=0).
+        a_prev = jnp.concatenate(
+            [jnp.zeros_like(act_seq[:, :1]), act_seq[:, :-1]], axis=1)
+        xs = (jnp.swapaxes(emb, 0, 1), jnp.swapaxes(a_prev, 0, 1),
+              jnp.swapaxes(is_first, 0, 1), keys)
+        (_, _), (hs, zs, priors, posts) = jax.lax.scan(step, (h0, z0), xs)
+        to_bl = lambda x: jnp.swapaxes(x, 0, 1)  # noqa: E731
+        feats = jnp.concatenate([to_bl(hs), to_bl(zs)], -1)
+        return feats, to_bl(priors), to_bl(posts), (to_bl(hs), to_bl(zs))
+
+    def imagine(self, params, h0, z0, horizon, key):
+        """Roll the PRIOR forward under the actor for `horizon` steps
+        from flattened start states [N, ...]."""
+        def step(carry, k_t):
+            h, z = carry
+            feat = jnp.concatenate([h, z], -1)
+            logits = _mlp_apply(params["actor"], feat, jax.nn.silu)
+            k_a, k_z = jax.random.split(k_t)
+            act = jax.nn.one_hot(
+                jax.random.categorical(k_a, logits), self.n_actions)
+            h = self._gru(params, h, jnp.concatenate([z, act], -1))
+            prior_logits = _mlp_apply(params["prior"], h, jax.nn.silu)
+            z = self._sample_latent(prior_logits, k_z)
+            return (h, z), (feat, act, logits)
+
+        keys = jax.random.split(key, horizon)
+        (_, _), (feats, acts, logits) = jax.lax.scan(step, (h0, z0), keys)
+        return feats, acts, logits  # [H, N, ...]
+
+    # -- heads ------------------------------------------------------------
+    def decode(self, params, feat):
+        return _mlp_apply(params["dec"], feat, jax.nn.silu)
+
+    def reward(self, params, feat):
+        return _mlp_apply(params["rew"], feat, jax.nn.silu)[..., 0]
+
+    def cont(self, params, feat):
+        return _mlp_apply(params["cont"], feat, jax.nn.silu)[..., 0]
+
+    def value(self, params, feat):
+        return _mlp_apply(params["critic"], feat, jax.nn.silu)[..., 0]
+
+    def policy_logits(self, params, feat):
+        return _mlp_apply(params["actor"], feat, jax.nn.silu)
+
+
+class DreamerLearner:
+    """One fused update: world-model loss over the sequence batch, then
+    actor and critic losses over imagination from every posterior
+    state."""
+
+    WM_KEYS = ("enc", "gru_x", "gru_h", "prior", "post", "dec", "rew",
+               "cont")
+
+    def __init__(self, module: DreamerModule, *, gamma: float = 0.99,
+                 lambda_: float = 0.95, horizon: int = 15,
+                 lr: float = 3e-4, actor_lr: float = 1e-4,
+                 entropy_coeff: float = 3e-3, beta_dyn: float = 0.5,
+                 beta_rep: float = 0.1, seed: int = 0):
+        self.module = module
+        self.gamma = gamma
+        self.lambda_ = lambda_
+        self.horizon = horizon
+        self.entropy_coeff = entropy_coeff
+        self.beta_dyn = beta_dyn
+        self.beta_rep = beta_rep
+        params = module.init(jax.random.key(seed))
+        self.state = {
+            "wm": {k: params[k] for k in self.WM_KEYS},
+            "actor": params["actor"],
+            "critic": params["critic"],
+            # V3 return normalizer (simplified): running max|return|.
+            "ret_scale": jnp.ones(()),
+        }
+        self.tx_wm = optax.chain(optax.clip_by_global_norm(100.0),
+                                 optax.adam(lr))
+        self.tx_actor = optax.chain(optax.clip_by_global_norm(100.0),
+                                    optax.adam(actor_lr))
+        self.tx_critic = optax.chain(optax.clip_by_global_norm(100.0),
+                                     optax.adam(actor_lr))
+        self.opt = {
+            "wm": self.tx_wm.init(self.state["wm"]),
+            "actor": self.tx_actor.init(self.state["actor"]),
+            "critic": self.tx_critic.init(self.state["critic"]),
+        }
+        self._update_fn = jax.jit(self._update)
+        self._key = jax.random.key(seed + 1)
+
+    # -- world model ------------------------------------------------------
+    def _wm_loss(self, wm, batch, key):
+        m = self.module
+        params = {**wm, "actor": self.state["actor"],
+                  "critic": self.state["critic"]}
+        acts = jax.nn.one_hot(batch["actions"], m.n_actions)
+        feats, priors, posts, (hs, zs) = m.observe(
+            params, batch["obs"], acts, batch["is_first"], key)
+        recon = m.decode(params, feats)
+        l_dec = ((recon - symlog(batch["obs"])) ** 2).mean()
+        l_rew = ((m.reward(params, feats)
+                  - symlog(batch["rewards"])) ** 2).mean()
+        cont_target = 1.0 - batch["dones"].astype(jnp.float32)
+        l_cont = optax.sigmoid_binary_cross_entropy(
+            m.cont(params, feats), cont_target).mean()
+        l_dyn = m._kl(jax.lax.stop_gradient(posts), priors).mean()
+        l_rep = m._kl(posts, jax.lax.stop_gradient(priors)).mean()
+        loss = (l_dec + l_rew + l_cont
+                + self.beta_dyn * l_dyn + self.beta_rep * l_rep)
+        return loss, (hs, zs, {"wm_loss": loss, "decoder_loss": l_dec,
+                               "reward_loss": l_rew, "kl_dyn": l_dyn})
+
+    def _update(self, state, opt, batch, key):
+        m = self.module
+        k_wm, k_im = jax.random.split(key)
+        (wm_loss, (hs, zs, wm_metrics)), g = jax.value_and_grad(
+            self._wm_loss, has_aux=True)(state["wm"], batch, k_wm)
+        up, opt_wm = self.tx_wm.update(g, opt["wm"], state["wm"])
+        wm = optax.apply_updates(state["wm"], up)
+
+        # Imagination from every posterior state (flattened, no grads
+        # into the world model).
+        h0 = jax.lax.stop_gradient(hs.reshape(-1, m.deter))
+        z0 = jax.lax.stop_gradient(zs.reshape(-1, m.stoch))
+        params_im = {**wm, "actor": state["actor"],
+                     "critic": state["critic"]}
+
+        def actor_loss(actor):
+            p = {**params_im, "actor": actor}
+            feats, acts, logits = m.imagine(p, h0, z0, self.horizon, k_im)
+            rew = symexp(m.reward(p, feats))
+            cont = jax.nn.sigmoid(m.cont(p, feats))
+            disc = self.gamma * cont
+            val = m.value(p, feats)
+
+            # lambda-returns, backward scan over the horizon.
+            def lam(carry, x):
+                r_t, d_t, v_next = x
+                ret = r_t + d_t * ((1 - self.lambda_) * v_next
+                                   + self.lambda_ * carry)
+                return ret, ret
+
+            v_last = val[-1]
+            xs = (rew[:-1][::-1], disc[:-1][::-1],
+                  val[1:][::-1])
+            _, rets = jax.lax.scan(lam, v_last, xs)
+            rets = rets[::-1]  # [H-1, N]
+            feats_h = feats[:-1]
+            val_h = val[:-1]
+            scale = jnp.maximum(
+                1.0, jax.lax.stop_gradient(jnp.abs(rets).max()))
+            adv = jax.lax.stop_gradient((rets - val_h) / scale)
+            lp = jax.nn.log_softmax(logits[:-1])
+            act_lp = (lp * acts[:-1]).sum(-1)
+            ent = -(jnp.exp(lp) * lp).sum(-1).mean()
+            # Trajectory weights: product of continues up to t.
+            w = jax.lax.stop_gradient(jnp.concatenate(
+                [jnp.ones_like(disc[:1]),
+                 jnp.cumprod(disc[:-1], 0)], 0))[:-1]
+            loss = -(w * act_lp * adv).mean() - self.entropy_coeff * ent
+            return loss, (rets, feats_h, w, scale, ent)
+
+        (a_loss, (rets, feats_h, w, scale, ent)), ag = jax.value_and_grad(
+            actor_loss, has_aux=True)(state["actor"])
+        aup, opt_actor = self.tx_actor.update(ag, opt["actor"],
+                                              state["actor"])
+        actor = optax.apply_updates(state["actor"], aup)
+
+        def critic_loss(critic):
+            p = {**params_im, "critic": critic}
+            v = m.value(p, jax.lax.stop_gradient(feats_h))
+            return (w * (v - jax.lax.stop_gradient(rets)) ** 2).mean()
+
+        c_loss, cg = jax.value_and_grad(critic_loss)(state["critic"])
+        cup, opt_critic = self.tx_critic.update(cg, opt["critic"],
+                                                state["critic"])
+        critic = optax.apply_updates(state["critic"], cup)
+
+        new_state = {"wm": wm, "actor": actor, "critic": critic,
+                     "ret_scale": scale}
+        new_opt = {"wm": opt_wm, "actor": opt_actor,
+                   "critic": opt_critic}
+        metrics = {**wm_metrics, "actor_loss": a_loss,
+                   "critic_loss": c_loss, "actor_entropy": ent,
+                   "imagined_return_mean": rets.mean()}
+        return new_state, new_opt, metrics
+
+    def update_from_batch(self, batch: dict) -> dict:
+        self._key, sub = jax.random.split(self._key)
+        dev = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.state, self.opt, metrics = self._update_fn(
+            self.state, self.opt, dev, sub)
+        return {k: float(v) for k, v in metrics.items()}
+
+    # -- acting (posterior filter over the live episode) ------------------
+    def make_policy_fn(self):
+        m = self.module
+
+        @jax.jit
+        def step(params, h, z, a_prev, obs, first, key):
+            mask = (1.0 - first)[:, None]
+            h, z, a_prev = h * mask, z * mask, a_prev * mask
+            emb = _mlp_apply(params["enc"], symlog(obs), jax.nn.silu)
+            h = self._gru_of(params, h, z, a_prev)
+            post_in = jnp.concatenate([h, emb], -1)
+            k_z, k_a = jax.random.split(key)
+            z = m._sample_latent(
+                _mlp_apply(params["post"], post_in, jax.nn.silu), k_z)
+            feat = jnp.concatenate([h, z], -1)
+            logits = m.policy_logits(params, feat)
+            act = jax.random.categorical(k_a, logits)
+            return h, z, act
+
+        return step
+
+    def _gru_of(self, params, h, z, a_prev):
+        return self.module._gru(params, h,
+                                jnp.concatenate([z, a_prev], -1))
+
+    # -- checkpoint surface ----------------------------------------------
+    def get_state(self):
+        return self.state
+
+    def set_state(self, params):
+        self.state.update(params)
+
+    def get_full_state(self) -> dict:
+        return {"state": self.state, "opt": self.opt}
+
+    def set_full_state(self, full: dict):
+        self.state = full["state"]
+        self.opt = full["opt"]
+
+
+class DreamerV3(Algorithm):
+    """Model-based training loop (reference: dreamerv3.py
+    training_step): collect with the posterior-filter policy, store
+    fragments, train world model + imagination actor-critic from
+    sequence replay."""
+
+    def _make_module(self):
+        vec = self.local_runner.vec
+        obs_space = vec.single_observation_space
+        act_space = vec.single_action_space
+        if not hasattr(act_space, "n"):
+            raise ValueError("this DreamerV3 build is discrete-action")
+        return DreamerModule(int(np.prod(obs_space.shape)),
+                             int(act_space.n))
+
+    def _make_learner_group(self):
+        cfg = self.config
+        learner = DreamerLearner(
+            self._make_module(), gamma=cfg.gamma, lambda_=cfg.lambda_,
+            horizon=cfg.imagine_horizon, lr=cfg.lr,
+            actor_lr=cfg.actor_lr, entropy_coeff=cfg.entropy_coeff,
+            seed=cfg.seed or 0)
+        return LearnerGroup(learner)
+
+    def setup(self, config):
+        if config.num_env_runners > 0:
+            raise ValueError("DreamerV3 trains from its local runner")
+        super().setup(config)
+        cfg = config
+        self.replay = SequenceReplay(cfg.replay_buffer_capacity,
+                                     cfg.sequence_length,
+                                     seed=cfg.seed)
+        self._env_steps = 0
+        self._act_key = jax.random.key((cfg.seed or 0) + 5)
+        self._policy_step = None
+        self._policy_state = None
+
+    def _sync_weights(self):
+        pass
+
+    def _policy(self, obs, dones_prev):
+        learner = self.learner_group.learner
+        m = learner.module
+        if self._policy_step is None:
+            self._policy_step = learner.make_policy_fn()
+        n = len(obs)
+        if self._policy_state is None:
+            self._policy_state = (jnp.zeros((n, m.deter)),
+                                  jnp.zeros((n, m.stoch)),
+                                  jnp.zeros((n, m.n_actions)))
+        h, z, a_prev = self._policy_state
+        self._act_key, sub = jax.random.split(self._act_key)
+        params = {**learner.state["wm"],
+                  "actor": learner.state["actor"],
+                  "critic": learner.state["critic"]}
+        h, z, act = self._policy_step(
+            params, h, z, a_prev, jnp.asarray(obs, jnp.float32),
+            jnp.asarray(dones_prev, jnp.float32), sub)
+        self._policy_state = (h, z, jax.nn.one_hot(act, m.n_actions))
+        return np.asarray(act)
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        runner = self.local_runner
+        dones_prev = np.ones(runner.vec.num_envs, np.float32)
+
+        def policy(obs):
+            nonlocal dones_prev
+            act = self._policy(obs, dones_prev)
+            dones_prev = np.zeros(len(obs), np.float32)
+            return act
+
+        tr = runner.rollout_transitions(cfg.rollout_fragment_length,
+                                        policy)
+        n = len(tr["rewards"])
+        is_first = np.zeros(n, np.float32)
+        is_first[0] = 1.0
+        # dones within the fragment start new episodes at the NEXT step.
+        is_first[1:] = tr["dones"][:-1].astype(np.float32)
+        self.replay.add_fragment(
+            obs=tr["obs"].astype(np.float32), actions=tr["actions"],
+            rewards=tr["rewards"].astype(np.float32),
+            dones=tr["dones"], is_first=is_first)
+        self._env_steps += n
+        self._record_episodes(runner.episode_returns())
+
+        metrics = {"replay_steps": len(self.replay)}
+        if len(self.replay) >= cfg.learning_starts:
+            learner = self.learner_group.learner
+            for _ in range(cfg.num_epochs):
+                metrics.update(learner.update_from_batch(
+                    self.replay.sample(cfg.train_batch_size)))
+        metrics["num_env_steps_sampled"] = self._env_steps
+        return metrics
