@@ -76,7 +76,17 @@ class SequenceReplay:
             n = len(frag["rewards"])
             start = int(self.rng.integers(0, n - self.seq_len + 1))
             for k, v in frag.items():
-                out[k].append(v[start:start + self.seq_len])
+                w = v[start:start + self.seq_len]
+                if k == "is_first":
+                    # The window begins from an UNKNOWN recurrent state:
+                    # mark it so observe() resets (the reference marks
+                    # every sampled sequence's head the same way) —
+                    # otherwise the first posterior states are computed
+                    # from zeros mid-episode and train the heads on
+                    # garbage features.
+                    w = w.copy()
+                    w[0] = 1.0
+                out[k].append(w)
         return {k: np.stack(v) for k, v in out.items()}
 
 
@@ -99,6 +109,7 @@ class DreamerModule:
     def init(self, key) -> dict:
         ks = jax.random.split(key, 10)
         h, d = self.hidden, self.deter
+        n_act = self.n_actions
         in_gru = self.stoch + self.n_actions
         return {
             "enc": _mlp_init(ks[0], (self.obs_dim, *h, h[-1])),
@@ -108,9 +119,15 @@ class DreamerModule:
             "prior": _mlp_init(ks[3], (d, *h, self.stoch)),
             "post": _mlp_init(ks[4], (d + h[-1], *h, self.stoch)),
             "dec": _mlp_init(ks[5], (self.feat_dim, *h, self.obs_dim)),
-            "rew": _mlp_init(ks[6], (self.feat_dim, *h, 1),
+            # Reward/continue condition on (state, ACTION): flat
+            # auto-reset transitions align (s_t, a_t, r_t, done_t), and
+            # a state-only head could never attribute r_t to a_t (the
+            # arrival-aligned alternative needs stored terminal
+            # observations). Q-style factorization keeps the stored
+            # alignment exactly right and makes episode ends learnable.
+            "rew": _mlp_init(ks[6], (self.feat_dim + n_act, *h, 1),
                              scale_last=0.01),
-            "cont": _mlp_init(ks[7], (self.feat_dim, *h, 1)),
+            "cont": _mlp_init(ks[7], (self.feat_dim + n_act, *h, 1)),
             "actor": _mlp_init(ks[8], (self.feat_dim, *h, self.n_actions),
                                scale_last=0.01),
             "critic": _mlp_init(ks[9], (self.feat_dim, *h, 1),
@@ -183,7 +200,13 @@ class DreamerModule:
         def step(carry, k_t):
             h, z = carry
             feat = jnp.concatenate([h, z], -1)
-            logits = _mlp_apply(params["actor"], feat, jax.nn.silu)
+            # The actor sees sg(feat): gradients reach it ONLY through
+            # the reinforce term — letting them flow through the
+            # imagined dynamics (ST latents + GRU) adds an uncontrolled
+            # pathwise term that dominates and collapses the policy
+            # (V3: actor/critic heads consume stop_gradient features).
+            logits = self.policy_log_probs(
+                params, jax.lax.stop_gradient(feat))
             k_a, k_z = jax.random.split(k_t)
             act = jax.nn.one_hot(
                 jax.random.categorical(k_a, logits), self.n_actions)
@@ -200,17 +223,26 @@ class DreamerModule:
     def decode(self, params, feat):
         return _mlp_apply(params["dec"], feat, jax.nn.silu)
 
-    def reward(self, params, feat):
-        return _mlp_apply(params["rew"], feat, jax.nn.silu)[..., 0]
+    def reward(self, params, feat, act):
+        x = jnp.concatenate([feat, act], -1)
+        return _mlp_apply(params["rew"], x, jax.nn.silu)[..., 0]
 
-    def cont(self, params, feat):
-        return _mlp_apply(params["cont"], feat, jax.nn.silu)[..., 0]
+    def cont(self, params, feat, act):
+        x = jnp.concatenate([feat, act], -1)
+        return _mlp_apply(params["cont"], x, jax.nn.silu)[..., 0]
 
     def value(self, params, feat):
         return _mlp_apply(params["critic"], feat, jax.nn.silu)[..., 0]
 
     def policy_logits(self, params, feat):
         return _mlp_apply(params["actor"], feat, jax.nn.silu)
+
+    def policy_log_probs(self, params, feat):
+        """V3 unimix: 99% policy + 1% uniform — exploration (and the
+        reinforce gradient's counterfactuals) can never fully die."""
+        logits = self.policy_logits(params, feat)
+        probs = 0.99 * jax.nn.softmax(logits) + 0.01 / self.n_actions
+        return jnp.log(probs)
 
 
 class DreamerLearner:
@@ -265,11 +297,11 @@ class DreamerLearner:
             params, batch["obs"], acts, batch["is_first"], key)
         recon = m.decode(params, feats)
         l_dec = ((recon - symlog(batch["obs"])) ** 2).mean()
-        l_rew = ((m.reward(params, feats)
+        l_rew = ((m.reward(params, feats, acts)
                   - symlog(batch["rewards"])) ** 2).mean()
         cont_target = 1.0 - batch["dones"].astype(jnp.float32)
         l_cont = optax.sigmoid_binary_cross_entropy(
-            m.cont(params, feats), cont_target).mean()
+            m.cont(params, feats, acts), cont_target).mean()
         l_dyn = m._kl(jax.lax.stop_gradient(posts), priors).mean()
         l_rep = m._kl(posts, jax.lax.stop_gradient(priors)).mean()
         loss = (l_dec + l_rew + l_cont
@@ -295,8 +327,8 @@ class DreamerLearner:
         def actor_loss(actor):
             p = {**params_im, "actor": actor}
             feats, acts, logits = m.imagine(p, h0, z0, self.horizon, k_im)
-            rew = symexp(m.reward(p, feats))
-            cont = jax.nn.sigmoid(m.cont(p, feats))
+            rew = symexp(m.reward(p, feats, acts))
+            cont = jax.nn.sigmoid(m.cont(p, feats, acts))
             disc = self.gamma * cont
             val = m.value(p, feats)
 
@@ -314,10 +346,15 @@ class DreamerLearner:
             rets = rets[::-1]  # [H-1, N]
             feats_h = feats[:-1]
             val_h = val[:-1]
-            scale = jnp.maximum(
-                1.0, jax.lax.stop_gradient(jnp.abs(rets).max()))
+            # V3 return normalizer: the 5th-95th percentile RANGE of the
+            # return batch (max-abs over-normalizes — on dense-reward
+            # tasks every return is large but the SPREAD carrying the
+            # learning signal is small, and the entropy bonus then
+            # dominates a crushed advantage).
+            lo, hi = jnp.percentile(rets, jnp.asarray([5.0, 95.0]))
+            scale = jnp.maximum(1.0, jax.lax.stop_gradient(hi - lo))
             adv = jax.lax.stop_gradient((rets - val_h) / scale)
-            lp = jax.nn.log_softmax(logits[:-1])
+            lp = logits[:-1]  # already unimix log-probs
             act_lp = (lp * acts[:-1]).sum(-1)
             ent = -(jnp.exp(lp) * lp).sum(-1).mean()
             # Trajectory weights: product of continues up to t.
@@ -374,7 +411,7 @@ class DreamerLearner:
             z = m._sample_latent(
                 _mlp_apply(params["post"], post_in, jax.nn.silu), k_z)
             feat = jnp.concatenate([h, z], -1)
-            logits = m.policy_logits(params, feat)
+            logits = m.policy_log_probs(params, feat)
             act = jax.random.categorical(k_a, logits)
             return h, z, act
 
