@@ -36,6 +36,14 @@ _PROBE_SRC = (
     "sum(1 for d in jax.devices() if d.platform != 'cpu'), flush=True)\n"
 )
 
+
+def _probe_src() -> str:
+    """The probe child's source. RT_BACKEND_PROBE_SRC overrides it —
+    tests use this to simulate a WEDGED tunnel deterministically (a
+    blackhole POOL_IPS stops wedging the moment the plugin prefers a
+    healthy local tunnel); production code never sets it."""
+    return os.environ.get("RT_BACKEND_PROBE_SRC") or _PROBE_SRC
+
 # Per-process cached device count. Repeated init() calls in one process
 # must not pay the subprocess again (and after a failure we have already
 # pinned jax to CPU, so re-probing could not help this process).
@@ -150,7 +158,7 @@ def _device_count(timeout_s: float | None = None) -> int:
     if timeout_s is None:
         timeout_s = probe_timeout_s()
     proc = subprocess.Popen(
-        [sys.executable, "-c", _PROBE_SRC],
+        [sys.executable, "-c", _probe_src()],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         start_new_session=True, text=True,
     )

@@ -1567,7 +1567,7 @@ class NodeService:
                 # immediately so method calls submitted right after
                 # creation queue behind the in-flight construction
                 # instead of failing as "unknown actor".
-                self.spawn(self._create_actor_remotely(spec))
+                self._create_actor_remotely(spec)
             else:
                 self.spawn(self._execute_remotely(
                     spec, pin_node=NodeID(strat.node_id)))
@@ -1588,7 +1588,7 @@ class NodeService:
                                and self._lacks_lifetime_room(spec.resources)))
         if needs_placement and self.head is not None:
             if spec.is_actor_creation:
-                self.spawn(self._create_actor_remotely(spec))
+                self._create_actor_remotely(spec)
             else:
                 self.spawn(self._execute_remotely(spec))
             return
@@ -2378,14 +2378,18 @@ class NodeService:
         self._event(spec, "FINISHED")
 
     # -- remote actors (owner side) -------------------------------------
-    async def _create_actor_remotely(self, spec: TaskSpec):
-        """Place an actor whose resources this node can't satisfy."""
+    def _create_actor_remotely(self, spec: TaskSpec):
+        """Place an actor whose resources this node can't satisfy.
+        The RemoteActorEntry registers SYNCHRONOUSLY (submission is
+        fire-and-forget: the creating client's very next call_soon may
+        be a method call, which must queue on the entry rather than
+        fall into the unknown-actor path); placement runs async."""
         entry = RemoteActorEntry(
             actor_id=spec.actor_id, node_id=NodeID.nil(), address=(),
             creation_spec=spec, state="RESTARTING",
             ready=asyncio.Event())
         self.remote_actors[spec.actor_id] = entry
-        await self._place_remote_actor(entry, first=True)
+        self.spawn(self._place_remote_actor(entry, first=True))
 
     async def _place_remote_actor(self, entry: RemoteActorEntry,
                                   first: bool = False,
@@ -2444,12 +2448,32 @@ class NodeService:
                     if self._closing:
                         return
                     continue
+            if entry.state == "DEAD":
+                # Killed mid-placement (kill_actor_anywhere marked the
+                # entry while we awaited the head): placing now would
+                # RESURRECT the actor and leak its lifetime resources.
+                if entry.ready is not None:
+                    entry.ready.set()  # release any parked pump
+                return
             target = NodeID(placed["node_id"])
             if target == self.node_id:
                 # Became feasible locally (e.g. the blocking resource was
-                # freed): fall back to the local actor path.
+                # freed): fall back to the local actor path — and HAND
+                # OVER the method calls already queued on the remote
+                # entry (they'd be silently dropped otherwise; the
+                # local placeholder from _enqueue_local queues them
+                # behind the in-flight construction).
                 del self.remote_actors[entry.actor_id]
                 self._enqueue_local(spec)
+                for queued in entry.queue:
+                    self._submit_actor_task(queued)
+                entry.queue.clear()
+                # A pump parked on ready.wait() must drain and exit
+                # (its queue is empty now); DEAD + set() releases it.
+                entry.state = "DEAD"
+                entry.death_cause = "moved to the local actor path"
+                if entry.ready is not None:
+                    entry.ready.set()
                 return
             try:
                 conn = await self._peer_conn(target, placed["address"])
@@ -2474,6 +2498,17 @@ class NodeService:
                 self._fail_task(spec, err if isinstance(err, TaskError)
                                 else ActorDiedError(str(err)))
                 self._fail_remote_actor_queue(entry)
+                return
+            if entry.state == "DEAD":
+                # Killed while the remote creation ran: don't overwrite
+                # DEAD with ALIVE — kill the freshly-created instance
+                # on its node instead.
+                try:
+                    await conn.notify("kill_actor", entry.actor_id.binary())
+                except (ConnectionLost, RpcTimeout, OSError):
+                    pass
+                if entry.ready is not None:
+                    entry.ready.set()
                 return
             entry.node_id = target
             entry.address = tuple(placed["address"])

@@ -511,14 +511,30 @@ class DreamerV3(Algorithm):
         tr = runner.rollout_transitions(cfg.rollout_fragment_length,
                                         policy)
         n = len(tr["rewards"])
-        is_first = np.zeros(n, np.float32)
-        is_first[0] = 1.0
-        # dones within the fragment start new episodes at the NEXT step.
-        is_first[1:] = tr["dones"][:-1].astype(np.float32)
-        self.replay.add_fragment(
-            obs=tr["obs"].astype(np.float32), actions=tr["actions"],
-            rewards=tr["rewards"].astype(np.float32),
-            dones=tr["dones"], is_first=is_first)
+        # rollout_transitions is STEP-MAJOR flat ([t0e0..t0eN, t1e0..]):
+        # de-interleave into one time-contiguous fragment PER ENV, or
+        # every replay window would mix rotating envs step to step and
+        # the world model would train on garbage dynamics.
+        num_envs = runner.vec.num_envs
+        T = n // num_envs
+
+        def tn(x):
+            x = np.asarray(x)
+            return x.reshape((T, num_envs) + x.shape[1:])
+
+        obs_tn, act_tn = tn(tr["obs"]), tn(tr["actions"])
+        rew_tn, done_tn = tn(tr["rewards"]), tn(tr["dones"])
+        for e in range(num_envs):
+            dones_e = done_tn[:, e]
+            is_first = np.zeros(T, np.float32)
+            is_first[0] = 1.0
+            # dones start new episodes at the NEXT step.
+            is_first[1:] = dones_e[:-1].astype(np.float32)
+            self.replay.add_fragment(
+                obs=obs_tn[:, e].astype(np.float32),
+                actions=act_tn[:, e],
+                rewards=rew_tn[:, e].astype(np.float32),
+                dones=dones_e, is_first=is_first)
         self._env_steps += n
         self._record_episodes(runner.episode_returns())
 

@@ -41,10 +41,12 @@ def test_init_completes_on_wedged_tunnel():
     env.pop("XLA_FLAGS", None)
     env.update({
         "JAX_PLATFORMS": "axon",
-        # TEST-NET-3 (RFC 5737): never routable. Whether the tunnel dial
-        # hangs (-> probe timeout) or errors fast (-> probe failure),
-        # init must fall back to CPU quickly.
-        "PALLAS_AXON_POOL_IPS": "203.0.113.1",
+        # Deterministic wedge: override the probe child's source with
+        # an infinite sleep (a blackhole POOL_IPS stopped wedging once
+        # the plugin preferred a HEALTHY local tunnel over the env).
+        # The contract under test is ours: probe timeout -> CPU
+        # fallback. Production never sets RT_BACKEND_PROBE_SRC.
+        "RT_BACKEND_PROBE_SRC": "import time; time.sleep(3600)",
         "RT_BACKEND_PROBE_TIMEOUT_S": "5",
     })
     t0 = time.time()

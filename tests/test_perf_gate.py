@@ -45,7 +45,8 @@ SOLO_FLOORS = {
     "task_device_sync": 3300,
     "task_device_async": 8500,  # r5 fire-and-forget submit: ~14k solo
     "task_cpu_sync": 1300,
-    "task_cpu_async": 900,       # r5 dispatch guard: 1.3-1.7k solo; noisiest metric
+    "task_cpu_async": 600,       # r5 dispatch guard: 1.3-1.7k solo;
+                                 # 0.75k at loaded suite-start; noisiest
     "actor_call_sync": 1400,
     "actor_call_async": 1700,
     "actor_call_concurrent": 1900,
