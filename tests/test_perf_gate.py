@@ -45,8 +45,11 @@ SOLO_FLOORS = {
     "task_device_sync": 3300,
     "task_device_async": 8500,  # r5 fire-and-forget submit: ~14k solo
     "task_cpu_sync": 1300,
-    "task_cpu_async": 600,       # r5 dispatch guard: 1.3-1.7k solo;
-                                 # 0.75k at loaded suite-start; noisiest
+    # task_cpu_async is deliberately ABSENT: recorded 1.3-1.7k solo but
+    # 0.42-0.75k at pytest-session start with calibration ~1.0 — a 4x
+    # context swing the pure-CPU unit cannot normalize (worker-pool
+    # paging/fork effects). Its machinery is covered by task_cpu_sync
+    # here and by the loaded-context crash net in test_microbench.py.
     "actor_call_sync": 1400,
     "actor_call_async": 1700,
     "actor_call_concurrent": 1900,
