@@ -32,7 +32,8 @@ class _Stage:
     def __init__(self, kind: str, fn: Callable | None = None,
                  batch_size: Optional[int] = None,
                  pool: int = 0, ctor_args: tuple = (),
-                 ctor_kwargs: dict | None = None):
+                 ctor_kwargs: dict | None = None,
+                 batch_format: str = "numpy"):
         # pool: actor_map -> pool size (>=1); other kinds -> requested
         # task concurrency, 0 = unspecified (DataContext default).
         self.kind = kind  # map_rows | map_batches | filter | flat_map |
@@ -42,20 +43,49 @@ class _Stage:
         self.pool = pool
         self.ctor_args = ctor_args
         self.ctor_kwargs = ctor_kwargs or {}
+        self.batch_format = batch_format
+
+
+def _format_batch(blk: B.Block, batch_format: str):
+    """Block -> the user-facing batch type (reference: batch_format in
+    map_batches/iter_batches — "numpy" | "pandas" | "pyarrow")."""
+    if batch_format == "numpy":
+        return blk
+    if batch_format == "pandas":
+        import pandas as pd
+
+        return pd.DataFrame({k: (list(v) if getattr(v, "ndim", 1) > 1
+                                 else v) for k, v in blk.items()})
+    if batch_format == "pyarrow":
+        return B.block_to_arrow(blk)
+    raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
+def _unformat_batch(out) -> B.Block:
+    """User batch output (dict | DataFrame | arrow Table) -> Block."""
+    if isinstance(out, dict):
+        return {k: np.asarray(v) for k, v in out.items()}
+    mod = type(out).__module__
+    if mod.startswith("pandas"):
+        return {k: np.asarray(out[k].tolist())
+                if out[k].dtype == object else out[k].to_numpy()
+                for k in out.columns}
+    if mod.startswith("pyarrow"):
+        return B.arrow_to_block(out)
+    raise TypeError(
+        "map_batches fn must return a dict of arrays, a pandas "
+        f"DataFrame or a pyarrow Table, got {type(out).__name__}")
 
 
 def _apply_batched(fn: Callable, blk: B.Block,
-                   batch_size: Optional[int]) -> B.Block:
+                   batch_size: Optional[int],
+                   batch_format: str = "numpy") -> B.Block:
     """Apply a batch fn to a block in batch_size chunks (shared by fused
     task-pool stages and actor-pool stages)."""
 
     def one(chunk):
-        out = fn(chunk)
-        if not isinstance(out, dict):
-            raise TypeError(
-                "map_batches fn must return a dict of numpy arrays, "
-                f"got {type(out).__name__}")
-        return {k: np.asarray(v) for k, v in out.items()}
+        out = fn(_format_batch(chunk, batch_format))
+        return _unformat_batch(out)
 
     n = B.block_len(blk)
     if batch_size is None or n <= batch_size:
@@ -69,7 +99,8 @@ def _fuse(stages: list[_Stage]) -> Callable[[B.Block], B.Block]:
     """Compose stages into one Block -> Block function (operator fusion)."""
 
     def apply_map_batches(st: _Stage, blk: B.Block) -> B.Block:
-        return _apply_batched(st.fn, blk, st.batch_size)
+        return _apply_batched(st.fn, blk, st.batch_size,
+                              getattr(st, "batch_format", "numpy"))
 
     def apply(blk: B.Block) -> B.Block:
         for st in stages:
@@ -263,14 +294,16 @@ class _ActorMapWrapper:
     callable class once (expensive setup amortized over all blocks sent
     to this pool member) and applies it batch-wise to each block."""
 
-    def __init__(self, cls, ctor_args, ctor_kwargs, batch_size):
+    def __init__(self, cls, ctor_args, ctor_kwargs, batch_size,
+                 batch_format="numpy"):
         self._fn = cls(*ctor_args, **ctor_kwargs)
         self._bs = batch_size
+        self._bf = batch_format
 
     def apply(self, blk):
         if not B.block_len(blk):
             return {}
-        return _apply_batched(self._fn, blk, self._bs)
+        return _apply_batched(self._fn, blk, self._bs, self._bf)
 
 
 class Dataset:
@@ -319,25 +352,33 @@ class Dataset:
         return self._with(_Stage("map_rows", fn))
 
     def map_batches(self, fn, *, batch_size: Optional[int] = None,
+                    batch_format: str = "numpy",
                     concurrency: Optional[int] = None,
                     fn_constructor_args: tuple = (),
                     fn_constructor_kwargs: Optional[dict] = None) -> "Dataset":
         """Batch transform. A CLASS ``fn`` runs on an actor pool of
         ``concurrency`` members — setup (model weights etc.) paid once
         per actor, not per batch (reference: ActorPoolMapOperator via
-        map_batches(Cls, concurrency=N))."""
+        map_batches(Cls, concurrency=N)). ``batch_format``:
+        "numpy" (dict of arrays) | "pandas" (DataFrame) | "pyarrow"
+        (Table) — the fn receives that type and may return any of the
+        three (reference: map_batches batch_format)."""
+        if batch_format not in ("numpy", "pandas", "pyarrow"):
+            raise ValueError(f"unknown batch_format {batch_format!r}")
         if isinstance(fn, type):
             return self._with(_Stage(
                 "actor_map", fn, batch_size, pool=concurrency or 1,
                 ctor_args=fn_constructor_args,
-                ctor_kwargs=fn_constructor_kwargs))
+                ctor_kwargs=fn_constructor_kwargs,
+                batch_format=batch_format))
         if fn_constructor_args or fn_constructor_kwargs:
             raise ValueError(
                 "fn_constructor_args requires a class-based fn")
         # For plain fns, concurrency bounds the task pool of the fused
         # operator this stage lands in (reference honors it for both).
         return self._with(_Stage("map_batches", fn, batch_size,
-                                 pool=concurrency or 0))
+                                 pool=concurrency or 0,
+                                 batch_format=batch_format))
 
     def filter(self, fn) -> "Dataset":
         return self._with(_Stage("filter", fn))
@@ -592,7 +633,8 @@ class Dataset:
                 specs.append(ActorPoolSpec(
                     _ActorMapWrapper, st.pool, _remote_opts(),
                     ctor_args=(st.fn, st.ctor_args, st.ctor_kwargs,
-                               st.batch_size),
+                               st.batch_size,
+                               getattr(st, "batch_format", "numpy")),
                     name=f"ActorMap({getattr(st.fn, '__name__', '?')}"
                          f"x{st.pool})"))
         return source, specs
@@ -656,16 +698,20 @@ class Dataset:
     def iter_batches(self, *, batch_size: int = 256, batch_format: str = "numpy",
                      sharding=None, drop_last: bool = False,
                      dtypes=None) -> Iterator[Any]:
-        """Re-batched iteration. batch_format: "numpy" | "rows" | "jax".
-        With ``sharding`` (a jax.sharding.Sharding), batches are device_put
-        — the TPU ingest path (batch dim must divide the data axes)."""
-        if batch_format == "rows" and (sharding is not None or dtypes):
+        """Re-batched iteration. batch_format: "numpy" | "rows" |
+        "jax" | "pandas" | "pyarrow". With ``sharding`` (a
+        jax.sharding.Sharding), batches are device_put — the TPU ingest
+        path (batch dim must divide the data axes)."""
+        if batch_format in ("rows", "pandas", "pyarrow") and (
+                sharding is not None or dtypes):
             raise ValueError(
                 "sharding/dtypes only apply to batch_format='numpy'|'jax'")
 
         def emit(blk: B.Block):
             if batch_format == "rows":
                 return list(B.block_to_rows(blk))
+            if batch_format in ("pandas", "pyarrow"):
+                return _format_batch(blk, batch_format)
             if dtypes:
                 blk = {k: v.astype(dtypes.get(k, v.dtype))
                        for k, v in blk.items()}

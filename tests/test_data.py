@@ -168,3 +168,37 @@ def test_train_ingest_integration(tmp_path):
     ).fit()
     assert result.error is None
     assert result.metrics["rows"] == 64
+
+
+def test_map_batches_pandas_and_pyarrow_formats(rt):
+    """batch_format="pandas"/"pyarrow": the fn receives that type and
+    may return any supported type (reference: map_batches
+    batch_format)."""
+    import pandas as pd
+    import pyarrow as pa
+
+    ds = rd.range_(100, override_num_blocks=4)
+
+    def via_pandas(df):
+        assert isinstance(df, pd.DataFrame)
+        df = df.assign(double=df["id"] * 2)
+        return df  # DataFrame out
+
+    def via_arrow(t):
+        assert isinstance(t, pa.Table)
+        return t.append_column("plus1", pa.array(
+            [v.as_py() + 1 for v in t.column("id")]))
+
+    out = (ds.map_batches(via_pandas, batch_format="pandas")
+             .map_batches(via_arrow, batch_format="pyarrow")
+             .take_all())
+    assert len(out) == 100
+    assert out[3]["double"] == 6 and out[3]["plus1"] == 4
+
+    # iter_batches in both formats.
+    dfs = list(ds.iter_batches(batch_size=25, batch_format="pandas"))
+    assert all(isinstance(d, pd.DataFrame) for d in dfs)
+    assert sum(len(d) for d in dfs) == 100
+    tables = list(ds.iter_batches(batch_size=50, batch_format="pyarrow"))
+    assert all(isinstance(t, pa.Table) for t in tables)
+    assert sum(t.num_rows for t in tables) == 100
