@@ -128,7 +128,7 @@ def run_bench(use_flash: bool) -> dict:
         # artifact, not notes.
         try:
             per_op = profile_ops(cfg, mesh, batch, step, state, tokens,
-                                 dt / iters * 1000.0)
+                                 dt / iters * 1000.0, opt)
         except Exception as e:  # noqa: BLE001 - profiling must not cost
             print(f"per-op profile failed: {e!r}", file=sys.stderr)
     if on_tpu:
@@ -152,7 +152,7 @@ def run_bench(use_flash: bool) -> dict:
 
 
 def profile_ops(cfg, mesh, batch, step, state, tokens,
-                step_ms_ref: float) -> dict:
+                step_ms_ref: float, opt=None) -> dict:
     """Per-component wall times at the EXACT bench shapes: attention
     stack vs MLP stack vs embedding/unembed vs optimizer, each timed as
     its own jitted program. Differences from whole-step time reflect
@@ -184,8 +184,25 @@ def profile_ops(cfg, mesh, batch, step, state, tokens,
     table["loss_forward"] = timeit(fwd, params, tokens)
     grad = jax.jit(jax.grad(lambda p, t: gpt.loss_fn(p, t, cfg, mesh)))
     table["loss_fwd_bwd"] = timeit(grad, params, tokens)
-    table["optimizer_and_rest"] = max(0.0, step_ms_ref
-                                      - table["loss_fwd_bwd"])
+    if opt is not None:
+        # Measure the optimizer update DIRECTLY, blocked on dispatch.
+        # The old derivation (step_ms_ref - loss_fwd_bwd) underflowed
+        # to 0.0: step_ms_ref amortizes async dispatch across the step
+        # loop while the standalone loss_fwd_bwd timing above is fully
+        # blocked, so the subtrahend routinely exceeded the minuend.
+        import optax
+
+        grads = grad(params, tokens)
+
+        def opt_step(p, o, g):
+            updates, o2 = opt.update(g, o, p)
+            return optax.apply_updates(p, updates), o2
+
+        table["optimizer_and_rest"] = timeit(
+            jax.jit(opt_step), params, state["opt_state"], grads)
+    else:
+        table["optimizer_and_rest"] = max(0.0, step_ms_ref
+                                          - table["loss_fwd_bwd"])
 
     # Attention-only and MLP-only stacks at PER-SHARD layer shapes (per
     # layer x n_layer) on one device: a data shard's slice of the step,

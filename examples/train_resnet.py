@@ -1,9 +1,6 @@
 """ResNet/CIFAR data-parallel training with JaxTrainer (north star #1).
 
 Run:  python examples/train_resnet.py [--steps 30]
-
-Measured on one v5e chip: ResNet-20, batch 512 -> ~59,000 images/s
-(8.6ms/step).
 """
 
 import argparse

@@ -12,6 +12,7 @@ pay no serialization — the building block for Learner/Trainer gangs.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Optional
 
 from . import context as context_mod
@@ -36,9 +37,10 @@ class ActorMethod:
         ctx = context_mod.require_context()
         enc_args, enc_kwargs, nested_refs = encode_args(
             args, kwargs, h._is_device)
+        name = f"{h._class_name}.{self._method_name}"
         spec = TaskSpec(
             task_id=TaskID.for_actor_task(h._actor_id),
-            name=f"{h._class_name}.{self._method_name}",
+            name=name,
             func_id="",
             args=enc_args,
             kwargs=enc_kwargs,
@@ -48,8 +50,20 @@ class ActorMethod:
             actor_id=h._actor_id,
             method_name=self._method_name,
             nested_refs=nested_refs or None,
+            created_ts=time.time(),
         )
-        refs = ctx.submit_spec(spec)
+        from ray_tpu.util import tracing
+
+        # Same submit-span protocol as RemoteFunction.remote: actor calls
+        # carry trace context too, so driver→actor→subtask parentage
+        # survives the hop (reference: tracing_helper wraps actor method
+        # invocations the same as plain tasks).
+        if tracing.should_trace():
+            with tracing.span(f"task::{name}::submit") as sp:
+                spec.trace_ctx = sp.context()
+                refs = ctx.submit_spec(spec)
+        else:
+            refs = ctx.submit_spec(spec)
         return refs[0] if self._num_returns == 1 else refs
 
     def __call__(self, *a, **k):
@@ -222,8 +236,16 @@ class ActorClass:
             runtime_env=ctx.resolve_runtime_env(self._runtime_env,
                                                 device_lane=device),
             nested_refs=nested_refs or None,
+            created_ts=time.time(),
         )
-        refs = ctx.submit_spec(spec)
+        from ray_tpu.util import tracing
+
+        if tracing.should_trace():
+            with tracing.span(f"task::{spec.name}::submit") as sp:
+                spec.trace_ctx = sp.context()
+                refs = ctx.submit_spec(spec)
+        else:
+            refs = ctx.submit_spec(spec)
         return ActorHandle(actor_id, method_names, self._class_name, device,
                            creation_ref=refs[0])
 

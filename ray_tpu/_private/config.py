@@ -127,6 +127,10 @@ class Config:
     # --- metrics / events ---
     metrics_export_interval_s: float = _cfg(5.0)
     task_events_buffer_size: int = _cfg(100_000)
+    # Worker-side task-lifecycle event ring (args-fetched /
+    # output-serialized transitions), drained to the node on the 1s
+    # flusher plane. Bounded so a stalled node can't balloon a worker.
+    task_events_worker_ring_size: int = _cfg(10_000)
 
     # --- tpu ---
     tpu_chips_per_host: int = _cfg(0)  # 0 = autodetect

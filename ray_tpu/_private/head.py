@@ -166,6 +166,15 @@ class HeadService:
         self._pg_retry_task = None
         self._pg_retry_dirty = False
         self._pg_retry_last = 0.0
+        # Scheduling-decision counters for the task-lifecycle plane: how
+        # many placements the head made, how many demands were infeasible
+        # (task parked), how many spillback probes found nowhere better
+        # (normal on a lone busy node — NOT a health signal), and
+        # cumulative in-head decision time — the head-side half of the
+        # per-task "schedule" phase (the node measures the full RTT it
+        # observed).
+        self.sched_stats = {"decisions": 0, "infeasible": 0,
+                            "spill_miss": 0, "decision_s": 0.0}
         self._replay()
         self.server = DuplexServer(
             (self.cfg.head_host, port), self._handle_rpc, self._on_disconnect)
@@ -565,6 +574,7 @@ class HeadService:
         demands additionally tie-break BEST-FIT on remaining device
         capacity, steering gang members onto the least-fragmented TPU
         hosts (reference: scorer.h NodeScorer, least-resource)."""
+        t0 = time.perf_counter()
         exclude = exclude or set()
         candidates = [e for e in self.nodes.values()
                       if e.node_id not in exclude
@@ -573,6 +583,13 @@ class HeadService:
             candidates = [e for e in candidates
                           if self._labels_all(e.labels, labels_hard)]
         if not candidates:
+            # A spillback probe excludes its own node, so an empty
+            # candidate set is the EXPECTED answer on a lone busy node —
+            # count it apart from genuinely infeasible demands.
+            key = ("spill_miss" if strategy_kind == "spill"
+                   else "infeasible")
+            self.sched_stats[key] += 1
+            self.sched_stats["decision_s"] += time.perf_counter() - t0
             return None
         with_room = [e for e in candidates
                      if self._has_available(e, resources)]
@@ -620,6 +637,8 @@ class HeadService:
         for k, v in resources.items():
             if v:
                 chosen.available[k] = chosen.available.get(k, 0) - v
+        self.sched_stats["decisions"] += 1
+        self.sched_stats["decision_s"] += time.perf_counter() - t0
         return chosen.node_id
 
     def node_address(self, node_id: NodeID) -> Optional[tuple]:
@@ -915,6 +934,8 @@ class HeadService:
         if method == "node_address":
             addr = self.node_address(NodeID(payload))
             return addr
+        if method == "sched_stats":
+            return dict(self.sched_stats)
         if method == "pubsub_sub":
             return self.pubsub_sub(payload["channel"],
                                    NodeID(payload["node_id"]))
@@ -1066,6 +1087,9 @@ class LocalHeadClient:
     async def list_nodes(self):
         return [e.to_row() for e in self.head.nodes.values()]
 
+    async def sched_stats(self):
+        return dict(self.head.sched_stats)
+
     async def create_pg(self, pg_id, bundles, strategy):
         pg = await self.head.create_placement_group(pg_id, bundles, strategy)
         return {"state": pg.state}
@@ -1180,6 +1204,9 @@ class RemoteHeadClient:
 
     async def list_nodes(self):
         return await self._read("list_nodes", None)
+
+    async def sched_stats(self):
+        return await self._read("sched_stats", None)
 
     async def create_pg(self, pg_id, bundles, strategy):
         return await self.conn.call(

@@ -17,9 +17,20 @@ snapshot+append-log copy of the head's tables:
     seq wins), rebuilding the local store, then resuming as usual —
     the same replay contract as a local restart.
 
-Durability window: replication is asynchronous (acknowledged mutations
-may lag replicas by in-flight frames, like Redis async replication);
-the local fsync'd log remains the primary record.
+Durability window: replication is ASYNCHRONOUS (like Redis async
+replication). ``append``/``save`` return once the LOCAL fsync'd log has
+the mutation; the replica frame is only enqueued. Losing the head
+PROCESS loses nothing (the local log replays). Losing the head NODE —
+process and disk — between a mutation's local fsync and the replica's
+receipt loses that mutation's tail from the surviving copies. The
+un-acked tail is bounded: at most ``REPLICA_QUEUE_MAX`` frames per
+replica sit in the outbound queue (older overflow frames are dropped
+and covered by the snapshot-on-reconnect resync, which re-ships the
+whole local store — so a drop widens only the NODE-loss window, never
+the recovery path while the head's disk survives). Callers needing a
+synchronous-replication guarantee must wait for the replica's applied
+seq to catch up (as the tests do) before treating a mutation as
+node-loss durable.
 """
 
 from __future__ import annotations
@@ -31,6 +42,14 @@ import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 from .head_store import AppendLogHeadStore, HeadStore
+
+# Bound on the asynchronous-replication window (see module docstring):
+# at most this many un-acked frames per replica; an enqueue beyond it is
+# dropped (snapshot-on-reconnect resync covers the gap).
+REPLICA_QUEUE_MAX = 10_000
+# With a replica DOWN mid-send, retry only while the backlog is shallow;
+# past this depth the failed frame is dropped in favor of the resync.
+REPLICA_RETRY_QSIZE = 1_000
 
 
 def parse_replica_addrs(raw: Optional[str]) -> List[Tuple[str, int]]:
@@ -125,7 +144,7 @@ class ReplicatedHeadStore(HeadStore):
         asyncio.set_event_loop(self._loop)
         self._sender_tasks = []
         for addr in self.replicas:
-            self._queues[addr] = asyncio.Queue(maxsize=10_000)
+            self._queues[addr] = asyncio.Queue(maxsize=REPLICA_QUEUE_MAX)
             self._sender_tasks.append(
                 self._loop.create_task(self._sender(addr)))
         self._loop.run_forever()
@@ -167,7 +186,7 @@ class ReplicatedHeadStore(HeadStore):
                     self._conns.pop(addr, None)
                     # Drop THIS frame only if the queue is backing up —
                     # the snapshot-on-reconnect resync covers the gap.
-                    if q.qsize() > 1000:
+                    if q.qsize() > REPLICA_RETRY_QSIZE:
                         break
                     await asyncio.sleep(1.0)
 

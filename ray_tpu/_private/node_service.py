@@ -405,6 +405,14 @@ class NodeService:
         # and timeline read these).
         self.task_table: "collections.OrderedDict[str, dict]" = \
             collections.OrderedDict()
+        # ray_tpu_task_phase_seconds{phase=...} — created lazily on the
+        # first completed task so importing the node doesn't register
+        # metrics in processes that never run one. Tag tuples are
+        # normalized once per phase name (hot path: every finished task
+        # observes 4-5 phases).
+        self._phase_hist = None
+        self._phase_tag_cache: dict = {}
+        self._node_hex = self.node_id.hex()
 
     async def start(self):
         await self.server.start()
@@ -439,14 +447,21 @@ class NodeService:
     # events / state API, python/ray/util/state/api.py,
     # gcs_task_manager.h:85)
     # ------------------------------------------------------------------
-    def _event(self, spec, state: str, worker: str | None = None):
+    def _event(self, spec, state: str, worker: str | None = None,
+               phases: dict | None = None):
+        """Record one task state-transition event and upsert the task's
+        latest-state row. ``phases`` carries per-phase durations in
+        seconds (queue/schedule at RUNNING, the worker-reported
+        arg_fetch/execute/output_serialize merged in at FINISHED)."""
         tid = spec.task_id.hex()
         ev = {"task_id": tid, "name": spec.name, "state": state,
-              "ts": time.time(), "node_id": self.node_id.hex()}
+              "ts": time.time(), "node_id": self._node_hex}
         if worker is not None:
             ev["worker"] = worker
         if spec.actor_id is not None:
             ev["actor_id"] = spec.actor_id.hex()
+        if phases:
+            ev["phases"] = dict(phases)
         self.task_events.append(ev)
         row = self.task_table.get(tid)
         if row is None:
@@ -454,6 +469,8 @@ class NodeService:
                    "node_id": ev["node_id"],
                    "actor_id": ev.get("actor_id"),
                    "submitted_ts": ev["ts"]}
+            if spec.created_ts:
+                row["created_ts"] = spec.created_ts
             self.task_table[tid] = row
             # Evict the oldest TERMINAL row first — a long-running task's
             # live row must not be dropped (and later resurrected with a
@@ -475,12 +492,77 @@ class NodeService:
             row["worker"] = worker
         if state == "RUNNING":
             row["start_ts"] = ev["ts"]
+            # A retried attempt starts its phase ledger over — stale
+            # worker-side durations from the failed attempt would
+            # double-count in the per-phase summary.
+            row["phases"] = dict(phases) if phases else {}
+        elif phases:
+            row.setdefault("phases", {}).update(phases)
         if state in ("FINISHED", "FAILED"):
             row["end_ts"] = ev["ts"]
         else:
             # Re-execution (retry/reconstruction): a stale end_ts older
             # than the new start_ts would make an in-flight task look done.
             row.pop("end_ts", None)
+
+    # Sub-millisecond buckets on top of the defaults: scheduling phases
+    # sit at ~100µs on the cpu lane, which the 1ms default floor would
+    # flatten into one bucket.
+    _PHASE_BOUNDARIES = [0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05,
+                         0.1, 0.5, 1.0, 5.0, 10.0, 60.0]
+
+    def _dispatch_phases(self, spec) -> dict:
+        """queue/schedule durations for a spec at the moment it is
+        handed a worker (or the device pool). queue = pending-queue
+        wait (deps + capacity); schedule = routing decision from submit
+        to enqueue, plus any head placement round-trip the owner
+        measured (_sched_rtt)."""
+        now = time.monotonic()
+        pend = getattr(spec, "_pending_since", None)
+        sub = getattr(spec, "_submit_mono", None)
+        ph: dict = {}
+        if pend is not None:
+            ph["queue"] = max(0.0, now - pend)
+            if sub is not None:
+                ph["schedule"] = max(0.0, pend - sub)
+        elif sub is not None:
+            ph["queue"] = max(0.0, now - sub)
+        rtt = getattr(spec, "_sched_rtt", None)
+        if rtt is not None:
+            ph["schedule"] = ph.get("schedule", 0.0) + rtt
+        spec._phases = ph
+        return ph
+
+    def _observe_phases(self, phases: dict):
+        """Feed completed-task phase durations into the
+        ray_tpu_task_phase_seconds histogram (this process's registry —
+        _metrics_rows exports it, so Prometheus/`rtpu metrics` gets
+        p50/p99 per phase with no extra RPC)."""
+        if not phases:
+            return
+        if self._phase_hist is None:
+            from ray_tpu.util.metrics import Histogram
+
+            self._phase_hist = Histogram(
+                "ray_tpu_task_phase_seconds",
+                "Per-task phase latency: queue, schedule, arg_fetch, "
+                "execute, output_serialize",
+                boundaries=self._PHASE_BOUNDARIES,
+                tag_keys=("phase",))
+        cache = self._phase_tag_cache
+        items = []
+        for phase, dur in phases.items():
+            try:
+                tags = cache.get(phase)
+                if tags is None:
+                    tags = self._phase_hist.normalized_tags(
+                        {"phase": phase})
+                    cache[phase] = tags
+                items.append((tags, max(0.0, float(dur))))
+            except Exception:
+                pass  # a malformed phase must not fail the task
+        if items:
+            self._phase_hist.observe_normalized(items)
 
     def state_snapshot(self, include_events: bool = False,
                        light: bool = False, tables=None) -> dict:
@@ -510,7 +592,14 @@ class NodeService:
         want = (None if tables is None
                 else {t for t in tables})
         full = {
-            "tasks": lambda: [dict(r) for r in self.task_table.values()],
+            # Phase dicts are copied too: the row's ledger keeps mutating
+            # on the loop thread while an in-process reader (driver on
+            # the same host) iterates the snapshot.
+            "tasks": lambda: [
+                ({**r, "phases": dict(r["phases"])} if "phases" in r
+                 else dict(r))
+                for r in self.task_table.values()],
+            "task_events": lambda: list(self.task_events),
             "actors": lambda: [
                 {"actor_id": a.actor_id.hex(),
                  "name": getattr(a.creation_spec, "actor_name", None),
@@ -1538,6 +1627,7 @@ class NodeService:
             self.incref_ref(ObjectID(oid_b),
                             tuple(owner) if owner else None)
         self.counters["tasks_submitted"] += 1
+        spec._submit_mono = time.monotonic()
         self._event(spec, "SUBMITTED")
         self._route(spec)
         return rids
@@ -1921,7 +2011,8 @@ class NodeService:
     async def _run_on_worker(self, worker: WorkerHandle, spec: TaskSpec):
         worker.owner_node = getattr(spec, "_owner_node", None)
         worker.inflight[spec.task_id] = spec
-        self._event(spec, "RUNNING", worker=f"worker:{worker.proc.pid}")
+        self._event(spec, "RUNNING", worker=f"worker:{worker.proc.pid}",
+                    phases=self._dispatch_phases(spec))
         try:
             payload = self._spec_for_ipc(spec)
             reply = await worker.conn.call("execute_task", payload)
@@ -2001,7 +2092,10 @@ class NodeService:
         self._release_deps(spec)
         self.cancelled.discard(spec.task_id)  # cancel raced completion
         self.counters["tasks_finished"] += 1
-        self._event(spec, "FINISHED")
+        phases = dict(getattr(spec, "_phases", None) or {})
+        phases.update(reply.get("phases") or {})
+        self._observe_phases(phases)
+        self._event(spec, "FINISHED", phases=phases or None)
 
     def _release_deps(self, spec: TaskSpec):
         """Unpin task args exactly once, at the task's terminal state."""
@@ -2067,7 +2161,11 @@ class NodeService:
         self._release_deps(spec)
         self.cancelled.discard(spec.task_id)  # terminal: no leak
         self.counters["tasks_failed"] += 1
-        self._event(spec, "FAILED")
+        # Partial ledger (queue/schedule) still attributes where a doomed
+        # task spent its time; failed attempts stay out of the histogram
+        # so latency percentiles describe completed work only.
+        self._event(spec, "FAILED",
+                    phases=getattr(spec, "_phases", None) or None)
 
     # -- device lane ----------------------------------------------------
     def _resolve_args_in_process(self, spec: TaskSpec):
@@ -2090,6 +2188,7 @@ class NodeService:
 
     def _run_on_device(self, spec: TaskSpec, pool: ThreadPoolExecutor | None = None,
                        instance: Any = None, actor: ActorState | None = None):
+        t_args0 = time.perf_counter()
         try:
             args, kwargs = self._resolve_args_in_process(spec)
             fn = None if instance is not None else self._get_callable(spec.func_id)
@@ -2099,6 +2198,7 @@ class NodeService:
         except BaseException as e:  # noqa: BLE001
             self._fail_task(spec, TaskError.from_exception(e, spec.name))
             return
+        arg_fetch_s = time.perf_counter() - t_args0
 
         def run():
             from . import worker as worker_mod
@@ -2110,6 +2210,7 @@ class NodeService:
             # register() immediately precedes the try whose finally
             # unregisters (see worker._execute): no stale-mapping window.
             self._device_interrupts.register(spec.task_id.binary())
+            t_run0 = time.perf_counter()
             try:
                 tracer = (tracing.task_span(f"task::{spec.name}::execute",
                                             spec.trace_ctx,
@@ -2124,6 +2225,7 @@ class NodeService:
                     tracer.error(e)
                 return (False, TaskError.from_exception(e, spec.name))
             finally:
+                spec._exec_s = time.perf_counter() - t_run0
                 self._device_interrupts.unregister(spec.task_id.binary())
                 worker_mod._running_task.reset(tok)
                 if tracer is not None:
@@ -2133,7 +2235,11 @@ class NodeService:
                     # include device-lane work.
                     self.trace_spans.extend(tracing.drain_local_spans())
 
-        self._event(spec, "RUNNING", worker="device")
+        ph = self._dispatch_phases(spec)
+        # In-process arg resolution IS the device lane's arg-fetch phase
+        # (no deserialization for passthrough values — that's the point).
+        ph["arg_fetch"] = arg_fetch_s
+        self._event(spec, "RUNNING", worker="device", phases=ph)
         fut = (pool or self.device_pool).submit(run)
 
         def done(f):
@@ -2179,7 +2285,13 @@ class NodeService:
                     return
                 self._release_deps(spec)
                 self.counters["tasks_finished"] += 1
-                self._event(spec, "FINISHED", worker="device")
+                phases = dict(getattr(spec, "_phases", None) or {})
+                exec_s = getattr(spec, "_exec_s", None)
+                if exec_s is not None:
+                    phases["execute"] = exec_s
+                self._observe_phases(phases)
+                self._event(spec, "FINISHED", worker="device",
+                            phases=phases or None)
             self.loop.call_soon_threadsafe(finish)
 
         fut.add_done_callback(done)
@@ -2292,6 +2404,7 @@ class NodeService:
                     return
                 target, address = pin_node, addr
             else:
+                sched_t0 = time.monotonic()
                 try:
                     placed = await self.head.schedule(
                         spec.resources, spec.strategy.kind,
@@ -2300,6 +2413,12 @@ class NodeService:
                         labels_soft=spec.strategy.labels_soft)
                 except (ConnectionLost, RpcTimeout, OSError):
                     placed = None
+                # queued-at-head → scheduled-to-node: the placement
+                # round-trip is this attempt's schedule phase. It rides
+                # the (pickled) spec to the executor, whose RUNNING
+                # event folds it into the task's phase ledger.
+                spec._sched_rtt = (getattr(spec, "_sched_rtt", 0.0)
+                                   + (time.monotonic() - sched_t0))
                 if placed is None:
                     # Nothing feasible right now: park and retry (nodes may
                     # join / free up) — reference keeps infeasible tasks
@@ -2313,8 +2432,15 @@ class NodeService:
                 return
             try:
                 conn = await self._peer_conn(target, address)
+                rtt = getattr(spec, "_sched_rtt", None)
+                if rtt is not None:
+                    # payload_spec was copied before the placement loop —
+                    # re-stamp so the measured RTT travels with it.
+                    payload_spec._sched_rtt = rtt
                 self._event(spec, "FORWARDED",
-                            worker=f"node:{target.hex()[:8]}")
+                            worker=f"node:{target.hex()[:8]}",
+                            phases=({"schedule": rtt}
+                                    if rtt is not None else None))
                 reply = await conn.call("remote_execute", {
                     "spec": payload_spec,
                     # Log-routing owner: inherit the originating driver's
@@ -3107,6 +3233,8 @@ class NodeService:
             self._fail_task(spec, ActorDiedError(f"actor is dead: {cause}",
                                                  task_name=spec.name))
             return
+        # queue phase = time spent behind the actor's max_concurrency gate.
+        spec._pending_since = time.monotonic()
         actor.queue.append(spec)
         self._pump_actor(actor)
 
@@ -3139,6 +3267,8 @@ class NodeService:
     async def _run_actor_task(self, actor: ActorState, spec: TaskSpec):
         worker = actor.worker
         worker.inflight[spec.task_id] = spec
+        self._event(spec, "RUNNING", worker=f"worker:{worker.proc.pid}",
+                    phases=self._dispatch_phases(spec))
         try:
             reply = await worker.conn.call("execute_task", self._spec_for_ipc(spec))
             self._handle_task_reply(spec, reply)
@@ -3600,6 +3730,17 @@ class NodeService:
 
         if method == "spans_push":
             self.trace_spans.extend(payload)
+            return True
+
+        if method == "task_events_push":
+            # Worker-ring drain (1s flusher plane): fine-grained
+            # transitions (ARGS_FETCHED / OUTPUT_SERIALIZED) append to
+            # the node's event table only — the latest-state task row is
+            # owned by the node's own transitions, which may already
+            # have moved past these by the time the flush lands.
+            for ev in payload:
+                ev.setdefault("node_id", self.node_id.hex())
+                self.task_events.append(ev)
             return True
 
         if method == "fetch_object":

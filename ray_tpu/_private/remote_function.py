@@ -11,6 +11,7 @@ training steps receive device arrays at zero copy cost.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Optional
 
 from . import context as context_mod
@@ -141,6 +142,7 @@ class RemoteFunction:
             runtime_env=ctx.resolve_runtime_env(self._runtime_env,
                                                 device_lane=device),
             nested_refs=nested_refs or None,
+            created_ts=time.time(),
         )
         from ray_tpu.util import tracing
 

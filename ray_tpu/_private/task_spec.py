@@ -91,6 +91,11 @@ class TaskSpec:
     # submitter's active span (reference: span context inside the task
     # spec, tracing_helper.py).
     trace_ctx: Optional[dict] = None
+    # Wall-clock creation time at the submitting client (driver or
+    # worker), stamped by RemoteFunction.remote / ActorMethod.remote —
+    # the "created" transition of the task-lifecycle event stream
+    # (reference: export_task_event.proto state_ts_ns[CREATED]).
+    created_ts: float = 0.0
     # Resolved runtime environment (env_vars + kv:// package URIs —
     # see ray_tpu.runtime_env); workers are pooled by its hash.
     runtime_env: Optional[dict] = None

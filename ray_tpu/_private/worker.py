@@ -13,6 +13,7 @@ chips — device work belongs to the device lane in the node-owner process
 
 from __future__ import annotations
 
+import collections
 import contextvars
 import os
 import sys
@@ -62,6 +63,15 @@ class WorkerContext:
         from .interrupt import TaskInterruptRegistry
 
         self._interrupts = TaskInterruptRegistry()
+        # Bounded per-process task-lifecycle event ring (args-fetched /
+        # output-serialized transitions), drained to the node's
+        # task_events table on the same 1s flusher plane as ref drops
+        # and metric snapshots — never inline on the task's critical
+        # path (reference: worker task events buffered and pushed to the
+        # GCS task-events backend, task_event_buffer.h). Created before
+        # the client: a task can be pushed the instant register() lands.
+        self._task_event_ring: collections.deque = collections.deque(
+            maxlen=self.cfg.task_events_worker_ring_size)
         # Connect last: the node service may push tasks the moment we register.
         self.client = DuplexClient(sock_path, self._handle, handler_threads=32)
         # Wear the runtime environment BEFORE registering — tasks are only
@@ -109,6 +119,26 @@ class WorkerContext:
             time.sleep(1.0)
             if not self._flush_drops():
                 return
+            self._flush_task_events()
+
+    def _task_event(self, task_id: TaskID, name: str, state: str):
+        self._task_event_ring.append({
+            "task_id": task_id.hex(), "name": name, "state": state,
+            "ts": time.time(), "worker": f"worker:{os.getpid()}"})
+
+    def _flush_task_events(self):
+        if not self._task_event_ring:
+            return
+        batch = []
+        while True:
+            try:
+                batch.append(self._task_event_ring.popleft())
+            except IndexError:
+                break
+        try:
+            self.client.notify("task_events_push", batch)
+        except Exception:
+            pass  # connection gone; worker is dying
 
     def _flush_drops(self) -> bool:
         with self._decref_lock:
@@ -463,19 +493,33 @@ class WorkerContext:
                 f"task::{p['name']}::execute", trace_ctx,
                 attributes={"worker_pid": os.getpid()})
                 if trace_ctx is not None else None)
+            # Per-phase latency attribution: arg decode / user code /
+            # result encode are timed here and ride the task REPLY back
+            # to the node (zero extra RPCs on the critical path); the
+            # matching state-transition events go through the buffered
+            # ring instead.
+            t0 = time.perf_counter()
             args = [self._decode_arg(a) for a in p["args"]]
             kwargs = {k: self._decode_arg(v) for k, v in p["kwargs"].items()}
+            t_args = time.perf_counter()
+            self._task_event(task_id, p["name"], "ARGS_FETCHED")
             if p.get("actor_id") is not None:
                 instance = self._actors[ActorID(p["actor_id"])]
                 fn = getattr(instance, p["method_name"])
             else:
                 fn = self._get_callable(p["func_id"])
             value = fn(*args, **kwargs)
+            t_run = time.perf_counter()
             results, nested_refs = self._encode_results(
                 task_id, p["num_returns"], value)
+            t_enc = time.perf_counter()
+            self._task_event(task_id, p["name"], "OUTPUT_SERIALIZED")
             return {"results": results, "error": None,
                     "nested_refs": (nested_refs
-                                    if any(nested_refs) else None)}
+                                    if any(nested_refs) else None),
+                    "phases": {"arg_fetch": t_args - t0,
+                               "execute": t_run - t_args,
+                               "output_serialize": t_enc - t_run}}
         except BaseException as e:  # noqa: BLE001
             if tracer is not None:
                 tracer.error(e)

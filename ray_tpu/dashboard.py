@@ -43,6 +43,10 @@ _PAGE = """<!doctype html>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Resources</h2><table id="resources"></table>
 <h2>Tasks</h2><table id="tasks"></table>
+<h2>Throughput &amp; phase latency</h2>
+<div id="spark" style="background:#fff;padding:.6rem;box-shadow:0 1px 2px #0002;font-size:.8rem"></div>
+<h2>Task timeline <span id="sched" style="color:#888;font-size:.8rem"></span></h2>
+<canvas id="tl" width="1100" height="170" style="background:#fff;box-shadow:0 1px 2px #0002"></canvas>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Jobs</h2><table id="jobs"></table>
 <h2>Object store</h2><table id="store"></table>
@@ -54,6 +58,38 @@ function esc(v){return String(v).replace(/[&<>"']/g,
   c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]));}
 function row(cells, tag){return '<tr>'+cells.map(c=>'<'+(tag||'td')+'>'+c+'</'+(tag||'td')+'>').join('')+'</tr>';}
 function pill(s){s=esc(s);return '<span class="pill '+s+'">'+s+'</span>';}
+function spark(vals,w,h,color){
+  if(!vals||!vals.length)return '<svg width="'+w+'" height="'+h+'"></svg>';
+  const max=Math.max.apply(null,vals.concat([1e-9]));
+  const pts=vals.map((v,i)=>
+    (vals.length>1?i*w/(vals.length-1):0).toFixed(1)+','+
+    (h-1-v/max*(h-3)).toFixed(1)).join(' ');
+  return '<svg width="'+w+'" height="'+h+'" style="vertical-align:middle">'+
+    '<polyline fill="none" stroke="'+color+'" stroke-width="1.5" points="'+pts+'"/></svg>';}
+function drawSpark(s){
+  let html='<div>tasks/s '+spark(s.tasks_per_s,240,34,'#36c')+' '+
+    ((s.tasks_per_s[s.tasks_per_s.length-1]||0).toFixed(1))+'</div>';
+  for(const ph of Object.keys(s.phase_ms||{}))
+    html+='<div>'+esc(ph)+' (mean ms) '+spark(s.phase_ms[ph],240,34,'#c63')+' '+
+      ((s.phase_ms[ph][s.phase_ms[ph].length-1]||0).toFixed(3))+'</div>';
+  document.getElementById('spark').innerHTML=html;}
+function drawTimeline(evs){
+  const c=document.getElementById('tl'),g=c.getContext('2d');
+  g.clearRect(0,0,c.width,c.height);
+  const main=evs.filter(e=>e.cat==='task');
+  if(!main.length)return;
+  const t0=Math.min.apply(null,main.map(e=>e.ts));
+  const t1=Math.max.apply(null,main.map(e=>e.ts+e.dur));
+  const span=Math.max(1,t1-t0), x0=120, xw=c.width-x0-8;
+  const lanes=[...new Set(main.map(e=>e.pid+'/'+e.tid))];
+  const lh=Math.min(20,(c.height-6)/Math.max(1,lanes.length));
+  evs.forEach(e=>{
+    const li=lanes.indexOf(e.pid+'/'+e.tid); if(li<0)return;
+    const x=x0+(e.ts-t0)/span*xw, w=Math.max(1,e.dur/span*xw);
+    if(e.cat==='phase'){g.fillStyle='#fa3';g.fillRect(x,li*lh+3+lh*0.55,w,lh*0.3);}
+    else{g.fillStyle='#69c';g.fillRect(x,li*lh+3,w,lh*0.5);}});
+  g.fillStyle='#555';g.font='10px sans-serif';
+  lanes.forEach((l,i)=>g.fillText(l.slice(0,18),2,i*lh+13));}
 async function refresh(){
   try{
     const o = await (await fetch('api/overview')).json();
@@ -74,6 +110,11 @@ async function refresh(){
       Object.entries(t.by_name).map(([name,states])=>row([esc(name),
         states.SUBMITTED||0, states.RUNNING||0, states.FINISHED||0,
         states.FAILED||0])).join('');
+    const tl = await (await fetch('api/timeline')).json();
+    drawSpark(tl.series); drawTimeline(tl.events);
+    document.getElementById('sched').textContent = tl.scheduler ?
+      ('scheduler: '+tl.scheduler.decisions+' decisions, '+
+       tl.scheduler.infeasible+' infeasible') : '';
     const a = await (await fetch('api/actors')).json();
     document.getElementById('actors').innerHTML =
       row(['actor','class','state','restarts','node','pid'],'th') +
@@ -252,6 +293,93 @@ def _logs() -> dict:
         return {"logs": []}
 
 
+# Sparkline time-series ring: one sample per /api/timeline poll
+# (the page polls every 2s), bounded to ~4 minutes of history.
+_tl_state: dict = {"last_t": None, "last_finished": 0, "samples": None}
+
+
+def _sched_stats() -> Optional[dict]:
+    """Head scheduling-decision counters (decisions/infeasible/
+    cumulative decision time); None when the head isn't reachable from
+    this runtime (e.g. rtpu:// client sessions)."""
+    from ._private import context as context_mod
+
+    try:
+        rt = context_mod.require_context()
+        return rt._run(rt.head_client().sched_stats(), 5.0)
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _timeline() -> dict:
+    """Task-lifecycle timeline + derived time-series.
+
+    ``events``: chrome-trace "X" slices (same shape as
+    ray_tpu.timeline(), incl. ``name::phase`` sub-slices) for the most
+    recent completed tasks; ``series``: sparkline history of tasks/s
+    and mean per-phase latency; ``scheduler``: head decision counters.
+    """
+    import collections
+    import time as _t
+
+    from .util import state as state_mod
+
+    snap = _snapshot()
+    best: dict = {}
+    for s in snap["snapshots"]:
+        for r in s.get("tasks", []):
+            cur = best.get(r["task_id"])
+            if cur is None or ("start_ts" in r, r.get("ts", 0.0)) > \
+                    ("start_ts" in cur, cur.get("ts", 0.0)):
+                best[r["task_id"]] = r
+    finished = 0
+    phase_sums: dict = {}
+    phase_counts: dict = {}
+    for r in best.values():
+        if r.get("state") == "FINISHED":
+            finished += 1
+        for ph, dur in (r.get("phases") or {}).items():
+            phase_sums[ph] = phase_sums.get(ph, 0.0) + float(dur)
+            phase_counts[ph] = phase_counts.get(ph, 0) + 1
+    # The trace pane shows the most recent completed slices; the full
+    # event stream stays available via ray_tpu.timeline()/rtpu timeline.
+    done = sorted((r for r in best.values()
+                   if r.get("start_ts") is not None
+                   and r.get("end_ts") is not None),
+                  key=lambda r: r["end_ts"])[-300:]
+    events = []
+    for r in done:
+        pid = f"node:{r['node_id'][:8]}"
+        tid = r.get("worker", "driver")
+        events.append({"ph": "X", "name": r["name"], "cat": "task",
+                       "pid": pid, "tid": tid, "ts": r["start_ts"] * 1e6,
+                       "dur": max(0.0, r["end_ts"] - r["start_ts"]) * 1e6,
+                       "args": {"task_id": r["task_id"],
+                                "state": r["state"]}})
+        events.extend(state_mod._phase_slices(r, pid, tid))
+    now = _t.monotonic()
+    if _tl_state["samples"] is None:
+        _tl_state["samples"] = collections.deque(maxlen=120)
+    rate = 0.0
+    if _tl_state["last_t"] is not None and now > _tl_state["last_t"]:
+        rate = max(0.0, (finished - _tl_state["last_finished"])
+                   / (now - _tl_state["last_t"]))
+    _tl_state["last_t"] = now
+    _tl_state["last_finished"] = finished
+    _tl_state["samples"].append(
+        {"t": _t.time(), "tasks_per_s": rate,
+         "phase_ms": {ph: phase_sums[ph] / phase_counts[ph] * 1e3
+                      for ph in phase_sums}})
+    samples = list(_tl_state["samples"])
+    phases = sorted({p for smp in samples for p in smp["phase_ms"]})
+    series = {"ts": [smp["t"] for smp in samples],
+              "tasks_per_s": [smp["tasks_per_s"] for smp in samples],
+              "phase_ms": {p: [smp["phase_ms"].get(p, 0.0)
+                               for smp in samples] for p in phases}}
+    return {"events": events, "series": series,
+            "scheduler": _sched_stats()}
+
+
 def _jobs() -> dict:
     try:
         from .job_submission import JOB_MANAGER_NAME
@@ -281,6 +409,7 @@ def start_dashboard(port: int = 0, host: str = "127.0.0.1"):
         "/api/tasks": _tasks,
         "/api/actors": _actors,
         "/api/jobs": _jobs,
+        "/api/timeline": _timeline,
         "/api/rpc": _rpc_stats,
         "/api/serve": _serve_status,
         "/api/logs": _logs,

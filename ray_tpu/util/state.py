@@ -123,13 +123,49 @@ def list_placement_groups(filters: Optional[Sequence[Filter]] = None,
     return _apply_filters(rows, filters, limit)
 
 
+def list_task_events(filters: Optional[Sequence[Filter]] = None,
+                     limit: Optional[int] = None) -> list[dict]:
+    """Raw task state-transition events, cluster-wide, in timestamp
+    order. Rows: task_id, name, state (SUBMITTED/RUNNING/ARGS_FETCHED/
+    OUTPUT_SERIALIZED/FORWARDED/RECONSTRUCTING/FINISHED/FAILED), ts,
+    node_id, worker, and — on RUNNING/FINISHED/FAILED — a ``phases``
+    dict of per-phase durations in seconds (reference: the export-API
+    task event stream, export_task_event.proto)."""
+    rows, _ = _gather("task_events")
+    rows.sort(key=lambda e: e.get("ts", 0.0))
+    return _apply_filters(rows, filters, limit)
+
+
+def _phase_stats(durs: list) -> dict:
+    durs = sorted(durs)
+    n = len(durs)
+
+    def pct(q: float) -> float:
+        return durs[min(n - 1, int(round(q * (n - 1))))]
+
+    return {"count": n,
+            "mean_ms": sum(durs) / n * 1e3,
+            "p50_ms": pct(0.50) * 1e3,
+            "p99_ms": pct(0.99) * 1e3,
+            "max_ms": durs[-1] * 1e3}
+
+
 def summarize_tasks() -> dict:
     """Task counts grouped by (name, state) — the reference's
-    ``ray summary tasks`` surface."""
-    out: dict[str, dict[str, int]] = {}
+    ``ray summary tasks`` surface — plus a per-name ``phases`` breakdown
+    ({phase: {count, mean_ms, p50_ms, p99_ms, max_ms}}) over the phases
+    the lifecycle plane attributed to each task: queue, schedule,
+    arg_fetch, execute, output_serialize."""
+    out: dict[str, dict] = {}
+    acc: dict[str, dict[str, list]] = {}
     for t in list_tasks():
         by_state = out.setdefault(t["name"], {})
         by_state[t["state"]] = by_state.get(t["state"], 0) + 1
+        for phase, dur in (t.get("phases") or {}).items():
+            acc.setdefault(t["name"], {}).setdefault(phase, []).append(
+                float(dur))
+    for name, phases in acc.items():
+        out[name]["phases"] = {p: _phase_stats(d) for p, d in phases.items()}
     return out
 
 
@@ -158,7 +194,10 @@ def timeline(filename: Optional[str] = None) -> Any:
     python/ray/_private/state.py:434).
 
     Each completed task becomes one complete ("X") slice: pid = node,
-    tid = worker lane, ts/dur in microseconds.
+    tid = worker lane, ts/dur in microseconds. Tasks with a per-phase
+    ledger additionally get ``name::phase`` sub-slices (cat "phase"):
+    schedule/queue laid out before the RUNNING transition, arg_fetch/
+    execute/output_serialize stacked after it.
     """
     events = []
     rows, snap = _gather("tasks", include_events=False)
@@ -170,18 +209,57 @@ def timeline(filename: Optional[str] = None) -> Any:
             # driver's clock, so synthesizing an end time would skew or
             # hide the slice — leave it out.
             continue
+        pid = f"node:{t['node_id'][:8]}"
+        tid = t.get("worker", "driver")
         events.append({
             "ph": "X",
             "name": t["name"],
             "cat": "task",
-            "pid": f"node:{t['node_id'][:8]}",
-            "tid": t.get("worker", "driver"),
+            "pid": pid,
+            "tid": tid,
             "ts": start * 1e6,
             "dur": max(0.0, (end - start)) * 1e6,
             "args": {"task_id": t["task_id"], "state": t["state"],
                      "actor_id": t.get("actor_id")},
         })
+        events.extend(_phase_slices(t, pid, tid))
     if filename is not None:
         with open(filename, "w") as f:
             json.dump(events, f)
     return events
+
+
+# Lifecycle order of the attributed phases, pre-RUNNING vs post-RUNNING.
+_PRE_RUN_PHASES = ("schedule", "queue")
+_POST_RUN_PHASES = ("arg_fetch", "execute", "output_serialize")
+
+
+def _phase_slices(t: dict, pid: str, tid: str) -> list[dict]:
+    """``name::phase`` sub-slices for one completed task row. The phase
+    ledger holds durations, not wall-clock stamps, so slices are laid
+    out around the known RUNNING transition (start_ts): schedule+queue
+    end there, arg_fetch/execute/output_serialize stack from there."""
+    phases = t.get("phases") or {}
+    if not phases:
+        return []
+    out = []
+
+    def slice_(phase: str, ts: float) -> dict:
+        return {"ph": "X", "name": f"{t['name']}::{phase}", "cat": "phase",
+                "pid": pid, "tid": tid, "ts": ts * 1e6,
+                "dur": max(0.0, phases[phase]) * 1e6,
+                "args": {"task_id": t["task_id"]}}
+
+    start = t["start_ts"]
+    cursor = start - sum(max(0.0, phases.get(p, 0.0))
+                         for p in _PRE_RUN_PHASES)
+    for p in _PRE_RUN_PHASES:
+        if p in phases:
+            out.append(slice_(p, cursor))
+            cursor += max(0.0, phases[p])
+    cursor = start
+    for p in _POST_RUN_PHASES:
+        if p in phases:
+            out.append(slice_(p, cursor))
+            cursor += max(0.0, phases[p])
+    return out

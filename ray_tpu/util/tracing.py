@@ -169,8 +169,10 @@ def get_spans() -> List[dict]:
 
 
 def export_chrome_trace(filename: str) -> int:
-    """Spans as chrome://tracing 'X' events (complements the task-event
-    timeline; reference: ray timeline)."""
+    """Spans AND task-lifecycle slices as one chrome://tracing stream:
+    span rows keyed by trace, task rows (with ``name::phase``
+    sub-slices) keyed by node/worker lane — the merged view the
+    reference's ``ray timeline`` + OTel exporters provide separately."""
     import json
 
     spans = get_spans()
@@ -181,6 +183,12 @@ def export_chrome_trace(filename: str) -> int:
         "args": {**s.get("attributes", {}), "trace_id": s["trace_id"],
                  "span_id": s["span_id"], "parent_id": s.get("parent_id")},
     } for s in spans]
+    try:
+        from . import state as _state
+
+        events.extend(_state.timeline())
+    except Exception:
+        pass  # no cluster (tracing used standalone): spans-only trace
     with open(filename, "w") as f:
         json.dump(events, f)
     return len(events)

@@ -121,6 +121,77 @@ def test_lint_catches_a_weak_site(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# 1b. Task-lifecycle event-emission lint
+# ---------------------------------------------------------------------------
+def _methods_missing_call(path: Path, methods, callee: str) -> list:
+    """Names from ``methods`` whose body in ``path`` never calls
+    ``self.<callee>(...)`` — including methods that no longer exist
+    (a rename silently dropping its event is exactly the bug class)."""
+    tree = ast.parse(path.read_text())
+    has_call: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in methods:
+            calls = {
+                c.func.attr for c in ast.walk(node)
+                if isinstance(c, ast.Call)
+                and isinstance(c.func, ast.Attribute)
+                and isinstance(c.func.value, ast.Name)
+                and c.func.value.id == "self"}
+            has_call[node.name] = (has_call.get(node.name, False)
+                                   or callee in calls)
+    return [m for m in methods if not has_call.get(m, False)]
+
+
+# Every task state-transition site in the node service and the worker:
+# each must emit a lifecycle event, or the task_events stream (state
+# API, timeline, phase metrics) silently loses that transition.
+_NODE_TRANSITION_SITES = (
+    "submit",              # SUBMITTED
+    "_start_reconstruction",  # RECONSTRUCTING
+    "_run_on_worker",      # RUNNING (cpu lane)
+    "_run_on_device",      # RUNNING + FINISHED (device lane)
+    "_run_actor_task",     # RUNNING (actor call)
+    "_handle_task_reply",  # FINISHED (cpu lane)
+    "_fail_task",          # FAILED
+    "_execute_remotely",   # FORWARDED
+    "_handle_remote_reply",  # FINISHED/FAILED (owner side)
+    "_actor_alive",        # FINISHED (actor creation)
+)
+_WORKER_TRANSITION_SITES = (
+    "_execute",            # ARGS_FETCHED + OUTPUT_SERIALIZED
+)
+
+
+def test_every_task_transition_site_emits_an_event():
+    missing = _methods_missing_call(
+        REPO / "ray_tpu/_private/node_service.py",
+        _NODE_TRANSITION_SITES, "_event")
+    missing += [
+        f"worker.{m}" for m in _methods_missing_call(
+            REPO / "ray_tpu/_private/worker.py",
+            _WORKER_TRANSITION_SITES, "_task_event")]
+    assert not missing, (
+        f"task state-transition site(s) emit no lifecycle event "
+        f"(self._event / self._task_event): {missing}")
+
+
+def test_event_lint_catches_a_silent_site(tmp_path):
+    """The net itself is live: a transition method without an emit is
+    flagged, one with it is not, and a REMOVED method is flagged."""
+    src = tmp_path / "svc.py"
+    src.write_text(
+        "class S:\n"
+        "    def good(self, spec):\n"
+        "        self._event(spec, 'RUNNING')\n"
+        "    def silent(self, spec):\n"
+        "        pass\n")
+    assert _methods_missing_call(src, ("good",), "_event") == []
+    assert _methods_missing_call(
+        src, ("good", "silent", "gone"), "_event") == ["silent", "gone"]
+
+
+# ---------------------------------------------------------------------------
 # 2. Reply-path GC fuzz
 # ---------------------------------------------------------------------------
 def test_reply_path_survives_gc_storm(rt):

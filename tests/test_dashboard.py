@@ -65,6 +65,39 @@ def test_jobs_and_metrics_endpoints(dash):
     assert "rtpu_node_num_workers" in m
 
 
+def test_timeline_endpoint(dash):
+    """Acceptance: /api/timeline serves the task timeline + series."""
+
+    @ray_tpu.remote
+    def tl_task(x):
+        return x
+
+    ray_tpu.get([tl_task.remote(i) for i in range(4)], timeout=60)
+
+    from ray_tpu import dashboard as dash_mod
+
+    dash_mod._snap_cache["t"] = 0.0  # bypass the 1s TTL for the assert
+    body = json.loads(_get(dash + "/api/timeline"))
+    mains = [e for e in body["events"]
+             if e.get("cat") == "task" and e["name"] == "tl_task"]
+    assert len(mains) == 4
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in body["events"])
+    # Phase sub-slices ride along for the timeline pane.
+    assert any(e.get("cat") == "phase"
+               and e["name"] == "tl_task::execute"
+               for e in body["events"])
+    series = body["series"]
+    assert len(series["ts"]) == len(series["tasks_per_s"]) >= 1
+    assert "execute" in series["phase_ms"]
+    # Head scheduling counters ride along (single-node: may be 0s).
+    assert body["scheduler"] is not None
+    assert {"decisions", "infeasible", "decision_s"} <= \
+        set(body["scheduler"])
+    # The page renders the pane.
+    html = _get(dash + "/")
+    assert "Task timeline" in html and "api/timeline" in html
+
+
 def test_new_operator_panes(rt):
     """Serve/RPC/logs endpoints feed the page's r5 panes."""
     import json

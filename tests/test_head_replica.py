@@ -109,6 +109,53 @@ def test_local_copy_preferred_when_present(replica, tmp_path):
     store2.close()
 
 
+def test_async_replication_window_is_bounded(tmp_path):
+    """The documented durability window: with a replica UNREACHABLE,
+    acknowledged mutations are durable locally immediately, while the
+    un-acked replica tail is bounded by REPLICA_QUEUE_MAX frames."""
+    from ray_tpu._private.head_replica import (REPLICA_QUEUE_MAX,
+                                               REPLICA_RETRY_QSIZE)
+    from ray_tpu._private.head_store import AppendLogHeadStore
+
+    # A port nothing listens on: every frame stays un-acked.
+    dead_addr = ("127.0.0.1", 1)
+    primary = str(tmp_path / "pw" / "head.snapshot")
+    store = ReplicatedHeadStore(primary, [dead_addr])
+    assert _wait(lambda: dead_addr in store._queues)
+    # The bound is wired into the outbound queue itself, and the
+    # retry-drop threshold sits strictly inside it.
+    assert store._queues[dead_addr].maxsize == REPLICA_QUEUE_MAX
+    assert 0 < REPLICA_RETRY_QSIZE < REPLICA_QUEUE_MAX
+
+    store.save({"kv": {}, "functions": {}, "placement_groups": []})
+    n_appends = 200
+    for i in range(n_appends):
+        store.append("kv", (f"k{i}", b"v"))
+
+    # Local acknowledgement did NOT wait for the replica: every append's
+    # seq advanced even though nothing was delivered.
+    assert store.local._seq == n_appends
+    backlog = store._queues[dead_addr].qsize()
+    assert backlog <= REPLICA_QUEUE_MAX
+    store.close()
+
+    # The crash-window asymmetry: the head's own disk has the full
+    # tail (a process restart replays it)...
+    reread = AppendLogHeadStore(primary)
+    tables = reread.load()
+    assert tables["kv"]["k0"] == b"v"
+    assert tables["kv"][f"k{n_appends - 1}"] == b"v"
+    reread.close()
+
+    # ...but a blank-disk recovery (head NODE lost before any replica
+    # received the stream) has nothing to recover from — exactly the
+    # window the module documents.
+    fresh = str(tmp_path / "pw2" / "head.snapshot")
+    store2 = ReplicatedHeadStore(fresh, [dead_addr])
+    assert store2.load() is None
+    store2.close()
+
+
 def test_head_service_uses_replicated_store(replica, tmp_path,
                                             monkeypatch):
     """End-to-end through HeadService: mutations made via the head's kv

@@ -43,13 +43,31 @@ SOLO_FLOORS = {
     "put_gigabytes_gb": 2.0,
     "get_gigabytes_gb": 1050,
     "task_device_sync": 3300,
-    "task_device_async": 8500,  # r5 fire-and-forget submit: ~14k solo
+    # task_device_async: re-anchored 2026-08-04 for the task-lifecycle
+    # event backend, which adds ~11us node-side bookkeeping per device
+    # task (SUBMITTED/RUNNING/FINISHED events + 4-phase histogram) —
+    # intentional cost, ~10% on this ~90us/task in-process lane. Also
+    # the pure-CPU calibration unit over-scales this lane today: the
+    # reference sped up ~25% since the 07-31 anchor while the asyncio
+    # round-trip lane did not (events-OFF gate runs sat borderline at
+    # the old scaled floor). 0.7 x the events-on gate-context mean of
+    # calibration-normalized samples (5.7-7.3k, mean ~6.5k).
+    "task_device_async": 4500,
     "task_cpu_sync": 1300,
-    # task_cpu_async is deliberately ABSENT: recorded 1.3-1.7k solo but
-    # 0.42-0.75k at pytest-session start with calibration ~1.0 — a 4x
-    # context swing the pure-CPU unit cannot normalize (worker-pool
-    # paging/fork effects). Its machinery is covered by task_cpu_sync
-    # here and by the loaded-context crash net in test_microbench.py.
+    # task_cpu_async: re-gated with the per-phase event breakdown in
+    # hand. The ledger for async cpu submission shows the non-queue
+    # phases (schedule + arg_fetch + execute + output_serialize) hold a
+    # stable ~75µs/task while the QUEUE phase absorbs the entire
+    # context swing (worker-pool drain rate: p50 seconds-deep pipeline
+    # wait at 2 workers) — so the old 0.42-0.75k session-start dips the
+    # r5 note blamed on un-normalizable "paging/fork effects" are
+    # queue-phase dynamics, not machinery cost. Floor at 0.7 x the
+    # worst recorded drain throughput (420/s, calibration ~1.0):
+    # tolerates the queue swing, still fails on a genuine submit/reply
+    # machinery regression (which scales ALL of the drain rate).
+    # Gate-context samples 2026-08-04: 814-897/s at calibration
+    # 1.15-1.25.
+    "task_cpu_async": 290,
     "actor_call_sync": 1400,
     "actor_call_async": 1700,
     "actor_call_concurrent": 1900,

@@ -114,6 +114,85 @@ def test_cluster_metrics_and_timeline(rt, tmp_path):
         assert set(ev) >= {"pid", "tid", "ts", "dur", "name", "ph"}
 
 
+# The five phases the lifecycle plane attributes to every cpu-lane task.
+PHASES = ("queue", "schedule", "arg_fetch", "execute", "output_serialize")
+
+
+def test_per_phase_summary_1k_tasks(rt):
+    """Acceptance: summarize_tasks() reports per-phase latency (queue,
+    schedule, arg-fetch, execute, output-serialize) for a 1k-task run."""
+
+    @ray_tpu.remote
+    def tick(x):
+        return x
+
+    refs = [tick.remote(i) for i in range(1000)]
+    ray_tpu.get(refs, timeout=120)
+
+    summary = state.summarize_tasks()
+    assert summary["tick"]["FINISHED"] == 1000
+    phases = summary["tick"]["phases"]
+    for ph in PHASES:
+        st = phases[ph]
+        assert st["count"] == 1000
+        assert st["max_ms"] >= st["p99_ms"] >= st["p50_ms"] >= 0.0
+        assert st["mean_ms"] > 0.0
+
+
+def test_list_task_events_stream(rt):
+    import time
+
+    @ray_tpu.remote
+    def ev_task(x):
+        return x
+
+    ray_tpu.get([ev_task.remote(i) for i in range(3)])
+
+    # Node-owned transitions are visible immediately.
+    evs = state.list_task_events(filters=[("name", "=", "ev_task")])
+    assert {"SUBMITTED", "RUNNING", "FINISHED"} <= \
+        {e["state"] for e in evs}
+
+    # Worker-origin transitions ride the 1s flusher plane: poll.
+    deadline = time.monotonic() + 10
+    states: set = set()
+    while time.monotonic() < deadline:
+        evs = state.list_task_events(filters=[("name", "=", "ev_task")])
+        states = {e["state"] for e in evs}
+        if {"ARGS_FETCHED", "OUTPUT_SERIALIZED"} <= states:
+            break
+        time.sleep(0.2)
+    assert {"ARGS_FETCHED", "OUTPUT_SERIALIZED"} <= states
+
+    # Chronological order; the FINISHED event carries the phase ledger.
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts)
+    fin = [e for e in evs if e["state"] == "FINISHED"]
+    assert len(fin) == 3
+    assert all("execute" in (e.get("phases") or {}) for e in fin)
+    assert len(state.list_task_events(limit=2)) == 2
+
+
+def test_timeline_phase_subslices(rt):
+    @ray_tpu.remote
+    def sliced():
+        return 1
+
+    ray_tpu.get([sliced.remote() for _ in range(2)])
+    events = ray_tpu.timeline()
+    subs = [e for e in events if e.get("cat") == "phase"
+            and e["name"].startswith("sliced::")]
+    assert {e["name"] for e in subs} >= {"sliced::queue",
+                                         "sliced::execute"}
+    mains = [e for e in events if e["name"] == "sliced"]
+    assert len(mains) == 2
+    for e in subs:
+        assert e["ph"] == "X" and e["dur"] >= 0
+        # Sub-slices render on the same node/worker lane as their task.
+        assert any(m["pid"] == e["pid"] and m["tid"] == e["tid"]
+                   for m in mains)
+
+
 def test_state_across_nodes():
     cluster = Cluster(init_args={"num_cpus": 1, "resources": {"y": 1}})
     try:

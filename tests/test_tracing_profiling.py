@@ -109,6 +109,69 @@ def test_nested_tasks_share_trace(traced):
     assert len(inner_spans) >= 2
 
 
+def test_actor_call_spans_link_submit_to_execute(traced):
+    @ray_tpu.remote
+    class Traced:
+        def poke(self, x):
+            return x + 1
+
+    a = Traced.remote()
+    assert ray_tpu.get(a.poke.remote(1), timeout=60) == 2
+    spans = tracing.get_spans()
+    # Actor creation carries a submit span like a plain task.
+    assert any(s["name"] == "task::Traced.__init__::submit"
+               for s in spans)
+    subs = [s for s in spans if s["name"] == "task::Traced.poke::submit"]
+    execs = [s for s in spans
+             if s["name"] == "task::Traced.poke::execute"]
+    assert subs and execs
+    ex = [s for s in execs if s["parent_id"] == subs[-1]["span_id"]]
+    assert ex and ex[0]["trace_id"] == subs[-1]["trace_id"]
+    assert ex[0]["pid"] != os.getpid()  # ran in the actor's worker
+
+
+def test_driver_task_subtask_parentage_chain(traced):
+    """Driver span -> task -> nested subtask: the full submit/execute
+    parentage chain survives the worker-span flusher plane."""
+    import time
+
+    @ray_tpu.remote
+    def leaf(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def mid(x):
+        import ray_tpu as rt
+        return rt.get(leaf.remote(x))
+
+    with tracing.span("driver_root") as root:
+        ref = mid.remote(5)
+        root_trace = root.trace_id
+    assert ray_tpu.get(ref, timeout=90) == 6
+
+    # Worker spans reach the node tables on the 1s flusher: poll.
+    spans: list = []
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        spans = tracing.get_spans()
+        if any(s["name"] == "task::leaf::execute" for s in spans):
+            break
+        time.sleep(0.2)
+    by_id = {s["span_id"]: s for s in spans}
+    leaf_ex = next(s for s in spans
+                   if s["name"] == "task::leaf::execute")
+    chain = [leaf_ex["name"]]
+    cur = leaf_ex
+    while cur.get("parent_id") and cur["parent_id"] in by_id:
+        cur = by_id[cur["parent_id"]]
+        chain.append(cur["name"])
+    assert chain == ["task::leaf::execute", "task::leaf::submit",
+                     "task::mid::execute", "task::mid::submit",
+                     "driver_root"], chain
+    assert all(by_id[s]["trace_id"] == root_trace
+               for s in by_id if by_id[s]["name"] in chain)
+
+
 def test_tracing_off_records_nothing(rt):
     @ray_tpu.remote
     def quiet():
