@@ -580,7 +580,59 @@ def _finish_with_flash_pass(base: dict) -> int:
     return 0
 
 
+def run_data_shuffle(num_blocks: int = 128,
+                     rows_per_block: int = 2048) -> dict:
+    """Data-exchange throughput: random_shuffle + sort over num_blocks
+    blocks through the push-based pipelined exchange (MB/s, blocks/s).
+    Rows land in DATA_BENCH.json next to the streaming-ingest numbers."""
+    import ray_tpu
+    from ray_tpu import data as rd
+    from ray_tpu.data import DataContext
+    from ray_tpu.data import exchange as X
+
+    ray_tpu.init(num_cpus=4)
+    ctx = DataContext.get_current()
+    ctx.execution_lane = "device"
+    try:
+        rows = num_blocks * rows_per_block
+        rng = np.random.default_rng(0)
+
+        def source():
+            for i in range(num_blocks):
+                ids = np.arange(i * rows_per_block,
+                                (i + 1) * rows_per_block)
+                yield {"id": rng.permutation(ids),
+                       "v": rng.random((rows_per_block, 4))}
+
+        ds = rd.Dataset(source)
+        total_mb = num_blocks * rows_per_block * (8 + 32) / 1e6
+        out = {"blocks": num_blocks, "rows": rows,
+               "dataset_mb": round(total_mb, 2),
+               "merge_factor": ctx.exchange_merge_factor}
+        for op, make in (("shuffle",
+                          lambda: ds.random_shuffle(seed=7)),
+                         ("sort", lambda: ds.sort("id"))):
+            t0 = time.perf_counter()
+            n = sum(len(b["id"]) for b in make().iter_blocks())
+            dt = time.perf_counter() - t0
+            assert n == rows, (n, rows)
+            out[op] = {"seconds": round(dt, 3),
+                       "mb_per_s": round(total_mb / dt, 1),
+                       "blocks_per_s": round(num_blocks / dt, 1)}
+        recs = X.list_exchange_stats()
+        if recs:
+            out["inflight_parts_high_water"] = max(
+                r["inflight_parts_high_water"] for r in recs)
+            out["inflight_bound"] = max(r["inflight_bound"] for r in recs)
+        return out
+    finally:
+        ray_tpu.shutdown()
+
+
 def main():
+    if "--data-shuffle" in sys.argv:
+        print(json.dumps(run_data_shuffle()))
+        return 0
     if "--probe" in sys.argv:
         import jax
 

@@ -45,6 +45,8 @@ _PAGE = """<!doctype html>
 <h2>Tasks</h2><table id="tasks"></table>
 <h2>Throughput &amp; phase latency</h2>
 <div id="spark" style="background:#fff;padding:.6rem;box-shadow:0 1px 2px #0002;font-size:.8rem"></div>
+<h2>Data exchange <span id="xsum" style="color:#888;font-size:.8rem"></span></h2>
+<div id="xspark" style="background:#fff;padding:.6rem;box-shadow:0 1px 2px #0002;font-size:.8rem"></div>
 <h2>Task timeline <span id="sched" style="color:#888;font-size:.8rem"></span></h2>
 <canvas id="tl" width="1100" height="170" style="background:#fff;box-shadow:0 1px 2px #0002"></canvas>
 <h2>Actors</h2><table id="actors"></table>
@@ -112,6 +114,16 @@ async function refresh(){
         states.FAILED||0])).join('');
     const tl = await (await fetch('api/timeline')).json();
     drawSpark(tl.series); drawTimeline(tl.events);
+    const xs=tl.series, xr=xs.exchange_rounds||[], xm=xs.exchange_mb||[];
+    document.getElementById('xspark').innerHTML =
+      '<div>rounds completed '+spark(xr,240,34,'#393')+' '+
+        (xr[xr.length-1]||0)+'</div>'+
+      '<div>MB shuffled '+spark(xm,240,34,'#939')+' '+
+        ((xm[xm.length-1]||0).toFixed(2))+'</div>';
+    document.getElementById('xsum').textContent = tl.exchange ?
+      (tl.exchange.exchanges+' exchanges ('+tl.exchange.active+
+       ' active), map/merge/reduce '+tl.exchange.map_tasks+'/'+
+       tl.exchange.merge_tasks+'/'+tl.exchange.reduce_tasks) : '';
     document.getElementById('sched').textContent = tl.scheduler ?
       ('scheduler: '+tl.scheduler.decisions+' decisions, '+
        tl.scheduler.infeasible+' infeasible') : '';
@@ -316,12 +328,15 @@ def _timeline() -> dict:
 
     ``events``: chrome-trace "X" slices (same shape as
     ray_tpu.timeline(), incl. ``name::phase`` sub-slices) for the most
-    recent completed tasks; ``series``: sparkline history of tasks/s
-    and mean per-phase latency; ``scheduler``: head decision counters.
+    recent completed tasks; ``series``: sparkline history of tasks/s,
+    mean per-phase latency, and Data-exchange progress (rounds
+    completed, MB shuffled); ``exchange``: the current cumulative
+    exchange totals; ``scheduler``: head decision counters.
     """
     import collections
     import time as _t
 
+    from .data.exchange import progress_totals
     from .util import state as state_mod
 
     snap = _snapshot()
@@ -366,17 +381,24 @@ def _timeline() -> dict:
                    / (now - _tl_state["last_t"]))
     _tl_state["last_t"] = now
     _tl_state["last_finished"] = finished
+    xt = progress_totals()
     _tl_state["samples"].append(
         {"t": _t.time(), "tasks_per_s": rate,
+         "exchange_rounds": xt["rounds_completed"],
+         "exchange_mb": xt["bytes_shuffled"] / 1e6,
          "phase_ms": {ph: phase_sums[ph] / phase_counts[ph] * 1e3
                       for ph in phase_sums}})
     samples = list(_tl_state["samples"])
     phases = sorted({p for smp in samples for p in smp["phase_ms"]})
     series = {"ts": [smp["t"] for smp in samples],
               "tasks_per_s": [smp["tasks_per_s"] for smp in samples],
+              "exchange_rounds": [smp.get("exchange_rounds", 0)
+                                  for smp in samples],
+              "exchange_mb": [smp.get("exchange_mb", 0.0)
+                              for smp in samples],
               "phase_ms": {p: [smp["phase_ms"].get(p, 0.0)
                                for smp in samples] for p in phases}}
-    return {"events": events, "series": series,
+    return {"events": events, "series": series, "exchange": xt,
             "scheduler": _sched_stats()}
 
 

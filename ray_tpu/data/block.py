@@ -1,21 +1,190 @@
-"""Blocks: the unit of data movement — columnar dicts of numpy arrays.
+"""Blocks: the unit of data movement — columnar dicts with Arrow-optional
+columns.
 
-The reference uses Arrow tables / pandas as block formats
-(/root/reference/python/ray/data/_internal/arrow_block.py). Here the native
-block is a dict[str, np.ndarray] (column-major): it round-trips zero-copy
-through the shared-memory object store via pickle5 buffers, converts to/from
-Arrow at the IO boundary, and feeds jax.device_put directly.
+A Block is ``dict[str, column]`` (column-major, equal first-dim length)
+where each column is either
+
+  * a ``np.ndarray``   — the native format for numeric/bool/datetime data:
+    round-trips zero-copy through the shared-memory object store via
+    pickle5 buffers and feeds ``jax.device_put`` directly; or
+  * a ``pyarrow.Array`` — the format for strings, binary, and nullable
+    (missing-key) data. Arrow arrays ALSO serialize zero-copy (their
+    buffers ride pickle-protocol-5 out-of-band frames), so string columns
+    no longer take the object-dtype pickling path the old dict-of-numpy
+    format forced on them. When pyarrow is not installed, these columns
+    degrade to object-dtype ndarrays (same semantics, slower wire format).
+
+The reference uses Arrow tables as its block format
+(python/ray/data/_internal/arrow_block.py); here Arrow is adopted
+per-column so the TPU ingest path (numeric numpy -> device_put) keeps its
+zero-copy property while heterogeneous columns get real Arrow semantics
+(nulls, native strings, comparison kernels).
+
+Column-generic helpers (``take_block``, ``sort_indices``,
+``bucket_by_splitters``, ``concat_blocks``…) are what the exchange layer
+(exchange.py) is written against — exchange task bodies never care which
+representation a column uses. Null ordering contract: nulls sort LAST and
+range-partition into the LAST partition (Arrow's ``null_placement=
+"at_end"``), on both representations.
 """
 
 from __future__ import annotations
 
-from typing import Any, Iterable, Iterator
+import bisect
+from typing import Any, Iterable, Iterator, Optional
 
 import numpy as np
 
-Block = dict  # str -> np.ndarray (equal first-dim length)
+Block = dict  # str -> np.ndarray | pyarrow.Array (equal first-dim length)
 
 
+def _pa():
+    """pyarrow or None — every Arrow promotion site gates on this, so the
+    whole Data layer (minus parquet/csv IO) works without pyarrow."""
+    try:
+        import pyarrow
+
+        return pyarrow
+    except ImportError:  # pragma: no cover - pyarrow is in the test env
+        return None
+
+
+def is_arrow(col) -> bool:
+    return type(col).__module__.startswith("pyarrow")
+
+
+# ---------------------------------------------------------------------------
+# Column helpers (representation-generic)
+# ---------------------------------------------------------------------------
+def column_len(col) -> int:
+    return len(col)
+
+
+def column_nbytes(col) -> int:
+    return int(col.nbytes)
+
+
+def column_to_numpy(col) -> np.ndarray:
+    """Materialize a column as numpy (object dtype for strings/nullable) —
+    the user-facing "numpy" batch view of an Arrow column."""
+    if is_arrow(col):
+        try:
+            return col.to_numpy(zero_copy_only=False)
+        except Exception:  # noqa: BLE001 - nested types
+            return np.asarray(col.to_pylist(), dtype=object)
+    return col
+
+
+def slice_column(col, start: int, stop: int):
+    if is_arrow(col):
+        return col.slice(start, stop - start)  # zero-copy offset view
+    return col[start:stop]
+
+
+def take_column(col, indices):
+    idx = np.asarray(indices, dtype=np.int64)
+    if is_arrow(col):
+        return col.take(idx)
+    return col[idx]
+
+
+def concat_columns(cols: list):
+    """Concatenate one key's column across blocks. Mixed representations
+    (one block promoted to Arrow, another stayed numpy) unify to Arrow;
+    all-null Arrow chunks cast to the first typed chunk's type."""
+    if len(cols) == 1:
+        return cols[0]
+    pa = _pa()
+    if pa is not None and any(is_arrow(c) for c in cols):
+        arrs = []
+        for c in cols:
+            if is_arrow(c):
+                arrs.append(c.combine_chunks()
+                            if isinstance(c, pa.ChunkedArray) else c)
+            else:
+                arrs.append(pa.array(c if c.dtype != object else c.tolist()))
+        target = next((a.type for a in arrs if not pa.types.is_null(a.type)),
+                      None)
+        if target is not None:
+            arrs = [a.cast(target) if pa.types.is_null(a.type) else a
+                    for a in arrs]
+        return pa.concat_arrays(arrs)
+    return np.concatenate(cols)
+
+
+def sort_indices(col, descending: bool = False) -> np.ndarray:
+    """Stable sort permutation for one column; nulls order LAST under
+    both representations (Arrow null_placement="at_end"; object-ndarray
+    None values are partitioned out and appended)."""
+    if is_arrow(col):
+        import pyarrow.compute as pc
+
+        order = "descending" if descending else "ascending"
+        idx = pc.sort_indices(col, sort_keys=[("", order)],
+                              null_placement="at_end")
+        return idx.to_numpy(zero_copy_only=False).astype(np.int64)
+    if col.dtype == object:
+        nonnull = np.asarray([i for i, v in enumerate(col) if v is not None],
+                             dtype=np.int64)
+        nulls = np.asarray([i for i, v in enumerate(col) if v is None],
+                           dtype=np.int64)
+        order = sorted(nonnull, key=lambda i: col[i])
+        if descending:
+            order = order[::-1]
+        return np.concatenate([np.asarray(order, dtype=np.int64), nulls]) \
+            if len(col) else np.empty(0, dtype=np.int64)
+    order = np.argsort(col, kind="stable")
+    return order[::-1] if descending else order
+
+
+def bucket_by_splitters(col, splitters) -> np.ndarray:
+    """Range-partition bucket index per row (side="right" semantics):
+    values land in buckets 0..len(splitters); null keys get a DEDICATED
+    final bucket len(splitters)+1, so nulls stay globally last under
+    both sort directions (a descending sort reverses the value
+    partitions but keeps the null partition at the end)."""
+    null_bucket = len(splitters) + 1
+    vals = column_to_numpy(col)
+    if vals.dtype == object:
+        spl = list(splitters)
+        out = np.empty(len(vals), dtype=np.int64)
+        for i, v in enumerate(vals):
+            out[i] = (null_bucket if v is None
+                      else bisect.bisect_right(spl, v))
+        return out
+    return np.searchsorted(np.asarray(splitters, dtype=vals.dtype), vals,
+                           side="right").astype(np.int64)
+
+
+def sample_column(col, k: int, seed: int = 0) -> list:
+    """k random non-null values (python objects) for splitter estimation."""
+    vals = [v for v in column_to_numpy(col).tolist() if v is not None]
+    if len(vals) <= k:
+        return vals
+    rng = np.random.default_rng(seed)
+    return [vals[i] for i in rng.choice(len(vals), k, replace=False)]
+
+
+def compute_splitters(samples: Iterable, P: int) -> list:
+    """P-1 range splitters from pooled key samples: rank-based quantiles
+    (sorted-sample element picks, the old np.percentile(method="nearest")
+    generalized to any comparable key type), deduplicated."""
+    pool = sorted(v for s in samples for v in s)
+    if P <= 1 or not pool:
+        return []
+    n = len(pool)
+    picks = [pool[min(n - 1, round(q * (n - 1)))]
+             for q in (i / P for i in range(1, P))]
+    out: list = []
+    for v in picks:
+        if not out or out[-1] != v:
+            out.append(v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Block helpers
+# ---------------------------------------------------------------------------
 def block_len(b: Block) -> int:
     if not b:
         return 0
@@ -24,42 +193,71 @@ def block_len(b: Block) -> int:
 
 def rows_to_block(rows: list) -> Block:
     """List of dicts (or scalars -> {'item': ...}) to a columnar block.
-    Columns are the union of keys; rows missing a key contribute None
-    (object dtype), matching Arrow's null semantics."""
+
+    Columns are the union of keys. Numeric/bool/datetime columns become
+    numpy; string columns, columns with MISSING keys, and anything numpy
+    would store as object dtype promote to Arrow arrays (missing values
+    become Arrow nulls — the old object-ndarray fallback silently broke
+    ``np.searchsorted`` on mixed None/value data). Without pyarrow the
+    object-ndarray fallback remains."""
     if not rows:
         return {}
     if not isinstance(rows[0], dict):
-        return {"item": np.asarray(rows)}
+        return {"item": _column_from_values(list(rows), has_missing=False)}
     keys: dict = {}
     for r in rows:
         for k in r:
             keys[k] = True
     cols = {}
+    missing = object()
     for key in keys:
-        missing = object()
         vals = [r.get(key, missing) for r in rows]
-        if any(v is missing for v in vals):
-            arr = np.empty(len(vals), dtype=object)
-            for i, v in enumerate(vals):
-                arr[i] = None if v is missing else v
-            cols[key] = arr
-            continue
-        try:
-            cols[key] = np.asarray(vals)
-        except (ValueError, TypeError):
-            cols[key] = np.asarray(vals, dtype=object)
+        has_missing = any(v is missing for v in vals)
+        if has_missing:
+            vals = [None if v is missing else v for v in vals]
+        cols[key] = _column_from_values(vals, has_missing)
     return cols
+
+
+def _column_from_values(vals: list, has_missing: bool):
+    """One column from python values: numpy for numerics, Arrow for
+    strings/nullable/object data, object ndarray as the no-pyarrow
+    fallback."""
+    if not has_missing:
+        try:
+            arr = np.asarray(vals)
+        except (ValueError, TypeError):
+            arr = None
+        if arr is not None and arr.dtype != object \
+                and arr.dtype.kind not in "US":
+            return arr
+    pa = _pa()
+    if pa is not None:
+        try:
+            return pa.array(vals)
+        except Exception:  # noqa: BLE001 - mixed/unsupported types
+            pass
+    arr = np.empty(len(vals), dtype=object)
+    for i, v in enumerate(vals):
+        arr[i] = v
+    return arr
 
 
 def block_to_rows(b: Block) -> Iterator[dict]:
     n = block_len(b)
     keys = list(b)
+    mats = {k: (b[k].to_pylist() if is_arrow(b[k]) else b[k]) for k in keys}
     for i in range(n):
-        yield {k: b[k][i] for k in keys}
+        yield {k: mats[k][i] for k in keys}
 
 
 def slice_block(b: Block, start: int, stop: int) -> Block:
-    return {k: v[start:stop] for k, v in b.items()}
+    return {k: slice_column(v, start, stop) for k, v in b.items()}
+
+
+def take_block(b: Block, indices) -> Block:
+    """Row-permute/gather every column (sort + shuffle apply paths)."""
+    return {k: take_column(v, indices) for k, v in b.items()}
 
 
 def concat_blocks(blocks: list) -> Block:
@@ -67,25 +265,43 @@ def concat_blocks(blocks: list) -> Block:
     if not blocks:
         return {}
     keys = blocks[0].keys()
-    return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+    return {k: concat_columns([b[k] for b in blocks]) for k in keys}
 
 
 def block_schema(b: Block) -> dict:
-    return {k: str(v.dtype) for k, v in b.items()}
+    return {k: (str(v.type) if is_arrow(v) else str(v.dtype))
+            for k, v in b.items()}
 
 
 def block_nbytes(b: Block) -> int:
-    return sum(v.nbytes for v in b.values())
+    return sum(column_nbytes(v) for v in b.values())
+
+
+def block_to_numpy(b: Block) -> Block:
+    """All-numpy view of a block (Arrow columns materialize as object/str
+    ndarrays) — the user-facing "numpy" batch format."""
+    return {k: column_to_numpy(v) for k, v in b.items()}
 
 
 def arrow_to_block(table) -> Block:
+    """Arrow table -> block: numeric columns land as numpy (zero-copy
+    when possible), strings/nullable/nested columns STAY Arrow."""
     out = {}
     for name in table.column_names:
-        col = table.column(name)
+        col = table.column(name).combine_chunks()
         try:
-            out[name] = col.to_numpy(zero_copy_only=False)
-        except Exception:
-            out[name] = np.asarray(col.to_pylist(), dtype=object)
+            arr = col.to_numpy(zero_copy_only=True)
+        except Exception:  # noqa: BLE001 - strings / nulls / nested
+            arr = None
+        if arr is None:
+            try:
+                arr = col.to_numpy(zero_copy_only=False)
+            except Exception:  # noqa: BLE001
+                arr = None
+            if arr is None or arr.dtype == object or arr.dtype.kind in "US":
+                out[name] = col
+                continue
+        out[name] = arr
     return out
 
 
@@ -93,9 +309,13 @@ def block_to_arrow(b: Block):
     import pyarrow as pa
 
     def col(v):
+        if is_arrow(v):
+            return v
         if getattr(v, "ndim", 1) > 1:
             # Multi-dim columns (images, tensors) become nested lists —
             # arrow has no first-class ndarray type.
+            return pa.array(v.tolist())
+        if v.dtype == object:
             return pa.array(v.tolist())
         return pa.array(v)
 

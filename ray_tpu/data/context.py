@@ -24,6 +24,15 @@ class DataContext:
     # Reduce-partition count for random_shuffle (None => one per input
     # block; reference: push-based shuffle's reducer parallelism knob).
     shuffle_num_partitions: int | None = None
+    # Push-based exchange: map outputs merge in rounds of at most this
+    # many upstream blocks per partition group, bounding in-flight
+    # partition refs at merge_factor * P for ANY input block count
+    # (reference: push_based_shuffle.py's merge_factor).
+    exchange_merge_factor: int = 8
+    # Output-partition cap for sort/groupby exchanges (None => capped
+    # default min(num_blocks, 32); P = num_blocks made the partition-ref
+    # fan-out quadratic on wide datasets).
+    sort_num_partitions: int | None = None
 
     _current = None
 

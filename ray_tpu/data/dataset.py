@@ -9,8 +9,18 @@ Design: consecutive row/batch transforms are *fused* into one per-block
 function (the reference's planner does the same — TaskPoolMapOperator
 fusion), then the streaming executor keeps at most
 DataContext.max_in_flight_blocks map tasks in flight, yielding blocks in
-order. All-to-all ops (repartition/shuffle/sort) materialize, reorganize,
-and continue lazily from the new source.
+order.
+
+All-to-all ops: ``repartition`` assembles output blocks with remote
+gather tasks over row spans; ``random_shuffle``/``sort``/``groupby`` run
+through the push-based pipelined exchange (exchange.py) — map tasks
+partition each block, per-round merge tasks eagerly combine partitions
+(merge-factor-bounded), per-partition finalize tasks permute/sort/
+aggregate. The driver holds at most O(merge_factor × P) partition refs
+at any instant instead of the full num_blocks × P matrix, and blocks are
+Arrow-optional columnar dicts (block.py) so string/heterogeneous keys
+sort and group natively. Each exchange continues lazily from the new
+ref source.
 """
 
 from __future__ import annotations
@@ -48,14 +58,18 @@ class _Stage:
 
 def _format_batch(blk: B.Block, batch_format: str):
     """Block -> the user-facing batch type (reference: batch_format in
-    map_batches/iter_batches — "numpy" | "pandas" | "pyarrow")."""
+    map_batches/iter_batches — "numpy" | "pandas" | "pyarrow"). Arrow
+    columns materialize as ndarrays for the numpy/pandas views."""
     if batch_format == "numpy":
-        return blk
+        return B.block_to_numpy(blk)
     if batch_format == "pandas":
         import pandas as pd
 
-        return pd.DataFrame({k: (list(v) if getattr(v, "ndim", 1) > 1
-                                 else v) for k, v in blk.items()})
+        def series(v):
+            v = B.column_to_numpy(v)
+            return list(v) if getattr(v, "ndim", 1) > 1 else v
+
+        return pd.DataFrame({k: series(v) for k, v in blk.items()})
     if batch_format == "pyarrow":
         return B.block_to_arrow(blk)
     raise ValueError(f"unknown batch_format {batch_format!r}")
@@ -64,7 +78,8 @@ def _format_batch(blk: B.Block, batch_format: str):
 def _unformat_batch(out) -> B.Block:
     """User batch output (dict | DataFrame | arrow Table) -> Block."""
     if isinstance(out, dict):
-        return {k: np.asarray(v) for k, v in out.items()}
+        return {k: (v if B.is_arrow(v) else np.asarray(v))
+                for k, v in out.items()}
     mod = type(out).__module__
     if mod.startswith("pandas"):
         return {k: np.asarray(out[k].tolist())
@@ -136,110 +151,16 @@ def _gather_spans(spans, *blocks):
         [B.slice_block(blk, lo, hi) for (lo, hi), blk in zip(spans, blocks)])
 
 
-def _shuffle_map(blk, P, seed, block_index):
-    """Randomly scatter a block's rows into P partitions."""
-    import numpy as np
-
-    import ray_tpu.data.block as B
-
-    n = B.block_len(blk)
-    rng = np.random.default_rng((seed, block_index))
-    assign = rng.integers(0, P, n)
-    parts = tuple({k: v[assign == r] for k, v in blk.items()}
-                  for r in range(P))
-    return parts[0] if P == 1 else parts
-
-
-def _shuffle_reduce(seed, r, *parts):
-    """Concat one partition column and locally permute it."""
-    import numpy as np
-
-    import ray_tpu.data.block as B
-
-    blk = B.concat_blocks(list(parts))
-    n = B.block_len(blk)
-    if n == 0:
-        return {}
-    perm = np.random.default_rng((seed, 1_000_003, r)).permutation(n)
-    return {k: v[perm] for k, v in blk.items()}
-
-
-def _sort_map(blk, key, splitters):
-    """Range-partition a block by key against the splitters."""
-    import numpy as np
-
-    import ray_tpu.data.block as B
-
-    P = len(splitters) + 1
-    if P == 1:
-        return blk
-    bucket = np.searchsorted(splitters, blk[key], side="right")
-    return tuple({k: v[bucket == r] for k, v in blk.items()}
-                 for r in range(P))
-
-
-def _sort_reduce(key, descending, *parts):
-    """Sort one key range locally."""
-    import numpy as np
-
-    import ray_tpu.data.block as B
-
-    blk = B.concat_blocks(list(parts))
-    if not B.block_len(blk):
-        return {}
-    order = np.argsort(blk[key], kind="stable")
-    if descending:
-        order = order[::-1]
-    return {k: v[order] for k, v in blk.items()}
-
-
-def _groupby_reduce(key, agg, on, *parts):
-    """Aggregate one key-range partition (groups are complete here)."""
-    import numpy as np
-
-    import ray_tpu.data.block as B
-
-    blk = B.concat_blocks(list(parts))
-    if not B.block_len(blk):
-        return {}
-    order = np.argsort(blk[key], kind="stable")
-    keys = blk[key][order]
-    uniq, starts = np.unique(keys, return_index=True)
-    bounds = list(starts) + [len(keys)]
-    vals = blk[on][order] if on is not None else None
-    out = []
-    for i in range(len(uniq)):
-        lo, hi = bounds[i], bounds[i + 1]
-        if agg == "count":
-            out.append(hi - lo)
-        elif agg == "sum":
-            out.append(vals[lo:hi].sum())
-        elif agg == "mean":
-            out.append(vals[lo:hi].mean())
-        elif agg == "min":
-            out.append(vals[lo:hi].min())
-        elif agg == "max":
-            out.append(vals[lo:hi].max())
-        else:
-            raise ValueError(agg)
-    col = agg if on is None else f"{agg}({on})"
-    return {key: uniq, col: np.asarray(out)}
-
-
 def _block_meta(blk, sample_key, samples_per_block):
-    """(len, key-samples|None) — exchange-planning metadata computed where
-    the block lives."""
-    import numpy as np
-
+    """(len, nbytes, key-samples|None) — exchange-planning metadata
+    computed where the block lives; never ships the block itself."""
     import ray_tpu.data.block as B
 
     n = B.block_len(blk)
     if sample_key is None or n == 0:
-        return n, None
-    col = blk[sample_key]
-    take = min(len(col), samples_per_block)
-    rng = np.random.default_rng(0)
-    return n, rng.choice(col, take, replace=False)
+        return n, B.block_nbytes(blk), None
+    return (n, B.block_nbytes(blk),
+            B.sample_column(blk[sample_key], samples_per_block))
 
 
 def _read_file(path, kind):
@@ -270,6 +191,13 @@ def _remote_opts():
     if ctx.execution_lane == "device":
         return {"scheduling_strategy": "device"}
     return {"num_cpus": 1}
+
+
+def _range_partition_count(num_blocks: int) -> int:
+    """Output-partition count for sort/groupby: capped by default —
+    P = num_blocks made the partition fan-out quadratic in block count."""
+    ctx = DataContext.get_current()
+    return max(1, ctx.sort_num_partitions or min(num_blocks, 32))
 
 
 class _ReadTransform:
@@ -413,40 +341,45 @@ class Dataset:
     # -- all-to-all (materializing) ---------------------------------------
     def _stage_refs(self, sample_key: Optional[str] = None,
                     samples_per_block: int = 64):
-        """(refs, lens[, key samples]) — the input side of every exchange.
+        """(refs, lens, nbytes[, key samples]) — the input side of every
+        exchange.
 
         Task-produced pipelines stay driver-free: the upstream refs are
-        consumed directly and per-block metadata (length, key samples)
-        comes back from small meta TASKS, never the blocks themselves.
-        Driver-local value sources keep the cheap inline path."""
+        consumed directly and per-block metadata (length, bytes, key
+        samples) comes back from small meta TASKS, never the blocks
+        themselves. Driver-local value sources keep the cheap inline
+        path."""
         import ray_tpu
 
         if (self._ref_source is None and self._read_plan is None
                 and not self._stages):
-            refs, lens, samples = [], [], []
+            refs, lens, nbytes, samples = [], [], [], []
             for blk in self.iter_blocks():
                 refs.append(ray_tpu.put(blk))
-                n, s = _block_meta(blk, sample_key, samples_per_block)
+                n, nb, s = _block_meta(blk, sample_key, samples_per_block)
                 lens.append(n)
+                nbytes.append(nb)
                 if sample_key is not None:
                     samples.append(s)
             if sample_key is not None:
-                return refs, lens, samples
-            return refs, lens
+                return refs, lens, nbytes, samples
+            return refs, lens, nbytes
 
         meta = ray_tpu.remote(**_remote_opts())(_block_meta)
         refs = list(self.iter_refs())
         metas = ray_tpu.get(
             [meta.remote(r, sample_key, samples_per_block) for r in refs])
-        lens = [m[0] for m in metas]
         # Drop empty blocks (transform outputs can be {}): exchanges
         # assume every staged block has rows.
-        keep = [i for i, n in enumerate(lens) if n]
-        refs = [refs[i] for i in keep]
+        keep = [i for i, m in enumerate(metas) if m[0]]
+        out = (
+            [refs[i] for i in keep],
+            [metas[i][0] for i in keep],
+            [metas[i][1] for i in keep],
+        )
         if sample_key is not None:
-            return (refs, [lens[i] for i in keep],
-                    [metas[i][1] for i in keep])
-        return refs, [lens[i] for i in keep]
+            return out + ([metas[i][2] for i in keep],)
+        return out
 
     def repartition(self, num_blocks: int) -> "Dataset":
         """Distributed: inputs are staged as object refs and each output
@@ -459,7 +392,7 @@ class Dataset:
         def ref_source():
             import ray_tpu
 
-            refs, lens = parent._stage_refs()
+            refs, lens, _nbytes = parent._stage_refs()
             total = sum(lens)
             if total == 0:
                 return
@@ -487,12 +420,13 @@ class Dataset:
         return Dataset(ref_source=ref_source)
 
     def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        """Distributed map/reduce shuffle (reference: push_based_shuffle,
-        python/ray/data/_internal/planner/exchange/push_based_shuffle...):
-        map tasks split each input block into P random partitions
-        (num_returns=P refs), reduce tasks concat their column of parts
-        and locally permute — peak memory per task is O(rows/P), and the
-        exchange rides the object plane, not the driver."""
+        """Distributed shuffle via the push-based pipelined exchange
+        (exchange.py; reference: push_based_shuffle.py): map tasks split
+        each block into P random partitions, merge tasks combine them in
+        bounded rounds while later map rounds are still running, and
+        per-partition finalize tasks locally permute. Peak memory per
+        task is O(rows/P); in-flight partition refs are bounded at
+        merge_factor × P regardless of block count."""
         parent = self
         # Pin the seed at graph-construction time: shards from
         # streaming_split and re-executions must all observe the SAME
@@ -501,27 +435,19 @@ class Dataset:
             seed = int(np.random.default_rng().integers(2 ** 31))
 
         def ref_source():
-            import ray_tpu
+            from . import exchange as X
 
-            refs, _lens = parent._stage_refs()
+            refs, _lens, nbytes = parent._stage_refs()
             if not refs:
                 return
             ctx = DataContext.get_current()
             # Default partition count is capped: P = len(refs) made the
             # ref fan-out O(blocks^2) on wide datasets (VERDICT r2 weak 6).
             P = max(1, ctx.shuffle_num_partitions or min(len(refs), 32))
-            opts = _remote_opts()
-            mapper = ray_tpu.remote(num_returns=P, **opts)(_shuffle_map)
-            cols = [[] for _ in builtins.range(P)]
-            for m, ref in enumerate(refs):
-                out = mapper.remote(ref, P, seed, m)
-                if P == 1:
-                    out = [out]
-                for r in builtins.range(P):
-                    cols[r].append(out[r])
-            reducer = ray_tpu.remote(**opts)(_shuffle_reduce)
-            for r in builtins.range(P):
-                yield reducer.remote(seed, r, *cols[r])
+            yield from X.run_exchange(
+                X.shuffle_spec(seed), refs, P, _remote_opts(),
+                nbytes=nbytes,
+                free_inputs=parent._frees_consumed_blocks())
 
         return Dataset(ref_source=ref_source)
 
@@ -533,43 +459,35 @@ class Dataset:
         return GroupedData(self, key)
 
     def sort(self, key: str, *, descending: bool = False) -> "Dataset":
-        """Distributed sample-partitioned sort (reference: the sort
-        exchange, _internal/planner/exchange/sort_task_spec.py): the
-        driver picks range splitters from per-block samples, map tasks
-        range-partition each block, reduce tasks sort their range —
-        outputs stream back in global key order."""
+        """Distributed sample-partitioned sort through the push-based
+        exchange (reference: the sort exchange,
+        _internal/planner/exchange/sort_task_spec.py): the driver picks
+        range splitters from per-block key samples, map tasks
+        range-partition each block, bounded merge rounds accumulate each
+        key range, finalize tasks sort their range — outputs stream back
+        in global key order. Arrow-backed key columns make string (and
+        nullable) keys first-class; nulls order last."""
         parent = self
 
         def source():
-            import ray_tpu
+            from . import exchange as X
 
-            refs, _lens, samples = parent._stage_refs(sample_key=key)
+            refs, _lens, nbytes, samples = parent._stage_refs(
+                sample_key=key)
             if not refs:
                 return
-            sample = np.concatenate(samples) if samples else np.array([])
-            P = max(1, len(refs))
-            if P > 1 and len(sample):
-                qs = np.linspace(0, 100, P + 1)[1:-1]
-                splitters = np.percentile(np.sort(sample), qs,
-                                          method="nearest")
-                splitters = np.unique(splitters)
-            else:
-                splitters = np.array([])
-            P = len(splitters) + 1  # degenerate key ranges collapse
-            opts = _remote_opts()
-            mapper = ray_tpu.remote(num_returns=P, **opts)(_sort_map)
-            cols = [[] for _ in builtins.range(P)]
-            for ref in refs:
-                out = mapper.remote(ref, key, splitters)
-                if P == 1:
-                    out = [out]
-                for r in builtins.range(P):
-                    cols[r].append(out[r])
-            reducer = ray_tpu.remote(**opts)(_sort_reduce)
-            pending = [reducer.remote(key, descending, *cols[r])
-                       for r in builtins.range(P)]
-            if descending:
-                pending.reverse()
+            P = _range_partition_count(len(refs))
+            splitters = B.compute_splitters(samples, P)
+            # Partitions: len(splitters)+1 key ranges (degenerate ranges
+            # collapse) + one dedicated null partition at the end.
+            P = len(splitters) + 2
+            pending = X.run_exchange(
+                X.sort_spec(key, splitters, descending), refs, P,
+                _remote_opts(), nbytes=nbytes,
+                free_inputs=parent._frees_consumed_blocks())
+            if descending and len(pending) > 1:
+                # Reverse the value partitions; nulls stay LAST.
+                pending = pending[-2::-1] + pending[-1:]
             yield from pending
 
         return Dataset(ref_source=source)
@@ -712,6 +630,7 @@ class Dataset:
                 return list(B.block_to_rows(blk))
             if batch_format in ("pandas", "pyarrow"):
                 return _format_batch(blk, batch_format)
+            blk = B.block_to_numpy(blk)
             if dtypes:
                 blk = {k: v.astype(dtypes.get(k, v.dtype))
                        for k, v in blk.items()}
@@ -756,8 +675,7 @@ class Dataset:
         import pandas as pd
 
         full = B.concat_blocks(list(self.iter_blocks()))
-        return pd.DataFrame({k: list(v) if v.ndim > 1 else v
-                             for k, v in full.items()})
+        return _format_batch(full, "pandas")
 
     def to_arrow(self):
         """Materialize as one pyarrow Table (reference: to_arrow_refs)."""
@@ -1037,32 +955,18 @@ class GroupedData:
         ds, key = self._ds, self._key
 
         def source():
-            import ray_tpu
+            from . import exchange as X
 
-            refs, _lens, samples = ds._stage_refs(sample_key=key)
+            refs, _lens, nbytes, samples = ds._stage_refs(sample_key=key)
             if not refs:
                 return
-            sample = np.concatenate(samples) if samples else np.array([])
-            P = max(1, len(refs))
-            if P > 1 and len(sample):
-                qs = np.linspace(0, 100, P + 1)[1:-1]
-                splitters = np.unique(np.percentile(
-                    np.sort(sample), qs, method="nearest"))
-            else:
-                splitters = np.array([])
-            P = len(splitters) + 1
-            opts = _remote_opts()
-            mapper = ray_tpu.remote(num_returns=P, **opts)(_sort_map)
-            cols = [[] for _ in builtins.range(P)]
-            for ref in refs:
-                out = mapper.remote(ref, key, splitters)
-                if P == 1:
-                    out = [out]
-                for r in builtins.range(P):
-                    cols[r].append(out[r])
-            reducer = ray_tpu.remote(**opts)(_groupby_reduce)
-            for r in builtins.range(P):
-                yield reducer.remote(key, agg, on, *cols[r])
+            P = _range_partition_count(len(refs))
+            splitters = B.compute_splitters(samples, P)
+            P = len(splitters) + 2  # +1 key ranges, +1 null partition
+            yield from X.run_exchange(
+                X.groupby_spec(key, splitters, agg, on), refs, P,
+                _remote_opts(), nbytes=nbytes,
+                free_inputs=ds._frees_consumed_blocks())
 
         return Dataset(ref_source=source)
 

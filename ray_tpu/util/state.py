@@ -169,6 +169,52 @@ def summarize_tasks() -> dict:
     return out
 
 
+def list_exchanges(filters: Optional[Sequence[Filter]] = None,
+                   limit: Optional[int] = None) -> list[dict]:
+    """Rows for recent/active Data exchanges (random_shuffle/sort/
+    groupby through the push-based shuffle): op, state (RUNNING/
+    FINISHED), num_blocks, num_partitions, merge_factor, rounds_total/
+    rounds_completed, map/merge/reduce task counts, bytes_shuffled, and
+    the in-flight partition-ref accounting (inflight_parts,
+    inflight_parts_high_water vs inflight_bound = merge_factor × P).
+    Driver-side: the exchange coordinator runs in the driver, so no
+    cluster RPC is involved."""
+    from ..data.exchange import list_exchange_stats
+
+    rows = list_exchange_stats()
+    for r in rows:
+        r.pop("events", None)
+    return _apply_filters(rows, filters, limit)
+
+
+def summarize_exchanges() -> dict:
+    """Per-op rollup of the exchange registry — counts, rounds, bytes,
+    and the worst observed in-flight-ref high-water — plus the matching
+    ``exchange_*`` task-stage rows from ``summarize_tasks`` keyed next
+    to it (the stage tasks carry names exchange_map[op]/
+    exchange_merge[op]/exchange_reduce[op])."""
+    per_op: dict[str, dict] = {}
+    for r in list_exchanges():
+        o = per_op.setdefault(r["op"], {
+            "exchanges": 0, "active": 0, "rounds_completed": 0,
+            "bytes_shuffled": 0, "map_tasks": 0, "merge_tasks": 0,
+            "reduce_tasks": 0, "inflight_parts_high_water": 0,
+            "inflight_bound": 0})
+        o["exchanges"] += 1
+        o["active"] += r["state"] == "RUNNING"
+        for k in ("rounds_completed", "bytes_shuffled", "map_tasks",
+                  "merge_tasks", "reduce_tasks"):
+            o[k] += r[k]
+        for k in ("inflight_parts_high_water", "inflight_bound"):
+            o[k] = max(o[k], r[k])
+    try:
+        stages = {name: row for name, row in summarize_tasks().items()
+                  if name.startswith("exchange_")}
+    except RuntimeError:  # no runtime — registry is still readable
+        stages = {}
+    return {"ops": per_op, "stages": stages}
+
+
 def cluster_metrics() -> dict:
     """Per-node counters + store stats + worker counts, keyed by node id
     (reference: the dashboard's node metrics endpoint / stats exporter).

@@ -33,12 +33,35 @@ import pytest  # noqa: E402
 os.environ.setdefault("RT_LOOP_WATCHDOG_S", "5")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "pyarrow: test exercises the Arrow block path; auto-skipped "
+        "when pyarrow is not installed")
+
+
+def _have_pyarrow() -> bool:
+    try:
+        import pyarrow  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
 def pytest_collection_modifyitems(config, items):
     """The solo perf gate (test_perf_gate.py) must run FIRST — its
     floors assume no sibling test's workers/daemons are alive (VERDICT
     r4 weak 6: a perf stage measured under suite load stops being a
-    regression detector)."""
+    regression detector). Arrow-path tests skip cleanly without
+    pyarrow (the block format degrades to object ndarrays, but these
+    tests assert Arrow-specific behavior)."""
     items.sort(key=lambda it: 0 if "test_perf_gate" in it.nodeid else 1)
+    if not _have_pyarrow():
+        skip = pytest.mark.skip(reason="pyarrow not installed")
+        for it in items:
+            if "pyarrow" in it.keywords:
+                it.add_marker(skip)
 
 
 @pytest.fixture

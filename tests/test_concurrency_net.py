@@ -161,6 +161,16 @@ _NODE_TRANSITION_SITES = (
 _WORKER_TRANSITION_SITES = (
     "_execute",            # ARGS_FETCHED + OUTPUT_SERIALIZED
 )
+# Every merge-round state change in the push-based exchange coordinator
+# (data/exchange.py): each must emit into the exchange registry or
+# list_exchanges/the dashboard pane silently lose that transition.
+_EXCHANGE_TRANSITION_SITES = (
+    "_submit_map_round",    # MAP_ROUND_SUBMITTED
+    "_submit_merge_round",  # MERGE_ROUND_SUBMITTED
+    "_drain_round",         # ROUND_COMPLETED
+    "_submit_reduce",       # REDUCE_SUBMITTED
+    "_finish",              # FINISHED
+)
 
 
 def test_every_task_transition_site_emits_an_event():
@@ -174,6 +184,16 @@ def test_every_task_transition_site_emits_an_event():
     assert not missing, (
         f"task state-transition site(s) emit no lifecycle event "
         f"(self._event / self._task_event): {missing}")
+
+
+def test_every_exchange_transition_site_emits_an_event():
+    missing = [
+        f"exchange.{m}" for m in _methods_missing_call(
+            REPO / "ray_tpu/data/exchange.py",
+            _EXCHANGE_TRANSITION_SITES, "_event")]
+    assert not missing, (
+        f"exchange merge-round state-transition site(s) emit no "
+        f"lifecycle event (self._event): {missing}")
 
 
 def test_event_lint_catches_a_silent_site(tmp_path):

@@ -98,6 +98,37 @@ def test_timeline_endpoint(dash):
     assert "Task timeline" in html and "api/timeline" in html
 
 
+def test_exchange_progress_series(dash):
+    """The push-based exchange feeds /api/timeline: cumulative totals
+    plus rounds-completed / MB-shuffled sparkline series, and the page
+    renders the pane."""
+    from ray_tpu.data import DataContext
+    from ray_tpu import data as rd
+
+    ctx = DataContext.get_current()
+    old = ctx.execution_lane
+    ctx.execution_lane = "device"
+    try:
+        assert rd.range(80, override_num_blocks=8) \
+            .random_shuffle(seed=5).count() == 80
+    finally:
+        ctx.execution_lane = old
+
+    from ray_tpu import dashboard as dash_mod
+
+    dash_mod._snap_cache["t"] = 0.0  # bypass the 1s TTL for the assert
+    body = json.loads(_get(dash + "/api/timeline"))
+    x = body["exchange"]
+    assert x["exchanges"] >= 1 and x["rounds_completed"] >= 1
+    assert x["map_tasks"] >= 8 and x["reduce_tasks"] >= 1
+    series = body["series"]
+    assert len(series["exchange_rounds"]) == len(series["ts"]) >= 1
+    assert series["exchange_rounds"][-1] >= 1
+    assert series["exchange_mb"][-1] >= 0.0
+    html = _get(dash + "/")
+    assert "Data exchange" in html and "exchange_rounds" in html
+
+
 def test_new_operator_panes(rt):
     """Serve/RPC/logs endpoints feed the page's r5 panes."""
     import json

@@ -1,10 +1,12 @@
-"""Distributed data exchanges: map/reduce shuffle, sample-partitioned
-sort, ref-based repartition, and one-pass streaming_split.
+"""Distributed data exchanges: push-based pipelined shuffle/sort/groupby
+(data/exchange.py), ref-based repartition, and one-pass streaming_split.
 
 Parity models: /root/reference/python/ray/data/_internal/planner/
 exchange/ (push_based_shuffle.py, sort_task_spec.py) and the reference
 streaming_split coordinator. These replace the round-1 driver-concat
-implementations (VERDICT r1 weak item 5).
+implementations (VERDICT r1 weak item 5); the bound tests below pin the
+push-based property — in-flight partition refs stay ≤ merge_factor × P
+at ≥1024 input blocks, not the old num_blocks × P matrix.
 """
 
 import os
@@ -16,6 +18,7 @@ import pytest
 import ray_tpu
 from ray_tpu import data as rd
 from ray_tpu.data import DataContext
+from ray_tpu.data import exchange as X
 
 
 @pytest.fixture(autouse=True)
@@ -159,6 +162,145 @@ class TestStreamingSplitOnePass:
         for i in range(3):
             for j in range(i + 1, 3):
                 assert not (set(rows[i]) & set(rows[j]))
+
+def _last_exchange(op: str) -> dict:
+    recs = [r for r in X.list_exchange_stats() if r["op"] == op]
+    assert recs, f"no exchange record for {op}"
+    return recs[-1]
+
+
+def _assert_bounded(rec: dict, num_blocks: int):
+    P = rec["num_partitions"]
+    bound = rec["merge_factor"] * P
+    hw = rec["inflight_parts_high_water"]
+    assert rec["num_blocks"] == num_blocks
+    assert rec["state"] == "FINISHED"
+    assert rec["rounds_completed"] == rec["rounds_total"] >= 2
+    assert 0 < hw <= bound, (hw, bound)
+    # The property the subsystem exists for: NOT the full ref matrix.
+    assert hw < num_blocks * P
+    assert rec["inflight_parts"] == 0  # all rounds drained
+
+
+class TestPushBasedBounds:
+    """In-flight partition refs stay ≤ merge_factor × P at ≥1024 input
+    blocks (the old all-at-once fan-out held num_blocks × P)."""
+
+    NB = 1024
+
+    def _items(self):
+        return [{"k": i % 7, "id": i} for i in range(2 * self.NB)]
+
+    def test_shuffle_1024_blocks(self):
+        ds = rd.from_items(self._items(), override_num_blocks=self.NB)
+        out = ds.random_shuffle(seed=11)
+        ids = [r["id"] for r in out.take_all()]
+        assert sorted(ids) == list(range(2 * self.NB)) and \
+            ids != sorted(ids)
+        _assert_bounded(_last_exchange("random_shuffle"), self.NB)
+
+    def test_sort_1024_blocks(self):
+        ds = rd.from_items(self._items(), override_num_blocks=self.NB)
+        ids = [r["id"] for r in ds.sort("id").take_all()]
+        assert ids == list(range(2 * self.NB))
+        _assert_bounded(_last_exchange("sort"), self.NB)
+
+    def test_groupby_1024_blocks(self):
+        ds = rd.from_items(self._items(), override_num_blocks=self.NB)
+        counts = {r["k"]: r["count"]
+                  for r in ds.groupby("k").count().take_all()}
+        want = {k: len([i for i in range(2 * self.NB) if i % 7 == k])
+                for k in range(7)}
+        assert counts == want
+        _assert_bounded(_last_exchange("groupby"), self.NB)
+
+    def test_state_api_surfaces_exchanges(self):
+        """list_exchanges/summarize_exchanges expose the registry rows
+        the bound asserts read (the observability satellite)."""
+        from ray_tpu.util import state
+
+        assert rd.range(40, override_num_blocks=4) \
+            .random_shuffle(seed=2).count() == 40
+        rows = state.list_exchanges(
+            filters=[("op", "=", "random_shuffle")])
+        assert rows and rows[-1]["state"] == "FINISHED"
+        assert "events" not in rows[-1]  # trimmed for the list surface
+        summ = state.summarize_exchanges()
+        assert "random_shuffle" in summ["ops"]
+        ops = summ["ops"]["random_shuffle"]
+        assert ops["inflight_parts_high_water"] <= ops["inflight_bound"]
+        # Stage tasks carry observability names -> per-stage rows.
+        assert any(n.startswith("exchange_map[") for n in summ["stages"])
+
+
+@pytest.mark.pyarrow
+class TestArrowStringKeys:
+    """String (and nullable) key columns ride Arrow-backed columns
+    through the exchange: sort/groupby work where the object-ndarray
+    format raised in np.searchsorted."""
+
+    WORDS = ["pear", "apple", "fig", "kiwi", "apple", "plum", "date"]
+
+    def _rows(self, with_missing=False):
+        rows = [{"s": self.WORDS[i % len(self.WORDS)], "i": i}
+                for i in range(140)]
+        if with_missing:
+            for i in (3, 77):
+                rows[i] = {"i": i}  # missing key -> Arrow null
+        return rows
+
+    def test_string_sort_global_order(self):
+        ds = rd.from_items(self._rows(), override_num_blocks=7)
+        out = ds.sort("s").take_all()
+        ss = [r["s"] for r in out]
+        assert ss == sorted(ss)
+        # Rows stay aligned with their payload column.
+        assert all(self.WORDS[r["i"] % len(self.WORDS)] == r["s"]
+                   for r in out)
+
+    def test_string_sort_descending_and_nulls_last(self):
+        ds = rd.from_items(self._rows(with_missing=True),
+                           override_num_blocks=7)
+        ss = [r["s"] for r in ds.sort("s").take_all()]
+        assert ss[-2:] == [None, None]  # nulls order LAST
+        assert ss[:-2] == sorted(ss[:-2])
+        ss = [r["s"] for r in ds.sort("s", descending=True).take_all()]
+        assert ss[-2:] == [None, None]
+        assert ss[:-2] == sorted(ss[:-2], reverse=True)
+
+    def test_string_groupby(self):
+        import collections
+
+        rows = self._rows(with_missing=True)
+        ds = rd.from_items(rows, override_num_blocks=7)
+        got = {r["s"]: r["count"]
+               for r in ds.groupby("s").count().take_all()}
+        want = collections.Counter(r.get("s") for r in rows)
+        assert got == dict(want)
+
+    def test_rows_to_block_missing_key_promotes_arrow(self):
+        """Satellite regression: a column with missing keys becomes an
+        Arrow null-backed array — NOT an object ndarray that breaks
+        range-partitioning (np.searchsorted raised TypeError on
+        mixed str/None)."""
+        from ray_tpu.data import block as B
+
+        blk = B.rows_to_block([{"s": "b"}, {"x": 1}, {"s": "a"}])
+        assert B.is_arrow(blk["s"])
+        bucket = B.bucket_by_splitters(blk["s"], ["aa"])
+        # null -> the DEDICATED final bucket; "a" < "aa" < "b".
+        assert bucket.tolist() == [1, 2, 0]
+
+    def test_arrow_blocks_round_trip_exchange(self):
+        """Arrow columns survive map/merge/finalize concatenation, and
+        numeric columns stay numpy end to end."""
+        from ray_tpu.data import block as B
+
+        ds = rd.from_items(self._rows(), override_num_blocks=7)
+        blocks = list(ds.sort("s").iter_blocks())
+        assert any(B.is_arrow(b["s"]) for b in blocks)
+        assert all(isinstance(b["i"], np.ndarray) for b in blocks)
+
 
 class TestGroupBy:
     def test_count_sum_mean(self):
