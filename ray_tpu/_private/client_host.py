@@ -138,6 +138,29 @@ class SessionHost:
             return r
         return ent[0]
 
+    def submit_spec_nb(self, payload):
+        """Fire-and-forget submit from the client (cpu-lane fast path):
+        no reply — the client computed the return ids locally. Track the
+        refs here (cluster-side refcounts live in this registry); a
+        failed submission poisons those ids so the error surfaces on the
+        client's next get()."""
+        rt = self.rt
+        rids = payload["rids"]
+        try:
+            spec = cloudpickle.loads(payload["blob"])
+            refs = rt.submit_spec(spec)
+        except BaseException as e:  # noqa: BLE001 - poison the returns
+            from .exceptions import TaskError
+
+            err = e if isinstance(e, TaskError) \
+                else TaskError.from_exception(e, "submit")
+            for b in rids:
+                self._track(ObjectRef(ObjectID(b), _register=True))
+                rt._call_soon(rt.node.mark_error, ObjectID(b), err)
+            return
+        for r in refs:
+            self._track(r)
+
     # -- dispatch (runs in self.pool threads) ----------------------------
     def handle(self, method: str, payload):
         rt = self.rt
@@ -270,6 +293,12 @@ async def _serve(host: SessionHost, sock_path: str):
     async def handler(conn, method, payload):
         if method == "subscribe_logs":
             host._log_conns.add(conn)
+            return True
+        if method == "submit_spec_nb":
+            # Fire-and-forget submit: handled INLINE (not on the pool)
+            # so the registry holds the refs before any pool-dispatched
+            # get()/wait() the client pipelined right behind it.
+            host.submit_spec_nb(payload)
             return True
         if method == "pubsub_subscribe":
             # Registered here (not via host.handle) because delivery

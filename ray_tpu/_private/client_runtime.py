@@ -205,9 +205,15 @@ class ClientRuntime:
         return fid
 
     def submit_spec(self, spec) -> list[ObjectRef]:
-        ids = self._call("submit_spec", cloudpickle.dumps(spec),
-                              timeout=120)
-        return [ObjectRef(ObjectID(b), _register=False) for b in ids]
+        # Fire-and-forget (cpu-lane fast path): the submit reply is just
+        # the return ids, which are deterministic — compute them locally
+        # and skip the proxy round trip. The host tracks the refs and a
+        # failed submission poisons exactly these ids (error
+        # backchannel), so a later get() raises the original error.
+        rids = [oid.binary() for oid in spec.return_ids()]
+        self._conn.notify("submit_spec_nb",
+                          {"blob": cloudpickle.dumps(spec), "rids": rids})
+        return [ObjectRef(ObjectID(b), _register=False) for b in rids]
 
     def put(self, value: Any) -> ObjectRef:
         b = self._call("put", cloudpickle.dumps(value), timeout=120)

@@ -9,6 +9,48 @@ resolve in priority order:
   1. `init(system_config={...})` overrides,
   2. `RT_<NAME>` environment variables,
   3. the declared default.
+
+CPU-lane fast path (ISSUE 4)
+----------------------------
+Three knobs govern the pipelined CPU-lane dispatch path (reference:
+Ray's direct task calls against leased workers — OSDI '18; Ownership,
+NSDI '21):
+
+  * ``worker_pipeline_depth`` — how many task specs the node pushes to
+    one worker's serial FIFO lane before the first reply returns. 1
+    restores strict one-at-a-time dispatch; deeper windows hide the
+    node<->worker round trip and dispatcher latency at the cost of
+    head-of-line exposure (a pushed spec is bound to its worker).
+  * ``rpc_coalesce_max_bytes`` / ``rpc_coalesce_max_frames`` — caps for
+    the writer-side frame coalescing in both RPC stacks (threaded
+    DuplexClient: vectored ``sendmsg`` of frames parked while another
+    thread owned the socket; asyncio ServerConn: same-tick buffering so
+    a burst of replies/notifies is one transport write). An idle writer
+    always flushes immediately — depth-1 latency is unchanged.
+
+Measured effect (same-day interleaved A/B, 1-core CI box, 100-task
+bursts on 2 workers — `python -m ray_tpu.scripts.microbench` rows;
+absolute rates swing ~2x day-to-day on this box, ratios are the
+signal):
+
+  ====================  ==========  ==========================
+  metric                unpipelined  pipelined fast path
+  ====================  ==========  ==========================
+  task_cpu_async        ~375/s      depth 4 ~505/s (1.35x),
+                                    depth 8 ~820/s (1.5-2.2x
+                                    across box states),
+                                    depth 16 ~1,340/s (3.6x)
+  actor_call_async      ~2,530/s    ~3,170/s (+25%)
+  task_cpu_sync         parity within noise (the sequential
+                        round trip is execute+reply bound;
+                        pipelining never engages at window 1)
+  ====================  ==========  ==========================
+
+The same PR made worker-side ``submit_spec`` (and the client-runtime
+equivalent) fire-and-forget — the reply was just ``spec.return_ids()``,
+computable locally; submission failures now poison the returned refs
+(error backchannel) — and batched worker-side ``get()`` into a single
+``fetch_objects`` RPC.
 """
 
 from __future__ import annotations
@@ -123,6 +165,34 @@ class Config:
     # A locally-feasible task waiting longer than this with zero local
     # capacity is offered to the head for spillback to another node.
     spillback_delay_s: float = _cfg(0.2)
+
+    # --- cpu-lane fast path ---
+    # Pipelined worker dispatch: how many task specs the node may push to
+    # one CPU worker's serial execution lane before the first reply comes
+    # back (reference: Ray's direct task calls against leased workers —
+    # the next task is already on the worker when the current finishes,
+    # so the per-task cost amortizes the node<->worker round trip).
+    # 1 restores strict one-at-a-time dispatch; deeper windows trade
+    # head-of-line blocking (a pushed spec is bound to its worker, so a
+    # slow head task delays everything queued behind it even when other
+    # workers free up) for dispatcher-latency tolerance. The scan only
+    # engages once the pool can no longer grant a fresh lease, so a
+    # spec that could run on its own worker (or a pending fork) is
+    # never parked behind a head that might block on it; and with peer
+    # nodes alive (heartbeat ack carries the count), spillback gets the
+    # first shot — cluster-idle capacity beats local queuing, and
+    # pipelining takes the spec only after the head declines. Same-day A/B
+    # on the 1-core CI box: depth 4 ≈ 1.35x, 8 ≈ 1.5-2.2x, 16 ≈ 3.6x
+    # the unpipelined task_cpu_async burst rate — 8 is the default's
+    # throughput/fairness compromise.
+    worker_pipeline_depth: int = _cfg(8)
+    # RPC writer-side frame coalescing: frames queued while the socket is
+    # busy are merged into one vectored write. The caps bound a batch so
+    # multi-MB object-plane chunks still interleave with control frames;
+    # an idle writer always flushes immediately (no added latency when
+    # nothing is queued).
+    rpc_coalesce_max_bytes: int = _cfg(256 * 1024)
+    rpc_coalesce_max_frames: int = _cfg(64)
 
     # --- metrics / events ---
     metrics_export_interval_s: float = _cfg(5.0)

@@ -97,6 +97,10 @@ class HeadService:
         self.session_id = session_id
         self.loop = loop
         self.nodes: dict[NodeID, NodeEntry] = {}
+        # Alive-entry count maintained at membership transitions so the
+        # per-heartbeat peer-count ack stays O(1) (a scan of self.nodes
+        # per heartbeat turns membership churn quadratic).
+        self._alive_count = 0
         self.kv: dict[str, Any] = {}
         self.functions: dict[str, bytes] = {}
         self.named_actors: dict[str, dict] = {}  # name -> {actor_id, node_id, methods}
@@ -284,6 +288,9 @@ class HeadService:
     def attach_local_node(self, node_service, entry: NodeEntry):
         """The driver process's own NodeService (head node)."""
         self._local_node_service = node_service
+        prev = self.nodes.get(entry.node_id)
+        if prev is None or prev.state != ALIVE:
+            self._alive_count += 1
         self.nodes[entry.node_id] = entry
 
     # ------------------------------------------------------------------
@@ -301,6 +308,9 @@ class HeadService:
             resources=dict(resources), available=dict(resources), conn=conn,
             is_driver=is_driver, node_type=node_type,
             is_head_node=is_head_node, labels=dict(labels or {}))
+        prev = self.nodes.get(node_id)
+        if prev is None or prev.state != ALIVE:
+            self._alive_count += 1
         self.nodes[node_id] = entry
         if conn is not None:
             conn.meta["node_id"] = node_id
@@ -372,7 +382,13 @@ class HeadService:
         if self._pending_pg_ids and any(
                 v > old.get(k, 0) for k, v in entry.available.items()):
             self._schedule_pg_retry()
-        return True
+        # Ack with the count of OTHER alive nodes (0 is a valid ack;
+        # only a literal False means re-register): the node caches it
+        # so the dispatcher knows whether spillback could ever place
+        # work elsewhere — with zero peers it pipelines parked specs
+        # immediately instead of pointlessly offering them to the head.
+        # O(1): the count is maintained at membership transitions.
+        return max(0, self._alive_count - 1)
 
     async def _health_monitor(self):
         """Mark nodes dead on heartbeat silence (reference:
@@ -405,6 +421,8 @@ class HeadService:
             await self._mark_node_dead(entry, "connection lost")
 
     async def _mark_node_dead(self, entry: NodeEntry, cause: str):
+        if entry.state == ALIVE:
+            self._alive_count -= 1
         entry.state = DEAD
         entry.available = {}
         # Drop directory entries that pointed at the dead node (the table

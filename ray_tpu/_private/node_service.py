@@ -236,6 +236,13 @@ class WorkerHandle:
     # Node id (bytes) of the driver that owns the task this worker is
     # (last) running: routes its log lines to that driver's console.
     owner_node: Optional[bytes] = None
+    # CPU lease charge (cpu-lane fast path): the pool and amount debited
+    # when this worker took its current lease. Pipelined specs piggyback
+    # on the lease — the worker executes one task at a time on its
+    # serial lane, so one charge covers the whole in-flight window; it
+    # is credited back when inflight drains empty.
+    charged_pool: Optional[dict] = None
+    charged_cpu: float = 0.0
 
 
 @dataclass
@@ -308,6 +315,11 @@ class NodeService:
         self.node_id = node_id or NodeID.from_random()
         self.head = head  # LocalHeadClient | RemoteHeadClient | None
         self.is_head_node = is_head_node
+        # Other alive nodes per the last heartbeat ack: 0 ⇒ spillback
+        # can never place work elsewhere, so the dispatcher pipelines
+        # parked specs immediately.
+        self._peer_nodes = 0
+        self._spill_kick_pending = False
         self.total_resources = dict(resources)
         self.available = dict(resources)
         # Node labels for label-selector scheduling: auto labels + the
@@ -718,6 +730,10 @@ class NodeService:
                 if ok is False:
                     # Head lost track of us (restart/expiry): re-register.
                     await self._register_with_head()
+                elif isinstance(ok, int) and not isinstance(ok, bool):
+                    # The ack carries the count of other alive nodes —
+                    # the dispatcher's "could spillback ever help" bit.
+                    self._peer_nodes = ok
             except (ConnectionLost, RpcTimeout, OSError):
                 pass
             await asyncio.sleep(self.cfg.heartbeat_interval_s)
@@ -1325,7 +1341,6 @@ class NodeService:
             self.incref_ref(ObjectID(oid_b),
                             tuple(owner) if owner else None)
         spec._remote = False
-        spec._charged = None
         self._event(spec, "RECONSTRUCTING")
         self._route(spec)
         return True
@@ -1831,6 +1846,12 @@ class NodeService:
                     spec._spill_inflight = True
                     self.spawn(self._try_spill(spec))
                     continue
+                if self._spill_candidate(spec):
+                    # Parked awaiting its spillback window; nothing else
+                    # may re-kick dispatch before it opens (few pending
+                    # specs ⇒ no deep-queue re-kick, head task may run
+                    # for minutes) — so schedule one.
+                    self._schedule_spill_kick()
                 still_pending.append(spec)
                 self._dispatch_misses += 1
                 if self._dispatch_misses >= 4:
@@ -1855,6 +1876,34 @@ class NodeService:
         for actor in self.actors.values():
             if actor.queue:
                 self._pump_actor(actor)
+
+    def _schedule_spill_kick(self):
+        """One coalesced delayed dispatch re-run, timed so parked spill
+        candidates come back through _should_spill after their
+        spillback_delay_s window has opened."""
+        if self._spill_kick_pending or self._closing:
+            return
+        self._spill_kick_pending = True
+
+        def kick():
+            self._spill_kick_pending = False
+            self._dispatch()
+
+        self.loop.call_later(self.cfg.spillback_delay_s + 0.02, kick)
+
+    def _spill_candidate(self, spec: TaskSpec) -> bool:
+        """True while the spillback path should get the first shot at a
+        spec the local pool can't freshly lease: a head is attached, the
+        spec is spillable (default strategy, not already spilled here),
+        and no spill offer has been declined yet. Such specs park
+        instead of pipelining so cluster-idle capacity wins over local
+        queuing."""
+        return (self.head is not None
+                and self._peer_nodes > 0
+                and not getattr(spec, "_remote", False)
+                and spec.strategy.kind == "default"
+                and spec.actor_id is None
+                and getattr(spec, "_spill_cooldown", 0.0) == 0.0)
 
     def _should_spill(self, spec: TaskSpec) -> bool:
         """A locally-queued task stuck behind zero capacity is offered to
@@ -1916,48 +1965,83 @@ class NodeService:
         need = spec.resources.get("CPU", 1.0)
         env_id = spec.env_id
         pool = self._charge_pool(spec)
-        if pool.get("CPU", 0) < need:
-            return None
-        skipped = []
-        found = None
-        while self.idle_workers:
-            w = self.idle_workers.popleft()
-            if not (w.state == "IDLE" and w.conn is not None
-                    and w.conn.alive):
-                continue  # dead/stale handle: drop it
-            if w.env_id != env_id:
-                skipped.append(w)  # wears a different env; keep for others
-                continue
-            found = w
-            break
-        self.idle_workers.extend(skipped)
-        if found is not None:
-            found.state = "BUSY"
-            pool["CPU"] = pool.get("CPU", 0) - need
-            spec._charged = pool
-            return found
-        # No idle worker with this env: fork one, but never more STARTING
-        # workers than CPU slots could run concurrently (forks cost ~2.5s
-        # on small hosts).
-        live = [w for w in self.workers.values()
-                if w.state != "DEAD" and w.actor_id is None]
-        starting = sum(1 for w in live if w.state == "STARTING")
-        if (len(live) >= self.cfg.max_cpu_workers and skipped
-                and starting == 0):
-            # Pool is full of idle workers wearing OTHER envs: evict the
-            # longest-idle mismatch to make room (reference: worker_pool
-            # kills idle workers for a different runtime env).
-            victim = min(skipped, key=lambda w: w.last_idle)
-            try:
-                self.idle_workers.remove(victim)
-            except ValueError:
-                pass
-            self._kill_worker(victim)
+        if pool.get("CPU", 0) >= need:
+            skipped = []
+            found = None
+            while self.idle_workers:
+                w = self.idle_workers.popleft()
+                if not (w.state == "IDLE" and w.conn is not None
+                        and w.conn.alive):
+                    continue  # dead/stale handle: drop it
+                if w.env_id != env_id:
+                    skipped.append(w)  # wears a different env; keep for
+                    continue           # others
+                found = w
+                break
+            self.idle_workers.extend(skipped)
+            if found is not None:
+                found.state = "BUSY"
+                pool["CPU"] = pool.get("CPU", 0) - need
+                found.charged_pool = pool
+                found.charged_cpu = need
+                found.inflight[spec.task_id] = spec
+                return found
+            # No idle worker with this env: fork one, but never more
+            # STARTING workers than CPU slots could run concurrently
+            # (forks cost ~2.5s on small hosts).
             live = [w for w in self.workers.values()
                     if w.state != "DEAD" and w.actor_id is None]
-        if (len(live) < self.cfg.max_cpu_workers
-                and starting < max(1, int(self.available.get("CPU", 1)))):
-            self._spawn_worker(runtime_env=spec.runtime_env)
+            starting = sum(1 for w in live if w.state == "STARTING")
+            if (len(live) >= self.cfg.max_cpu_workers and skipped
+                    and starting == 0):
+                # Pool is full of idle workers wearing OTHER envs: evict
+                # the longest-idle mismatch to make room (reference:
+                # worker_pool kills idle workers for a different env).
+                victim = min(skipped, key=lambda w: w.last_idle)
+                try:
+                    self.idle_workers.remove(victim)
+                except ValueError:
+                    pass
+                self._kill_worker(victim)
+                live = [w for w in self.workers.values()
+                        if w.state != "DEAD" and w.actor_id is None]
+            if (len(live) < self.cfg.max_cpu_workers
+                    and starting < max(1, int(self.available.get("CPU", 1)))):
+                self._spawn_worker(runtime_env=spec.runtime_env)
+            # The pool can still grant a fresh lease (a fork is pending
+            # or a busy worker will go idle): park rather than pipeline.
+            # Pipelining here can push a spec behind a head that BLOCKS
+            # on it — e.g. a nested child queued on its own parent's
+            # lane deadlocks, where waiting ~2.5s for the fork does not.
+            return None
+        # No fresh lease possible (the pool is out of CPU, so this spec
+        # can only run locally on a worker already charged for it):
+        # PIPELINE the spec into the in-flight window of the
+        # least-loaded busy worker whose lease already covers it (same
+        # env, same pool, enough charged CPU). The worker executes its
+        # window one task at a time on a serial FIFO lane, so the next
+        # spec is on the worker the moment the current one finishes
+        # instead of a node round trip later. Spillback gets the first
+        # shot, though: while a head could still place this spec on a
+        # node with idle capacity, parking beats binding it behind a
+        # busy local worker — pipelining engages once the head declines
+        # (or there is no head / the spec can't spill).
+        depth = self.cfg.worker_pipeline_depth
+        if depth > 1 and not self._spill_candidate(spec):
+            best = None
+            for w in self.workers.values():
+                if (w.state == "BUSY" and w.actor_id is None
+                        and w.conn is not None and w.conn.alive
+                        and w.env_id == env_id
+                        and w.charged_pool is pool
+                        and w.charged_cpu >= need
+                        and 0 < len(w.inflight) < depth):
+                    if best is None or len(w.inflight) < len(best.inflight):
+                        best = w
+            if best is not None:
+                spec._pipelined = True
+                best.inflight[spec.task_id] = spec
+                return best
         return None
 
     def _spawn_worker(self, actor_id: ActorID | None = None,
@@ -2011,34 +2095,86 @@ class NodeService:
     async def _run_on_worker(self, worker: WorkerHandle, spec: TaskSpec):
         worker.owner_node = getattr(spec, "_owner_node", None)
         worker.inflight[spec.task_id] = spec
-        self._event(spec, "RUNNING", worker=f"worker:{worker.proc.pid}",
-                    phases=self._dispatch_phases(spec))
+        pipelined = getattr(spec, "_pipelined", False)
+        spec._pipelined = False
+        spec._worker_started = False
+        if not pipelined:
+            # Head of a fresh lease: it executes the moment it lands on
+            # the worker's serial lane, so RUNNING is anchored here —
+            # depth-1 behavior unchanged. A pipelined spec is only
+            # QUEUED on the worker; its RUNNING transition arrives via
+            # the worker's task_running notify (_on_task_running), so
+            # the queue phase keeps meaning "waited to execute".
+            spec._worker_started = True
+            self._event(spec, "RUNNING", worker=f"worker:{worker.proc.pid}",
+                        phases=self._dispatch_phases(spec))
         try:
-            payload = self._spec_for_ipc(spec)
+            payload = self._spec_for_ipc(spec, serial=True)
+            if pipelined:
+                payload["_notify_start"] = True
             reply = await worker.conn.call("execute_task", payload)
             self._handle_task_reply(spec, reply)
         except ConnectionLost:
-            self._retry_or_fail(spec, WorkerCrashedError(task_name=spec.name))
+            if getattr(spec, "_worker_started", False):
+                self._retry_or_fail(
+                    spec, WorkerCrashedError(task_name=spec.name))
+            else:
+                # Queued on the dead worker but never started: the crash
+                # cannot have been its fault — requeue, don't charge a
+                # retry.
+                self._requeue_unstarted(spec)
         except TaskError as e:
             self._fail_task(spec, e)
         except BaseException as e:  # noqa: BLE001 - never leave returns pending
             self._fail_task(spec, TaskError.from_exception(e, spec.name))
         finally:
             worker.inflight.pop(spec.task_id, None)
-            pool = getattr(spec, "_charged", None)
-            if pool is None:
-                pool = self.available
-            pool["CPU"] = pool.get("CPU", 0) + spec.resources.get("CPU", 1.0)
-            spec._charged = None
-            if worker.state == "BUSY":
-                worker.state = "IDLE"
-                worker.last_idle = time.monotonic()
-                self.idle_workers.append(worker)
+            if not worker.inflight:
+                # Last in-flight spec done: credit the lease charge back
+                # and return the worker to the idle pool.
+                if worker.charged_pool is not None:
+                    worker.charged_pool["CPU"] = (
+                        worker.charged_pool.get("CPU", 0)
+                        + worker.charged_cpu)
+                    worker.charged_pool = None
+                    worker.charged_cpu = 0.0
+                if worker.state == "BUSY":
+                    worker.state = "IDLE"
+                    worker.last_idle = time.monotonic()
+                    self.idle_workers.append(worker)
             self._kick()
 
-    def _spec_for_ipc(self, spec: TaskSpec) -> dict:
+    def _requeue_unstarted(self, spec: TaskSpec):
+        """A spec pushed into a dead worker's pipeline window that never
+        began executing: back to the queue WITHOUT consuming a retry.
+        Its RUNNING event never fired, so re-emitting SUBMITTED keeps
+        the lifecycle stream's SUBMITTED->RUNNING ordering intact."""
+        if getattr(spec, "_cancel_requested", False):
+            self._fail_task(spec, TaskCancelledError(task_name=spec.name))
+            return
+        spec._oom_killed = False  # an unstarted spec used no memory
+        spec._pending_since = time.monotonic()
+        self.counters["tasks_requeued"] += 1
+        self._event(spec, "SUBMITTED")
+        self.pending_cpu.append(spec)
+        self._kick()
+
+    def _on_task_running(self, worker: WorkerHandle, task_id: TaskID):
+        """task_running notify from a worker: a pipelined spec reached
+        the head of the worker's serial lane and is now executing."""
+        spec = worker.inflight.get(task_id)
+        if spec is None or getattr(spec, "_worker_started", False):
+            return
+        spec._worker_started = True
+        self._event(spec, "RUNNING", worker=f"worker:{worker.proc.pid}",
+                    phases=self._dispatch_phases(spec))
+
+    def _spec_for_ipc(self, spec: TaskSpec, serial: bool = False) -> dict:
         """Resolve READY deps: memory-store values are inlined (serialized),
-        shm objects stay refs (worker mmaps them)."""
+        shm objects stay refs (worker mmaps them). ``serial`` routes the
+        push to the worker's single-thread FIFO lane (pipelined plain
+        tasks and max_concurrency=1 actor calls execute in push order,
+        one at a time — the lease charges CPU for ONE running task)."""
         def enc(a):
             if a[0] == REF:
                 st = self.objects[a[1]]
@@ -2049,7 +2185,7 @@ class NodeService:
                     return ("v", mat[1])
                 return ("shm", a[1].binary())
             return a
-        return {
+        out = {
             "task_id": spec.task_id.binary(),
             "name": spec.name,
             "func_id": spec.func_id,
@@ -2061,6 +2197,9 @@ class NodeService:
             "is_actor_creation": spec.is_actor_creation,
             "trace_ctx": spec.trace_ctx,
         }
+        if serial:
+            out["_lane"] = "s"
+        return out
 
     def _handle_task_reply(self, spec: TaskSpec, reply: dict):
         rids = spec.return_ids()
@@ -3242,6 +3381,12 @@ class NodeService:
         if actor.state != "ALIVE":
             return
         limit = max(1, actor.creation_spec.max_concurrency)
+        if limit == 1 and not actor.is_device:
+            # Serial worker-backed actor: pipeline up to depth calls into
+            # the worker's FIFO lane — execution stays one-at-a-time and
+            # in submission order, but the next call is already on the
+            # worker when the current one returns (cpu-lane fast path).
+            limit = max(1, self.cfg.worker_pipeline_depth)
         while actor.queue and actor.inflight < limit:
             spec = actor.queue.popleft()
             if spec.task_id in self.cancelled:
@@ -3270,7 +3415,9 @@ class NodeService:
         self._event(spec, "RUNNING", worker=f"worker:{worker.proc.pid}",
                     phases=self._dispatch_phases(spec))
         try:
-            reply = await worker.conn.call("execute_task", self._spec_for_ipc(spec))
+            serial = actor.creation_spec.max_concurrency <= 1
+            reply = await worker.conn.call(
+                "execute_task", self._spec_for_ipc(spec, serial=serial))
             self._handle_task_reply(spec, reply)
         except (ConnectionLost, OSError):
             # OSError covers the conn dying mid-WRITE (a kill landing
@@ -3719,8 +3866,25 @@ class NodeService:
                     spec._owner_node = (
                         getattr(parent, "_owner_node", None)
                         or w.owner_node)
-            rids = self.submit(spec)
+            # Workers submit fire-and-forget (notify): there is no reply
+            # to carry an error, so the backchannel is the refs — the
+            # submitter computed spec.return_ids() locally, and a failed
+            # submission poisons exactly those (same path _fail_task
+            # uses for every other task failure).
+            try:
+                rids = self.submit(spec)
+            except BaseException as e:  # noqa: BLE001 - poison returns
+                err = e if isinstance(e, TaskError) \
+                    else TaskError.from_exception(e, spec.name)
+                self._fail_task(spec, err)
+                rids = spec.return_ids()
             return [r.binary() for r in rids]
+
+        if method == "task_running":
+            w = conn.meta.get("worker")
+            if w is not None:
+                self._on_task_running(w, TaskID(payload))
+            return True
 
         if method == "metrics_push":
             # Cumulative user-metric snapshot from a worker process
@@ -3755,6 +3919,33 @@ class NodeService:
             if st.status == ERROR:
                 return ("err", st.error)
             return self.materialize_for_ipc(oid)
+
+        if method == "fetch_objects":
+            # Batched worker get(): one RPC for N refs, resolved
+            # concurrently (remote pulls overlap instead of serializing
+            # one round trip per ref). Per-ref outcomes mirror
+            # fetch_object so the worker fans replies back out.
+            timeout = payload.get("timeout")
+
+            async def fetch_one(r):
+                oid = ObjectID(r["oid"])
+                owner = r.get("owner")
+                try:
+                    if owner is not None:
+                        await self.ensure_object(oid, tuple(owner), timeout)
+                    st = await self.wait_object(oid, timeout)
+                    if st.status == PENDING:
+                        return ("timeout",)
+                    if st.status == ERROR:
+                        return ("err", st.error)
+                    return self.materialize_for_ipc(oid)
+                except TaskError as e:
+                    return ("err", e)
+                except BaseException as e:  # noqa: BLE001 - per-ref error
+                    return ("err", TaskError.from_exception(e, "get"))
+
+            return list(await asyncio.gather(
+                *[fetch_one(r) for r in payload["reqs"]]))
 
         if method == "wait_objects":
             oids = [ObjectID(b) for b in payload["oids"]]

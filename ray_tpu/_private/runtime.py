@@ -439,10 +439,23 @@ class Runtime:
         # term in serve's request path). Ordering safety: any later
         # get/wait/cancel from this thread reaches the loop through the
         # same FIFO (call_soon_threadsafe), strictly after the submit.
+        # Error backchannel: with no reply to carry a submission error,
+        # a failure poisons the locally computed return ids instead —
+        # the same _fail_task path every other task failure takes.
         rids = spec.return_ids()
-        self._call_soon(self.node.submit, spec)
+        self._call_soon(self._submit_guarded, spec)
         return [ObjectRef(r, _register=False, owner_addr=self.node_addr)
                 for r in rids]
+
+    def _submit_guarded(self, spec: TaskSpec):
+        from .exceptions import TaskError
+
+        try:
+            self.node.submit(spec)
+        except BaseException as e:  # noqa: BLE001 - poison the returns
+            err = e if isinstance(e, TaskError) \
+                else TaskError.from_exception(e, spec.name)
+            self.node._fail_task(spec, err)
 
     def put(self, value: Any) -> ObjectRef:
         with self._put_lock:

@@ -63,6 +63,13 @@ class WorkerContext:
         from .interrupt import TaskInterruptRegistry
 
         self._interrupts = TaskInterruptRegistry()
+        # Cancels that arrived for tasks NOT yet running: with pipelined
+        # dispatch a spec can sit queued on this worker's serial lane —
+        # _execute discards it at entry instead of running user code.
+        # Bounded: a cancel for an already-finished task leaves a dead
+        # entry behind.
+        self._cancelled_pending: set = set()
+        self._cancelled_lock = threading.Lock()
         # Bounded per-process task-lifecycle event ring (args-fetched /
         # output-serialized transitions), drained to the node's
         # task_events table on the same 1s flusher plane as ref drops
@@ -94,6 +101,12 @@ class WorkerContext:
         # The context must be visible BEFORE registration: the node may
         # push a task the instant the register RESP lands, and that task
         # can run on the reader pool before main() executes another line.
+        # For the same reason node_addr must EXIST before registration —
+        # a pipelined spec can create ObjectRefs (which stamp it as
+        # owner_addr) before the line below the register call assigns
+        # the real address; such early refs carry None, like a reply
+        # without a peer address.
+        self.node_addr = None
         context_mod.set_context(self)
         reply = self.client.call(
             "register", {"worker_id": worker_id.hex(),
@@ -280,27 +293,36 @@ class WorkerContext:
         single = isinstance(refs, ObjectRef)
         if single:
             refs = [refs]
-        out = []
-        for ref in refs:
+        out: list = [None] * len(refs)
+        # Local shm hits resolve inline; everything else rides ONE
+        # batched fetch_objects RPC (the node resolves the batch
+        # concurrently) instead of a blocking round trip per ref.
+        misses: list = []
+        for i, ref in enumerate(refs):
             mv = self.shm.get(ref.id)
             if mv is not None:
-                out.append(serialization.deserialize(mv))
-                continue
-            res = self.client.call(
-                "fetch_object", {"oid": ref.id.binary(), "timeout": timeout,
-                                 "owner": ref.owner_addr}
-            )
-            if res[0] == "timeout":
-                raise GetTimeoutError(f"get() timed out on {ref}")
-            if res[0] == "err":
-                raise res[1]
-            if res[0] == "shm":
-                mv = self.shm.wait(ref.id, timeout=5.0)
-                if mv is None:
-                    raise GetTimeoutError(f"object {ref} not in shm after fetch")
-                out.append(serialization.deserialize(mv))
+                out[i] = serialization.deserialize(mv)
             else:
-                out.append(serialization.deserialize(res[1]))
+                misses.append((i, ref))
+        if misses:
+            replies = self.client.call(
+                "fetch_objects",
+                {"reqs": [{"oid": ref.id.binary(), "owner": ref.owner_addr}
+                          for _, ref in misses],
+                 "timeout": timeout})
+            for (i, ref), res in zip(misses, replies):
+                if res[0] == "timeout":
+                    raise GetTimeoutError(f"get() timed out on {ref}")
+                if res[0] == "err":
+                    raise res[1]
+                if res[0] == "shm":
+                    mv = self.shm.wait(ref.id, timeout=5.0)
+                    if mv is None:
+                        raise GetTimeoutError(
+                            f"object {ref} not in shm after fetch")
+                    out[i] = serialization.deserialize(mv)
+                else:
+                    out[i] = serialization.deserialize(res[1])
         return out[0] if single else out
 
     def wait(self, refs, num_returns=1, timeout=None):
@@ -321,13 +343,22 @@ class WorkerContext:
         # the RIGHT owner stamp for log routing — a concurrent actor
         # serves tasks from several drivers, so a per-worker slot is
         # not enough.
+        #
+        # Fire-and-forget (cpu-lane fast path): the submit_task reply is
+        # just spec.return_ids(), which we can compute locally — so skip
+        # the blocking round trip. Submission failures surface on the
+        # refs themselves: the node wraps submit() and poisons the
+        # returns via _fail_task (error backchannel). Socket FIFO keeps
+        # this notify ahead of any later frame that references the
+        # children (task reply, fetch, decref).
         parent = _running_task.get()
-        rids = self.client.call(
+        rids = spec.return_ids()
+        self.client.notify(
             "submit_task",
             {"spec": spec,
              "parent": parent.binary() if parent else None})
-        return [ObjectRef(ObjectID(b), _register=False,
-                          owner_addr=self.node_addr) for b in rids]
+        return [ObjectRef(oid, _register=False,
+                          owner_addr=self.node_addr) for oid in rids]
 
     def export_function(self, fn) -> str:
         from .task_spec import export_function
@@ -468,11 +499,36 @@ class WorkerContext:
         out injecting into a reused thread)."""
         from .exceptions import TaskCancelledError
 
-        return self._interrupts.interrupt(task_id.binary(),
-                                          TaskCancelledError)
+        hit = self._interrupts.interrupt(task_id.binary(),
+                                         TaskCancelledError)
+        if not hit:
+            # Not running: it may be queued on the pipelined serial lane
+            # behind the current task — mark it so _execute drops it.
+            with self._cancelled_lock:
+                self._cancelled_pending.add(task_id.binary())
+                while len(self._cancelled_pending) > 1024:
+                    self._cancelled_pending.pop()
+        return hit
 
     def _execute(self, p: dict):
         task_id = TaskID(p["task_id"])
+        with self._cancelled_lock:
+            was_cancelled = p["task_id"] in self._cancelled_pending
+            self._cancelled_pending.discard(p["task_id"])
+        if was_cancelled:
+            # Cancelled while queued on the serial lane: never run it.
+            from .exceptions import TaskCancelledError
+
+            return {"results": None,
+                    "error": TaskCancelledError(task_name=p["name"])}
+        if p.get("_notify_start"):
+            # Pipelined push: tell the node we are actually starting so
+            # the RUNNING transition (and the queue-phase boundary) is
+            # anchored to real execution, not the push.
+            try:
+                self.client.notify("task_running", p["task_id"])
+            except Exception:
+                pass  # connection gone; worker is dying
         tok = _running_task.set(task_id)
         tracer = None
         try:

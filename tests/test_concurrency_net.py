@@ -149,7 +149,9 @@ def _methods_missing_call(path: Path, methods, callee: str) -> list:
 _NODE_TRANSITION_SITES = (
     "submit",              # SUBMITTED
     "_start_reconstruction",  # RECONSTRUCTING
-    "_run_on_worker",      # RUNNING (cpu lane)
+    "_run_on_worker",      # RUNNING (cpu lane, head of a fresh lease)
+    "_on_task_running",    # RUNNING (pipelined spec starts on the worker)
+    "_requeue_unstarted",  # SUBMITTED (unstarted spec off a dead worker)
     "_run_on_device",      # RUNNING + FINISHED (device lane)
     "_run_actor_task",     # RUNNING (actor call)
     "_handle_task_reply",  # FINISHED (cpu lane)

@@ -53,22 +53,32 @@ SOLO_FLOORS = {
     # the old scaled floor). 0.7 x the events-on gate-context mean of
     # calibration-normalized samples (5.7-7.3k, mean ~6.5k).
     "task_device_async": 4500,
-    "task_cpu_sync": 1300,
-    # task_cpu_async: re-gated with the per-phase event breakdown in
-    # hand. The ledger for async cpu submission shows the non-queue
-    # phases (schedule + arg_fetch + execute + output_serialize) hold a
-    # stable ~75µs/task while the QUEUE phase absorbs the entire
-    # context swing (worker-pool drain rate: p50 seconds-deep pipeline
-    # wait at 2 workers) — so the old 0.42-0.75k session-start dips the
-    # r5 note blamed on un-normalizable "paging/fork effects" are
-    # queue-phase dynamics, not machinery cost. Floor at 0.7 x the
-    # worst recorded drain throughput (420/s, calibration ~1.0):
-    # tolerates the queue swing, still fails on a genuine submit/reply
-    # machinery regression (which scales ALL of the drain rate).
-    # Gate-context samples 2026-08-04: 814-897/s at calibration
-    # 1.15-1.25.
-    "task_cpu_async": 290,
-    "actor_call_sync": 1400,
+    # task_cpu_sync: re-anchored 2026-08-05 with the CPU-lane fast
+    # path. The sequential fork-lane round trip is execute+reply bound
+    # (pipelining never engages at window 1, A/B parity), but the
+    # pure-CPU calibration unit now pegs 1.25 on this box while the
+    # fork-lane round trip did not speed up with it — the old 1300
+    # floor scaled to 1625 and sat above real gate-context samples
+    # (1400-1704 raw, 1120-1363 calibration-normalized). 0.7 x the
+    # normalized gate-context mean (~1200).
+    "task_cpu_sync": 840,
+    # task_cpu_async: re-anchored 2026-08-05 for pipelined worker
+    # dispatch (worker_pipeline_depth=8). The old 290 floor was 0.7 x
+    # the worst UNPIPELINED drain throughput (420/s) because the QUEUE
+    # phase absorbed multi-x context swings; the pipelined window keeps
+    # the next spec already on the worker, so the drain rate is both
+    # higher and steadier (gate-context samples 2026-08-05: 842-1,340
+    # raw, 674-1,072 calibration-normalized). Floor at 0.7 x the worst
+    # normalized sample — deliberately ABOVE the old unpipelined drain
+    # rate, so a revert to one-at-a-time dispatch fails this gate.
+    "task_cpu_async": 470,
+    # actor_call_sync: re-anchored 2026-08-05 alongside the serial-lane
+    # rework (per-lane executor -> completion-event chaining on the
+    # shared pool; A/B parity). Same calibration over-scale as
+    # task_cpu_sync: gate-context samples 1479-1838 raw / 1183-1470
+    # normalized vs the old floor's 1750 scaled threshold. 0.7 x the
+    # normalized mean (~1280).
+    "actor_call_sync": 900,
     "actor_call_async": 1700,
     "actor_call_concurrent": 1900,
     "wait_1k_refs": 4100,
