@@ -248,6 +248,8 @@ class SessionHost:
             return rt.list_placement_groups()
         if method == "cluster_state":
             return rt.cluster_state(**(payload or {}))
+        if method == "timeseries":
+            return rt.timeseries(**(payload or {}))
         if method == "cluster_logs":
             return rt.cluster_logs(**(payload or {}))
         if method == "session_info":

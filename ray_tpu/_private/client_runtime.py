@@ -315,6 +315,14 @@ class ClientRuntime:
                               "light": light, "tables": tables,
                               "timeout": timeout}, timeout=timeout + 30)
 
+    def timeseries(self, metric: str | None = None,
+                   node_id: str | None = None, resolution: float = 1.0,
+                   timeout: float = 10.0) -> dict:
+        return self._call(
+            "timeseries", {"metric": metric, "node_id": node_id,
+                           "resolution": resolution, "timeout": timeout},
+            timeout=timeout + 30)
+
     def cluster_logs(self, tail_bytes: int = 16_384,
                      timeout: float = 15.0) -> dict:
         return self._call(

@@ -202,6 +202,21 @@ class Config:
     # flusher plane. Bounded so a stalled node can't balloon a worker.
     task_events_worker_ring_size: int = _cfg(10_000)
 
+    # --- telemetry time-series plane ---
+    # Node-side sampler cadence: each tick turns cumulative counters into
+    # rates and snapshots the hop gauges; samples piggyback on the next
+    # heartbeat to the head. 0 disables sampling entirely.
+    telemetry_sample_interval_s: float = _cfg(1.0)
+    # Head-side retention per tier (samples kept per metric x node):
+    # base tier at the sample interval (~15 min at 1s), then 10x and 60x
+    # downsampled tiers (~1 h / ~4 h at the defaults).
+    telemetry_window_1x: int = _cfg(900)
+    telemetry_window_10x: int = _cfg(360)
+    telemetry_window_60x: int = _cfg(240)
+    # Node-side sample buffer cap while the head is unreachable (oldest
+    # dropped beyond this — a partitioned node must stay bounded).
+    telemetry_buffer_max: int = _cfg(120)
+
     # --- tpu ---
     tpu_chips_per_host: int = _cfg(0)  # 0 = autodetect
     # Mesh axis names used throughout the parallel layer.

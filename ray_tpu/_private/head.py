@@ -179,6 +179,16 @@ class HeadService:
         # observed).
         self.sched_stats = {"decisions": 0, "infeasible": 0,
                             "spill_miss": 0, "decision_s": 0.0}
+        # Cluster telemetry plane: per-(metric, node) tiered ring buffers
+        # fed by samples piggybacked on node heartbeats (reference: the
+        # per-node stats agent -> GCS -> dashboard time-series pipeline).
+        from .telemetry import TelemetryStore
+
+        self.telemetry = TelemetryStore(
+            interval=max(self.cfg.telemetry_sample_interval_s, 1e-3),
+            sizes={1: self.cfg.telemetry_window_1x,
+                   10: self.cfg.telemetry_window_10x,
+                   60: self.cfg.telemetry_window_60x})
         self._replay()
         self.server = DuplexServer(
             (self.cfg.head_host, port), self._handle_rpc, self._on_disconnect)
@@ -366,10 +376,13 @@ class HeadService:
                     pg.ready_event.set()
         return release
 
-    def heartbeat(self, node_id: NodeID, available: dict, load=None):
+    def heartbeat(self, node_id: NodeID, available: dict, load=None,
+                  telemetry=None):
         entry = self.nodes.get(node_id)
         if entry is None or entry.state == DEAD:
             return False  # node should re-register (head restarted / expired)
+        if telemetry:
+            self.telemetry.ingest(node_id.hex(), telemetry)
         old = entry.available
         entry.available = dict(available)
         if load is not None:
@@ -425,6 +438,10 @@ class HeadService:
             self._alive_count -= 1
         entry.state = DEAD
         entry.available = {}
+        # Telemetry rings for a dead node are dropped outright: with
+        # membership churn (1000-node bench) retaining per-dead-node
+        # series would grow without bound.
+        self.telemetry.drop_node(entry.node_id.hex())
         # Drop directory entries that pointed at the dead node (the table
         # stores raw bytes; compare bytes, not NodeID objects).
         for name in [n for n, info in self.named_actors.items()
@@ -930,7 +947,8 @@ class HeadService:
             # coalesced PG retry; no per-heartbeat rescan.
             return self.heartbeat(NodeID(payload["node_id"]),
                                   payload["available"],
-                                  payload.get("load"))
+                                  payload.get("load"),
+                                  payload.get("telemetry"))
         if method == "kv":
             op, key, val = payload
             return self.kv_op(op, key, val)
@@ -954,6 +972,10 @@ class HeadService:
             return addr
         if method == "sched_stats":
             return dict(self.sched_stats)
+        if method == "timeseries":
+            p = payload or {}
+            return self.telemetry.query(p.get("metric"), p.get("node_id"),
+                                        p.get("resolution", 1.0))
         if method == "pubsub_sub":
             return self.pubsub_sub(payload["channel"],
                                    NodeID(payload["node_id"]))
@@ -1097,16 +1119,19 @@ class LocalHeadClient:
         nid = self.head.actor_nodes.get(actor_id)
         return nid.binary() if nid is not None else None
 
-    async def heartbeat(self, node_id, available, load=None):
+    async def heartbeat(self, node_id, available, load=None, telemetry=None):
         # Capacity-growth detection inside heartbeat() schedules the
         # coalesced PG retry (same contract as the RPC path).
-        return self.head.heartbeat(node_id, available, load)
+        return self.head.heartbeat(node_id, available, load, telemetry)
 
     async def list_nodes(self):
         return [e.to_row() for e in self.head.nodes.values()]
 
     async def sched_stats(self):
         return dict(self.head.sched_stats)
+
+    async def timeseries(self, metric=None, node_id=None, resolution=1.0):
+        return self.head.telemetry.query(metric, node_id, resolution)
 
     async def create_pg(self, pg_id, bundles, strategy):
         pg = await self.head.create_placement_group(pg_id, bundles, strategy)
@@ -1210,11 +1235,13 @@ class RemoteHeadClient:
     async def actor_node(self, actor_id):
         return await self._read("actor_node", actor_id.binary())
 
-    async def heartbeat(self, node_id, available, load=None):
-        return await self.conn.call(
-            "heartbeat", {"node_id": node_id.binary(),
-                          "available": available, "load": load},
-            timeout=self.READ_TIMEOUT_S)
+    async def heartbeat(self, node_id, available, load=None, telemetry=None):
+        payload = {"node_id": node_id.binary(),
+                   "available": available, "load": load}
+        if telemetry:
+            payload["telemetry"] = telemetry
+        return await self.conn.call("heartbeat", payload,
+                                    timeout=self.READ_TIMEOUT_S)
 
     async def push_worker_logs(self, payload):
         return await self.conn.call("worker_logs", payload,
@@ -1225,6 +1252,11 @@ class RemoteHeadClient:
 
     async def sched_stats(self):
         return await self._read("sched_stats", None)
+
+    async def timeseries(self, metric=None, node_id=None, resolution=1.0):
+        return await self._read(
+            "timeseries", {"metric": metric, "node_id": node_id,
+                           "resolution": resolution})
 
     async def create_pg(self, pg_id, bundles, strategy):
         return await self.conn.call(

@@ -425,6 +425,18 @@ class NodeService:
         self._phase_hist = None
         self._phase_tag_cache: dict = {}
         self._node_hex = self.node_id.hex()
+        # Telemetry plane: hop-gauge scratchpad (high-water marks between
+        # sampler ticks, maintained by _gauge_queues at every dispatch-
+        # queue / pipeline-window mutation site — lint-enforced), the
+        # sampler itself, and the outbound sample buffer the heartbeat
+        # drains to the head (bounded: a partition drops oldest).
+        self.telemetry_gauges: dict = {"dispatch_queue_hw": 0,
+                                       "pipeline_inflight_hw": 0}
+        from .telemetry import TelemetrySampler
+
+        self._telemetry_sampler = TelemetrySampler(self)
+        self._telemetry_buf: collections.deque = collections.deque(
+            maxlen=max(1, self.cfg.telemetry_buffer_max))
 
     async def start(self):
         await self.server.start()
@@ -445,6 +457,8 @@ class NodeService:
         if self.cfg.memory_monitor_interval_s > 0:
             self._bg_tasks.append(
                 self.spawn(self._memory_monitor_loop()))
+        if self.cfg.telemetry_sample_interval_s > 0:
+            self._bg_tasks.append(self.spawn(self._telemetry_loop()))
         if self.head is not None:
             self._bg_tasks.append(self.spawn(self._heartbeat_loop()))
             self._bg_tasks.append(
@@ -627,6 +641,8 @@ class NodeService:
                 {"object_id": o.hex(), "status": st.status,
                  "location": st.location, "size": st.size,
                  "refcount": st.refcount,
+                 "owner": (st.creating_spec.name if st.creating_spec
+                           is not None else "driver/put"),
                  "node_id": self.node_id.hex()}
                 for o, st in self.objects.items()],
             "workers": lambda: [
@@ -724,9 +740,23 @@ class NodeService:
     async def _heartbeat_loop(self):
         while not self._closing:
             try:
-                ok = await self.head.heartbeat(self.node_id,
-                                               dict(self.available),
-                                               self._demand_shapes())
+                # Telemetry piggyback: buffered samples ride the beat
+                # (drained optimistically; restored in order on failure
+                # so a head blip loses nothing — the deque cap still
+                # bounds a long partition).
+                telemetry = None
+                if self._telemetry_buf:
+                    telemetry = list(self._telemetry_buf)
+                    self._telemetry_buf.clear()
+                try:
+                    ok = await self.head.heartbeat(self.node_id,
+                                                   dict(self.available),
+                                                   self._demand_shapes(),
+                                                   telemetry=telemetry)
+                except BaseException:
+                    if telemetry:
+                        self._telemetry_buf.extendleft(reversed(telemetry))
+                    raise
                 if ok is False:
                     # Head lost track of us (restart/expiry): re-register.
                     await self._register_with_head()
@@ -737,6 +767,36 @@ class NodeService:
             except (ConnectionLost, RpcTimeout, OSError):
                 pass
             await asyncio.sleep(self.cfg.heartbeat_interval_s)
+
+    async def _telemetry_loop(self):
+        """Fixed-interval sampler: counter deltas -> rates, hop gauges
+        snapshotted, sample buffered for the next heartbeat to carry to
+        the head (see _private/telemetry.py)."""
+        while not self._closing:
+            await asyncio.sleep(self.cfg.telemetry_sample_interval_s)
+            try:
+                self._telemetry_buf.append(self._telemetry_sampler.sample())
+            except Exception:  # noqa: BLE001 - telemetry must never kill
+                pass           # the node; next tick retries
+
+    def _gauge_queues(self):
+        """Refresh dispatch-queue / pipeline-window high-water marks.
+
+        Called from every site that mutates pending_cpu or a worker's
+        inflight window (AST-lint enforced in test_concurrency_net.py):
+        the sampler reads instantaneous depths itself, but spikes
+        between 1s ticks only survive through these marks. O(workers);
+        workers is O(num_cpus)."""
+        g = self.telemetry_gauges
+        d = len(self.pending_cpu)
+        if d > g["dispatch_queue_hw"]:
+            g["dispatch_queue_hw"] = d
+        occ = 0
+        for w in self.workers.values():
+            if w.actor_id is None and w.proc is not None:
+                occ += len(w.inflight)
+        if occ > g["pipeline_inflight_hw"]:
+            g["pipeline_inflight_hw"] = occ
 
     def _demand_shapes(self, cap: int = 100) -> list:
         """Resource shapes of work parked on this node — the per-node
@@ -1713,6 +1773,7 @@ class NodeService:
         else:
             spec._pending_since = time.monotonic()
             self.pending_cpu.append(spec)
+            self._gauge_queues()
             self._kick()
 
     def _locally_feasible(self, spec: TaskSpec) -> bool:
@@ -1873,6 +1934,7 @@ class NodeService:
             self.spawn(self._run_on_worker(worker, spec))
         self._dispatch_misses = 0
         self.pending_cpu = still_pending
+        self._gauge_queues()
         for actor in self.actors.values():
             if actor.queue:
                 self._pump_actor(actor)
@@ -1930,6 +1992,7 @@ class NodeService:
         if placed is None:
             spec._spill_cooldown = time.monotonic()
             self.pending_cpu.append(spec)
+            self._gauge_queues()
             self._kick()
             return
         self.counters["tasks_spilled"] += 1
@@ -1985,6 +2048,7 @@ class NodeService:
                 found.charged_pool = pool
                 found.charged_cpu = need
                 found.inflight[spec.task_id] = spec
+                self._gauge_queues()
                 return found
             # No idle worker with this env: fork one, but never more
             # STARTING workers than CPU slots could run concurrently
@@ -2041,6 +2105,7 @@ class NodeService:
             if best is not None:
                 spec._pipelined = True
                 best.inflight[spec.task_id] = spec
+                self._gauge_queues()
                 return best
         return None
 
@@ -2095,6 +2160,7 @@ class NodeService:
     async def _run_on_worker(self, worker: WorkerHandle, spec: TaskSpec):
         worker.owner_node = getattr(spec, "_owner_node", None)
         worker.inflight[spec.task_id] = spec
+        self._gauge_queues()
         pipelined = getattr(spec, "_pipelined", False)
         spec._pipelined = False
         spec._worker_started = False
@@ -2157,6 +2223,7 @@ class NodeService:
         self.counters["tasks_requeued"] += 1
         self._event(spec, "SUBMITTED")
         self.pending_cpu.append(spec)
+        self._gauge_queues()
         self._kick()
 
     def _on_task_running(self, worker: WorkerHandle, task_id: TaskID):
@@ -2208,6 +2275,7 @@ class NodeService:
             if spec.retry_exceptions and spec.max_retries > 0 and spec.actor_id is None:
                 spec.max_retries -= 1
                 self.pending_cpu.append(spec)
+                self._gauge_queues()
                 self._kick()
                 return
             self._fail_task(spec, err)
@@ -2290,6 +2358,7 @@ class NodeService:
             spec.max_retries -= 1
             self.counters["tasks_retried"] += 1
             self.pending_cpu.append(spec)
+            self._gauge_queues()
             self._kick()
         else:
             self._fail_task(spec, err)
@@ -2404,6 +2473,7 @@ class NodeService:
                         spec.max_retries -= 1
                         self.counters["tasks_retried"] += 1
                         self.pending_cpu.append(spec)
+                        self._gauge_queues()
                         self._kick()
                         return
                     self._fail_task(spec, value)
@@ -3412,6 +3482,7 @@ class NodeService:
     async def _run_actor_task(self, actor: ActorState, spec: TaskSpec):
         worker = actor.worker
         worker.inflight[spec.task_id] = spec
+        self._gauge_queues()
         self._event(spec, "RUNNING", worker=f"worker:{worker.proc.pid}",
                     phases=self._dispatch_phases(spec))
         try:
@@ -3818,6 +3889,7 @@ class NodeService:
                     else:
                         keep.append(spec)
                 self.pending_cpu = keep
+                self._gauge_queues()
                 self._kick()
                 return {"session_id": self.session_id,
                         "peer_address": self.peer_address}

@@ -147,6 +147,27 @@ def call_stats() -> dict:
         }
 
 
+# Writer coalescing efficiency: frames sent vs physical flushes, summed
+# over both stacks (DuplexClient's threaded vectored writer and
+# ServerConn's asyncio same-tick join). frames/flushes is the batching
+# ratio the telemetry sampler exports per interval — 1.0 means every
+# frame paid its own syscall; higher means the coalescer is working.
+# Plain ints mutated under the GIL on the writer paths (the sampler only
+# reads, so per-flush lock traffic would be pure overhead).
+_writer_stats = {"frames": 0, "flushes": 0, "bytes": 0}
+
+
+def _record_flush(frames: int, nbytes: int):
+    _writer_stats["frames"] += frames
+    _writer_stats["flushes"] += 1
+    _writer_stats["bytes"] += nbytes
+
+
+def writer_stats() -> dict:
+    """Cumulative coalesced-writer counters for this process."""
+    return dict(_writer_stats)
+
+
 async def call_with_retry(conn, method: str, payload: Any = None, *,
                           timeout: float = 10.0, retries: int = 2,
                           backoff_s: float = 0.25):
@@ -453,6 +474,7 @@ class DuplexClient:
                     b = self._wqueue.popleft()
                     batch.append(b)
                     size += len(b)
+            _record_flush(len(batch), size)
             self._write_out(batch)
 
     def _write_out(self, batch):
@@ -642,6 +664,7 @@ class ServerConn:
         if not self._wbuf:
             return
         batch, self._wbuf = self._wbuf, []
+        _record_flush(len(batch), self._wbytes)
         self._wbytes = 0
         if not self.alive:
             return

@@ -520,6 +520,16 @@ class Runtime:
 
         return self._run(gather(), timeout)
 
+    def timeseries(self, metric: str | None = None,
+                   node_id: str | None = None, resolution: float = 1.0,
+                   timeout: float = 10.0) -> dict:
+        """Head-retained telemetry time-series (the cluster telemetry
+        plane): {"resolution": s, "series": {metric: {node_hex:
+        [[ts, value, high_water], ...]}}}. ``resolution`` snaps down to
+        the nearest retention tier (1x/10x/60x the sample interval)."""
+        return self._run(
+            self.node.head.timeseries(metric, node_id, resolution), timeout)
+
     def head_client(self):
         return self.node.head
 

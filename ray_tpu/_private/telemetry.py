@@ -1,0 +1,325 @@
+"""Cluster telemetry plane: per-node time-series sampling + head-side
+retention.
+
+Capability parity target: the reference's continuous metrics pipeline
+(src/ray/stats/metric_defs.cc -> per-node stats agent -> Prometheus ->
+dashboard time-series). Here each node runs a fixed-interval sampler
+(TelemetrySampler) that turns its cumulative counters into per-second
+*rates* (reset-safe: a counter that went backwards reads as a restart,
+not a negative rate) and snapshots the hop-level gauges the fast path
+maintains (dispatch-queue depth, pipeline-window occupancy, writer
+coalescing efficiency, object-store usage). Samples piggyback on the
+existing heartbeat to the head, which retains them in bounded ring
+buffers (TelemetryStore) with coarser downsampled tiers, queryable via
+``state.timeseries()`` / the ``timeseries`` head RPC.
+
+Metric name convention: flat strings, sub-keyed with ``:`` (e.g.
+``rpc_calls_per_s:submit_task``) so the store stays a 2-level
+(metric, node) map with bounded cardinality.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+# Downsampled retention tiers: (resolution_s multiplier, config attr).
+# Tier resolutions are multiples of the base sample interval so one
+# incremental pass aggregates base samples upward without re-walking.
+TIERS = (1, 10, 60)
+
+
+class TieredRing:
+    """Ring buffers for ONE (metric, node) series at several resolutions.
+
+    The base tier stores raw samples; coarser tiers store the mean of
+    each completed bucket (rates average correctly; gauges read as the
+    bucket-mean level, with ``hi`` keeping the in-bucket max so spikes
+    survive downsampling)."""
+
+    __slots__ = ("rings", "_acc")
+
+    def __init__(self, sizes: Dict[int, int]):
+        # tier multiple -> deque of (ts, value, hi)
+        self.rings = {t: collections.deque(maxlen=sizes.get(t, 0) or 1)
+                      for t in TIERS}
+        # tier multiple -> [bucket_id, sum, count, hi]
+        self._acc = {t: None for t in TIERS if t != 1}
+
+    def append(self, ts: float, value: float, interval: float):
+        self.rings[1].append((ts, value, value))
+        for t in TIERS:
+            if t == 1:
+                continue
+            width = t * interval
+            bucket = int(ts // width)
+            acc = self._acc[t]
+            if acc is None or acc[0] != bucket:
+                if acc is not None and acc[2]:
+                    # Close the finished bucket at its mid-point.
+                    self.rings[t].append(
+                        ((acc[0] + 0.5) * width, acc[1] / acc[2], acc[3]))
+                self._acc[t] = [bucket, value, 1, value]
+            else:
+                acc[1] += value
+                acc[2] += 1
+                if value > acc[3]:
+                    acc[3] = value
+
+    def samples(self, tier: int) -> List[list]:
+        return [[ts, v, hi] for ts, v, hi in self.rings.get(tier, ())]
+
+
+class TelemetryStore:
+    """Head-side retention: (metric, node_hex) -> TieredRing.
+
+    Bounded: ring sizes are fixed per tier and the metric set is the
+    sampler's (bounded per node), so memory is O(nodes x metrics x
+    window)."""
+
+    def __init__(self, interval: float = 1.0,
+                 sizes: Optional[Dict[int, int]] = None):
+        self.interval = max(1e-3, float(interval))
+        self.sizes = dict(sizes or {1: 900, 10: 360, 60: 240})
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple[str, str], TieredRing] = {}
+
+    def ingest(self, node_hex: str, samples: List[dict]):
+        """``samples``: [{"ts": float, "metrics": {name: value}}, ...] —
+        the node sampler's buffered output riding a heartbeat."""
+        if not samples:
+            return
+        with self._lock:
+            for smp in samples:
+                ts = smp.get("ts", 0.0)
+                for name, value in smp.get("metrics", {}).items():
+                    ring = self._series.get((name, node_hex))
+                    if ring is None:
+                        ring = self._series[(name, node_hex)] = \
+                            TieredRing(self.sizes)
+                    try:
+                        ring.append(ts, float(value), self.interval)
+                    except (TypeError, ValueError):
+                        continue
+
+    def drop_node(self, node_hex: str):
+        with self._lock:
+            for key in [k for k in self._series if k[1] == node_hex]:
+                del self._series[key]
+
+    def metric_names(self) -> List[str]:
+        with self._lock:
+            return sorted({m for m, _ in self._series})
+
+    def query(self, metric: Optional[str] = None,
+              node_id: Optional[str] = None,
+              resolution: float = 1.0) -> dict:
+        """{"resolution": s, "series": {metric: {node: [[ts, value,
+        hi], ...]}}} — ``resolution`` snaps to the nearest tier at or
+        below the request (1/10/60 x the sample interval)."""
+        tier = 1
+        for t in TIERS:
+            if t * self.interval <= resolution + 1e-9:
+                tier = t
+        out: dict = {}
+        with self._lock:
+            for (name, node), ring in self._series.items():
+                if metric is not None and name != metric:
+                    continue
+                if node_id is not None and node != node_id:
+                    continue
+                out.setdefault(name, {})[node] = ring.samples(tier)
+        return {"resolution": tier * self.interval, "series": out}
+
+    def latest(self) -> List[tuple]:
+        """[(metric, node_hex, ts, value)] — newest base-tier sample per
+        series, for the Prometheus gauge export."""
+        rows = []
+        with self._lock:
+            for (name, node), ring in self._series.items():
+                base = ring.rings[1]
+                if base:
+                    ts, v, _hi = base[-1]
+                    rows.append((name, node, ts, v))
+        return rows
+
+
+class TelemetrySampler:
+    """Node-side delta engine: successive calls to ``sample()`` turn the
+    node's cumulative counters into per-second rates plus instantaneous
+    gauges. Counter RESETS (process restart, stats cleared) read as a
+    fresh anchor — the first post-reset delta is dropped rather than
+    emitted as a huge negative (or bogus positive) rate."""
+
+    # Node counters exported as per-second rates.
+    RATE_COUNTERS = (
+        ("tasks_per_s", ("tasks_finished", "tasks_failed")),
+        ("tasks_submitted_per_s", ("tasks_submitted",)),
+        ("object_bytes_pulled_per_s", ("object_bytes_pulled",)),
+    )
+
+    def __init__(self, node):
+        self.node = node
+        self._prev_t: Optional[float] = None
+        self._prev: Dict[str, float] = {}
+        self._store_hw = 0.0
+
+    def _rate(self, name: str, cum: float, dt: float,
+              out: Dict[str, float]):
+        prev = self._prev.get(name)
+        self._prev[name] = cum
+        if prev is None or cum < prev:
+            # First sample or counter reset: no defensible rate.
+            out[name] = 0.0
+            return
+        out[name] = (cum - prev) / dt
+
+    def sample(self) -> dict:
+        """One sample: {"ts": wall, "metrics": {...}}. Cheap by design —
+        O(counters + workers + rpc methods); runs on the node loop every
+        telemetry_sample_interval_s (perf-gated)."""
+        from .rpc import call_stats as rpc_call_stats
+        from .rpc import writer_stats as rpc_writer_stats
+
+        node = self.node
+        now = time.monotonic()
+        dt = (now - self._prev_t) if self._prev_t is not None else 0.0
+        self._prev_t = now
+        dt = max(dt, 1e-6)
+        m: Dict[str, float] = {}
+
+        for name, counters in self.RATE_COUNTERS:
+            self._rate(name, sum(node.counters.get(c, 0)
+                                 for c in counters), dt, m)
+
+        # Per-method RPC call rates.
+        for method, st in rpc_call_stats().items():
+            self._rate(f"rpc_calls_per_s:{method}", st["count"], dt, m)
+
+        # Writer coalescing efficiency: frames per flush over the
+        # interval (1.0 == no coalescing; higher == batched writes).
+        ws = rpc_writer_stats()
+        pf, pfl = self._prev.get("_wframes"), self._prev.get("_wflushes")
+        self._prev["_wframes"] = float(ws["frames"])
+        self._prev["_wflushes"] = float(ws["flushes"])
+        if pf is not None and pfl is not None \
+                and ws["frames"] >= pf and ws["flushes"] >= pfl:
+            dfl = ws["flushes"] - pfl
+            m["writer_frames_per_flush"] = (
+                (ws["frames"] - pf) / dfl if dfl > 0 else 0.0)
+        else:
+            m["writer_frames_per_flush"] = 0.0
+
+        # Hop gauges (maintained by the mutation-site hooks; the
+        # high-water keys reset each sample so spikes between samples
+        # are never lost).
+        g = node.telemetry_gauges
+        m["dispatch_queue_depth"] = float(len(node.pending_cpu))
+        m["dispatch_queue_hw"] = float(
+            max(g.get("dispatch_queue_hw", 0), len(node.pending_cpu)))
+        g["dispatch_queue_hw"] = len(node.pending_cpu)
+
+        occ = busy = 0
+        for w in node.workers.values():
+            if w.actor_id is None and w.proc is not None:
+                occ += len(w.inflight)
+                if w.state == "BUSY":
+                    busy += 1
+        depth = max(1, int(getattr(node.cfg, "worker_pipeline_depth", 1)))
+        m["pipeline_inflight"] = float(occ)
+        m["pipeline_inflight_hw"] = float(
+            max(g.get("pipeline_inflight_hw", 0), occ))
+        g["pipeline_inflight_hw"] = occ
+        m["pipeline_occupancy"] = (occ / (busy * depth)) if busy else 0.0
+
+        # Object-store level + monotone high-water.
+        used = sum(st.size for st in node.objects.values()
+                   if st.status == "READY")
+        if used > self._store_hw:
+            self._store_hw = used
+        m["store_used_bytes"] = float(used)
+        m["store_hw_bytes"] = float(self._store_hw)
+        m["store_num_objects"] = float(len(node.objects))
+
+        # Serving-path signals from worker metric pushes (replicas and
+        # proxy actors flush cumulative snapshots every 1s): queue-depth
+        # gauges sum across sources; request histograms become
+        # per-interval p50/p95/p99 + rates from bucket deltas.
+        try:
+            self._sample_serve(m, dt)
+        except Exception:  # noqa: BLE001 - serve sampling is best-effort
+            pass
+
+        return {"ts": time.time(), "metrics": m}
+
+    def _sample_serve(self, m: Dict[str, float], dt: float):
+        depth_by_dep: Dict[str, float] = {}
+        hists: Dict[tuple, list] = {}
+        for source, snap in self.node.user_metrics.items():
+            for r in snap.get("rows", ()):
+                name = r.get("name", "")
+                if name == "rtpu_serve_replica_queue_depth":
+                    dep = r.get("tags", {}).get("deployment", "?")
+                    depth_by_dep[dep] = depth_by_dep.get(dep, 0.0) \
+                        + float(r.get("value", 0.0))
+                elif name == "rtpu_serve_proxy_inflight":
+                    m["serve_proxy_inflight"] = \
+                        m.get("serve_proxy_inflight", 0.0) \
+                        + float(r.get("value", 0.0))
+                elif name == "rtpu_serve_request_seconds" \
+                        and r.get("type") == "histogram":
+                    tags = r.get("tags", {})
+                    key = (tags.get("deployment", "?"),
+                           tags.get("phase", "?"))
+                    cur = hists.get(key)
+                    if cur is None:
+                        hists[key] = [list(r["bucket_counts"]),
+                                      r["boundaries"], r["count"]]
+                    elif cur[1] == r["boundaries"]:
+                        cur[0] = [a + b for a, b in
+                                  zip(cur[0], r["bucket_counts"])]
+                        cur[2] += r["count"]
+        for dep, depth in depth_by_dep.items():
+            m[f"serve_queue_depth:{dep}"] = depth
+        for (dep, phase), (counts, bounds, total) in hists.items():
+            pkey = f"_serve:{dep}:{phase}"
+            prev = self._prev.get(pkey)
+            self._prev[pkey] = counts
+            self._rate(f"serve_req_per_s:{dep}:{phase}", total, dt, m)
+            if prev is None or len(prev) != len(counts):
+                # First sighting: the cumulative counts ARE the delta
+                # since the source started (else a burst that completes
+                # before the first flush never yields quantiles).
+                prev = [0] * len(counts)
+            delta = [a - b for a, b in zip(counts, prev)]
+            if any(d < 0 for d in delta):
+                continue  # source restarted: re-anchor
+            n = sum(delta)
+            if n == 0:
+                continue
+            for q in (0.50, 0.95, 0.99):
+                m[f"serve_p{int(q * 100)}_ms:{dep}:{phase}"] = \
+                    quantile_from_buckets(delta, bounds, q) * 1e3
+
+
+def quantile_from_buckets(counts: List[int], bounds: List[float],
+                          q: float) -> float:
+    """Linear-interpolated quantile from histogram bucket counts
+    (Prometheus histogram_quantile semantics; the +Inf bucket reads as
+    its lower bound)."""
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if c <= 0:
+            continue
+        if cum + c >= rank:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i] if i < len(bounds) else bounds[-1]
+            return lo + (hi - lo) * max(0.0, min(1.0, (rank - cum) / c))
+        cum += c
+    return bounds[-1] if bounds else 0.0

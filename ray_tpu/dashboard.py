@@ -14,6 +14,7 @@ Endpoints:
   /api/actors          actor table
   /api/jobs            job table (if a JobManager exists)
   /api/tasks           task summary by name/state
+  /api/timeseries      head telemetry rings (?metric=&node_id=&resolution=)
   /metrics             Prometheus text (same as util.serve_metrics)
 
 Start with ``ray_tpu.dashboard.start_dashboard(port)`` or
@@ -43,6 +44,8 @@ _PAGE = """<!doctype html>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Resources</h2><table id="resources"></table>
 <h2>Tasks</h2><table id="tasks"></table>
+<h2>Cluster health <span id="tssum" style="color:#888;font-size:.8rem"></span></h2>
+<div id="health" style="background:#fff;padding:.6rem;box-shadow:0 1px 2px #0002;font-size:.8rem"></div>
 <h2>Throughput &amp; phase latency</h2>
 <div id="spark" style="background:#fff;padding:.6rem;box-shadow:0 1px 2px #0002;font-size:.8rem"></div>
 <h2>Data exchange <span id="xsum" style="color:#888;font-size:.8rem"></span></h2>
@@ -112,6 +115,36 @@ async function refresh(){
       Object.entries(t.by_name).map(([name,states])=>row([esc(name),
         states.SUBMITTED||0, states.RUNNING||0, states.FINISHED||0,
         states.FAILED||0])).join('');
+    const hs = await (await fetch('api/timeseries')).json();
+    const sumNodes = byNode => {
+      const nodes=Object.keys(byNode||{});
+      const L=Math.max.apply(null,nodes.map(n=>byNode[n].length).concat([0]));
+      const vals=[];
+      for(let i=0;i<L;i++){let s=0;
+        for(const n of nodes){const pts=byNode[n];
+          const p=pts[pts.length-L+i]; if(p)s+=p[1];}
+        vals.push(s);}
+      return vals;};
+    const HEALTH=[['tasks/s','tasks_per_s','#36c',1],
+      ['dispatch queue','dispatch_queue_depth','#c63',1],
+      ['pipeline in-flight','pipeline_inflight','#393',1],
+      ['pipeline occupancy','pipeline_occupancy','#939',1],
+      ['store MB','store_used_bytes','#09c',1e-6],
+      ['pull MB/s','object_bytes_pulled_per_s','#c09',1e-6]];
+    let hh='';
+    for(const [label,m,color,scale] of HEALTH){
+      if(!(hs.series||{})[m])continue;
+      const vals=sumNodes(hs.series[m]).map(v=>v*scale);
+      hh+='<div>'+esc(label)+' '+spark(vals,240,34,color)+' '+
+        ((vals[vals.length-1]||0).toFixed(2))+'</div>';}
+    for(const m of Object.keys(hs.series||{})
+        .filter(k=>k.indexOf('serve_p95_ms:')===0).sort()){
+      const vals=sumNodes(hs.series[m]);
+      hh+='<div>'+esc(m)+' '+spark(vals,240,34,'#666')+' '+
+        ((vals[vals.length-1]||0).toFixed(2))+'</div>';}
+    document.getElementById('health').innerHTML=hh||'(telemetry disabled)';
+    document.getElementById('tssum').textContent=
+      'resolution '+(hs.resolution||'?')+'s';
     const tl = await (await fetch('api/timeline')).json();
     drawSpark(tl.series); drawTimeline(tl.events);
     const xs=tl.series, xr=xs.exchange_rounds||[], xm=xs.exchange_mb||[];
@@ -402,6 +435,20 @@ def _timeline() -> dict:
             "scheduler": _sched_stats()}
 
 
+def _timeseries_api(metric=None, node_id=None,
+                    resolution: float = 1.0) -> dict:
+    """Head telemetry rings (the cluster-health pane's data source) —
+    per-metric per-node [ts, value, high-water] points."""
+    from ._private import context as context_mod
+
+    try:
+        rt = context_mod.require_context()
+        return rt.timeseries(metric=metric, node_id=node_id,
+                             resolution=resolution)
+    except Exception:  # noqa: BLE001 - telemetry disabled / old head
+        return {"resolution": resolution, "series": {}}
+
+
 def _jobs() -> dict:
     try:
         from .job_submission import JOB_MANAGER_NAME
@@ -446,6 +493,18 @@ def start_dashboard(port: int = 0, host: str = "127.0.0.1"):
                 elif path == "/metrics":
                     body, ctype = (prometheus_text().encode(),
                                    "text/plain; version=0.0.4")
+                elif path == "/api/timeseries":
+                    from urllib.parse import parse_qs, urlparse
+
+                    q = parse_qs(urlparse(self.path).query)
+
+                    def one(key, default=None):
+                        return q[key][0] if q.get(key) else default
+
+                    body = json.dumps(_timeseries_api(
+                        metric=one("metric"), node_id=one("node_id"),
+                        resolution=float(one("resolution", 1.0)))).encode()
+                    ctype = "application/json"
                 elif path in routes:
                     body = json.dumps(routes[path]()).encode()
                     ctype = "application/json"

@@ -258,22 +258,127 @@ def cmd_stop(args):
     print(f"stopped {stopped} process group(s)")
 
 
-def cmd_status(args):
-    rt = _attach(args)
+def _telemetry_latest(rt) -> dict:
+    """{metric: {node_hex: latest_value}} from the head time-series.
+
+    Goes through the state facade (not ``rt``): ``_attach`` hands the
+    commands the ray_tpu module, which has no ``timeseries`` attribute.
+    """
+    from ray_tpu.util import state
+
+    out = {}
+    try:
+        ts = state.timeseries()
+    except Exception:  # noqa: BLE001 - old head / telemetry disabled
+        return out
+    for metric, by_node in ts.get("series", {}).items():
+        for node, points in by_node.items():
+            if points:
+                out.setdefault(metric, {})[node] = points[-1][1]
+    return out
+
+
+def _print_status(rt):
     from ray_tpu.util import state
 
     # Attached drivers (this CLI process included) aren't cluster capacity.
     nodes = state.list_nodes(filters=[("is_driver", "=", False)])
+    latest = _telemetry_latest(rt)
+
+    def tele(metric, node_hex, fmt="{:g}"):
+        v = latest.get(metric, {}).get(node_hex)
+        return "-" if v is None else fmt.format(v)
+
     print(f"{len(nodes)} node(s):")
     for n in nodes:
         role = "head" if n["is_head_node"] else "worker"
-        print(f"  {n['node_id'][:12]}  {role:6s}  {n['state']:5s}  "
+        nid = n["node_id"]
+        print(f"  {nid[:12]}  {role:6s}  {n['state']:5s}  "
               f"{n['address'][0]}:{n['address'][1]}  "
-              f"avail={_fmt_resources(n['available'])}")
+              f"avail={_fmt_resources(n['available'])}  "
+              f"tasks/s={tele('tasks_per_s', nid)} "
+              f"q={tele('dispatch_queue_depth', nid)} "
+              f"occ={tele('pipeline_occupancy', nid, '{:.0%}')}")
     total = rt.cluster_resources()
     avail = rt.available_resources()
     print(f"resources: total={_fmt_resources(total)} "
           f"available={_fmt_resources(avail)}")
+
+
+def cmd_status(args):
+    rt = _attach(args)
+    if not getattr(args, "watch", False):
+        _print_status(rt)
+        return
+    try:
+        while True:
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            print(time.strftime("%H:%M:%S"), "(^C to exit)")
+            _print_status(rt)
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+
+
+_TOP_COLUMNS = (
+    # (header, metric, format)
+    ("tasks/s", "tasks_per_s", "{:.1f}"),
+    ("submit/s", "tasks_submitted_per_s", "{:.1f}"),
+    ("pull MB/s", "object_bytes_pulled_per_s", None),  # scaled below
+    ("queue", "dispatch_queue_depth", "{:.0f}"),
+    ("q-hw", "dispatch_queue_hw", "{:.0f}"),
+    ("inflight", "pipeline_inflight", "{:.0f}"),
+    ("occ", "pipeline_occupancy", "{:.0%}"),
+    ("store MB", "store_used_bytes", None),
+    ("frames/fl", "writer_frames_per_flush", "{:.1f}"),
+)
+
+
+def _print_top(rt):
+    from ray_tpu.util import state
+
+    nodes = state.list_nodes(filters=[("is_driver", "=", False)])
+    latest = _telemetry_latest(rt)
+    hdr = "node          " + "".join(f"{h:>11}" for h, _, _ in _TOP_COLUMNS)
+    print(hdr)
+    for n in nodes:
+        nid = n["node_id"]
+        cells = []
+        for _, metric, fmt in _TOP_COLUMNS:
+            v = latest.get(metric, {}).get(nid)
+            if v is None:
+                cells.append(f"{'-':>11}")
+            elif fmt is None:  # bytes -> MB
+                cells.append(f"{v / 1e6:>11.1f}")
+            else:
+                cells.append(f"{fmt.format(v):>11}")
+        print(f"{nid[:12]}  " + "".join(cells))
+    serve_rows = sorted((m, by_node) for m, by_node in latest.items()
+                        if m.startswith(("serve_p95_ms:",
+                                         "serve_queue_depth:")))
+    if serve_rows:
+        print("serve:")
+        for metric, by_node in serve_rows:
+            val = sum(by_node.values())
+            print(f"  {metric:<44} {val:10.2f}")
+
+
+def cmd_top(args):
+    rt = _attach(args)
+    if args.once:
+        _print_top(rt)
+        return
+    try:
+        while True:
+            sys.stdout.write("\x1b[2J\x1b[H")
+            print(time.strftime("%H:%M:%S"),
+                  "cluster telemetry (^C to exit)")
+            _print_top(rt)
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
 
 
 def _fmt_resources(res: dict) -> str:
@@ -472,19 +577,34 @@ def cmd_memory(args):
     from ray_tpu.util import state
 
     rows = state.list_objects()
-    by_node = defaultdict(lambda: [0, 0])
+    group_by = getattr(args, "group_by", "node")
+    sort_by = getattr(args, "sort", "size")
+
+    def group_key(r):
+        if group_by == "owner":
+            return r.get("owner") or "?"
+        return r["node_id"][:12]
+
+    groups = defaultdict(lambda: [0, 0])
     for r in rows:
-        by_node[r["node_id"][:12]][0] += 1
-        by_node[r["node_id"][:12]][1] += r.get("size") or 0
+        g = groups[group_key(r)]
+        g[0] += 1
+        g[1] += r.get("size") or 0
     print(f"{len(rows)} object(s) cluster-wide")
-    for node, (count, nbytes) in sorted(by_node.items()):
-        print(f"  node {node}: {count} objects, {nbytes / 1e6:.2f} MB")
+    # sort groups: size -> by bytes desc, count -> by count desc
+    order = sorted(groups.items(),
+                   key=lambda kv: kv[1][1 if sort_by == "size" else 0],
+                   reverse=True)
+    label = "owner" if group_by == "owner" else "node"
+    for key, (count, nbytes) in order:
+        print(f"  {label} {key}: {count} objects, {nbytes / 1e6:.2f} MB")
     top = sorted(rows, key=lambda r: r.get("size") or 0, reverse=True)[:20]
     if top:
         print("top objects by size:")
         for r in top:
             print(f"  {r['object_id'][:16]}  {r.get('size') or 0:>12}  "
-                  f"{r['status']:<8} refs={r.get('refcount', '?')}")
+                  f"{r['status']:<8} refs={r.get('refcount', '?')}  "
+                  f"owner={r.get('owner', '?')}")
 
 
 # ---------------------------------------------------------------------------
@@ -573,7 +693,19 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("status", help="cluster membership + resources")
     sp.add_argument("--address", default=None)
+    sp.add_argument("--watch", action="store_true",
+                    help="refresh continuously (live telemetry columns)")
+    sp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period seconds (with --watch)")
     sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser(
+        "top", help="live per-node telemetry (tasks/s, queues, store)")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--interval", type=float, default=2.0)
+    sp.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit")
+    sp.set_defaults(fn=cmd_top)
 
     sp = sub.add_parser("list", help="list cluster state")
     sp.add_argument("kind", choices=["tasks", "actors", "objects",
@@ -638,6 +770,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("memory", help="object store usage summary")
     sp.add_argument("--address", default=None)
+    sp.add_argument("--group-by", choices=["node", "owner"],
+                    default="node", dest="group_by",
+                    help="group the summary by node or by the task that "
+                         "created each object (driver puts -> driver/put)")
+    sp.add_argument("--sort", choices=["size", "count"], default="size",
+                    help="order groups by total bytes or object count")
     sp.set_defaults(fn=cmd_memory)
 
     sp = sub.add_parser("timeline", help="dump chrome://tracing JSON")

@@ -184,10 +184,25 @@ def get_deployment_handle(deployment_name: str, app_name: str = "default"
 
 
 def status(timeout: float = 30) -> dict:
+    """Per-deployment status: target/num replicas plus — once the
+    control loop has gathered replica stats — ``queue_depth`` and a
+    ``latency`` block of p50/p95/p99 (ms) per SLO phase (proxy_queue /
+    replica_queue / batch_wait / execute)."""
     import ray_tpu
 
-    return ray_tpu.get(_get_controller(create=False).status.remote(),
-                       timeout=timeout)
+    out = ray_tpu.get(_get_controller(create=False).status.remote(),
+                      timeout=timeout)
+    # Local-proxy mode records proxy_queue in THIS process; replica-side
+    # phases came from the controller — graft the proxy phase in.
+    from . import slo
+
+    for dep, hists in slo.all_phase_hists().items():
+        row = out.get(dep)
+        if row is None:
+            continue
+        for phase, summary in slo.latency_summary(hists).items():
+            row.setdefault("latency", {}).setdefault(phase, summary)
+    return out
 
 
 def _wait_controller_alive(timeout: float = 60) -> bool:

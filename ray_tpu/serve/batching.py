@@ -11,13 +11,16 @@ length.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, List, Optional
 
+from . import slo
 from .multiplex import _set_request_model_id, get_multiplexed_model_id
 
 
 class _Pending:
-    __slots__ = ("item", "event", "result", "error", "model_id")
+    __slots__ = ("item", "event", "result", "error", "model_id",
+                 "submit_t")
 
     def __init__(self, item):
         self.item = item
@@ -27,6 +30,7 @@ class _Pending:
         # Request context is thread-local and the batch executes on the
         # collector thread — capture it at submit time (caller's thread).
         self.model_id = get_multiplexed_model_id()
+        self.submit_t = time.monotonic()  # batch_wait anchor
 
 
 class _Batcher:
@@ -83,6 +87,12 @@ class _Batcher:
                 self._run_batch(owner, group)
 
     def _run_batch(self, owner, batch: list[_Pending]):
+        now = time.monotonic()
+        for p in batch:
+            # SLO phase: time parked in the batch queue before the
+            # batched call fired (deployment attribution is the
+            # process-global set by the hosting replica).
+            slo.record_phase("batch_wait", now - p.submit_t)
         _set_request_model_id(batch[0].model_id or None)
         try:
             results = self.fn(owner, [p.item for p in batch])

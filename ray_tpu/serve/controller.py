@@ -41,6 +41,9 @@ class DeploymentState:
     # monotonic() would make the first scale decision bypass its delay).
     last_scale_up: float = field(default_factory=time.monotonic)
     last_scale_down: float = field(default_factory=time.monotonic)
+    # Latest complete replica stats() gather (control-loop refreshed):
+    # the SLO source for status()'s latency/queue-depth block.
+    latest_stats: list = field(default_factory=list)
 
 
 def _drain_and_kill(victims, drain_timeout_s: float = 30.0):
@@ -212,7 +215,8 @@ class ServeController:
             rname = f"SERVE:{d.name}:{uuid.uuid4().hex[:8]}"
             opts["name"] = rname
             actor = ray_tpu.remote(Replica).options(**opts).remote(
-                d.func_or_class, d.init_args, d.init_kwargs, d.user_config)
+                d.func_or_class, d.init_args, d.init_kwargs, d.user_config,
+                d.name)
             state.replicas.append(actor)
             state.replica_names.append(rname)
         victims = []
@@ -281,6 +285,8 @@ class ServeController:
                     if self._deployments.get(
                             state.deployment.name) is not state:
                         continue
+                    if not dead and not slow:
+                        state.latest_stats = stats
                     if dead:
                         for r in dead:
                             for i, have in enumerate(state.replicas):
@@ -330,12 +336,25 @@ class ServeController:
             return self._apps[app_name]
 
     def status(self) -> dict:
+        from . import slo
+
         with self._lock:
-            return {
-                name: {"target_replicas": s.target_replicas,
+            out = {}
+            for name, s in self._deployments.items():
+                row = {"target_replicas": s.target_replicas,
                        "num_replicas": len(s.replicas)}
-                for name, s in self._deployments.items()
-            }
+                stats = s.latest_stats
+                if stats:
+                    row["queue_depth"] = sum(
+                        st.get("queue_depth", st.get("ongoing", 0))
+                        for st in stats)
+                    merged = slo.merge_phase_hists(
+                        [st.get("phase_hist") for st in stats])
+                    lat = slo.latency_summary(merged)
+                    if lat:
+                        row["latency"] = lat
+                out[name] = row
+            return out
 
     def num_replicas(self, name: str) -> int:
         with self._lock:

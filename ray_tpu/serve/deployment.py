@@ -143,7 +143,7 @@ class DeploymentResponse:
                     _time.sleep(0.2)
                     self._router.maybe_refresh(force=True)
             self._ref = actor.handle_request.remote(
-                method, args, kwargs, model_id)
+                method, args, kwargs, model_id, _time.time())
             self._replica_key = key
             self._done = False
             self._retry = None  # one retry only
@@ -419,10 +419,15 @@ class DeploymentHandle:
         return self.options(method_name=name)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
+        import time as _time
+
         self._router.maybe_refresh()
         actor, key = self._router.pick_replica(self._model_id)
+        # Submit stamp travels with the request so the replica can
+        # attribute its actor-lane queueing (the replica_queue SLO
+        # phase).
         ref = actor.handle_request.remote(
-            self._method, args, kwargs, self._model_id)
+            self._method, args, kwargs, self._model_id, _time.time())
         return DeploymentResponse(
             ref, self._router, key,
             retry=(self._method, args, kwargs, self._model_id))

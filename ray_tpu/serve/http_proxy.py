@@ -68,9 +68,25 @@ class HTTPProxy:
     async def _handle(self, request):
         from aiohttp import web
 
+        from . import slo
+
         route = self.resolve(request.path)
         if route is None:
             return web.json_response({"error": "no route"}, status=404)
+        slo.proxy_inflight(+1)
+        try:
+            return await self._handle_routed(request, route)
+        finally:
+            slo.proxy_inflight(-1)
+
+    async def _handle_routed(self, request, route):
+        import time as _time
+
+        from aiohttp import web
+
+        from . import slo
+
+        t_arrive = _time.perf_counter()
         app, is_asgi = route
         raw = await request.read()
         if is_asgi:
@@ -97,6 +113,10 @@ class HTTPProxy:
             # Routing/submission may RPC (replica refresh): off-loop.
             resp = await loop.run_in_executor(
                 None, lambda: handle.remote(body))
+            # SLO phase: arrival -> dispatched to a replica (routing +
+            # proxy-side queueing; replica_queue picks up from here).
+            slo.record_phase("proxy_queue", _time.perf_counter() - t_arrive,
+                             handle._name)
             try:
                 # Fast path: await the result future directly — a
                 # second executor hop for a blocking .result() costs

@@ -37,6 +37,14 @@ class ProxyActor:
     def ping(self) -> str:
         return "pong"
 
+    def stats(self) -> dict:
+        """Proxy-side SLO surface: in-flight requests + per-deployment
+        proxy_queue phase buckets recorded in this proxy process."""
+        from . import slo
+
+        return {"inflight": slo.proxy_inflight(0),
+                "phase_hists": slo.all_phase_hists()}
+
     def shutdown(self) -> bool:
         self._proxy.shutdown()
         return True

@@ -15,6 +15,7 @@ import time
 import types
 from typing import Any, Optional
 
+from . import slo
 from .multiplex import _set_request_model_id
 
 # A request whose user code returned a generator answers with this marker;
@@ -41,13 +42,19 @@ def _with_model_id(gen, model_id: str):
 
 class Replica:
     def __init__(self, cls_or_fn, init_args, init_kwargs,
-                 user_config: Optional[dict] = None):
+                 user_config: Optional[dict] = None,
+                 deployment_name: str = ""):
         self._lock = threading.Lock()
         self._ongoing = 0
         self._total = 0
         self._window: list[float] = []  # request-arrival timestamps
         self._streams: dict[int, Any] = {}
         self._stream_counter = 0
+        self._deployment = deployment_name or getattr(
+            cls_or_fn, "__name__", "deployment")
+        # One replica actor per worker process: the module-global lets
+        # batcher collector threads attribute batch_wait observations.
+        slo.set_deployment(self._deployment)
         if isinstance(cls_or_fn, type):
             self.instance = cls_or_fn(*init_args, **init_kwargs)
         else:
@@ -64,13 +71,22 @@ class Replica:
         return True
 
     def handle_request(self, method: str, args: tuple, kwargs: dict,
-                       multiplexed_model_id: str = "") -> Any:
+                       multiplexed_model_id: str = "",
+                       submit_ts: float = 0.0) -> Any:
+        if submit_ts:
+            # Handle-side submit stamp -> here: actor-lane queueing.
+            # Cross-process wall clocks on the same host; clamped >= 0.
+            slo.record_phase("replica_queue", time.time() - submit_ts,
+                             self._deployment)
         with self._lock:
             self._ongoing += 1
             self._total += 1
             self._window.append(time.monotonic())
             if len(self._window) > 1000:
                 del self._window[:-1000]
+        slo.set_queue_depth(self._ongoing + len(self._streams),
+                            self._deployment)
+        t_exec0 = time.perf_counter()
         try:
             _set_request_model_id(multiplexed_model_id)
             if callable(self.instance) and method == "__call__":
@@ -92,9 +108,16 @@ class Replica:
                 return {STREAM_MARKER: sid}
             return result
         finally:
+            # For @serve.batch methods this span includes batch
+            # residency (batch_wait is recorded separately by the
+            # batcher): execute - batch_wait isolates pure compute.
+            slo.record_phase("execute", time.perf_counter() - t_exec0,
+                             self._deployment)
             _set_request_model_id(None)
             with self._lock:
                 self._ongoing -= 1
+            slo.set_queue_depth(self._ongoing + len(self._streams),
+                                self._deployment)
 
     def stream_next(self, sid: int, max_chunks: int = 16):
         """(chunks, done) — up to max_chunks items of stream ``sid``."""
@@ -125,9 +148,13 @@ class Replica:
             recent = sum(1 for t in self._window if now - t < 10.0)
             # Parked streams ARE ongoing work: autoscaling/drain must not
             # kill a replica mid-stream.
-            return {"ongoing": self._ongoing + len(self._streams),
-                    "total": self._total,
-                    "rate_10s": recent / 10.0}
+            ongoing = self._ongoing + len(self._streams)
+        return {"ongoing": ongoing,
+                "total": self._total,
+                "rate_10s": recent / 10.0,
+                "deployment": self._deployment,
+                "queue_depth": ongoing,
+                "phase_hist": slo.phase_hist(self._deployment)}
 
     def check_health(self) -> bool:
         fn = getattr(self.instance, "check_health", None)

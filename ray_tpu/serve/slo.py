@@ -1,0 +1,208 @@
+"""Serving-path SLO instrumentation: per-deployment request-latency
+phase histograms + queue-depth gauges.
+
+Reference parity: Serve's request-latency metrics
+(serve_deployment_processing_latency_ms et al. in the reference's
+metrics surface) with an explicit PHASE breakdown — the signals the
+continuous-batching autoscaler consumes (ROADMAP item 1):
+
+  * ``proxy_queue``     — HTTP arrival -> dispatched to a replica
+                          (routing + proxy-side queueing)
+  * ``replica_queue``   — handle submit -> replica began the request
+                          (actor-lane queueing; cross-process clocks,
+                          clamped at 0)
+  * ``batch_wait``      — request parked in a @serve.batch queue
+  * ``execute``         — user code (includes batch residency for
+                          batched methods; ``execute - batch_wait``
+                          isolates pure compute)
+
+Two sinks per observation, both cheap (a bucket increment under one
+lock):
+
+  1. process-local fixed-boundary buckets, shipped via
+     ``Replica.stats()`` / ``ProxyActor.stats()`` so the controller can
+     merge replicas and surface p50/p95/p99 in ``serve.status()``;
+  2. the ``rtpu_serve_request_seconds`` user-metric histogram
+     (tags: deployment, phase), which rides the worker 1s flusher into
+     the node's telemetry sampler -> head time-series
+     (``serve_p95_ms:<deployment>:<phase>`` et al.).
+
+One replica actor runs per worker process, so the module-global
+current-deployment name safely attributes batch_wait observations made
+on batcher collector threads.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from typing import Dict, List, Optional
+
+# Request-phase bucket upper bounds (seconds): sub-ms to 10s, tuned for
+# serving latencies rather than the coarser task-phase defaults.
+PHASE_BOUNDS: List[float] = [
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0]
+
+PHASES = ("proxy_queue", "replica_queue", "batch_wait", "execute")
+
+_lock = threading.Lock()
+# Deployment hosted by THIS process (set by Replica.__init__).
+_deployment = ""
+# (deployment, phase) -> [bucket_counts, sum, count]
+_local: Dict[tuple, list] = {}
+
+_hist = None
+_replica_gauge = None
+_proxy_gauge = None
+_proxy_inflight = 0
+
+
+def _metrics():
+    """Lazy metric construction: importing this module must not
+    register metrics in processes that never serve."""
+    global _hist, _replica_gauge, _proxy_gauge
+    if _hist is None:
+        from ray_tpu.util.metrics import Gauge, Histogram
+
+        _hist = Histogram(
+            "rtpu_serve_request_seconds",
+            "Serve request latency by deployment and phase",
+            boundaries=list(PHASE_BOUNDS),
+            tag_keys=("deployment", "phase"))
+        _replica_gauge = Gauge(
+            "rtpu_serve_replica_queue_depth",
+            "Ongoing requests on this replica (in-flight + parked)",
+            tag_keys=("deployment",))
+        _proxy_gauge = Gauge(
+            "rtpu_serve_proxy_inflight",
+            "HTTP requests in flight in this proxy")
+    return _hist, _replica_gauge, _proxy_gauge
+
+
+def set_deployment(name: str):
+    global _deployment
+    _deployment = name or ""
+
+
+def current_deployment() -> str:
+    return _deployment
+
+
+def record_phase(phase: str, seconds: float,
+                 deployment: Optional[str] = None):
+    dep = deployment if deployment else (_deployment or "?")
+    seconds = max(0.0, float(seconds))
+    key = (dep, phase)
+    with _lock:
+        cell = _local.get(key)
+        if cell is None:
+            cell = _local[key] = [[0] * (len(PHASE_BOUNDS) + 1), 0.0, 0]
+        cell[0][bisect_left(PHASE_BOUNDS, seconds)] += 1
+        cell[1] += seconds
+        cell[2] += 1
+    try:
+        hist, _, _ = _metrics()
+        hist.observe(seconds, tags={"deployment": dep, "phase": phase})
+    except Exception:  # noqa: BLE001 - SLO recording is best-effort
+        pass
+
+
+def set_queue_depth(depth: int, deployment: Optional[str] = None):
+    try:
+        _, gauge, _ = _metrics()
+        gauge.set(float(depth),
+                  tags={"deployment": deployment or _deployment or "?"})
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def proxy_inflight(delta: int) -> int:
+    """Adjust + publish the proxy in-flight gauge; returns the new
+    value (single-writer per proxy process, so a plain int suffices)."""
+    global _proxy_inflight
+    _proxy_inflight = max(0, _proxy_inflight + delta)
+    try:
+        _, _, gauge = _metrics()
+        gauge.set(float(_proxy_inflight))
+    except Exception:  # noqa: BLE001
+        pass
+    return _proxy_inflight
+
+
+def phase_hist(deployment: Optional[str] = None) -> dict:
+    """{phase: {"bounds", "counts", "sum", "count"}} for one deployment
+    (default: this process's). Cumulative since process start — callers
+    diff or merge, they don't reset."""
+    dep = deployment if deployment else (_deployment or "?")
+    out = {}
+    with _lock:
+        for (d, phase), (counts, total, n) in _local.items():
+            if d != dep:
+                continue
+            out[phase] = {"bounds": list(PHASE_BOUNDS),
+                          "counts": list(counts),
+                          "sum": total, "count": n}
+    return out
+
+
+def all_phase_hists() -> dict:
+    """{deployment: {phase: cell}} for every deployment observed in
+    this process (the proxy records several)."""
+    out: dict = {}
+    with _lock:
+        for (d, phase), (counts, total, n) in _local.items():
+            out.setdefault(d, {})[phase] = {
+                "bounds": list(PHASE_BOUNDS), "counts": list(counts),
+                "sum": total, "count": n}
+    return out
+
+
+def merge_phase_hists(hists: List[dict]) -> dict:
+    """Merge per-replica ``phase_hist()`` payloads (bucket-wise sum)."""
+    merged: dict = {}
+    for h in hists:
+        for phase, cell in (h or {}).items():
+            cur = merged.get(phase)
+            if cur is None:
+                merged[phase] = {"bounds": list(cell["bounds"]),
+                                 "counts": list(cell["counts"]),
+                                 "sum": cell["sum"],
+                                 "count": cell["count"]}
+            elif cur["bounds"] == cell["bounds"]:
+                cur["counts"] = [a + b for a, b in
+                                 zip(cur["counts"], cell["counts"])]
+                cur["sum"] += cell["sum"]
+                cur["count"] += cell["count"]
+    return merged
+
+
+def latency_summary(merged: dict) -> dict:
+    """{phase: {p50_ms, p95_ms, p99_ms, mean_ms, count}} from a merged
+    phase-hist dict — the ``serve.status()`` latency block."""
+    from ray_tpu._private.telemetry import quantile_from_buckets
+
+    out = {}
+    for phase, cell in merged.items():
+        n = cell["count"]
+        if not n:
+            continue
+        out[phase] = {
+            "count": n,
+            "mean_ms": cell["sum"] / n * 1e3,
+            "p50_ms": quantile_from_buckets(
+                cell["counts"], cell["bounds"], 0.50) * 1e3,
+            "p95_ms": quantile_from_buckets(
+                cell["counts"], cell["bounds"], 0.95) * 1e3,
+            "p99_ms": quantile_from_buckets(
+                cell["counts"], cell["bounds"], 0.99) * 1e3,
+        }
+    return out
+
+
+def _reset_for_tests():
+    global _deployment, _proxy_inflight
+    with _lock:
+        _local.clear()
+    _deployment = ""
+    _proxy_inflight = 0

@@ -24,10 +24,18 @@ _SYSTEM_HELP = {
 }
 
 
+def _escape_label(value) -> str:
+    # Exposition-format label escaping: backslash first, then quote and
+    # newline (the spec's only three escapes).
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_tags(tags: dict) -> str:
     if not tags:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(tags.items()))
+    inner = ",".join(f'{k}="{_escape_label(v)}"'
+                     for k, v in sorted(tags.items()))
     return "{" + inner + "}"
 
 
@@ -131,6 +139,24 @@ def prometheus_text() -> str:
                 out.append(f"{mname}_bucket{_fmt_tags(bt)} {n}")
                 out.append(f"{mname}_sum{_fmt_tags(tags)} {total}")
                 out.append(f"{mname}_count{_fmt_tags(tags)} {n}")
+
+    # -- telemetry plane: latest sample of each head time-series -----------
+    # Raw metric names carry ':'-separated subkeys (illegal in metric
+    # names), so they go in a label instead. Best-effort: an old head
+    # without the timeseries RPC just skips the section.
+    try:
+        ts = rt.timeseries()
+        emit_meta("rtpu_telemetry", "gauge",
+                  "Latest head time-series sample per metric and node")
+        for metric, by_node in sorted(ts.get("series", {}).items()):
+            for node, points in sorted(by_node.items()):
+                if not points:
+                    continue
+                tags = {"metric": metric, "node_id": node}
+                out.append(f"rtpu_telemetry{_fmt_tags(tags)} "
+                           f"{points[-1][1]}")
+    except Exception:  # noqa: BLE001 - export must not fail the page
+        pass
     return "\n".join(out) + "\n"
 
 

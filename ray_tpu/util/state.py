@@ -233,6 +233,26 @@ def cluster_metrics() -> dict:
     return out
 
 
+def timeseries(metric: Optional[str] = None,
+               node_id: Optional[str] = None,
+               resolution: float = 1.0) -> dict:
+    """Head-retained telemetry time-series (the cluster telemetry
+    plane). Returns ``{"resolution": seconds, "series": {metric:
+    {node_hex: [[ts, value, high_water], ...]}}}``.
+
+    ``metric`` filters to one metric name (None = all; see
+    ``state.timeseries_metrics()`` for what's recorded), ``node_id`` to
+    one node (hex), and ``resolution`` snaps down to the nearest
+    retention tier — 1x, 10x, or 60x the sample interval (defaults:
+    ~15 min of 1s samples, ~1 h at 10s, ~4 h at 60s)."""
+    return _runtime("timeseries").timeseries(metric, node_id, resolution)
+
+
+def timeseries_metrics() -> list[str]:
+    """Metric names currently recorded by the telemetry plane."""
+    return sorted(timeseries().get("series", {}))
+
+
 def timeline(filename: Optional[str] = None) -> Any:
     """Dump task execution as a chrome-tracing JSON (load in
     chrome://tracing or Perfetto). Returns the event list, and writes it

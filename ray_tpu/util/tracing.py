@@ -30,6 +30,8 @@ _MAX_SPANS = 10_000
 # Drop-OLDEST on overflow (a long-lived traced driver keeps recording;
 # matching the node table's deque semantics).
 _spans: collections.deque = collections.deque(maxlen=_MAX_SPANS)
+# Spans evicted by the ring on overflow (this process, since start).
+_spans_dropped = 0
 _exporters: List[Callable[[dict], None]] = []
 
 # The active span context in this thread/task ({"trace_id", "span_id"}).
@@ -63,7 +65,10 @@ def should_trace() -> bool:
 
 
 def _record(span: dict) -> None:
+    global _spans_dropped
     with _lock:
+        if len(_spans) == _MAX_SPANS:
+            _spans_dropped += 1  # deque evicts the oldest silently
         _spans.append(span)
     for fn in _exporters:
         try:
@@ -114,6 +119,10 @@ class span:
         self.span_id = uuid.uuid4().hex[:16]
         self.parent_id = (parent or {}).get("span_id")
         self.start = time.time()
+        # Durations come off the monotonic clock: a wall-clock step
+        # (NTP slew, manual set) between enter and exit must not
+        # produce a negative or wildly wrong span.
+        self._mono = time.monotonic()
         self._token = current_context.set(
             {"trace_id": self.trace_id, "span_id": self.span_id})
         return self
@@ -128,10 +137,18 @@ class span:
         _record({
             "name": self.name, "trace_id": self.trace_id,
             "span_id": self.span_id, "parent_id": self.parent_id,
-            "start": self.start, "end": time.time(),
+            "start": self.start,
+            "end": self.start + (time.monotonic() - self._mono),
             "pid": os.getpid(), "attributes": self.attributes,
         })
         return False
+
+
+def span_stats() -> Dict[str, int]:
+    """{"recorded": spans currently buffered, "dropped": spans evicted
+    from this process's ring since start}."""
+    with _lock:
+        return {"recorded": len(_spans), "dropped": _spans_dropped}
 
 
 def local_spans() -> List[dict]:
@@ -146,9 +163,11 @@ def drain_local_spans() -> List[dict]:
     return out
 
 
-def get_spans() -> List[dict]:
+def get_spans(with_stats: bool = False):
     """Cluster-wide spans: this process's plus every node's collected
-    worker spans (the ``spans`` state table)."""
+    worker spans (the ``spans`` state table). With ``with_stats=True``
+    returns ``(spans, span_stats())`` so callers can see how many spans
+    the local ring dropped."""
     from .._private import context as context_mod
 
     rt = context_mod.get_context()
@@ -165,7 +184,10 @@ def get_spans() -> List[dict]:
             continue
         seen.add(r["span_id"])
         out.append(r)
-    return sorted(out, key=lambda r: r["start"])
+    out = sorted(out, key=lambda r: r["start"])
+    if with_stats:
+        return out, span_stats()
+    return out
 
 
 def export_chrome_trace(filename: str) -> int:

@@ -198,6 +198,38 @@ def test_every_exchange_transition_site_emits_an_event():
         f"lifecycle event (self._event): {missing}")
 
 
+# Every site that mutates the CPU dispatch queue (pending_cpu) or a
+# worker's pipeline window (inflight): each must refresh the telemetry
+# high-water gauges, or the sampler's dispatch_queue_hw /
+# pipeline_inflight_hw silently miss between-sample bursts.
+_DISPATCH_QUEUE_SITES = (
+    "_enqueue_local",      # pending_cpu.append (local submit)
+    "_dispatch",           # pending_cpu = still_pending
+    "_try_spill",          # pending_cpu.append (spill bounce-back)
+    "_requeue_unstarted",  # pending_cpu re-queue off a dead worker
+    "_retry_or_fail",      # pending_cpu.append (retry)
+    "_handle_task_reply",  # pending_cpu.append (retry_exceptions)
+    "_run_on_device",      # pending_cpu.append (device retry)
+    "_handle_rpc",         # pending_cpu = keep (register setup_error)
+)
+_PIPELINE_WINDOW_SITES = (
+    "_acquire_worker",     # inflight[...] = spec (pipelined lease)
+    "_run_on_worker",      # inflight[...] = spec (fresh lease)
+    "_run_actor_task",     # inflight[...] = spec (actor lane)
+)
+
+
+def test_every_queue_mutation_site_updates_its_gauge():
+    path = REPO / "ray_tpu/_private/node_service.py"
+    missing = _methods_missing_call(
+        path, _DISPATCH_QUEUE_SITES, "_gauge_queues")
+    missing += _methods_missing_call(
+        path, _PIPELINE_WINDOW_SITES, "_gauge_queues")
+    assert not missing, (
+        f"dispatch-queue/pipeline-window mutation site(s) never refresh "
+        f"the telemetry gauges (self._gauge_queues): {missing}")
+
+
 def test_event_lint_catches_a_silent_site(tmp_path):
     """The net itself is live: a transition method without an emit is
     flagged, one with it is not, and a REMOVED method is flagged."""

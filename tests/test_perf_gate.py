@@ -153,6 +153,38 @@ def test_solo_perf_gate():
             f"(calibrations {cal:.2f}/{cal2:.2f}): {confirmed}")
 
 
+def test_telemetry_sampler_overhead_gate():
+    """The telemetry sampler runs on the node loop every interval: its
+    hot path must stay in the tens-of-microseconds class. Budget 1ms
+    per sample at calibration 1.0 (~20-60us observed solo) so a
+    regression to O(expensive) scanning fails loudly, scaled like every
+    other floor."""
+    from ray_tpu._private.telemetry import TelemetrySampler
+
+    cal = _calibrate()
+    ray_tpu.shutdown()
+    rt = ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        def tick(i):
+            return ray_tpu.put(bytes(100))
+
+        ray_tpu.get([tick.remote(i) for i in range(50)], timeout=60)
+        sampler = TelemetrySampler(rt.node)
+        sampler.sample()  # prime the anchors
+        n = 500
+        t0 = time.perf_counter()
+        for _ in range(n):
+            sampler.sample()
+        per_sample = (time.perf_counter() - t0) / n
+    finally:
+        ray_tpu.shutdown()
+    budget = 1e-3 / cal
+    assert per_sample < budget, (
+        f"telemetry sampler hot path regressed: {per_sample * 1e6:.1f}us "
+        f"per sample > budget {budget * 1e6:.1f}us (calibration {cal:.2f})")
+
+
 def test_solo_cross_node_fetch_gate():
     cal = _calibrate()
     os.environ["RT_MB_FETCH_MB"] = "16"

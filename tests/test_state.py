@@ -75,6 +75,51 @@ def test_list_actors_workers_objects(rt):
     del ref
 
 
+def test_objects_carry_owner_attribution(rt):
+    """Every object row names what created it: the task's name for
+    task returns, "driver/put" for direct puts — the grouping key of
+    ``rtpu memory --group-by owner``."""
+    @ray_tpu.remote
+    def producer(i):
+        return bytes(256)
+
+    refs = [producer.remote(i) for i in range(3)]
+    ray_tpu.get(refs)
+    put_ref = ray_tpu.put(b"y" * 512)
+
+    objs = state.list_objects(filters=[("status", "=", "READY")])
+    by_owner = {}
+    for o in objs:
+        by_owner.setdefault(o.get("owner"), []).append(o)
+    assert len(by_owner.get("producer", [])) >= 3, sorted(by_owner)
+    assert any(o["object_id"] == put_ref.id.hex()
+               for o in by_owner.get("driver/put", []))
+    del refs, put_ref
+
+
+def test_state_timeseries_surface(rt):
+    """state.timeseries() reaches the head rings (default 1s interval
+    in this fixture): hop metrics appear with [ts, value, hi] points."""
+    import time
+
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    ray_tpu.get([one.remote() for _ in range(20)], timeout=60)
+    deadline = time.monotonic() + 20
+    out = {}
+    while time.monotonic() < deadline:
+        out = state.timeseries()
+        if "tasks_per_s" in out.get("series", {}):
+            break
+        time.sleep(0.3)
+    assert "tasks_per_s" in out["series"], sorted(out.get("series", {}))
+    pts = next(iter(out["series"]["tasks_per_s"].values()))
+    assert pts and len(pts[0]) == 3
+    assert "dispatch_queue_depth" in state.timeseries_metrics()
+
+
 def test_device_lane_tasks_in_state(rt):
     @ray_tpu.remote(scheduling_strategy="device")
     def on_device():

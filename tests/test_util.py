@@ -172,6 +172,40 @@ class TestMetrics:
         assert "rtpu_node_tasks_finished" in text
         assert "rtpu_node_num_workers" in text
 
+    def test_label_value_escaping(self, rt):
+        """Exposition-format escaping regression: a label value holding
+        a backslash, a double quote, AND a newline must render as the
+        spec's three escapes (unescaped, it corrupts the whole page)."""
+        from ray_tpu.util import metrics
+        from ray_tpu.util.prometheus import _fmt_tags
+
+        assert _fmt_tags({"p": 'a\\b"c\nd'}) == '{p="a\\\\b\\"c\\nd"}'
+        c = metrics.Counter("t_escape_check", tag_keys=("path",))
+        c.inc(1, tags={"path": 'C:\\tmp\n"quoted"'})
+        text = prometheus_text()
+        assert ('t_escape_check{path="C:\\\\tmp\\n\\"quoted\\""} 1.0'
+                in text)
+        # No raw newline may survive inside any sample line's braces.
+        for line in text.splitlines():
+            assert not line.endswith("\\")
+
+    def test_telemetry_latest_export(self, rt):
+        import time as _time
+
+        @ray_tpu.remote
+        def one():
+            return 1
+
+        ray_tpu.get(one.remote(), timeout=60)
+        deadline = _time.monotonic() + 15
+        text = ""
+        while _time.monotonic() < deadline:
+            text = prometheus_text()
+            if 'rtpu_telemetry{metric="tasks_per_s"' in text:
+                break
+            _time.sleep(0.3)
+        assert 'rtpu_telemetry{metric="tasks_per_s"' in text
+
     def test_http_endpoint(self, rt):
         import urllib.request
 
