@@ -629,9 +629,41 @@ def run_data_shuffle(num_blocks: int = 128,
         ray_tpu.shutdown()
 
 
+def run_serve_llm():
+    """LLM serving path: streaming clients vs the continuous-batching
+    engine; appends tokens/s + TTFT/TPOT rows to SERVE_BENCH.json."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import ray_tpu
+    from ray_tpu.scripts.serve_bench import run_serve_llm as _bench
+
+    duration = float(os.environ.get("RT_SERVE_BENCH_S", "6"))
+    clients = int(os.environ.get("RT_SERVE_BENCH_CLIENTS", "6"))
+    ray_tpu.init(num_cpus=2)
+    try:
+        row = _bench(duration_s=duration, clients=clients)
+    finally:
+        ray_tpu.shutdown()
+    row["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    out = os.environ.get("RT_SERVE_BENCH_OUT", "SERVE_BENCH.json")
+    doc = {}
+    if os.path.exists(out):
+        with open(out) as f:
+            doc = json.load(f)
+    doc["llm"] = row
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    return row
+
+
 def main():
     if "--data-shuffle" in sys.argv:
         print(json.dumps(run_data_shuffle()))
+        return 0
+    if "--serve-llm" in sys.argv:
+        print(json.dumps(run_serve_llm()))
         return 0
     if "--probe" in sys.argv:
         import jax

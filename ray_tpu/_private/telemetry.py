@@ -222,7 +222,7 @@ class TelemetrySampler:
         g["dispatch_queue_hw"] = len(node.pending_cpu)
 
         occ = busy = 0
-        for w in node.workers.values():
+        for w in list(node.workers.values()):
             if w.actor_id is None and w.proc is not None:
                 occ += len(w.inflight)
                 if w.state == "BUSY":
@@ -234,8 +234,9 @@ class TelemetrySampler:
         g["pipeline_inflight_hw"] = occ
         m["pipeline_occupancy"] = (occ / (busy * depth)) if busy else 0.0
 
-        # Object-store level + monotone high-water.
-        used = sum(st.size for st in node.objects.values()
+        # Object-store level + monotone high-water. Snapshot the dict:
+        # worker threads insert/seal objects while the sampler walks it.
+        used = sum(st.size for st in list(node.objects.values())
                    if st.status == "READY")
         if used > self._store_hw:
             self._store_hw = used
@@ -254,13 +255,31 @@ class TelemetrySampler:
 
         return {"ts": time.time(), "metrics": m}
 
+    # Generation-engine gauges (serve/llm.py replicas): metric name ->
+    # (series prefix, cross-replica reduction). Rates and batch sizes
+    # sum over replicas; pool utilization takes the hottest replica.
+    _LLM_GAUGES = {
+        "rtpu_llm_tokens_per_s": ("llm_tokens_per_s", "sum"),
+        "rtpu_llm_batch_size": ("llm_batch_size", "sum"),
+        "rtpu_llm_kv_util": ("llm_kv_util", "max"),
+    }
+
     def _sample_serve(self, m: Dict[str, float], dt: float):
         depth_by_dep: Dict[str, float] = {}
         hists: Dict[tuple, list] = {}
         for source, snap in self.node.user_metrics.items():
             for r in snap.get("rows", ()):
                 name = r.get("name", "")
-                if name == "rtpu_serve_replica_queue_depth":
+                if name in self._LLM_GAUGES:
+                    prefix, red = self._LLM_GAUGES[name]
+                    dep = r.get("tags", {}).get("deployment", "?")
+                    key = f"{prefix}:{dep}"
+                    val = float(r.get("value", 0.0))
+                    if red == "max":
+                        m[key] = max(m.get(key, 0.0), val)
+                    else:
+                        m[key] = m.get(key, 0.0) + val
+                elif name == "rtpu_serve_replica_queue_depth":
                     dep = r.get("tags", {}).get("deployment", "?")
                     depth_by_dep[dep] = depth_by_dep.get(dep, 0.0) \
                         + float(r.get("value", 0.0))

@@ -1,0 +1,26 @@
+"""ray_tpu.llm — native continuous-batching LLM inference.
+
+Reference layer map: where the reference runtime fronts external
+inference engines (vLLM et al.), this package is the TPU-native engine
+itself, built from the repo's own layers:
+
+  * llm/kv_cache.py      — paged KV pool (PagedAttention block manager)
+  * ops/pallas/paged_decode.py — decode-attention kernel gathering K/V
+                            through block tables (interpret mode on CPU)
+  * models/gpt.py        — forward_prefill / forward_decode modes
+  * llm/engine.py        — Orca-style iteration-level scheduler
+  * serve/llm.py         — streaming deployment (TTFT/TPOT SLO phases,
+                            tokens/s + KV-utilization telemetry)
+"""
+
+from .engine import (  # noqa: F401
+    FINISHED,
+    PREEMPTED,
+    PREFILL,
+    RUNNING,
+    WAITING,
+    LLMEngine,
+    Request,
+)
+from .kv_cache import PagedKVCache  # noqa: F401
+from .sampling import sample  # noqa: F401

@@ -1,0 +1,418 @@
+"""Continuous-batching generation engine (iteration-level scheduling).
+
+Reference layer map: the Orca-style scheduler (Yu et al., OSDI '22) the
+reference runtime fronts with external inference servers — here it is
+native. One engine owns the model params, the paged KV pool
+(llm/kv_cache.py) and a step loop; requests stream tokens out through
+per-request queues, so N serve threads (one per in-flight HTTP request)
+share ONE device-resident batch.
+
+Scheduling is per STEP, not per request: every step first admits waiting
+requests into the in-flight batch (prefill), then runs ONE decode token
+for every running sequence. A request that arrives mid-generation joins
+the very next step — the batch is recomposed continuously instead of
+draining.
+
+Request lifecycle (every transition emits an event — the concurrency-net
+lint in tests/test_concurrency_net.py holds these sites to it):
+
+    WAITING --admit--> PREFILL --activate--> RUNNING --finish--> FINISHED
+                          ^                     |
+                          '----- PREEMPTED <----'  (pool exhausted)
+
+Preemption is recompute-on-resume: the victim's blocks are freed (its
+generated tokens are kept host-side) and on re-admission the engine
+re-prefills prompt + generated-so-far. Sampling is keyed by
+(seed, position) only (llm/sampling.py), so a resumed sequence produces
+bit-identical output — admission beyond pool capacity degrades latency,
+never correctness, and never OOMs.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import itertools
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..models.gpt import GPTConfig, forward_decode, forward_prefill
+from .kv_cache import PagedKVCache
+from .sampling import sample
+
+# Request states (the event vocabulary).
+WAITING = "WAITING"
+PREFILL = "PREFILL"
+RUNNING = "RUNNING"
+PREEMPTED = "PREEMPTED"
+FINISHED = "FINISHED"
+
+
+@dataclass
+class Request:
+    rid: int
+    prompt: List[int]
+    max_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    seed: int = 0
+    stop_tokens: Tuple[int, ...] = ()
+    state: str = WAITING
+    block_table: List[int] = field(default_factory=list)
+    context_len: int = 0          # tokens resident in the KV pool
+    output: List[int] = field(default_factory=list)
+    emitted: int = 0              # tokens already pushed to the consumer
+    finish_reason: Optional[str] = None
+    submit_t: float = 0.0
+    first_token_t: Optional[float] = None
+    finish_t: Optional[float] = None
+    preemptions: int = 0
+    out_q: "queue.Queue" = field(default_factory=queue.Queue)
+
+    def tokens(self):
+        """Blocking generator over this request's output tokens (the
+        serve streaming path iterates this on a replica thread)."""
+        while True:
+            tok = self.out_q.get()
+            if tok is None:
+                return
+            yield tok
+
+
+class LLMEngine:
+    """One model + one KV pool + one step scheduler.
+
+    Thread-safe: add_request() may be called from any thread (serve
+    replicas run requests on a thread pool); step() is driven either by
+    the background loop (start()) or manually (tests)."""
+
+    def __init__(self, params, cfg: GPTConfig, *, num_blocks: int = 64,
+                 block_size: int = 16, max_batch: int = 8,
+                 mesh=None, rules=None, name: str = "llm"):
+        self.cfg = cfg
+        self.name = name
+        self.max_batch = int(max_batch)
+        self.kv = PagedKVCache(cfg, num_blocks=num_blocks,
+                               block_size=block_size)
+        self.params = params
+        # Fixed decode shapes — one compile: batch padded to max_batch,
+        # tables padded to the worst-case blocks/sequence.
+        self.max_nb = self.kv.blocks_for_tokens(cfg.max_seq)
+        self._decode = jax.jit(
+            functools.partial(forward_decode, cfg=cfg, mesh=mesh,
+                              rules=rules),
+            donate_argnums=(3, 4))
+        # Prefill recompiles per length bucket (lengths are padded to a
+        # block multiple, so at most max_seq/block_size variants).
+        self._prefill = jax.jit(
+            functools.partial(forward_prefill, cfg=cfg, mesh=mesh,
+                              rules=rules))
+
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._waiting: Deque[Request] = collections.deque()
+        self._active: List[Request] = []      # PREFILL/RUNNING, batch order
+        self._requests: Dict[int, Request] = {}
+        self._ids = itertools.count(1)
+        self._events: Deque[tuple] = collections.deque(maxlen=4096)
+        # (step_idx, (rid, ...)) per step — the in-flight composition
+        # trace the batch-recomposition test asserts on.
+        self.step_log: Deque[tuple] = collections.deque(maxlen=1024)
+        self._steps = 0
+        self._finished_count = 0
+        self._token_times: Deque[tuple] = collections.deque()  # (t, n)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        self._gauges = None
+
+    # -- events ------------------------------------------------------------
+
+    def _event(self, req: Request, state: str):
+        req.state = state
+        self._events.append((time.time(), req.rid, state))
+
+    def events(self) -> List[tuple]:
+        return list(self._events)
+
+    # -- submission --------------------------------------------------------
+
+    def add_request(self, prompt: List[int], max_tokens: int = 16, *,
+                    temperature: float = 0.0, top_k: int = 0,
+                    seed: int = 0, stop_tokens=()) -> Request:
+        """Validate + enqueue; returns the Request whose .tokens()
+        generator streams the output. Raises if the request could never
+        run (so the pool-exhaustion path is always recoverable by
+        preemption, never a livelock)."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if len(prompt) + max_tokens > self.cfg.max_seq:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_tokens ({max_tokens}) "
+                f"exceeds max_seq {self.cfg.max_seq}")
+        need = self.kv.blocks_for_tokens(len(prompt) + max_tokens)
+        if need > self.kv.capacity:
+            raise ValueError(
+                f"request needs {need} KV blocks; pool capacity is "
+                f"{self.kv.capacity} — it could never be admitted")
+        req = Request(rid=next(self._ids), prompt=prompt,
+                      max_tokens=int(max_tokens),
+                      temperature=float(temperature), top_k=int(top_k),
+                      seed=int(seed),
+                      stop_tokens=tuple(int(t) for t in stop_tokens),
+                      submit_t=time.time())
+        with self._cond:
+            self._requests[req.rid] = req
+            self._waiting.append(req)
+            self._event(req, WAITING)
+            self._cond.notify()
+        return req
+
+    # -- scheduler ---------------------------------------------------------
+
+    def _admit(self):
+        """Move waiting requests into the batch while blocks last.
+        FIFO head-of-line: a request that doesn't fit blocks the ones
+        behind it (simple + starvation-free given the add_request
+        capacity check)."""
+        while self._waiting and len(self._active) < self.max_batch:
+            req = self._waiting[0]
+            seq_len = len(req.prompt) + len(req.output)
+            grant = self.kv.alloc(self.kv.blocks_for_tokens(seq_len + 1))
+            if grant is None:
+                break
+            self._waiting.popleft()
+            req.block_table = grant
+            self._active.append(req)
+            self._event(req, PREFILL)
+
+    def _activate(self, req: Request, logits_row):
+        """Prefill done: sample the first (or first-since-resume) token
+        and enter the decode batch."""
+        self._event(req, RUNNING)
+        self._sample_into(req, logits_row)
+
+    def _preempt(self, req: Request):
+        """Evict req from the batch, free its blocks, requeue at the
+        FRONT (resume priority beats fresh admissions — bounds each
+        request's preemption count)."""
+        self._active.remove(req)
+        self.kv.free(req.block_table)
+        req.block_table = []
+        req.context_len = 0
+        req.preemptions += 1
+        self._waiting.appendleft(req)
+        self._event(req, PREEMPTED)
+
+    def _finish(self, req: Request, reason: str):
+        if req in self._active:
+            self._active.remove(req)
+        if req.block_table:
+            self.kv.free(req.block_table)
+            req.block_table = []
+        req.finish_reason = reason
+        req.finish_t = time.time()
+        self._finished_count += 1
+        self._event(req, FINISHED)
+        req.out_q.put(None)
+
+    def _sample_into(self, req: Request, logits_row) -> bool:
+        """Sample the next token at the request's current absolute
+        position; emit it; apply stop conditions. Returns True if the
+        request finished."""
+        pos = len(req.prompt) + len(req.output)
+        tok = sample(logits_row, temperature=req.temperature,
+                     top_k=req.top_k, seed=req.seed, position=pos)
+        req.output.append(tok)
+        now = time.time()
+        if req.first_token_t is None:
+            req.first_token_t = now
+        self._token_times.append((now, 1))
+        while req.emitted < len(req.output):
+            req.out_q.put(req.output[req.emitted])
+            req.emitted += 1
+        if tok in req.stop_tokens:
+            self._finish(req, "stop")
+            return True
+        if len(req.output) >= req.max_tokens:
+            self._finish(req, "length")
+            return True
+        return False
+
+    def _run_prefills(self):
+        """Prefill newly admitted requests one sequence at a time
+        (prompt lengths are ragged; padding to a block multiple bounds
+        recompiles to max_seq/block_size variants)."""
+        for req in [r for r in self._active if r.state == PREFILL]:
+            seq = req.prompt + req.output
+            T = len(seq)
+            pad = -T % self.kv.block_size or 0
+            toks = np.zeros((1, T + pad), np.int32)
+            toks[0, :T] = seq
+            logits, k, v = self._prefill(self.params, toks)
+            # Export the cache: [L, 1, s, Hkv, d] -> [L, T, Hkv, d].
+            self.kv.write_prefill(k[:, 0, :T], v[:, 0, :T],
+                                  req.block_table)
+            req.context_len = T
+            row = np.asarray(jax.device_get(logits[0, T - 1]), np.float32)
+            self._activate(req, row)
+
+    def _ensure_decode_slot(self, req: Request) -> bool:
+        """Guarantee req's next token has a pool slot, preempting LIFO
+        victims if the pool is dry. Returns False if req itself was
+        preempted (the last resort when it is the newest — and possibly
+        only — sequence)."""
+        slot = req.context_len
+        if slot // self.kv.block_size < len(req.block_table):
+            return True
+        while True:
+            grant = self.kv.alloc(1)
+            if grant is not None:
+                req.block_table.extend(grant)
+                return True
+            victims = [r for r in self._active
+                       if r.state == RUNNING and r is not req]
+            if victims:
+                self._preempt(victims[-1])
+                continue
+            self._preempt(req)
+            return False
+
+    def _run_decode(self):
+        batch = [r for r in self._active if r.state == RUNNING]
+        for req in list(batch):
+            if req.state == RUNNING:
+                self._ensure_decode_slot(req)
+        # An ensure call may have preempted requests anywhere in the
+        # batch (LIFO victims) — only still-RUNNING sequences decode.
+        batch = [r for r in batch if r.state == RUNNING]
+        if not batch:
+            return
+        B = self.max_batch
+        bs = self.kv.block_size
+        tokens = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        slot_blocks = np.zeros((B,), np.int32)
+        slot_offsets = np.zeros((B,), np.int32)
+        # Padded lanes: scratch block 0, context 1 — attention over the
+        # scratch block's garbage is masked-in but their logits are
+        # never sampled.
+        context_lens = np.ones((B,), np.int32)
+        tables = np.zeros((B, self.max_nb), np.int32)
+        for i, req in enumerate(batch):
+            slot = req.context_len
+            tokens[i] = req.output[-1]
+            positions[i] = slot
+            slot_blocks[i] = req.block_table[slot // bs]
+            slot_offsets[i] = slot % bs
+            context_lens[i] = slot + 1
+            tables[i, :len(req.block_table)] = req.block_table
+        logits, self.kv.k, self.kv.v = self._decode(
+            self.params, tokens, positions, self.kv.k, self.kv.v,
+            tables, context_lens, slot_blocks, slot_offsets)
+        rows = np.asarray(jax.device_get(logits), np.float32)
+        for i, req in enumerate(batch):
+            req.context_len += 1
+            self._sample_into(req, rows[i])
+
+    def step(self) -> int:
+        """One scheduler iteration: admit -> prefill -> decode one token
+        for every running sequence. Returns the number of in-flight
+        sequences after the step."""
+        with self._lock:
+            self._admit()
+            self._run_prefills()
+            self._run_decode()
+            self._steps += 1
+            self.step_log.append(
+                (self._steps, tuple(r.rid for r in self._active)))
+            self._publish_gauges()
+            return len(self._active)
+
+    # -- introspection / telemetry ----------------------------------------
+
+    def tokens_per_s(self, window: float = 5.0) -> float:
+        now = time.time()
+        while self._token_times and self._token_times[0][0] < now - window:
+            self._token_times.popleft()
+        if not self._token_times:
+            return 0.0
+        span = max(now - self._token_times[0][0], 1e-3)
+        return sum(n for _, n in self._token_times) / span
+
+    def stats(self) -> dict:
+        return {
+            "steps": self._steps,
+            "waiting": len(self._waiting),
+            "in_flight": len(self._active),
+            "finished": self._finished_count,
+            "kv_utilization": self.kv.utilization(),
+            "kv_free_blocks": self.kv.num_free,
+            "tokens_per_s": self.tokens_per_s(),
+        }
+
+    def _publish_gauges(self):
+        """Per-step gauge writes onto the telemetry plane (ride the
+        worker 1s flusher -> node user_metrics -> head sampler series
+        llm_tokens_per_s:<dep> / llm_kv_util:<dep> / llm_batch:<dep>)."""
+        try:
+            if self._gauges is None:
+                from ray_tpu.util.metrics import Gauge
+
+                self._gauges = (
+                    Gauge("rtpu_llm_tokens_per_s",
+                          "Generated tokens/s (5s window)",
+                          tag_keys=("deployment",)),
+                    Gauge("rtpu_llm_kv_util",
+                          "Paged KV pool utilization [0,1]",
+                          tag_keys=("deployment",)),
+                    Gauge("rtpu_llm_batch_size",
+                          "Sequences in the in-flight batch",
+                          tag_keys=("deployment",)),
+                )
+            tags = {"deployment": self.name}
+            tps, util, bsz = self._gauges
+            tps.set(self.tokens_per_s(), tags=tags)
+            util.set(self.kv.utilization(), tags=tags)
+            bsz.set(float(len(self._active)), tags=tags)
+        except Exception:  # noqa: BLE001 - telemetry is best-effort
+            pass
+
+    # -- background loop ---------------------------------------------------
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"llm-engine-{self.name}")
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                while not self._stop and not self._waiting \
+                        and not self._active:
+                    self._cond.wait(timeout=0.5)
+                if self._stop:
+                    return
+            self.step()
+
+    def stop(self):
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        # Release any parked consumers.
+        with self._lock:
+            for req in list(self._active) + list(self._waiting):
+                self._finish(req, "aborted")
+            self._waiting.clear()

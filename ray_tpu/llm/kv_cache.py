@@ -1,0 +1,132 @@
+"""Block-allocated paged KV pool for the generation engine.
+
+Reference layer map: this is the TPU-native analogue of vLLM's
+PagedAttention block manager (Kwon et al., SOSP '23) sitting where the
+reference runtime would hold framework-external model state. KV for
+every in-flight sequence lives in ONE device-resident pool per layer —
+``[kv_heads, num_blocks, block_size, head_dim]`` stacked over layers —
+and a sequence owns an ordered list of block ids (its *block table*)
+rather than a contiguous region. Consequences:
+
+  * admission/finish/preempt are allocator ops (list pushes), never
+    device copies or compactions;
+  * fragmentation is bounded at one partial block per sequence;
+  * the pool NEVER overflows: ``alloc()`` returns None when empty and
+    the engine preempts a victim (freeing its blocks for the requester)
+    and recomputes it on resume — admission beyond capacity degrades
+    throughput, not correctness (llm/engine.py).
+
+Block 0 is reserved as scratch: padded decode lanes and padded block-
+table slots point at it, so gather indices are always in range and
+masked writes need no bounds branch. The allocator never hands it out.
+
+Writes are functional jnp scatters under jit with the pool donated —
+XLA aliases the buffers so steady-state decode does not copy the pool.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.gpt import GPTConfig
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _scatter_blocks(k_pool, v_pool, k_blocks, v_blocks, ids):
+    """Write whole blocks: pools [L, Hkv, NB, BS, d], blocks
+    [L, Hkv, nb, BS, d], ids [nb] int32."""
+    return (k_pool.at[:, :, ids].set(k_blocks),
+            v_pool.at[:, :, ids].set(v_blocks))
+
+
+class PagedKVCache:
+    """The pool + its free-list allocator. Sequence bookkeeping (block
+    tables, context lengths) belongs to the engine; this class owns the
+    device arrays and which blocks are free."""
+
+    def __init__(self, cfg: GPTConfig, num_blocks: int = 64,
+                 block_size: int = 16, dtype=None):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is reserved)")
+        self.cfg = cfg
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.dtype = dtype if dtype is not None else cfg.dtype
+        shape = (cfg.n_layer, cfg.kv_heads, num_blocks, block_size,
+                 cfg.head_dim)
+        self.k = jnp.zeros(shape, self.dtype)
+        self.v = jnp.zeros(shape, self.dtype)
+        # LIFO free list (hot blocks rotate), block 0 reserved.
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+
+    # -- allocator ---------------------------------------------------------
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable blocks (excludes the reserved scratch block)."""
+        return self.num_blocks - 1
+
+    def utilization(self) -> float:
+        return 1.0 - self.num_free / max(1, self.capacity)
+
+    def blocks_for_tokens(self, num_tokens: int) -> int:
+        return max(1, math.ceil(num_tokens / self.block_size))
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n blocks, or None if the pool can't cover them (all-or-
+        nothing: a partial grant would strand blocks on a sequence that
+        cannot run)."""
+        if n > len(self._free):
+            return None
+        grant = self._free[-n:][::-1]
+        del self._free[-n:]
+        return grant
+
+    def free(self, blocks: List[int]):
+        for b in blocks:
+            if b == 0:
+                raise ValueError("block 0 is reserved, never allocated")
+        self._free.extend(blocks)
+
+    # -- writes ------------------------------------------------------------
+
+    def write_prefill(self, k, v, block_ids: List[int]):
+        """Scatter a prefill's K/V into the pool. k, v:
+        ``[L, T, kv_heads, head_dim]`` (the stacked per-layer tensors
+        forward_prefill emits); the tail of the last block is zero-
+        padded (masked by context_lens at read time)."""
+        L, T, hkv, d = k.shape
+        nb = len(block_ids)
+        pad = nb * self.block_size - T
+        if pad < 0:
+            raise ValueError(f"{nb} blocks cannot hold {T} tokens")
+        if pad:
+            k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        # [L, T', Hkv, d] -> [L, Hkv, nb, BS, d]
+        kb = k.reshape(L, nb, self.block_size, hkv, d).transpose(
+            0, 3, 1, 2, 4).astype(self.dtype)
+        vb = v.reshape(L, nb, self.block_size, hkv, d).transpose(
+            0, 3, 1, 2, 4).astype(self.dtype)
+        ids = jnp.asarray(block_ids, jnp.int32)
+        self.k, self.v = _scatter_blocks(self.k, self.v, kb, vb, ids)
+
+    def gather_tokens(self, block_ids: List[int], length: int):
+        """Read back ``length`` tokens' K/V as ``[L, length, Hkv, d]``
+        (tests / debugging — the decode path never materializes this)."""
+        ids = jnp.asarray(block_ids, jnp.int32)
+        k = jnp.take(self.k, ids, axis=2)   # [L, Hkv, nb, BS, d]
+        v = jnp.take(self.v, ids, axis=2)
+        L, hkv, nb, bs, d = k.shape
+        k = k.transpose(0, 2, 3, 1, 4).reshape(L, nb * bs, hkv, d)
+        v = v.transpose(0, 2, 3, 1, 4).reshape(L, nb * bs, hkv, d)
+        return k[:, :length], v[:, :length]

@@ -153,8 +153,12 @@ def _constrain(x, logical, mesh, rules):
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
 
-def _block(x, p, cfg: GPTConfig, mesh, rules, mlp_remat: bool = False):
-    """One transformer block. p: per-layer slice of the stacked block params."""
+def _block(x, p, cfg: GPTConfig, mesh, rules, mlp_remat: bool = False,
+           return_kv: bool = False):
+    """One transformer block. p: per-layer slice of the stacked block
+    params. ``return_kv=True`` additionally returns this layer's
+    (k, v) projections as [b, s, kv_heads, head_dim] — the prefill
+    path hands them to the paged KV pool (llm/kv_cache.py)."""
     dt = cfg.dtype
     h = _layernorm(x, p["ln1"])
     if cfg.use_flash:
@@ -173,6 +177,7 @@ def _block(x, p, cfg: GPTConfig, mesh, rules, mlp_remat: bool = False):
         o = flash_attention(q, kk, v, causal=True,
                             block_size=cfg.flash_block, layout="bhsd")
         o = jnp.einsum("bhsd,hdm->bsm", o, p["wo"].astype(dt))
+        kv = (kk.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3))
     else:
         q = jnp.einsum("bsm,mhd->bshd", h, p["wq"].astype(dt))
         kk = jnp.einsum("bsm,mhd->bshd", h, p["wk"].astype(dt))
@@ -180,6 +185,7 @@ def _block(x, p, cfg: GPTConfig, mesh, rules, mlp_remat: bool = False):
         q = _constrain(q, ("batch", "seq", "heads", None), mesh, rules)
         o = causal_attention(q, kk, v)
         o = jnp.einsum("bshd,hdm->bsm", o, p["wo"].astype(dt))
+        kv = (kk, v)
     x = x + _constrain(o, ("batch", "seq", "embed_act"), mesh, rules)
 
     def mlp(xin):
@@ -191,6 +197,8 @@ def _block(x, p, cfg: GPTConfig, mesh, rules, mlp_remat: bool = False):
     if mlp_remat:
         mlp = jax.checkpoint(mlp)
     x = x + _constrain(mlp(x), ("batch", "seq", "embed_act"), mesh, rules)
+    if return_kv:
+        return x, kv
     return x
 
 
@@ -259,6 +267,117 @@ def forward(params, tokens, cfg: GPTConfig, mesh: Optional[Mesh] = None,
     x = _layernorm(x, params["ln_f"])
     logits = jnp.einsum("bsm,vm->bsv", x, params["wte"].astype(dt))
     return _constrain(logits, ("batch", "seq", "vocab"), mesh, rules)
+
+
+# ---------------------------------------------------------------------------
+# Inference forward modes (continuous-batching engine, llm/engine.py).
+#
+# Reference layer map: the reference runtime serves external inference
+# engines; here the decode path is native. forward_prefill runs the full
+# prompt once and EXPORTS each layer's K/V for the paged pool
+# (llm/kv_cache.py); forward_decode runs one token per sequence against
+# that pool through the paged-attention kernel (ops/pallas/paged_decode).
+# Both reuse the training blocks' params and parallelism rules verbatim —
+# there is no separate "inference model".
+# ---------------------------------------------------------------------------
+
+
+def forward_prefill(params, tokens, cfg: GPTConfig,
+                    mesh: Optional[Mesh] = None,
+                    rules: Optional[dict] = None):
+    """Prompt pass that also exports the KV cache.
+
+    tokens [b, s] int32 -> (logits [b, s, vocab],
+                            k [L, b, s, kv_heads, head_dim], v like k).
+
+    Same math as forward() (so decode continues exactly the training
+    model's distribution); remat is ignored — inference keeps no
+    backward residuals worth trading compute for.
+    """
+    rules = {**DEFAULT_RULES, **ACT_RULES, **(rules or {})}
+    dt = cfg.dtype
+    b, s = tokens.shape
+    wte = params["wte"].astype(dt)
+    x = wte[tokens] + params["wpe"].astype(dt)[:s]
+    x = _constrain(x, ("batch", "seq", "embed_act"), mesh, rules)
+    block_fn = functools.partial(_block, cfg=cfg, mesh=mesh, rules=rules,
+                                 return_kv=True)
+
+    def scan_body(x, layer_params):
+        x, kv = block_fn(x, layer_params)
+        return x, kv
+
+    x, (k, v) = jax.lax.scan(scan_body, x, params["blocks"])
+    x = _layernorm(x, params["ln_f"])
+    logits = jnp.einsum("bsm,vm->bsv", x, params["wte"].astype(dt))
+    return logits, k, v
+
+
+def forward_decode(params, tokens, positions, k_pool, v_pool,
+                   block_tables, context_lens, slot_blocks, slot_offsets,
+                   cfg: GPTConfig, mesh: Optional[Mesh] = None,
+                   rules: Optional[dict] = None):
+    """One decode step for a batch of in-flight sequences.
+
+    Each lane projects its token's K/V, writes them into the paged pool
+    at (slot_blocks[lane], slot_offsets[lane]) — the cache write at the
+    sequence's positional offset — and THEN attends over its block table
+    (context_lens include the new token, so it sees itself; this is the
+    write-then-attend convention of ops/pallas/paged_decode).
+
+    Args:
+      tokens / positions: [b] int32 — last sampled token + its absolute
+        position per lane. Padded lanes point at the pool's reserved
+        scratch block 0 with context_lens 1; their logits are garbage
+        the engine never samples.
+      k_pool / v_pool: [L, kv_heads, num_blocks, block_size, head_dim]
+        (donate these in the caller's jit — steady-state decode then
+        updates the pool in place).
+      block_tables: [b, max_nb] int32, 0-padded.
+      slot_blocks / slot_offsets: [b] int32 — the pool block and
+        in-block offset of each lane's CURRENT token.
+
+    Returns (logits [b, vocab], k_pool, v_pool).
+    """
+    from ..ops.pallas.paged_decode import paged_decode_attention
+
+    rules = {**DEFAULT_RULES, **ACT_RULES, **(rules or {})}
+    dt = cfg.dtype
+    B = tokens.shape[0]
+    hkv, group = cfg.kv_heads, cfg.n_head // cfg.kv_heads
+    wte = params["wte"].astype(dt)
+    x = wte[tokens] + params["wpe"].astype(dt)[positions]   # [b, m]
+
+    def scan_body(x, layer):
+        p, kp, vp = layer
+        h = _layernorm(x, p["ln1"])
+        q = jnp.einsum("bm,mhd->bhd", h, p["wq"].astype(dt))
+        k_tok = jnp.einsum("bm,mhd->bhd", h, p["wk"].astype(dt))
+        v_tok = jnp.einsum("bm,mhd->bhd", h, p["wv"].astype(dt))
+        # Cache write at the positional offset, before attending. Lanes
+        # have unique slots by construction (padded lanes collide on the
+        # scratch block, which is never read unmasked).
+        kp = kp.at[:, slot_blocks, slot_offsets].set(
+            k_tok.astype(kp.dtype).transpose(1, 0, 2))
+        vp = vp.at[:, slot_blocks, slot_offsets].set(
+            v_tok.astype(vp.dtype).transpose(1, 0, 2))
+        o = paged_decode_attention(
+            q.reshape(B, hkv, group, cfg.head_dim), kp, vp,
+            block_tables, context_lens)
+        o = jnp.einsum("bhd,hdm->bm",
+                       o.reshape(B, cfg.n_head, cfg.head_dim),
+                       p["wo"].astype(dt))
+        x = x + o
+        h2 = _layernorm(x, p["ln2"])
+        ff = jax.nn.gelu(jnp.einsum("bm,mf->bf", h2, p["wi"].astype(dt)))
+        x = x + jnp.einsum("bf,fm->bm", ff, p["wm"].astype(dt))
+        return x, (kp, vp)
+
+    x, (k_pool, v_pool) = jax.lax.scan(
+        scan_body, x, (params["blocks"], k_pool, v_pool))
+    x = _layernorm(x, params["ln_f"])
+    logits = jnp.einsum("bm,vm->bv", x, params["wte"].astype(dt))
+    return logits, k_pool, v_pool
 
 
 @jax.custom_vjp

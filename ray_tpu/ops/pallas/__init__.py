@@ -6,3 +6,4 @@ and the ground truth in tests.
 """
 
 from .flash import flash_attention_pallas  # noqa: F401
+from .paged_decode import paged_decode_attention  # noqa: F401

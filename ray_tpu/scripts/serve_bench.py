@@ -37,6 +37,7 @@ def _percentiles(xs):
 
     return {"p50_ms": round(pct(50) * 1000, 2),
             "p90_ms": round(pct(90) * 1000, 2),
+            "p95_ms": round(pct(95) * 1000, 2),
             "p99_ms": round(pct(99) * 1000, 2),
             "mean_ms": round(statistics.fmean(xs) * 1000, 2)}
 
@@ -197,6 +198,103 @@ def run_fleet(duration_s: float = 3.0, clients: int = 4) -> dict:
         return out
     finally:
         cluster.shutdown()
+
+
+def run_serve_llm(duration_s: float = 6.0, clients: int = 6,
+                  max_tokens: int = 24) -> dict:
+    """Generation-path bench (``bench.py --serve-llm``): closed-loop
+    streaming clients against the continuous-batching LLM deployment
+    (serve/llm.py). Reported numbers are the LLM serving SLO pair —
+    TTFT and TPOT p50/p95 per request, measured at the CLIENT off the
+    ndjson frame arrivals — plus aggregate tokens/s and the engine's
+    own view (KV utilization, batch size) at the end of the run."""
+    from ray_tpu import serve
+    from ray_tpu.models.gpt import TINY
+    from ray_tpu.serve.llm import build_app
+
+    serve.run(build_app(TINY, num_blocks=64, block_size=16,
+                        max_batch=clients + 2), name="llm")
+    proxy = serve.start(http_port=0)
+    h = serve.get_app_handle("llm")
+
+    def one_stream(conn, seed):
+        """Returns (ttft_s, [gap_s...], n_tokens)."""
+        body = json.dumps({"prompt": [seed % 200 + 1] * (4 + seed % 9),
+                           "max_tokens": max_tokens, "seed": seed,
+                           "temperature": 0.8})
+        t0 = time.perf_counter()
+        conn.request("POST", "/", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        ttft = None
+        stamps = []
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            if not line.strip():
+                continue
+            frame = json.loads(line)
+            if "token" in frame:
+                now = time.perf_counter()
+                if ttft is None:
+                    ttft = now - t0
+                stamps.append(now)
+        gaps = [b - a for a, b in zip(stamps, stamps[1:])]
+        return ttft, gaps, len(stamps)
+
+    # Warm: first request pays prefill+decode compiles.
+    warm = http.client.HTTPConnection("127.0.0.1", proxy.port,
+                                      timeout=300)
+    one_stream(warm, 0)
+    warm.close()
+
+    ttfts: list = []
+    gaps_all: list = []
+    tokens = [0]
+    lock = threading.Lock()
+    stop_at = time.perf_counter() + duration_s
+
+    def client(cid):
+        conn = http.client.HTTPConnection("127.0.0.1", proxy.port,
+                                          timeout=300)
+        seed = cid
+        try:
+            while time.perf_counter() < stop_at:
+                ttft, gaps, n = one_stream(conn, seed)
+                seed += clients
+                with lock:
+                    if ttft is not None:
+                        ttfts.append(ttft)
+                    gaps_all.extend(gaps)
+                    tokens[0] += n
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t_start
+    eng = h.options(method_name="engine_stats").remote().result(
+        timeout=60)
+    serve.shutdown()
+    return {
+        "clients": clients,
+        "max_tokens": max_tokens,
+        "requests": len(ttfts),
+        "tokens_per_s": round(tokens[0] / elapsed, 1),
+        "ttft": _percentiles(ttfts),
+        "tpot": _percentiles(gaps_all),
+        "engine": {"kv_utilization": round(eng["kv_utilization"], 3),
+                   "steps": eng["steps"],
+                   "finished": eng["finished"]},
+        "note": "TTFT/TPOT measured at the client off ndjson frame "
+                "arrivals; CPU interpret-mode kernel (TINY config)",
+    }
 
 
 def main():

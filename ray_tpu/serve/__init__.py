@@ -30,3 +30,5 @@ from .deployment import (  # noqa: F401
     deployment,
 )
 from .multiplex import get_multiplexed_model_id, multiplexed  # noqa: F401
+
+from . import llm  # noqa: F401  (streaming LLM deployment: serve.llm.build_app)

@@ -68,8 +68,16 @@ class _Batcher:
                 if not self._queue:
                     self._collector = None  # hand off restart duty
                     return
-                if len(self._queue) < self.max_batch_size:
-                    self._flush.wait(self.timeout)
+                # Flush deadline anchors to the OLDEST pending request's
+                # submit stamp, not to loop entry: with hot back-to-back
+                # batches the loop re-enters mid-wait, and an entry-
+                # anchored wait would grant the head request up to 2x
+                # the configured bound.
+                while len(self._queue) < self.max_batch_size:
+                    remaining = (self._queue[0].submit_t + self.timeout
+                                 - time.monotonic())
+                    if remaining <= 0 or not self._flush.wait(remaining):
+                        break
                 batch, self._queue = (
                     self._queue[: self.max_batch_size],
                     self._queue[self.max_batch_size:],

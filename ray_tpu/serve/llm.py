@@ -1,0 +1,143 @@
+"""Streaming LLM deployment: the continuous-batching engine behind
+Serve's generator/chunked-transfer path.
+
+Reference layer map: the "LLM serving" integration the reference runtime
+provides by fronting external engines — here the engine is native
+(ray_tpu.llm). One replica hosts ONE LLMEngine; Serve's replica thread
+pool delivers concurrent ``__call__``s, each of which registers a
+request with the shared engine EAGERLY (so TTFT starts at arrival, not
+at first stream pull) and returns a generator. The generator rides the
+existing STREAM_MARKER protocol: the replica parks it, the proxy drains
+it chunk-at-a-time, and HTTP clients see ndjson chunked transfer — one
+frame per token.
+
+SLO + telemetry: per-request TTFT and TPOT are recorded as serve phases
+(slo.record_phase), so ``serve.status()`` reports their p50/p95/p99 next
+to the routing phases and the head keeps ``serve_p95_ms:<dep>:ttft``
+series; the engine itself publishes tokens/s, KV-pool utilization and
+in-flight batch size gauges that surface as ``llm_tokens_per_s:<dep>``
+et al. in ``state.timeseries()`` (the PR-6 telemetry plane).
+
+Tokenization is byte-level (ids 0..255) so the subsystem is runnable
+without any external vocabulary: string prompts encode to UTF-8 bytes,
+and the final frame carries the decoded text when every token is a byte.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from . import slo
+from .deployment import deployment
+
+
+def encode(text: str):
+    """Byte-level tokenize (ids 0..255)."""
+    return list(text.encode("utf-8"))
+
+
+def decode(tokens) -> Optional[str]:
+    """Inverse of encode(); None if any token is out of byte range."""
+    if any(t < 0 or t > 255 for t in tokens):
+        return None
+    return bytes(tokens).decode("utf-8", errors="replace")
+
+
+class _LLMServer:
+    """User class for the generation deployment (wrapped by
+    ``LLMServer = serve.deployment(_LLMServer)`` below; use
+    ``build_app()`` for the common case)."""
+
+    def __init__(self, cfg=None, params=None, *, seed: int = 0,
+                 num_blocks: int = 64, block_size: int = 16,
+                 max_batch: int = 8, default_max_tokens: int = 32):
+        import jax
+
+        from ..llm.engine import LLMEngine
+        from ..models.gpt import TINY, init
+
+        cfg = cfg if cfg is not None else TINY
+        if params is None:
+            params = init(jax.random.PRNGKey(seed), cfg)
+        # Replica.__init__ sets the process deployment name before
+        # constructing us — tag the engine's gauges with it.
+        name = slo.current_deployment() or "llm"
+        self.default_max_tokens = int(default_max_tokens)
+        self.engine = LLMEngine(params, cfg, num_blocks=num_blocks,
+                                block_size=block_size,
+                                max_batch=max_batch, name=name)
+        self.engine.start()
+
+    def __call__(self, request: Any):
+        """request: {"prompt": str | [int], "max_tokens": int?,
+        "temperature": float?, "top_k": int?, "seed": int?,
+        "stop_tokens": [int]?}. Streams {"token": id} frames, then a
+        final {"done": ..., "text": ...} frame."""
+        if isinstance(request, str):
+            request = {"prompt": request}
+        prompt = request.get("prompt")
+        if isinstance(prompt, str):
+            prompt = encode(prompt)
+        if not prompt:
+            raise ValueError("request needs a non-empty 'prompt'")
+        # Register with the engine NOW: the request joins the in-flight
+        # batch at the next step even though the generator body below
+        # only runs when the stream is first pulled.
+        req = self.engine.add_request(
+            prompt,
+            max_tokens=int(request.get("max_tokens",
+                                       self.default_max_tokens)),
+            temperature=float(request.get("temperature", 0.0)),
+            top_k=int(request.get("top_k", 0)),
+            seed=int(request.get("seed", 0)),
+            stop_tokens=request.get("stop_tokens", ()))
+        dep = self.engine.name
+
+        def gen():
+            first = True
+            for tok in req.tokens():
+                if first:
+                    first = False
+                    slo.record_phase("ttft", time.time() - req.submit_t,
+                                     dep)
+                yield {"token": tok}
+            if req.first_token_t and req.finish_t \
+                    and len(req.output) > 1:
+                slo.record_phase(
+                    "tpot",
+                    (req.finish_t - req.first_token_t)
+                    / (len(req.output) - 1), dep)
+            yield {"done": True,
+                   "finish_reason": req.finish_reason,
+                   "num_tokens": len(req.output),
+                   "preemptions": req.preemptions,
+                   "text": decode(req.output)}
+
+        return gen()
+
+    def engine_stats(self) -> dict:
+        """Engine introspection over the handle
+        (``h.options(method_name="engine_stats")``)."""
+        return self.engine.stats()
+
+    def check_health(self) -> bool:
+        return True
+
+    def __del__(self):
+        try:
+            self.engine.stop()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
+
+
+LLMServer = deployment(name="LLMServer")(_LLMServer)
+
+
+def build_app(cfg=None, **kwargs):
+    """The copy-pasteable entrypoint:
+
+        from ray_tpu.serve.llm import build_app
+        serve.run(build_app(), name="llm")
+    """
+    return LLMServer.bind(cfg, **kwargs)

@@ -59,14 +59,20 @@ def multiplexed(_func=None, *, max_num_models_per_replica: int = 3):
                 ev.wait()
             try:
                 model = load_fn(self, mid)
+                evicted = []
                 with lock:
                     cache[mid] = model
                     cache.move_to_end(mid)
                     while len(cache) > max_num_models_per_replica:
-                        _, evicted = cache.popitem(last=False)
-                        unload = getattr(evicted, "unload", None)
-                        if callable(unload):
-                            unload()
+                        evicted.append(cache.popitem(last=False)[1])
+                # unload() outside the lock: a slow device-memory free
+                # must not block every cache hit / load on the replica.
+                # The evicted entries are already unreachable from the
+                # cache, so late lookups re-load rather than racing us.
+                for ev_model in evicted:
+                    unload = getattr(ev_model, "unload", None)
+                    if callable(unload):
+                        unload()
                 return model
             finally:
                 with lock:

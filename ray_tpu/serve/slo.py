@@ -15,6 +15,8 @@ continuous-batching autoscaler consumes (ROADMAP item 1):
   * ``execute``         — user code (includes batch residency for
                           batched methods; ``execute - batch_wait``
                           isolates pure compute)
+  * ``ttft`` / ``tpot`` — generation deployments only (serve/llm.py):
+                          time-to-first-token and time-per-output-token
 
 Two sinks per observation, both cheap (a bucket increment under one
 lock):
@@ -44,7 +46,11 @@ PHASE_BOUNDS: List[float] = [
     0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
     0.25, 0.5, 1.0, 2.5, 5.0, 10.0]
 
-PHASES = ("proxy_queue", "replica_queue", "batch_wait", "execute")
+# ttft/tpot are generation-path phases (serve/llm.py): time-to-first-
+# token from request arrival at the engine, and per-output-token latency
+# (decode cadence) — the two numbers an LLM serving SLO is written in.
+PHASES = ("proxy_queue", "replica_queue", "batch_wait", "execute",
+          "ttft", "tpot")
 
 _lock = threading.Lock()
 # Deployment hosted by THIS process (set by Replica.__init__).

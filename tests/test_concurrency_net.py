@@ -88,7 +88,7 @@ def test_no_weak_fire_and_forget_spawn_sites():
     file:line."""
     offenders = {}
     for pkg in ("ray_tpu/_private", "ray_tpu/serve", "ray_tpu/data",
-                "ray_tpu/util"):
+                "ray_tpu/util", "ray_tpu/llm"):
         for path in sorted((REPO / pkg).rglob("*.py")):
             found = _weak_spawn_sites(path)
             if found:
@@ -196,6 +196,30 @@ def test_every_exchange_transition_site_emits_an_event():
     assert not missing, (
         f"exchange merge-round state-transition site(s) emit no "
         f"lifecycle event (self._event): {missing}")
+
+
+# Every request state-transition site in the generation engine's
+# scheduler (llm/engine.py): WAITING/PREFILL/RUNNING/PREEMPTED/FINISHED
+# must emit events, or the engine's lifecycle trace (and the
+# preempt+resume determinism tests built on it) silently lose
+# transitions.
+_ENGINE_TRANSITION_SITES = (
+    "add_request",  # WAITING
+    "_admit",       # PREFILL (joined the in-flight batch)
+    "_activate",    # RUNNING (prefill done, decoding)
+    "_preempt",     # PREEMPTED (pool exhausted, blocks freed)
+    "_finish",      # FINISHED (stop token / length / abort)
+)
+
+
+def test_every_engine_transition_site_emits_an_event():
+    missing = [
+        f"engine.{m}" for m in _methods_missing_call(
+            REPO / "ray_tpu/llm/engine.py",
+            _ENGINE_TRANSITION_SITES, "_event")]
+    assert not missing, (
+        f"engine scheduler state-transition site(s) emit no lifecycle "
+        f"event (self._event): {missing}")
 
 
 # Every site that mutates the CPU dispatch queue (pending_cpu) or a

@@ -1,0 +1,144 @@
+"""Model multiplexing: single-flight loads, LRU eviction (with unload
+outside the replica-wide lock), and the load-failure retry path.
+
+Parity: /root/reference/python/ray/serve/multiplex.py — these run the
+decorator directly (no cluster needed; the decorator's state is lazy
+per-instance, so a bare object is exactly what a replica hosts).
+"""
+
+import threading
+import time
+
+import pytest
+
+from ray_tpu.serve.multiplex import multiplexed
+
+
+class _Model:
+    def __init__(self, mid, unloaded, unload_s=0.0):
+        self.mid = mid
+        self._unloaded = unloaded
+        self._unload_s = unload_s
+
+    def unload(self):
+        if self._unload_s:
+            time.sleep(self._unload_s)
+        self._unloaded.append(self.mid)
+
+
+class _Host:
+    def __init__(self, max_models=2, load_s=0.0, unload_s=0.0,
+                 fail_once_for=()):
+        self.loads = []
+        self.unloaded = []
+        self._load_s = load_s
+        self._unload_s = unload_s
+        self._fail_once = set(fail_once_for)
+        self.load = multiplexed(
+            max_num_models_per_replica=max_models)(_Host._load).__get__(self)
+
+    def _load(self, model_id):
+        self.loads.append(model_id)
+        if self._load_s:
+            time.sleep(self._load_s)
+        if model_id in self._fail_once:
+            self._fail_once.discard(model_id)
+            raise RuntimeError(f"flaky load of {model_id}")
+        return _Model(model_id, self.unloaded, self._unload_s)
+
+
+def test_single_flight_under_racing_loaders():
+    host = _Host(max_models=4, load_s=0.2)
+    results = []
+
+    def racer():
+        results.append(host.load("m1"))
+
+    threads = [threading.Thread(target=racer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # One load served every racer, and all got the SAME object.
+    assert host.loads == ["m1"]
+    assert len(results) == 8
+    assert all(r is results[0] for r in results)
+
+
+def test_lru_eviction_order_and_unload():
+    host = _Host(max_models=2)
+    host.load("a")
+    host.load("b")
+    host.load("a")          # refresh a: b is now least-recent
+    host.load("c")          # evicts b
+    assert host.unloaded == ["b"]
+    host.load("d")          # evicts a (refreshed after b)
+    assert host.unloaded == ["b", "a"]
+    # Evicted model reloads (and evicts the current LRU, c).
+    host.load("b")
+    assert host.loads == ["a", "b", "c", "d", "b"]
+    assert host.unloaded == ["b", "a", "c"]
+
+
+def test_slow_unload_does_not_block_other_loads():
+    """Eviction's unload() runs outside the cache lock: a hit on another
+    model must complete while the evicting thread sleeps in unload."""
+    host = _Host(max_models=1, unload_s=1.0)
+    host.load("a")
+
+    started = threading.Event()
+    done = threading.Event()
+
+    def evictor():
+        started.set()
+        host.load("b")      # evicts a -> slow unload
+        done.set()
+
+    t = threading.Thread(target=evictor)
+    t.start()
+    started.wait(5)
+    time.sleep(0.2)         # let the evictor reach unload()
+    t0 = time.monotonic()
+    host.load("b")          # cache hit must not wait for a.unload()
+    hit_s = time.monotonic() - t0
+    assert hit_s < 0.5, f"cache hit blocked {hit_s:.2f}s behind unload"
+    assert done.wait(10)
+    t.join()
+    assert host.unloaded == ["a"]
+
+
+def test_load_failure_retry_path():
+    """A failed load propagates to its caller but leaves no poisoned
+    single-flight entry: racers waiting on it retry, and the next call
+    succeeds."""
+    host = _Host(max_models=2, load_s=0.1, fail_once_for=("bad",))
+    errors, models = [], []
+
+    def caller():
+        try:
+            models.append(host.load("bad"))
+        except RuntimeError as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=caller) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # Exactly one attempt failed (the single-flight winner); the racers
+    # retried after its event fired and the reload succeeded.
+    assert len(errors) == 1
+    assert len(models) == 3
+    assert all(m is models[0] for m in models)
+    assert host.loads.count("bad") == 2
+    # A fresh call is a plain cache hit now.
+    assert host.load("bad") is models[0]
+
+
+def test_load_failure_solo_caller_raises_then_recovers():
+    host = _Host(fail_once_for=("m",))
+    with pytest.raises(RuntimeError):
+        host.load("m")
+    m = host.load("m")
+    assert m.mid == "m"
+    assert host.loads == ["m", "m"]

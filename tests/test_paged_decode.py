@@ -1,0 +1,133 @@
+"""Paged decode-attention kernel numerics (interpret mode on CPU).
+
+The kernel (ops/pallas/paged_decode.py) gathers K/V through per-sequence
+block tables; ground truth is (a) the pure-jnp paged reference and
+(b) the repo's dense causal_attention over the same contiguous K/V.
+
+Tolerances: f32 matches the reference to atol 2e-5 (one fused online-
+softmax accumulation vs a dense softmax — only rounding differs);
+bf16 inputs with f32 accumulation sit within atol 2e-2 (bf16 has ~3
+decimal digits; both paths accumulate in f32 so the error is input
+quantization, not the algorithm).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ray_tpu.ops.attention import causal_attention  # noqa: E402
+from ray_tpu.ops.pallas.paged_decode import (  # noqa: E402
+    paged_decode_attention,
+    paged_decode_attention_reference,
+)
+
+ATOL_F32 = 2e-5
+ATOL_BF16 = 2e-2
+
+
+def _paged_case(key, *, batch, hkv, group, d, num_blocks, block_size,
+                max_nb, dtype):
+    """Random pool + tables + context lens (block 0 kept as scratch,
+    tables padded with 0 — the layout llm/kv_cache.py produces)."""
+    ks = jax.random.split(key, 4)
+    q = jax.random.normal(ks[0], (batch, hkv, group, d), dtype)
+    k_pool = jax.random.normal(ks[1], (hkv, num_blocks, block_size, d),
+                               dtype)
+    v_pool = jax.random.normal(ks[2], (hkv, num_blocks, block_size, d),
+                               dtype)
+    rng = np.random.default_rng(0)
+    tables = np.zeros((batch, max_nb), np.int32)
+    lens = np.zeros((batch,), np.int32)
+    # Distinct blocks per sequence, like the allocator grants them.
+    avail = list(range(1, num_blocks))
+    rng.shuffle(avail)
+    for b in range(batch):
+        nb = int(rng.integers(1, max_nb + 1))
+        lens[b] = int(rng.integers((nb - 1) * block_size + 1,
+                                   nb * block_size + 1))
+        grant = [avail.pop() for _ in range(nb)]
+        tables[b, :nb] = grant
+    return q, k_pool, v_pool, jnp.asarray(tables), jnp.asarray(lens)
+
+
+def test_matches_paged_reference_f32():
+    q, k, v, tables, lens = _paged_case(
+        jax.random.PRNGKey(0), batch=3, hkv=2, group=1, d=16,
+        num_blocks=24, block_size=8, max_nb=3, dtype=jnp.float32)
+    out = paged_decode_attention(q, k, v, tables, lens, interpret=True)
+    ref = paged_decode_attention_reference(q, k, v, tables, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=ATOL_F32, rtol=0)
+
+
+def test_matches_paged_reference_gqa_f32():
+    """group > 1: query heads share their KV head's pool blocks."""
+    q, k, v, tables, lens = _paged_case(
+        jax.random.PRNGKey(1), batch=2, hkv=2, group=3, d=8,
+        num_blocks=16, block_size=4, max_nb=4, dtype=jnp.float32)
+    out = paged_decode_attention(q, k, v, tables, lens, interpret=True)
+    ref = paged_decode_attention_reference(q, k, v, tables, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=ATOL_F32, rtol=0)
+
+
+def test_matches_paged_reference_bf16():
+    q, k, v, tables, lens = _paged_case(
+        jax.random.PRNGKey(2), batch=2, hkv=2, group=2, d=16,
+        num_blocks=12, block_size=8, max_nb=2, dtype=jnp.bfloat16)
+    out = paged_decode_attention(q, k, v, tables, lens, interpret=True)
+    ref = paged_decode_attention_reference(q, k, v, tables, lens)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=ATOL_BF16, rtol=0)
+
+
+def test_matches_dense_causal_attention():
+    """The decode step IS the last row of dense causal attention: lay
+    contiguous K/V into blocks, attend with the paged kernel, compare
+    against ops/attention.causal_attention's final position."""
+    d, heads, block_size, ctx = 16, 2, 8, 21
+    key = jax.random.PRNGKey(3)
+    kq, kk, kv = jax.random.split(key, 3)
+    k_seq = jax.random.normal(kk, (1, ctx, heads, d), jnp.float32)
+    v_seq = jax.random.normal(kv, (1, ctx, heads, d), jnp.float32)
+    q_seq = jax.random.normal(kq, (1, ctx, heads, d), jnp.float32)
+    dense = causal_attention(q_seq, k_seq, v_seq)[0, -1]   # [heads, d]
+
+    nb = -(-ctx // block_size)
+    num_blocks = nb + 2
+    k_pool = np.zeros((heads, num_blocks, block_size, d), np.float32)
+    v_pool = np.zeros((heads, num_blocks, block_size, d), np.float32)
+    table = np.arange(1, nb + 1, dtype=np.int32)  # skip scratch block 0
+    pad = nb * block_size - ctx
+    k_pad = np.pad(np.asarray(k_seq[0]), ((0, pad), (0, 0), (0, 0)))
+    v_pad = np.pad(np.asarray(v_seq[0]), ((0, pad), (0, 0), (0, 0)))
+    for j in range(nb):
+        blk = slice(j * block_size, (j + 1) * block_size)
+        k_pool[:, j + 1] = k_pad[blk].transpose(1, 0, 2)
+        v_pool[:, j + 1] = v_pad[blk].transpose(1, 0, 2)
+
+    q = q_seq[0, -1].reshape(1, heads, 1, d)  # MHA: group == 1
+    out = paged_decode_attention(
+        q, jnp.asarray(k_pool), jnp.asarray(v_pool),
+        jnp.asarray(table)[None], jnp.asarray([ctx], jnp.int32),
+        interpret=True)
+    np.testing.assert_allclose(np.asarray(out[0, :, 0]),
+                               np.asarray(dense),
+                               atol=ATOL_F32, rtol=0)
+
+
+def test_scratch_block_garbage_is_masked():
+    """Padded table slots point at block 0; whatever lives there must
+    not leak into the output."""
+    q, k, v, tables, lens = _paged_case(
+        jax.random.PRNGKey(4), batch=2, hkv=1, group=1, d=8,
+        num_blocks=8, block_size=4, max_nb=4, dtype=jnp.float32)
+    out1 = paged_decode_attention(q, k, v, tables, lens, interpret=True)
+    k2 = k.at[:, 0].set(1e4)
+    v2 = v.at[:, 0].set(-1e4)
+    out2 = paged_decode_attention(q, k2, v2, tables, lens, interpret=True)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2),
+                               atol=ATOL_F32, rtol=0)
