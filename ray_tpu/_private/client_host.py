@@ -250,6 +250,10 @@ class SessionHost:
             return rt.cluster_state(**(payload or {}))
         if method == "timeseries":
             return rt.timeseries(**(payload or {}))
+        if method == "get_trace":
+            return rt.get_trace(**(payload or {}))
+        if method == "list_traces":
+            return rt.list_traces(**(payload or {}))
         if method == "cluster_logs":
             return rt.cluster_logs(**(payload or {}))
         if method == "session_info":

@@ -323,6 +323,19 @@ class ClientRuntime:
                            "resolution": resolution, "timeout": timeout},
             timeout=timeout + 30)
 
+    def get_trace(self, trace_id: str, timeout: float = 10.0):
+        return self._call(
+            "get_trace", {"trace_id": trace_id, "timeout": timeout},
+            timeout=timeout + 30)
+
+    def list_traces(self, deployment: str | None = None,
+                    min_ms: float = 0.0, errors_only: bool = False,
+                    limit: int = 50, timeout: float = 10.0):
+        return self._call(
+            "list_traces", {"deployment": deployment, "min_ms": min_ms,
+                            "errors_only": errors_only, "limit": limit,
+                            "timeout": timeout}, timeout=timeout + 30)
+
     def cluster_logs(self, tail_bytes: int = 16_384,
                      timeout: float = 15.0) -> dict:
         return self._call(

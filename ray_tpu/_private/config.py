@@ -217,6 +217,21 @@ class Config:
     # dropped beyond this — a partitioned node must stay bounded).
     telemetry_buffer_max: int = _cfg(120)
 
+    # --- request tracing (serving lane) ---
+    # Head-side tail sampling over completed request traces: error
+    # traces and the slowest trace_slow_fraction per deployment are
+    # ALWAYS retained; the rest survive with trace_sample_rate
+    # probability (0 = slow/error only).
+    trace_sample_rate: float = _cfg(0.01)
+    trace_slow_fraction: float = _cfg(0.05)
+    # Retained traces per deployment (bounded ring, like the telemetry
+    # tiers) and the quiet period after a root span lands before a
+    # pending trace is considered complete and sampled.
+    trace_window: int = _cfg(256)
+    trace_linger_s: float = _cfg(1.0)
+    # Node-side request-span buffer cap while the head is unreachable.
+    trace_buffer_max: int = _cfg(2000)
+
     # --- tpu ---
     tpu_chips_per_host: int = _cfg(0)  # 0 = autodetect
     # Mesh axis names used throughout the parallel layer.

@@ -189,6 +189,16 @@ class HeadService:
             sizes={1: self.cfg.telemetry_window_1x,
                    10: self.cfg.telemetry_window_10x,
                    60: self.cfg.telemetry_window_60x})
+        # Request-trace plane: completed serving-lane traces arrive on
+        # the same heartbeats; the store tail-samples (errors + slowest
+        # p% always kept) into bounded per-deployment rings.
+        from .telemetry import TraceStore
+
+        self.traces = TraceStore(
+            sample_rate=self.cfg.trace_sample_rate,
+            slow_fraction=self.cfg.trace_slow_fraction,
+            window=self.cfg.trace_window,
+            linger_s=self.cfg.trace_linger_s)
         self._replay()
         self.server = DuplexServer(
             (self.cfg.head_host, port), self._handle_rpc, self._on_disconnect)
@@ -377,12 +387,14 @@ class HeadService:
         return release
 
     def heartbeat(self, node_id: NodeID, available: dict, load=None,
-                  telemetry=None):
+                  telemetry=None, trace=None):
         entry = self.nodes.get(node_id)
         if entry is None or entry.state == DEAD:
             return False  # node should re-register (head restarted / expired)
         if telemetry:
             self.telemetry.ingest(node_id.hex(), telemetry)
+        if trace:
+            self.traces.ingest(trace)
         old = entry.available
         entry.available = dict(available)
         if load is not None:
@@ -948,7 +960,8 @@ class HeadService:
             return self.heartbeat(NodeID(payload["node_id"]),
                                   payload["available"],
                                   payload.get("load"),
-                                  payload.get("telemetry"))
+                                  payload.get("telemetry"),
+                                  payload.get("trace"))
         if method == "kv":
             op, key, val = payload
             return self.kv_op(op, key, val)
@@ -976,6 +989,14 @@ class HeadService:
             p = payload or {}
             return self.telemetry.query(p.get("metric"), p.get("node_id"),
                                         p.get("resolution", 1.0))
+        if method == "get_trace":
+            return self.traces.get((payload or {}).get("trace_id"))
+        if method == "list_traces":
+            p = payload or {}
+            return self.traces.list(p.get("deployment"),
+                                    p.get("min_ms", 0.0),
+                                    p.get("errors_only", False),
+                                    p.get("limit", 50))
         if method == "pubsub_sub":
             return self.pubsub_sub(payload["channel"],
                                    NodeID(payload["node_id"]))
@@ -1119,10 +1140,12 @@ class LocalHeadClient:
         nid = self.head.actor_nodes.get(actor_id)
         return nid.binary() if nid is not None else None
 
-    async def heartbeat(self, node_id, available, load=None, telemetry=None):
+    async def heartbeat(self, node_id, available, load=None, telemetry=None,
+                        trace=None):
         # Capacity-growth detection inside heartbeat() schedules the
         # coalesced PG retry (same contract as the RPC path).
-        return self.head.heartbeat(node_id, available, load, telemetry)
+        return self.head.heartbeat(node_id, available, load, telemetry,
+                                   trace)
 
     async def list_nodes(self):
         return [e.to_row() for e in self.head.nodes.values()]
@@ -1132,6 +1155,13 @@ class LocalHeadClient:
 
     async def timeseries(self, metric=None, node_id=None, resolution=1.0):
         return self.head.telemetry.query(metric, node_id, resolution)
+
+    async def get_trace(self, trace_id):
+        return self.head.traces.get(trace_id)
+
+    async def list_traces(self, deployment=None, min_ms=0.0,
+                          errors_only=False, limit=50):
+        return self.head.traces.list(deployment, min_ms, errors_only, limit)
 
     async def create_pg(self, pg_id, bundles, strategy):
         pg = await self.head.create_placement_group(pg_id, bundles, strategy)
@@ -1235,11 +1265,14 @@ class RemoteHeadClient:
     async def actor_node(self, actor_id):
         return await self._read("actor_node", actor_id.binary())
 
-    async def heartbeat(self, node_id, available, load=None, telemetry=None):
+    async def heartbeat(self, node_id, available, load=None, telemetry=None,
+                        trace=None):
         payload = {"node_id": node_id.binary(),
                    "available": available, "load": load}
         if telemetry:
             payload["telemetry"] = telemetry
+        if trace:
+            payload["trace"] = trace
         return await self.conn.call("heartbeat", payload,
                                     timeout=self.READ_TIMEOUT_S)
 
@@ -1257,6 +1290,15 @@ class RemoteHeadClient:
         return await self._read(
             "timeseries", {"metric": metric, "node_id": node_id,
                            "resolution": resolution})
+
+    async def get_trace(self, trace_id):
+        return await self._read("get_trace", {"trace_id": trace_id})
+
+    async def list_traces(self, deployment=None, min_ms=0.0,
+                          errors_only=False, limit=50):
+        return await self._read(
+            "list_traces", {"deployment": deployment, "min_ms": min_ms,
+                            "errors_only": errors_only, "limit": limit})
 
     async def create_pg(self, pg_id, bundles, strategy):
         return await self.conn.call(

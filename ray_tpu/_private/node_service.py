@@ -437,6 +437,11 @@ class NodeService:
         self._telemetry_sampler = TelemetrySampler(self)
         self._telemetry_buf: collections.deque = collections.deque(
             maxlen=max(1, self.cfg.telemetry_buffer_max))
+        # Request-trace relay: spans pushed by workers (1s flusher) wait
+        # here for the next heartbeat to carry them to the head's
+        # TraceStore. Bounded like telemetry: a partition drops oldest.
+        self._trace_buf: collections.deque = collections.deque(
+            maxlen=max(1, self.cfg.trace_buffer_max))
 
     async def start(self):
         await self.server.start()
@@ -748,14 +753,29 @@ class NodeService:
                 if self._telemetry_buf:
                     telemetry = list(self._telemetry_buf)
                     self._telemetry_buf.clear()
+                # Request-trace piggyback: worker-pushed spans plus any
+                # recorded in THIS process (driver-side proxy roots in
+                # local mode share our interpreter) ride the same beat.
+                from ray_tpu.util import tracing
+
+                local_spans = tracing.drain_request_spans()
+                if local_spans:
+                    self._trace_buf.extend(local_spans)
+                trace = None
+                if self._trace_buf:
+                    trace = list(self._trace_buf)
+                    self._trace_buf.clear()
                 try:
                     ok = await self.head.heartbeat(self.node_id,
                                                    dict(self.available),
                                                    self._demand_shapes(),
-                                                   telemetry=telemetry)
+                                                   telemetry=telemetry,
+                                                   trace=trace)
                 except BaseException:
                     if telemetry:
                         self._telemetry_buf.extendleft(reversed(telemetry))
+                    if trace:
+                        self._trace_buf.extendleft(reversed(trace))
                     raise
                 if ok is False:
                     # Head lost track of us (restart/expiry): re-register.
@@ -3966,6 +3986,10 @@ class NodeService:
 
         if method == "spans_push":
             self.trace_spans.extend(payload)
+            return True
+
+        if method == "request_spans_push":
+            self._trace_buf.extend(payload)
             return True
 
         if method == "task_events_push":

@@ -530,6 +530,20 @@ class Runtime:
         return self._run(
             self.node.head.timeseries(metric, node_id, resolution), timeout)
 
+    def get_trace(self, trace_id: str, timeout: float = 10.0):
+        """One retained (or still-pending) request trace: its spans,
+        start-sorted; None if the tail sampler dropped it."""
+        return self._run(self.node.head.get_trace(trace_id), timeout)
+
+    def list_traces(self, deployment: str | None = None,
+                    min_ms: float = 0.0, errors_only: bool = False,
+                    limit: int = 50, timeout: float = 10.0):
+        """Retained request-trace summaries, newest first (the head's
+        tail-sampled ring: errors + slowest p% + probabilistic rest)."""
+        return self._run(
+            self.node.head.list_traces(deployment, min_ms, errors_only,
+                                       limit), timeout)
+
     def head_client(self):
         return self.node.head
 

@@ -323,6 +323,158 @@ class TelemetrySampler:
                     quantile_from_buckets(delta, bounds, q) * 1e3
 
 
+class TraceStore:
+    """Head-side request-trace retention with TAIL-based sampling.
+
+    Completed request traces (span lists keyed by trace_id) arrive on
+    the heartbeat plane from every node. A trace stays *pending* until
+    its root span (``serve.request``) has landed and the trace has been
+    quiet for ``linger_s`` (stragglers from other processes get to
+    join), then the retention decision runs over the WHOLE trace:
+
+      * any span carrying an ``error`` attribute  -> always kept
+      * root duration in the slowest ``slow_fraction`` of that
+        deployment's recent requests                -> always kept
+      * otherwise                                   -> kept with
+        ``sample_rate`` probability
+
+    Retention is a bounded per-deployment ring (``window`` traces, like
+    the telemetry tiers) — evicting a ring entry drops its spans too,
+    so memory is O(deployments x window x spans/trace). Rootless traces
+    expire after ``max_age_s`` and go through the same decision (their
+    spans may still carry errors worth keeping)."""
+
+    ROOT_SPAN = "serve.request"
+
+    def __init__(self, sample_rate: float = 0.01,
+                 slow_fraction: float = 0.05, window: int = 256,
+                 linger_s: float = 1.0, max_age_s: float = 30.0):
+        import random
+
+        self.sample_rate = max(0.0, min(1.0, float(sample_rate)))
+        self.slow_fraction = max(0.0, min(1.0, float(slow_fraction)))
+        self.window = max(1, int(window))
+        self.linger_s = max(0.0, float(linger_s))
+        self.max_age_s = max(self.linger_s, float(max_age_s))
+        self._lock = threading.Lock()
+        # trace_id -> {"spans": [...], "last": mono, "root": span|None}
+        self._pending: Dict[str, dict] = {}
+        self._retained: Dict[str, List[dict]] = {}
+        # deployment -> deque of trace summaries (newest right)
+        self._rings: Dict[str, collections.deque] = {}
+        # deployment -> recent root durations (ms) for the slow quantile
+        self._durations: Dict[str, collections.deque] = {}
+        self._rng = random.Random()
+        self.stats = {"completed": 0, "kept": 0, "dropped": 0}
+
+    def ingest(self, spans: List[dict]):
+        now = time.monotonic()
+        with self._lock:
+            for s in spans:
+                tid = s.get("trace_id")
+                if not tid:
+                    continue
+                p = self._pending.get(tid)
+                if p is None:
+                    if tid in self._retained:
+                        # Straggler after finalize: graft it in.
+                        self._retained[tid].append(s)
+                        continue
+                    p = self._pending[tid] = {
+                        "spans": [], "last": now, "root": None}
+                p["spans"].append(s)
+                p["last"] = now
+                if s.get("name") == self.ROOT_SPAN:
+                    p["root"] = s
+            self._flush_locked(now)
+
+    def _flush_locked(self, now: float):
+        done = [
+            tid for tid, p in self._pending.items()
+            if (p["root"] is not None and now - p["last"] >= self.linger_s)
+            or now - p["last"] >= self.max_age_s]
+        for tid in done:
+            self._finalize(tid, self._pending.pop(tid))
+
+    def _finalize(self, tid: str, p: dict):
+        spans = p["spans"]
+        root = p["root"]
+        self.stats["completed"] += 1
+        error = any("error" in (s.get("attributes") or {}) for s in spans)
+        attrs = (root or {}).get("attributes") or {}
+        dep = attrs.get("deployment") or attrs.get("app") or "?"
+        base = root if root is not None else spans[0]
+        dur_ms = max(0.0, base["end"] - base["start"]) * 1e3
+        durs = self._durations.setdefault(
+            dep, collections.deque(maxlen=256))
+        # Slow = at/above the (1 - slow_fraction) quantile of this
+        # deployment's recent roots. Until enough history exists the
+        # threshold is unreliable — keep those early traces.
+        if len(durs) >= 20 and self.slow_fraction < 1.0:
+            ranked = sorted(durs)
+            idx = min(len(ranked) - 1,
+                      int(len(ranked) * (1.0 - self.slow_fraction)))
+            slow = dur_ms >= ranked[idx]
+        else:
+            slow = True
+        durs.append(dur_ms)
+        if error:
+            reason = "error"
+        elif slow:
+            reason = "slow"
+        elif self._rng.random() < self.sample_rate:
+            reason = "sampled"
+        else:
+            self.stats["dropped"] += 1
+            return
+        ring = self._rings.setdefault(dep, collections.deque())
+        while len(ring) >= self.window:
+            old = ring.popleft()
+            self._retained.pop(old["trace_id"], None)
+        ring.append({
+            "trace_id": tid, "deployment": dep,
+            "duration_ms": dur_ms, "error": error, "reason": reason,
+            "start": base.get("start", 0.0), "spans": len(spans),
+            "name": base.get("name", "?")})
+        self._retained[tid] = list(spans)
+        self.stats["kept"] += 1
+
+    def get(self, trace_id: str) -> Optional[List[dict]]:
+        """The spans of one trace (start-sorted), retained or still
+        pending; None if unknown (dropped or never seen)."""
+        with self._lock:
+            self._flush_locked(time.monotonic())
+            spans = self._retained.get(trace_id)
+            if spans is None:
+                p = self._pending.get(trace_id)
+                spans = p["spans"] if p else None
+            if spans is None:
+                return None
+            return sorted(spans, key=lambda s: s.get("start", 0.0))
+
+    def list(self, deployment: Optional[str] = None,
+             min_ms: float = 0.0, errors_only: bool = False,
+             limit: int = 50) -> List[dict]:
+        """Retained trace summaries, newest first."""
+        with self._lock:
+            self._flush_locked(time.monotonic())
+            rows: List[dict] = []
+            for dep, ring in self._rings.items():
+                if deployment is not None and dep != deployment:
+                    continue
+                rows.extend(ring)
+        rows = [r for r in rows
+                if r["duration_ms"] >= min_ms
+                and (not errors_only or r["error"])]
+        rows.sort(key=lambda r: -r["start"])
+        return rows[:max(1, int(limit))]
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {**self.stats, "pending": len(self._pending),
+                    "retained": len(self._retained)}
+
+
 def quantile_from_buckets(counts: List[int], bounds: List[float],
                           q: float) -> float:
     """Linear-interpolated quantile from histogram bucket counts

@@ -133,6 +133,7 @@ class WorkerContext:
             if not self._flush_drops():
                 return
             self._flush_task_events()
+            self._flush_request_spans()
 
     def _task_event(self, task_id: TaskID, name: str, state: str):
         self._task_event_ring.append({
@@ -602,6 +603,20 @@ class WorkerContext:
         if spans:
             try:
                 self.client.call("spans_push", spans)
+            except Exception:
+                pass
+
+    def _flush_request_spans(self):
+        """Request-plane spans (replica/batch/engine slices recorded in
+        this worker) ride the same 1s flusher to the node, which relays
+        them to the head on its next heartbeat. Fire-and-forget: a lost
+        batch costs a partial waterfall, never a stalled request."""
+        from ray_tpu.util import tracing
+
+        spans = tracing.drain_request_spans()
+        if spans:
+            try:
+                self.client.notify("request_spans_push", spans)
             except Exception:
                 pass
 

@@ -15,6 +15,8 @@ Endpoints:
   /api/jobs            job table (if a JobManager exists)
   /api/tasks           task summary by name/state
   /api/timeseries      head telemetry rings (?metric=&node_id=&resolution=)
+  /api/traces          retained request-trace summaries (tail-sampled)
+  /api/trace/<id>      one trace's spans (the waterfall pane's source)
   /metrics             Prometheus text (same as util.serve_metrics)
 
 Start with ``ray_tpu.dashboard.start_dashboard(port)`` or
@@ -56,6 +58,8 @@ _PAGE = """<!doctype html>
 <h2>Jobs</h2><table id="jobs"></table>
 <h2>Object store</h2><table id="store"></table>
 <h2>Serve</h2><table id="serve"></table>
+<h2>Request traces</h2><table id="traces"></table>
+<div id="waterfall" style="font-family:monospace;font-size:.75rem;white-space:pre;background:#fff;padding:.6rem;box-shadow:0 1px 2px #0002;overflow:auto"></div>
 <h2>RPC (top methods)</h2><table id="rpc"></table>
 <h2>Worker logs</h2><div id="logs" style="font-family:monospace;font-size:.75rem;white-space:pre-wrap;background:#fff;padding:.6rem;box-shadow:0 1px 2px #0002;max-height:20rem;overflow:auto"></div>
 <script>
@@ -95,6 +99,23 @@ function drawTimeline(evs){
     else{g.fillStyle='#69c';g.fillRect(x,li*lh+3,w,lh*0.5);}});
   g.fillStyle='#555';g.font='10px sans-serif';
   lanes.forEach((l,i)=>g.fillText(l.slice(0,18),2,i*lh+13));}
+async function showTrace(id){
+  const d = await (await fetch('api/trace/'+id)).json();
+  const spans = d.spans||[], el = document.getElementById('waterfall');
+  if(!spans.length){el.textContent='trace '+id+' not retained';return;}
+  const t0=Math.min.apply(null,spans.map(s=>s.start));
+  const t1=Math.max.apply(null,spans.map(s=>s.end));
+  const total=Math.max(t1-t0,1e-9), W=60;
+  el.textContent='trace '+id+'  '+(total*1e3).toFixed(1)+' ms\n'+
+    spans.map(s=>{
+      const off=Math.min(W-1,Math.round((s.start-t0)/total*W));
+      const len=Math.min(W-off,Math.max(1,Math.round((s.end-s.start)/total*W)));
+      const errs=(s.attributes&&s.attributes.error)?'  ERROR':'';
+      return (s.name+' '.repeat(30)).slice(0,30)+'|'+' '.repeat(off)+
+        '#'.repeat(len)+' '.repeat(W-off-len)+'| '+
+        ((s.end-s.start)*1e3).toFixed(2)+' ms'+errs;
+    }).join('\n');
+}
 async function refresh(){
   try{
     const o = await (await fetch('api/overview')).json();
@@ -182,6 +203,15 @@ async function refresh(){
         esc(d.deployment), pill(d.status),
         sv.proxies.map(p=>p.node_id.slice(0,8)+':'+p.port).join(' ')||'-'])).join('')
         : row(['-','-','-','-']));
+    const tr = await (await fetch('api/traces')).json();
+    document.getElementById('traces').innerHTML =
+      row(['trace','deployment','ms','spans','reason','error'],'th') +
+      (tr.traces.length ? tr.traces.map(x=>row([
+        '<a href="#" onclick="showTrace(\\''+esc(x.trace_id)+
+          '\\');return false">'+esc(x.trace_id)+'</a>',
+        esc(x.deployment), x.duration_ms.toFixed(1), x.spans,
+        esc(x.reason), x.error?pill('ERROR'):'-'])).join('')
+        : row(['-','-','-','-','-','-']));
     const rp = await (await fetch('api/rpc')).json();
     document.getElementById('rpc').innerHTML =
       row(['node','method','count','errors','timeouts','mean ms','max ms'],'th') +
@@ -449,6 +479,29 @@ def _timeseries_api(metric=None, node_id=None,
         return {"resolution": resolution, "series": {}}
 
 
+def _traces() -> dict:
+    """Retained request-trace summaries (the trace pane's list)."""
+    from ._private import context as context_mod
+
+    try:
+        rt = context_mod.require_context()
+        return {"traces": rt.list_traces(limit=50)}
+    except Exception:  # noqa: BLE001 - old head / no serve traffic
+        return {"traces": []}
+
+
+def _trace_api(trace_id: str) -> dict:
+    """One trace's spans, start-sorted, for the waterfall render."""
+    from ._private import context as context_mod
+
+    try:
+        rt = context_mod.require_context()
+        return {"trace_id": trace_id,
+                "spans": rt.get_trace(trace_id) or []}
+    except Exception:  # noqa: BLE001
+        return {"trace_id": trace_id, "spans": []}
+
+
 def _jobs() -> dict:
     try:
         from .job_submission import JOB_MANAGER_NAME
@@ -481,6 +534,7 @@ def start_dashboard(port: int = 0, host: str = "127.0.0.1"):
         "/api/timeline": _timeline,
         "/api/rpc": _rpc_stats,
         "/api/serve": _serve_status,
+        "/api/traces": _traces,
         "/api/logs": _logs,
     }
 
@@ -504,6 +558,10 @@ def start_dashboard(port: int = 0, host: str = "127.0.0.1"):
                     body = json.dumps(_timeseries_api(
                         metric=one("metric"), node_id=one("node_id"),
                         resolution=float(one("resolution", 1.0)))).encode()
+                    ctype = "application/json"
+                elif path.startswith("/api/trace/"):
+                    body = json.dumps(
+                        _trace_api(path.rsplit("/", 1)[1])).encode()
                     ctype = "application/json"
                 elif path in routes:
                     body = json.dumps(routes[path]()).encode()

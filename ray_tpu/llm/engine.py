@@ -43,6 +43,7 @@ import jax
 import numpy as np
 
 from ..models.gpt import GPTConfig, forward_decode, forward_prefill
+from ..util import tracing
 from .kv_cache import PagedKVCache
 from .sampling import sample
 
@@ -73,6 +74,9 @@ class Request:
     first_token_t: Optional[float] = None
     finish_t: Optional[float] = None
     preemptions: int = 0
+    # Serving-lane trace context ({"trace_id", "span_id"} of the request
+    # span this generation belongs to); None outside traced requests.
+    trace_ctx: Optional[dict] = None
     out_q: "queue.Queue" = field(default_factory=queue.Queue)
 
     def tokens(self):
@@ -125,6 +129,7 @@ class LLMEngine:
         # trace the batch-recomposition test asserts on.
         self.step_log: Deque[tuple] = collections.deque(maxlen=1024)
         self._steps = 0
+        self._last_prefill_count = 0
         self._finished_count = 0
         self._token_times: Deque[tuple] = collections.deque()  # (t, n)
         self._thread: Optional[threading.Thread] = None
@@ -144,7 +149,8 @@ class LLMEngine:
 
     def add_request(self, prompt: List[int], max_tokens: int = 16, *,
                     temperature: float = 0.0, top_k: int = 0,
-                    seed: int = 0, stop_tokens=()) -> Request:
+                    seed: int = 0, stop_tokens=(),
+                    trace_ctx: Optional[dict] = None) -> Request:
         """Validate + enqueue; returns the Request whose .tokens()
         generator streams the output. Raises if the request could never
         run (so the pool-exhaustion path is always recoverable by
@@ -161,12 +167,18 @@ class LLMEngine:
             raise ValueError(
                 f"request needs {need} KV blocks; pool capacity is "
                 f"{self.kv.capacity} — it could never be admitted")
+        if trace_ctx is None:
+            # Implicit propagation: inside a traced serve request the
+            # replica span is the calling thread's current context.
+            from ray_tpu.util import tracing
+
+            trace_ctx = tracing.current_context.get()
         req = Request(rid=next(self._ids), prompt=prompt,
                       max_tokens=int(max_tokens),
                       temperature=float(temperature), top_k=int(top_k),
                       seed=int(seed),
                       stop_tokens=tuple(int(t) for t in stop_tokens),
-                      submit_t=time.time())
+                      submit_t=time.time(), trace_ctx=trace_ctx)
         with self._cond:
             self._requests[req.rid] = req
             self._waiting.append(req)
@@ -191,6 +203,13 @@ class LLMEngine:
             req.block_table = grant
             self._active.append(req)
             self._event(req, PREFILL)
+            if req.preemptions and req.trace_ctx is not None:
+                # Resume after preemption: an instant on the victim's
+                # own trace closing the preempt->resume gap.
+                tracing.emit("llm.resume", req.trace_ctx,
+                             time.time(), 0.0,
+                             {"rid": req.rid,
+                              "preemptions": req.preemptions})
 
     def _activate(self, req: Request, logits_row):
         """Prefill done: sample the first (or first-since-resume) token
@@ -209,6 +228,14 @@ class LLMEngine:
         req.preemptions += 1
         self._waiting.appendleft(req)
         self._event(req, PREEMPTED)
+        if req.trace_ctx is not None:
+            # Link the eviction back to the VICTIM's trace: its
+            # waterfall shows who got preempted and why its tokens
+            # stalled (recompute-on-resume).
+            tracing.emit("llm.preempt", req.trace_ctx, time.time(), 0.0,
+                         {"rid": req.rid,
+                          "preemptions": req.preemptions,
+                          "kv_util": self.kv.utilization()})
 
     def _finish(self, req: Request, reason: str):
         if req in self._active:
@@ -249,7 +276,10 @@ class LLMEngine:
         """Prefill newly admitted requests one sequence at a time
         (prompt lengths are ragged; padding to a block multiple bounds
         recompiles to max_seq/block_size variants)."""
-        for req in [r for r in self._active if r.state == PREFILL]:
+        prefills = [r for r in self._active if r.state == PREFILL]
+        self._last_prefill_count = len(prefills)
+        for req in prefills:
+            t0 = time.time()
             seq = req.prompt + req.output
             T = len(seq)
             pad = -T % self.kv.block_size or 0
@@ -262,6 +292,11 @@ class LLMEngine:
             req.context_len = T
             row = np.asarray(jax.device_get(logits[0, T - 1]), np.float32)
             self._activate(req, row)
+            if req.trace_ctx is not None:
+                tracing.emit("llm.prefill", req.trace_ctx, t0,
+                             time.time() - t0,
+                             {"rid": req.rid, "tokens": T,
+                              "resumed": bool(req.preemptions)})
 
     def _ensure_decode_slot(self, req: Request) -> bool:
         """Guarantee req's next token has a pool slot, preempting LIFO
@@ -294,6 +329,7 @@ class LLMEngine:
         batch = [r for r in batch if r.state == RUNNING]
         if not batch:
             return
+        t0 = time.time()
         B = self.max_batch
         bs = self.kv.block_size
         tokens = np.zeros((B,), np.int32)
@@ -320,6 +356,18 @@ class LLMEngine:
         for i, req in enumerate(batch):
             req.context_len += 1
             self._sample_into(req, rows[i])
+        # One decode-step slice per TRACED sequence in the batch: the
+        # request's waterfall shows its token cadence, and every slice
+        # carries the step's batch composition + pool pressure.
+        dur = time.time() - t0
+        kv_util = self.kv.utilization()
+        for req in batch:
+            if req.trace_ctx is not None:
+                tracing.emit(
+                    "llm.decode_step", req.trace_ctx, t0, dur,
+                    {"step": self._steps + 1, "rid": req.rid,
+                     "prefill": self._last_prefill_count,
+                     "decode": len(batch), "kv_util": kv_util})
 
     def step(self) -> int:
         """One scheduler iteration: admit -> prefill -> decode one token

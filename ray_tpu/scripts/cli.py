@@ -498,6 +498,42 @@ def cmd_serve_shutdown(args):
     print("serve shut down")
 
 
+def cmd_trace_list(args):
+    _attach(args)
+    from ray_tpu.util import state
+
+    rows = state.list_traces(deployment=args.deployment,
+                             min_ms=args.min_ms,
+                             errors_only=args.errors_only,
+                             limit=args.limit)
+    if not rows:
+        print("no retained traces (the head keeps errors, the slowest "
+              "p% per deployment, and a sampled rest — send traffic "
+              "first, then wait one heartbeat)")
+        return
+    print(f"{'TRACE':<33} {'DEPLOYMENT':<16} {'MS':>9} {'SPANS':>5} "
+          f"{'REASON':<7} ERR")
+    for r in rows:
+        print(f"{r['trace_id']:<33} {str(r['deployment'])[:16]:<16} "
+              f"{r['duration_ms']:>9.1f} {r['spans']:>5} "
+              f"{r['reason']:<7} {'x' if r['error'] else ''}")
+
+
+def cmd_trace_show(args):
+    _attach(args)
+    from ray_tpu.util import state, tracing
+
+    spans = state.get_trace(args.id)
+    if not spans:
+        print(f"trace {args.id} not retained (tail sampler dropped it, "
+              f"or it never completed)")
+        return
+    sys.stdout.write(tracing.render_waterfall(spans))
+    if args.output:
+        tracing.export_chrome_trace(args.output, trace_id=args.id)
+        print(f"chrome trace written to {args.output}")
+
+
 def cmd_logs(args):
     _attach(args)
     from ray_tpu._private import context as context_mod
@@ -761,6 +797,24 @@ def build_parser() -> argparse.ArgumentParser:
     sp = ssub.add_parser("shutdown")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_serve_shutdown)
+
+    tp = sub.add_parser("trace",
+                        help="request traces (serving-lane waterfalls)")
+    tsub = tp.add_subparsers(dest="trace_cmd", required=True)
+    sp = tsub.add_parser("list", help="retained traces, newest first")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--deployment", default=None)
+    sp.add_argument("--min-ms", type=float, default=0.0, dest="min_ms")
+    sp.add_argument("--errors-only", action="store_true",
+                    dest="errors_only")
+    sp.add_argument("--limit", type=int, default=50)
+    sp.set_defaults(fn=cmd_trace_list)
+    sp = tsub.add_parser("show", help="ASCII waterfall of one trace")
+    sp.add_argument("id")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--output", "-o", default=None,
+                    help="also write a chrome://tracing JSON here")
+    sp.set_defaults(fn=cmd_trace_show)
 
     sp = sub.add_parser("logs", help="recent worker logs cluster-wide")
     sp.add_argument("--address", default=None)

@@ -20,16 +20,22 @@ from .multiplex import _set_request_model_id, get_multiplexed_model_id
 
 class _Pending:
     __slots__ = ("item", "event", "result", "error", "model_id",
-                 "submit_t")
+                 "submit_t", "trace_ctx")
 
     def __init__(self, item):
+        from ray_tpu.util import tracing
+
         self.item = item
         self.event = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
         # Request context is thread-local and the batch executes on the
         # collector thread — capture it at submit time (caller's thread).
+        # Same story for the trace context (the replica span): the
+        # batcher's spans must join the parked request's trace, not the
+        # collector thread's.
         self.model_id = get_multiplexed_model_id()
+        self.trace_ctx = tracing.current_context.get()
         self.submit_t = time.monotonic()  # batch_wait anchor
 
 
@@ -95,13 +101,37 @@ class _Batcher:
                 self._run_batch(owner, group)
 
     def _run_batch(self, owner, batch: list[_Pending]):
+        from ray_tpu.util import tracing
+
         now = time.monotonic()
+        now_wall = time.time()
+        oldest_wait = max(now - p.submit_t for p in batch)
         for p in batch:
             # SLO phase: time parked in the batch queue before the
             # batched call fired (deployment attribution is the
             # process-global set by the hosting replica).
-            slo.record_phase("batch_wait", now - p.submit_t)
+            waited = now - p.submit_t
+            slo.record_phase(
+                "batch_wait", waited,
+                trace_id=(p.trace_ctx or {}).get("trace_id"))
+            # Per-request waterfall slice of the same parked interval.
+            tracing.emit("serve.batch_wait", p.trace_ctx,
+                         now_wall - waited, waited)
         _set_request_model_id(batch[0].model_id or None)
+        # One span per batch execution, anchored to the OLDEST waiter's
+        # trace (the request whose deadline fired the flush); becomes
+        # the collector thread's context so engine work inside the
+        # batched call nests under it.
+        anchor = max(batch, key=lambda p: now - p.submit_t)
+        bspan = None
+        if anchor.trace_ctx is not None:
+            bspan = tracing.span(
+                "serve.batch_execute", ctx=anchor.trace_ctx,
+                kind="request",
+                attributes={"batch_size": len(batch),
+                            "oldest_wait_ms": oldest_wait * 1e3,
+                            "model_id": batch[0].model_id or ""})
+            bspan.__enter__()
         try:
             results = self.fn(owner, [p.item for p in batch])
             if len(results) != len(batch):
@@ -111,9 +141,13 @@ class _Batcher:
             for p, r in zip(batch, results):
                 p.result = r
         except BaseException as e:  # noqa: BLE001 - delivered to callers
+            if bspan is not None:
+                bspan.attributes["error"] = f"{type(e).__name__}: {e}"
             for p in batch:
                 p.error = e
         finally:
+            if bspan is not None:
+                bspan.__exit__(None, None, None)
             _set_request_model_id(None)
             for p in batch:
                 p.event.set()

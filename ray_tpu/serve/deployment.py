@@ -111,7 +111,8 @@ class DeploymentResponse:
         self._ref = ref
         self._router = router
         self._replica_key = replica_key
-        self._retry = retry  # (method, args, kwargs, model_id) | None
+        # (method, args, kwargs, model_id, trace_ctx) | None
+        self._retry = retry
         self._done = False
 
     def result(self, timeout: Optional[float] = None):
@@ -123,7 +124,7 @@ class DeploymentResponse:
                 ray_tpu.WorkerCrashedError):
             if self._retry is None:
                 raise
-            method, args, kwargs, model_id = self._retry
+            method, args, kwargs, model_id, trace_ctx = self._retry
             self._settle()
             # Drop the dead replica locally FIRST — a controller-side
             # refresh may still list it until its health loop catches up.
@@ -142,8 +143,10 @@ class DeploymentResponse:
                         raise
                     _time.sleep(0.2)
                     self._router.maybe_refresh(force=True)
+            # Retry keeps the ORIGINAL trace context: the retried hop is
+            # part of the same request's story.
             self._ref = actor.handle_request.remote(
-                method, args, kwargs, model_id, _time.time())
+                method, args, kwargs, model_id, _time.time(), trace_ctx)
             self._replica_key = key
             self._done = False
             self._retry = None  # one retry only
@@ -421,16 +424,22 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         import time as _time
 
+        from ray_tpu.util import tracing
+
         self._router.maybe_refresh()
         actor, key = self._router.pick_replica(self._model_id)
         # Submit stamp travels with the request so the replica can
         # attribute its actor-lane queueing (the replica_queue SLO
-        # phase).
+        # phase); the caller's trace context (the proxy's root span, or
+        # an upstream replica doing model composition) rides along so
+        # the replica's spans join the request's trace.
+        trace_ctx = tracing.current_context.get()
         ref = actor.handle_request.remote(
-            self._method, args, kwargs, self._model_id, _time.time())
+            self._method, args, kwargs, self._model_id, _time.time(),
+            trace_ctx)
         return DeploymentResponse(
             ref, self._router, key,
-            retry=(self._method, args, kwargs, self._model_id))
+            retry=(self._method, args, kwargs, self._model_id, trace_ctx))
 
     def __reduce__(self):
         return (_rebuild_handle,

@@ -43,35 +43,58 @@ class GRPCProxy:
         def _call(request_value, context):
             """Aborts (NOT_FOUND / INTERNAL) propagate to the client as
             their own status — never re-wrapped."""
-            app, method = _resolve(context)
-            try:
-                handle = proxy.controller.get_app_handle(app)
-            except Exception as e:  # noqa: BLE001 - surfaced as NOT_FOUND
-                context.abort(grpc.StatusCode.NOT_FOUND,
-                              f"no app {app!r}: {e}")
-            if method:
-                handle = handle.options(method_name=method)
-            # Deadline: whatever the client asked for (gRPC deadline via
-            # time_remaining), bounded by the proxy-level default.
-            timeout = proxy.request_timeout_s
-            remaining = context.time_remaining()
-            if remaining is not None:
-                timeout = min(timeout, remaining)
-            try:
-                resp = handle.remote(request_value)
-                value = resp.result(timeout=timeout)
-                from .replica import STREAM_MARKER
+            from ray_tpu.util import tracing
 
-                if isinstance(value, dict) and STREAM_MARKER in value:
-                    # Unary gRPC: drain a streaming deployment into a
-                    # list (and free the replica-side generator).
-                    value = list(resp.iter_stream(timeout=timeout))
-                return value
-            except (TimeoutError, futures.TimeoutError):
-                context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
-                              f"no reply within {timeout:.1f}s")
-            except Exception as e:  # noqa: BLE001
-                context.abort(grpc.StatusCode.INTERNAL, str(e))
+            app, method = _resolve(context)
+            meta = dict(context.invocation_metadata())
+            # Root span per gRPC request (same request plane as the
+            # HTTP ingress): handlers run on pool threads, so entering
+            # here makes the context visible to handle.remote below.
+            root = tracing.span(
+                "serve.request", kind="request",
+                ctx=tracing.parse_traceparent(meta.get("traceparent")),
+                attributes={"rpc.system": "grpc", "app": app})
+            root.__enter__()
+            try:
+                context.set_trailing_metadata(
+                    (("x-rtpu-trace-id", root.trace_id),))
+            except Exception:  # noqa: BLE001
+                pass
+            try:
+                try:
+                    handle = proxy.controller.get_app_handle(app)
+                except Exception as e:  # noqa: BLE001 - NOT_FOUND
+                    context.abort(grpc.StatusCode.NOT_FOUND,
+                                  f"no app {app!r}: {e}")
+                if method:
+                    handle = handle.options(method_name=method)
+                root.attributes["deployment"] = handle._name
+                # Deadline: whatever the client asked for (gRPC deadline
+                # via time_remaining), bounded by the proxy default.
+                timeout = proxy.request_timeout_s
+                remaining = context.time_remaining()
+                if remaining is not None:
+                    timeout = min(timeout, remaining)
+                try:
+                    resp = handle.remote(request_value)
+                    value = resp.result(timeout=timeout)
+                    from .replica import STREAM_MARKER
+
+                    if isinstance(value, dict) and STREAM_MARKER in value:
+                        # Unary gRPC: drain a streaming deployment into
+                        # a list (and free the replica-side generator).
+                        value = list(resp.iter_stream(timeout=timeout))
+                    return value
+                except (TimeoutError, futures.TimeoutError):
+                    context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                                  f"no reply within {timeout:.1f}s")
+                except Exception as e:  # noqa: BLE001
+                    context.abort(grpc.StatusCode.INTERNAL, str(e))
+            except BaseException as e:
+                root.attributes["error"] = f"{type(e).__name__}: {e}"
+                raise
+            finally:
+                root.__exit__(None, None, None)
 
         def predict(request: bytes, context) -> bytes:
             try:

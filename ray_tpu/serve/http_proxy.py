@@ -68,25 +68,60 @@ class HTTPProxy:
     async def _handle(self, request):
         from aiohttp import web
 
+        from ray_tpu.util import tracing
+
         from . import slo
 
         route = self.resolve(request.path)
         if route is None:
             return web.json_response({"error": "no route"}, status=404)
         slo.proxy_inflight(+1)
+        # Root span of the request trace (one per HTTP request, always
+        # on — the head's tail sampler decides retention). An inbound
+        # W3C traceparent header makes this a child of the caller's
+        # trace instead of a new root.
+        root = tracing.span(
+            "serve.request", kind="request",
+            ctx=tracing.parse_traceparent(
+                request.headers.get("traceparent")),
+            attributes={"http.path": request.path,
+                        "http.method": request.method,
+                        "app": route[0]})
+        root.__enter__()
         try:
-            return await self._handle_routed(request, route)
+            resp = await self._handle_routed(request, route, root)
+            status = getattr(resp, "status", 200)
+            root.attributes["http.status"] = status
+            if status >= 500:
+                root.attributes["error"] = f"http {status}"
+            try:
+                # Hand the id back so a curl user can jump straight to
+                # `rtpu trace show`. Streaming responses are already
+                # prepared (headers sent) — skip, the id still lands in
+                # the store.
+                resp.headers["x-rtpu-trace-id"] = root.trace_id
+            except Exception:  # noqa: BLE001
+                pass
+            return resp
+        except BaseException as e:
+            root.attributes["error"] = f"{type(e).__name__}: {e}"
+            raise
         finally:
+            root.__exit__(None, None, None)
             slo.proxy_inflight(-1)
 
-    async def _handle_routed(self, request, route):
+    async def _handle_routed(self, request, route, root):
+        import contextvars
         import time as _time
 
         from aiohttp import web
 
+        from ray_tpu.util import tracing
+
         from . import slo
 
         t_arrive = _time.perf_counter()
+        t_wall = _time.time()
         app, is_asgi = route
         raw = await request.read()
         if is_asgi:
@@ -111,12 +146,21 @@ class HTTPProxy:
         try:
             handle = self.controller.get_app_handle(app)
             # Routing/submission may RPC (replica refresh): off-loop.
+            # contextvars don't cross run_in_executor, so the submit
+            # runs under a COPY of this task's context — the handle
+            # reads the root span's trace context from it and forwards
+            # it to the replica.
+            cv_ctx = contextvars.copy_context()
             resp = await loop.run_in_executor(
-                None, lambda: handle.remote(body))
+                None, lambda: cv_ctx.run(handle.remote, body))
             # SLO phase: arrival -> dispatched to a replica (routing +
             # proxy-side queueing; replica_queue picks up from here).
-            slo.record_phase("proxy_queue", _time.perf_counter() - t_arrive,
-                             handle._name)
+            dispatch_dur = _time.perf_counter() - t_arrive
+            slo.record_phase("proxy_queue", dispatch_dur, handle._name,
+                             trace_id=root.trace_id)
+            root.attributes["deployment"] = handle._name
+            tracing.emit("serve.proxy_queue", root.context(), t_wall,
+                         dispatch_dur, {"deployment": handle._name})
             try:
                 # Fast path: await the result future directly — a
                 # second executor hop for a blocking .result() costs
@@ -143,7 +187,7 @@ class HTTPProxy:
         from .replica import STREAM_MARKER
 
         if isinstance(result, dict) and STREAM_MARKER in result:
-            return await self._stream(request, resp)
+            return await self._stream(request, resp, root)
         if is_asgi and isinstance(result, dict) and "status" in result:
             from multidict import CIMultiDict
 
@@ -158,17 +202,22 @@ class HTTPProxy:
                                 headers=hdrs)
         return web.json_response(result)
 
-    async def _stream(self, request, resp):
+    async def _stream(self, request, resp, root=None):
         """Chunked transfer of a generator response: each chunk is a raw
         bytes frame or one newline-delimited JSON document."""
+        import time as _time
+
         from aiohttp import web
 
-        sr = web.StreamResponse(
-            headers={"Content-Type": "application/x-ndjson"})
+        headers = {"Content-Type": "application/x-ndjson"}
+        if root is not None:
+            headers["x-rtpu-trace-id"] = root.trace_id
+        sr = web.StreamResponse(headers=headers)
         sr.enable_chunked_encoding()
         await sr.prepare(request)
         it = resp.iter_stream(timeout=self.request_timeout_s)
         timed_out = False
+        first_chunk = True
         cf = None
         try:
             while True:
@@ -187,10 +236,22 @@ class HTTPProxy:
                     break
                 if chunk is _END:
                     break
+                if first_chunk and root is not None:
+                    # TTFT on the root span: arrival -> first streamed
+                    # chunk reaches the proxy.
+                    root.add_event(
+                        "ttft",
+                        ms=(_time.time() - root.start) * 1e3)
+                    first_chunk = False
                 if isinstance(chunk, (bytes, bytearray)):
                     await sr.write(bytes(chunk))
                 else:
                     await sr.write((json.dumps(chunk) + "\n").encode())
+            if root is not None and not first_chunk:
+                root.add_event(
+                    "last_token",
+                    ms=(_time.time() - root.start) * 1e3,
+                    aborted=timed_out)
         finally:
             # Free the replica-side generator. If a pull is still
             # executing in the pool thread (timeout above, or the client

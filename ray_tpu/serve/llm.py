@@ -83,7 +83,13 @@ class _LLMServer:
             raise ValueError("request needs a non-empty 'prompt'")
         # Register with the engine NOW: the request joins the in-flight
         # batch at the next step even though the generator body below
-        # only runs when the stream is first pulled.
+        # only runs when the stream is first pulled. The replica span's
+        # trace context is captured HERE (this thread) because gen()
+        # executes later on stream_next threads with no context set.
+        from ray_tpu.util import tracing
+
+        trace_ctx = tracing.current_context.get()
+        trace_id = (trace_ctx or {}).get("trace_id")
         req = self.engine.add_request(
             prompt,
             max_tokens=int(request.get("max_tokens",
@@ -91,7 +97,8 @@ class _LLMServer:
             temperature=float(request.get("temperature", 0.0)),
             top_k=int(request.get("top_k", 0)),
             seed=int(request.get("seed", 0)),
-            stop_tokens=request.get("stop_tokens", ()))
+            stop_tokens=request.get("stop_tokens", ()),
+            trace_ctx=trace_ctx)
         dep = self.engine.name
 
         def gen():
@@ -100,14 +107,14 @@ class _LLMServer:
                 if first:
                     first = False
                     slo.record_phase("ttft", time.time() - req.submit_t,
-                                     dep)
+                                     dep, trace_id=trace_id)
                 yield {"token": tok}
             if req.first_token_t and req.finish_t \
                     and len(req.output) > 1:
                 slo.record_phase(
                     "tpot",
                     (req.finish_t - req.first_token_t)
-                    / (len(req.output) - 1), dep)
+                    / (len(req.output) - 1), dep, trace_id=trace_id)
             yield {"done": True,
                    "finish_reason": req.finish_reason,
                    "num_tokens": len(req.output),

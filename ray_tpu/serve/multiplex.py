@@ -58,7 +58,17 @@ def multiplexed(_func=None, *, max_num_models_per_replica: int = 3):
                         break
                 ev.wait()
             try:
-                model = load_fn(self, mid)
+                from ray_tpu.util import tracing
+
+                # Cold model loads are a classic tail-latency culprit:
+                # when a traced request triggers one, the load shows up
+                # as its own slice in the waterfall.
+                if tracing.current_context.get() is not None:
+                    with tracing.span("serve.model_load", kind="request",
+                                      attributes={"model_id": mid}):
+                        model = load_fn(self, mid)
+                else:
+                    model = load_fn(self, mid)
                 evicted = []
                 with lock:
                     cache[mid] = model

@@ -72,12 +72,21 @@ class Replica:
 
     def handle_request(self, method: str, args: tuple, kwargs: dict,
                        multiplexed_model_id: str = "",
-                       submit_ts: float = 0.0) -> Any:
+                       submit_ts: float = 0.0,
+                       trace_ctx: Optional[dict] = None) -> Any:
+        from ray_tpu.util import tracing
+
+        trace_id = (trace_ctx or {}).get("trace_id")
         if submit_ts:
             # Handle-side submit stamp -> here: actor-lane queueing.
             # Cross-process wall clocks on the same host; clamped >= 0.
-            slo.record_phase("replica_queue", time.time() - submit_ts,
-                             self._deployment)
+            queued = max(0.0, time.time() - submit_ts)
+            slo.record_phase("replica_queue", queued, self._deployment,
+                             trace_id=trace_id)
+            # Retroactive waterfall slice for the same interval.
+            tracing.emit("serve.replica_queue", trace_ctx,
+                         time.time() - queued, queued,
+                         {"deployment": self._deployment})
         with self._lock:
             self._ongoing += 1
             self._total += 1
@@ -86,6 +95,16 @@ class Replica:
                 del self._window[:-1000]
         slo.set_queue_depth(self._ongoing + len(self._streams),
                             self._deployment)
+        # Replica-side span: becomes the thread's current context, so a
+        # @serve.batch submit or an engine add_request inside the user
+        # code inherits the request's trace without explicit plumbing.
+        rspan = None
+        if trace_ctx is not None:
+            rspan = tracing.span(
+                "serve.replica", ctx=trace_ctx, kind="request",
+                attributes={"deployment": self._deployment,
+                            "method": method})
+            rspan.__enter__()
         t_exec0 = time.perf_counter()
         try:
             _set_request_model_id(multiplexed_model_id)
@@ -107,12 +126,18 @@ class Replica:
                     self._streams[sid] = result
                 return {STREAM_MARKER: sid}
             return result
+        except BaseException as e:
+            if rspan is not None:
+                rspan.attributes["error"] = f"{type(e).__name__}: {e}"
+            raise
         finally:
             # For @serve.batch methods this span includes batch
             # residency (batch_wait is recorded separately by the
             # batcher): execute - batch_wait isolates pure compute.
             slo.record_phase("execute", time.perf_counter() - t_exec0,
-                             self._deployment)
+                             self._deployment, trace_id=trace_id)
+            if rspan is not None:
+                rspan.__exit__(None, None, None)
             _set_request_model_id(None)
             with self._lock:
                 self._ongoing -= 1

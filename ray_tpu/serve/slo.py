@@ -57,6 +57,12 @@ _lock = threading.Lock()
 _deployment = ""
 # (deployment, phase) -> [bucket_counts, sum, count]
 _local: Dict[tuple, list] = {}
+# (deployment, phase) -> [wall_ts, seconds, trace_id]: the slowest
+# RECENT traced observation — the exemplar a p95/p99 row points at.
+# "Recent" keeps exemplars actionable: a stored one is replaced by any
+# slower observation, or by any traced observation once it ages out.
+_exemplars: Dict[tuple, list] = {}
+_EXEMPLAR_MAX_AGE_S = 120.0
 
 _hist = None
 _replica_gauge = None
@@ -96,7 +102,8 @@ def current_deployment() -> str:
 
 
 def record_phase(phase: str, seconds: float,
-                 deployment: Optional[str] = None):
+                 deployment: Optional[str] = None,
+                 trace_id: Optional[str] = None):
     dep = deployment if deployment else (_deployment or "?")
     seconds = max(0.0, float(seconds))
     key = (dep, phase)
@@ -107,6 +114,14 @@ def record_phase(phase: str, seconds: float,
         cell[0][bisect_left(PHASE_BOUNDS, seconds)] += 1
         cell[1] += seconds
         cell[2] += 1
+        if trace_id:
+            import time as _time
+
+            now = _time.time()
+            ex = _exemplars.get(key)
+            if ex is None or seconds >= ex[1] \
+                    or now - ex[0] > _EXEMPLAR_MAX_AGE_S:
+                _exemplars[key] = [now, seconds, trace_id]
     try:
         hist, _, _ = _metrics()
         hist.observe(seconds, tags={"deployment": dep, "phase": phase})
@@ -149,6 +164,10 @@ def phase_hist(deployment: Optional[str] = None) -> dict:
             out[phase] = {"bounds": list(PHASE_BOUNDS),
                           "counts": list(counts),
                           "sum": total, "count": n}
+            ex = _exemplars.get((d, phase))
+            if ex is not None:
+                out[phase]["exemplar"] = {
+                    "ts": ex[0], "ms": ex[1] * 1e3, "trace_id": ex[2]}
     return out
 
 
@@ -158,9 +177,13 @@ def all_phase_hists() -> dict:
     out: dict = {}
     with _lock:
         for (d, phase), (counts, total, n) in _local.items():
-            out.setdefault(d, {})[phase] = {
+            cell = out.setdefault(d, {})[phase] = {
                 "bounds": list(PHASE_BOUNDS), "counts": list(counts),
                 "sum": total, "count": n}
+            ex = _exemplars.get((d, phase))
+            if ex is not None:
+                cell["exemplar"] = {
+                    "ts": ex[0], "ms": ex[1] * 1e3, "trace_id": ex[2]}
     return out
 
 
@@ -175,11 +198,19 @@ def merge_phase_hists(hists: List[dict]) -> dict:
                                  "counts": list(cell["counts"]),
                                  "sum": cell["sum"],
                                  "count": cell["count"]}
+                if cell.get("exemplar"):
+                    merged[phase]["exemplar"] = dict(cell["exemplar"])
             elif cur["bounds"] == cell["bounds"]:
                 cur["counts"] = [a + b for a, b in
                                  zip(cur["counts"], cell["counts"])]
                 cur["sum"] += cell["sum"]
                 cur["count"] += cell["count"]
+                # Slowest replica's exemplar wins: the p99 row should
+                # point at the worst traced request across replicas.
+                ex = cell.get("exemplar")
+                if ex and ex["ms"] >= cur.get(
+                        "exemplar", {"ms": -1.0})["ms"]:
+                    cur["exemplar"] = dict(ex)
     return merged
 
 
@@ -203,6 +234,12 @@ def latency_summary(merged: dict) -> dict:
             "p99_ms": quantile_from_buckets(
                 cell["counts"], cell["bounds"], 0.99) * 1e3,
         }
+        if cell.get("exemplar"):
+            # p99 -> root cause: the trace id of the slowest traced
+            # request behind these quantiles (state.get_trace /
+            # `rtpu trace show` renders its waterfall).
+            out[phase]["exemplar_trace_id"] = cell["exemplar"]["trace_id"]
+            out[phase]["exemplar_ms"] = cell["exemplar"]["ms"]
     return out
 
 
@@ -210,5 +247,6 @@ def _reset_for_tests():
     global _deployment, _proxy_inflight
     with _lock:
         _local.clear()
+        _exemplars.clear()
     _deployment = ""
     _proxy_inflight = 0

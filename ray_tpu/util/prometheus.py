@@ -157,6 +157,32 @@ def prometheus_text() -> str:
                            f"{points[-1][1]}")
     except Exception:  # noqa: BLE001 - export must not fail the page
         pass
+
+    # -- serve SLO exemplars: the retained trace behind each phase's
+    # recent worst case. Native exemplar syntax needs OpenMetrics; the
+    # trace_id travels as a plain label instead so any scraper version
+    # can join a p99 spike to its waterfall (rtpu trace show <id>).
+    # Best-effort like the telemetry section.
+    try:
+        from ..serve import slo
+
+        emitted = False
+        for dep, hists in sorted(slo.all_phase_hists().items()):
+            for phase, cell in sorted(hists.items()):
+                ex = cell.get("exemplar")
+                if not ex or not ex.get("trace_id"):
+                    continue
+                if not emitted:
+                    emitted = True
+                    emit_meta("rtpu_serve_exemplar_ms", "gauge",
+                              "Slowest recent request per serve phase, "
+                              "labeled with its retained trace id")
+                tags = {"deployment": dep, "phase": phase,
+                        "trace_id": ex["trace_id"]}
+                out.append(f"rtpu_serve_exemplar_ms{_fmt_tags(tags)} "
+                           f"{ex['ms']}")
+    except Exception:  # noqa: BLE001 - export must not fail the page
+        pass
     return "\n".join(out) + "\n"
 
 

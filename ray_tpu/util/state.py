@@ -253,6 +253,26 @@ def timeseries_metrics() -> list[str]:
     return sorted(timeseries().get("series", {}))
 
 
+def get_trace(trace_id: str) -> Optional[list]:
+    """One retained serving-lane request trace: its spans (dicts with
+    trace_id/span_id/parent_id/name/start/end/attributes/events),
+    start-sorted — the proxy root, replica/batch slices, and per-step
+    engine spans of a single request. None if the head's tail sampler
+    dropped it (it keeps errors, the slowest p% per deployment, and a
+    probabilistic rest — see ``system_config.trace_sample_rate``)."""
+    return _runtime("get_trace").get_trace(trace_id)
+
+
+def list_traces(deployment: Optional[str] = None, min_ms: float = 0.0,
+                errors_only: bool = False, limit: int = 50) -> list:
+    """Retained request-trace summaries, newest first: ``{"trace_id",
+    "deployment", "duration_ms", "error", "reason" (error|slow|sampled),
+    "start", "spans"}``. Feed a trace_id to ``state.get_trace`` /
+    ``rtpu trace show`` for the waterfall."""
+    return _runtime("list_traces").list_traces(deployment, min_ms,
+                                               errors_only, limit)
+
+
 def timeline(filename: Optional[str] = None) -> Any:
     """Dump task execution as a chrome-tracing JSON (load in
     chrome://tracing or Perfetto). Returns the event list, and writes it

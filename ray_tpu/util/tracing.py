@@ -1,16 +1,36 @@
-"""Opt-in distributed tracing: spans around task submit/execute with
-context propagated through the task spec.
+"""Distributed tracing: task spans plus a request-scoped serving plane.
 
 Capability parity target: the reference's OpenTelemetry task tracing
 (/root/reference/python/ray/util/tracing/tracing_helper.py — spans
 injected around submit and execute, context carried inside the task
 spec; enabled via ray.init(_tracing_startup_hook)). This deployment has
 no OTel SDK baked in, so spans use the OTel data shape (trace_id,
-span_id, parent_id, name, start/end, attributes) in a process-local
-recorder; worker processes piggyback their spans to the node with the
-metrics flusher plane, and `get_spans()` / `export_chrome_trace()`
-aggregate cluster-wide. `register_exporter` is the hook where a real
-OTLP exporter would plug in.
+span_id, parent_id, name, start/end, attributes, events) in a
+process-local recorder; worker processes piggyback their spans to the
+node with the metrics flusher plane, and `get_spans()` /
+`export_chrome_trace()` aggregate cluster-wide. `register_exporter` is
+the hook where a real OTLP exporter would plug in.
+
+Two planes share this module:
+
+  * **task plane** (opt-in, `enable_tracing()`): spans around task
+    submit/execute, context propagated through the task spec across any
+    number of hops. Rides the worker metrics flusher into the node's
+    ``spans`` state table.
+  * **request plane** (always on, ``kind="request"``): every serving
+    request gets a root span at the proxy (honoring an inbound W3C
+    ``traceparent`` header) whose context flows handle → replica →
+    batcher → LLM engine, producing a per-request waterfall
+    (proxy_queue → replica_queue → batch_wait → prefill → decode
+    steps) with TTFT/last-token events. Request spans ride the
+    heartbeat plane into the head's ``TraceStore``, where TAIL-BASED
+    sampling decides retention (errors + slowest p% always kept) —
+    so the per-request cost here stays in the tens of microseconds
+    and the sampling decision can see the whole trace.
+
+Span IDs come from a seeded os.urandom prefix + counter rather than
+uuid4 (two uuid4 calls per span dominate the sampled-out hot path; the
+perf gate in tests/test_perf_gate.py enforces the budget).
 """
 
 from __future__ import annotations
@@ -19,7 +39,6 @@ import contextvars
 import os
 import threading
 import time
-import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 import collections
@@ -32,6 +51,11 @@ _MAX_SPANS = 10_000
 _spans: collections.deque = collections.deque(maxlen=_MAX_SPANS)
 # Spans evicted by the ring on overflow (this process, since start).
 _spans_dropped = 0
+# Request-plane spans: separate ring so the always-on serving path
+# never competes with (or leaks into) the opt-in task plane. Drained by
+# the worker 1s flusher / node heartbeat toward the head's TraceStore.
+_request_spans: collections.deque = collections.deque(maxlen=_MAX_SPANS)
+_request_spans_dropped = 0
 _exporters: List[Callable[[dict], None]] = []
 
 # The active span context in this thread/task ({"trace_id", "span_id"}).
@@ -47,6 +71,16 @@ def enable_tracing() -> None:
     os.environ["RT_TRACING"] = "1"
 
 
+def disable_tracing() -> None:
+    """Undo ``enable_tracing()``: recording off in this process AND the
+    RT_TRACING env var cleared so later-spawned workers don't inherit
+    it. (In-process test suites flip tracing per-test; without this the
+    env var leaks across tests.)"""
+    global _enabled
+    _enabled = False
+    os.environ.pop("RT_TRACING", None)
+
+
 def tracing_enabled() -> bool:
     return _enabled or os.environ.get("RT_TRACING") == "1"
 
@@ -54,6 +88,14 @@ def tracing_enabled() -> bool:
 def register_exporter(fn: Callable[[dict], None]) -> None:
     """fn(span) is called for every finished span (OTLP bridge point)."""
     _exporters.append(fn)
+
+
+def unregister_exporter(fn: Callable[[dict], None]) -> None:
+    """Remove a previously registered exporter (no-op if absent)."""
+    try:
+        _exporters.remove(fn)
+    except ValueError:
+        pass
 
 
 def should_trace() -> bool:
@@ -64,12 +106,79 @@ def should_trace() -> bool:
     return tracing_enabled() or current_context.get() is not None
 
 
+# ---------------------------------------------------------------------------
+# Span IDs: seeded-prefix + counter (uuid4 costs ~2us a call and the
+# request plane burns two IDs per root span on EVERY request, sampled
+# or not). A per-process random prefix from os.urandom plus a counter
+# gives unique, cheap IDs; the pid check reseeds after fork.
+# ---------------------------------------------------------------------------
+_id_lock = threading.Lock()
+_id_pid: Optional[int] = None
+_id_prefix = ""
+_id_counter = 0
+
+
+def _next_id() -> tuple:
+    global _id_pid, _id_prefix, _id_counter
+    with _id_lock:
+        pid = os.getpid()
+        if pid != _id_pid:
+            _id_pid = pid
+            _id_prefix = os.urandom(8).hex()
+            _id_counter = int.from_bytes(os.urandom(4), "big")
+        _id_counter += 1
+        return _id_prefix, _id_counter
+
+
+def new_trace_id() -> str:
+    """32 hex chars: 16 random (per-process) + 16 counter."""
+    prefix, c = _next_id()
+    return prefix + format(c & 0xFFFFFFFFFFFFFFFF, "016x")
+
+
+def new_span_id() -> str:
+    """16 hex chars: 8 random (per-process) + 8 counter."""
+    prefix, c = _next_id()
+    return prefix[:8] + format(c & 0xFFFFFFFF, "08x")
+
+
+# ---------------------------------------------------------------------------
+# W3C trace-context interop: the proxies honor an inbound traceparent
+# header so an external OTel-instrumented caller sees one connected
+# trace; format_traceparent lets responses/tools hand the id back out.
+# ---------------------------------------------------------------------------
+def parse_traceparent(header: Optional[str]) -> Optional[dict]:
+    """``00-<32 hex trace-id>-<16 hex span-id>-<flags>`` -> context
+    dict, or None for anything malformed (never raises)."""
+    if not header:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+        return None
+    try:
+        int(parts[1], 16)
+        int(parts[2], 16)
+    except ValueError:
+        return None
+    if parts[1] == "0" * 32 or parts[2] == "0" * 16:
+        return None
+    return {"trace_id": parts[1].lower(), "span_id": parts[2].lower()}
+
+
+def format_traceparent(ctx: dict) -> str:
+    return f"00-{ctx['trace_id']}-{ctx['span_id']}-01"
+
+
 def _record(span: dict) -> None:
-    global _spans_dropped
+    global _spans_dropped, _request_spans_dropped
+    ring = _request_spans if span.get("kind") == "request" else _spans
     with _lock:
-        if len(_spans) == _MAX_SPANS:
-            _spans_dropped += 1  # deque evicts the oldest silently
-        _spans.append(span)
+        if len(ring) == _MAX_SPANS:
+            if ring is _spans:
+                _spans_dropped += 1  # deque evicts the oldest silently
+            else:
+                _request_spans_dropped += 1
+        ring.append(span)
     for fn in _exporters:
         try:
             fn(span)
@@ -105,18 +214,23 @@ class task_span:
 
 class span:
     """Context manager recording one span; nests under the thread's
-    current context and becomes the context inside the block."""
+    current context and becomes the context inside the block.
+    ``kind="request"`` routes the finished span to the request-plane
+    ring (always recorded; the head's tail sampler decides retention).
+    """
 
     def __init__(self, name: str, attributes: Optional[dict] = None,
-                 ctx: Optional[dict] = None):
+                 ctx: Optional[dict] = None, kind: str = "task"):
         self.name = name
         self.attributes = dict(attributes or {})
+        self.kind = kind
+        self.events: List[dict] = []
         self._ctx_in = ctx
 
     def __enter__(self):
         parent = self._ctx_in or current_context.get()
-        self.trace_id = (parent or {}).get("trace_id") or uuid.uuid4().hex
-        self.span_id = uuid.uuid4().hex[:16]
+        self.trace_id = (parent or {}).get("trace_id") or new_trace_id()
+        self.span_id = new_span_id()
         self.parent_id = (parent or {}).get("span_id")
         self.start = time.time()
         # Durations come off the monotonic clock: a wall-clock step
@@ -130,25 +244,68 @@ class span:
     def context(self) -> dict:
         return {"trace_id": self.trace_id, "span_id": self.span_id}
 
+    def add_event(self, name: str, **attrs) -> None:
+        """Timestamped point annotation on this span (TTFT, last token,
+        preemption...) — the OTel span-event shape."""
+        ev = {"name": name, "ts": time.time()}
+        if attrs:
+            ev.update(attrs)
+        self.events.append(ev)
+
     def __exit__(self, exc_type, exc, tb):
         current_context.reset(self._token)
         if exc_type is not None:
             self.attributes["error"] = f"{exc_type.__name__}: {exc}"
-        _record({
+        rec = {
             "name": self.name, "trace_id": self.trace_id,
             "span_id": self.span_id, "parent_id": self.parent_id,
             "start": self.start,
             "end": self.start + (time.monotonic() - self._mono),
             "pid": os.getpid(), "attributes": self.attributes,
-        })
+        }
+        if self.kind != "task":
+            rec["kind"] = self.kind
+        if self.events:
+            rec["events"] = self.events
+        _record(rec)
         return False
+
+
+def emit(name: str, ctx: Optional[dict], start: float, duration: float,
+         attributes: Optional[dict] = None,
+         events: Optional[List[dict]] = None,
+         kind: str = "request") -> Optional[dict]:
+    """Record a RETROACTIVE span for an interval measured elsewhere
+    (replica_queue from a submit timestamp, batch_wait from the parked
+    duration...). Parented to ``ctx``; no-op (returns None) without a
+    trace context so un-traced paths pay nothing."""
+    if not ctx or not ctx.get("trace_id"):
+        return None
+    rec = {
+        "name": name, "trace_id": ctx["trace_id"],
+        "span_id": new_span_id(), "parent_id": ctx.get("span_id"),
+        "start": start, "end": start + max(0.0, duration),
+        "pid": os.getpid(), "attributes": dict(attributes or {}),
+        "kind": kind,
+    }
+    if events:
+        rec["events"] = list(events)
+    _record(rec)
+    return rec
 
 
 def span_stats() -> Dict[str, int]:
     """{"recorded": spans currently buffered, "dropped": spans evicted
-    from this process's ring since start}."""
+    from this process's ring since start} — task plane."""
     with _lock:
         return {"recorded": len(_spans), "dropped": _spans_dropped}
+
+
+def request_span_stats() -> Dict[str, int]:
+    """Same counters for the request-plane ring."""
+    with _lock:
+        return {"recorded": len(_request_spans),
+                "dropped": _request_spans_dropped}
 
 
 def local_spans() -> List[dict]:
@@ -160,6 +317,20 @@ def drain_local_spans() -> List[dict]:
     with _lock:
         out = list(_spans)
         _spans.clear()
+    return out
+
+
+def local_request_spans() -> List[dict]:
+    with _lock:
+        return list(_request_spans)
+
+
+def drain_request_spans() -> List[dict]:
+    """Atomically take the buffered request spans (worker flusher /
+    node heartbeat call this to ship them toward the head)."""
+    with _lock:
+        out = list(_request_spans)
+        _request_spans.clear()
     return out
 
 
@@ -190,21 +361,111 @@ def get_spans(with_stats: bool = False):
     return out
 
 
-def export_chrome_trace(filename: str) -> int:
+def _span_events(spans: List[dict]) -> List[dict]:
+    """Chrome-trace slices ("X") + instant markers ("i") for a span
+    list: rows keyed by trace, span events (TTFT...) as instants."""
+    events = []
+    for s in spans:
+        events.append({
+            "name": s["name"], "cat": s.get("kind", "span"), "ph": "X",
+            "ts": s["start"] * 1e6,
+            "dur": max(0.0, s["end"] - s["start"]) * 1e6,
+            "pid": s.get("pid", 0), "tid": s["trace_id"][:8],
+            "args": {**s.get("attributes", {}), "trace_id": s["trace_id"],
+                     "span_id": s["span_id"],
+                     "parent_id": s.get("parent_id")},
+        })
+        for ev in s.get("events", []) or []:
+            events.append({
+                "name": f"{s['name']}:{ev.get('name', '?')}",
+                "cat": "event", "ph": "i", "s": "t",
+                "ts": ev.get("ts", s["start"]) * 1e6,
+                "pid": s.get("pid", 0), "tid": s["trace_id"][:8],
+                "args": {k: v for k, v in ev.items()
+                         if k not in ("name", "ts")},
+            })
+    return events
+
+
+def render_waterfall(spans: List[dict], width: int = 56) -> str:
+    """ASCII waterfall of one trace: spans as horizontal bars on a
+    shared time axis, indented by parent/child depth, span events
+    (ttft, last_token...) as ``^`` markers. The ``rtpu trace show``
+    view; also handy in tests and notebooks."""
+    if not spans:
+        return "(empty trace)\n"
+    spans = sorted(spans, key=lambda s: s.get("start", 0.0))
+    t0 = min(s["start"] for s in spans)
+    t1 = max(s["end"] for s in spans)
+    total = max(t1 - t0, 1e-9)
+    by_id = {s.get("span_id"): s for s in spans}
+    children: Dict[str, List[dict]] = {}
+    roots = []
+    for s in spans:
+        pid = s.get("parent_id")
+        if pid and pid in by_id:
+            children.setdefault(pid, []).append(s)
+        else:
+            roots.append(s)
+    lines = [f"trace {spans[0].get('trace_id', '?')}  "
+             f"{total * 1e3:.1f} ms  {len(spans)} spans"]
+
+    def bar_line(label: str, off: int, ln: int, suffix: str):
+        off = min(max(0, off), width - 1)
+        ln = max(1, min(ln, width - off))
+        bar = " " * off + "#" * ln
+        lines.append(f"{label:<30.30} |{bar:<{width}}|{suffix}")
+
+    def walk(s: dict, depth: int):
+        dur = max(0.0, s["end"] - s["start"])
+        label = "  " * depth + s.get("name", "?")
+        err = "  ERROR" if "error" in (s.get("attributes") or {}) else ""
+        bar_line(label, int((s["start"] - t0) / total * width),
+                 int(dur / total * width), f" {dur * 1e3:9.2f} ms{err}")
+        for ev in s.get("events") or ():
+            off = min(max(0, int((ev.get("ts", s["start"]) - t0)
+                                 / total * width)), width - 1)
+            mark = " " * off + "^"
+            lines.append(f"{'  ' * depth + '` ' + ev.get('name', '?'):<30.30}"
+                         f" |{mark:<{width}}|")
+        for c in sorted(children.get(s.get("span_id"), ()),
+                        key=lambda x: x.get("start", 0.0)):
+            walk(c, depth + 1)
+
+    for r in roots:
+        walk(r, 0)
+    return "\n".join(lines) + "\n"
+
+
+def export_chrome_trace(filename: str,
+                        trace_id: Optional[str] = None) -> int:
     """Spans AND task-lifecycle slices as one chrome://tracing stream:
     span rows keyed by trace, task rows (with ``name::phase``
     sub-slices) keyed by node/worker lane — the merged view the
-    reference's ``ray timeline`` + OTel exporters provide separately."""
+    reference's ``ray timeline`` + OTel exporters provide separately.
+
+    With ``trace_id=...`` exports just that request's waterfall: the
+    spans come from the head's TraceStore (falling back to any locally
+    buffered spans of that trace), no task slices mixed in."""
     import json
 
-    spans = get_spans()
-    events = [{
-        "name": s["name"], "cat": "span", "ph": "X",
-        "ts": s["start"] * 1e6, "dur": max(0.0, s["end"] - s["start"]) * 1e6,
-        "pid": s.get("pid", 0), "tid": s["trace_id"][:8],
-        "args": {**s.get("attributes", {}), "trace_id": s["trace_id"],
-                 "span_id": s["span_id"], "parent_id": s.get("parent_id")},
-    } for s in spans]
+    if trace_id is not None:
+        spans = None
+        try:
+            from . import state as _state
+
+            spans = _state.get_trace(trace_id)
+        except Exception:
+            spans = None
+        if not spans:
+            spans = [s for s in local_request_spans()
+                     if s.get("trace_id") == trace_id]
+        events = _span_events(spans or [])
+        with open(filename, "w") as f:
+            json.dump(events, f)
+        return len(events)
+
+    events = _span_events(get_spans())
     try:
         from . import state as _state
 

@@ -75,6 +75,24 @@ def rt():
     ray_tpu.shutdown()
 
 
+@pytest.fixture
+def traced(rt):
+    """Task-plane tracing on for one test, undone with the symmetric
+    API (enable/disable + register/unregister) instead of hand-popping
+    RT_TRACING and poking tracing._enabled."""
+    from ray_tpu.util import tracing
+
+    exported = []
+    tracing.enable_tracing()
+    tracing.register_exporter(exported.append)
+    tracing.drain_local_spans()
+    yield rt
+    tracing.unregister_exporter(exported.append)
+    tracing.disable_tracing()
+    tracing.drain_local_spans()
+    tracing.drain_request_spans()
+
+
 @pytest.fixture(scope="session")
 def shared_rt():
     """A session-scoped runtime for cheap read-only tests."""

@@ -254,6 +254,93 @@ def test_every_queue_mutation_site_updates_its_gauge():
         f"the telemetry gauges (self._gauge_queues): {missing}")
 
 
+# ---------------------------------------------------------------------------
+# 1c. Request-trace propagation lint
+# ---------------------------------------------------------------------------
+def _funcs_missing_name(path: Path, funcs, name: str) -> list:
+    """Entries from ``funcs`` ("func" or "Class.method") whose body in
+    ``path`` never references identifier ``name`` (bare name,
+    attribute, parameter, or keyword argument) — including functions
+    that no longer exist (a rename silently dropping the propagation
+    is exactly the bug class)."""
+    tree = ast.parse(path.read_text())
+
+    def refs(node) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and n.id == name:
+                return True
+            if isinstance(n, ast.Attribute) and n.attr == name:
+                return True
+            if isinstance(n, ast.keyword) and n.arg == name:
+                return True
+            if isinstance(n, ast.arg) and n.arg == name:
+                return True
+        return False
+
+    found: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for ch in node.body:
+                if isinstance(ch, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                    key = f"{node.name}.{ch.name}"
+                    if key in funcs:
+                        found[key] = found.get(key, False) or refs(ch)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in funcs:
+                found[node.name] = (found.get(node.name, False)
+                                    or refs(node))
+    return [f for f in funcs if not found.get(f, False)]
+
+
+# Every hop that forwards a serving request must forward its trace
+# context too, or the waterfall silently breaks at that hop: the proxy's
+# executor handoff (contextvars do NOT cross run_in_executor without
+# copy_context), the handle submit + its replica-death retry, the
+# replica entry, the batcher's collect + execute, and the engine ingest.
+_TRACE_PROPAGATION_SITES = (
+    ("ray_tpu/serve/http_proxy.py", "HTTPProxy._handle_routed",
+     "copy_context"),
+    ("ray_tpu/serve/deployment.py", "DeploymentHandle.remote",
+     "trace_ctx"),
+    ("ray_tpu/serve/deployment.py", "DeploymentResponse.result",
+     "trace_ctx"),
+    ("ray_tpu/serve/replica.py", "Replica.handle_request",
+     "trace_ctx"),
+    ("ray_tpu/serve/batching.py", "_Pending.__init__", "trace_ctx"),
+    ("ray_tpu/serve/batching.py", "_Batcher._run_batch", "trace_ctx"),
+    ("ray_tpu/llm/engine.py", "LLMEngine.add_request", "trace_ctx"),
+    ("ray_tpu/serve/llm.py", "_LLMServer.__call__", "trace_ctx"),
+)
+
+
+def test_every_request_hop_forwards_trace_context():
+    missing = []
+    for rel, func, ident in _TRACE_PROPAGATION_SITES:
+        missing += [f"{rel}:{f} (no {ident})" for f in
+                    _funcs_missing_name(REPO / rel, (func,), ident)]
+    assert not missing, (
+        f"request-forwarding hop(s) drop the trace context — the "
+        f"waterfall breaks at that hop: {missing}")
+
+
+def test_trace_lint_catches_a_dropping_hop(tmp_path):
+    """The net itself is live: a forwarding method that drops the
+    context is flagged, one that carries it is not, and a REMOVED
+    method is flagged."""
+    src = tmp_path / "hop.py"
+    src.write_text(
+        "class H:\n"
+        "    def good(self, req, trace_ctx=None):\n"
+        "        return self.next(req, trace_ctx)\n"
+        "    def drops(self, req):\n"
+        "        return self.next(req)\n")
+    assert _funcs_missing_name(src, ("H.good",), "trace_ctx") == []
+    assert _funcs_missing_name(
+        src, ("H.good", "H.drops", "H.gone"), "trace_ctx") == [
+        "H.drops", "H.gone"]
+
+
 def test_event_lint_catches_a_silent_site(tmp_path):
     """The net itself is live: a transition method without an emit is
     flagged, one with it is not, and a REMOVED method is flagged."""

@@ -185,6 +185,43 @@ def test_telemetry_sampler_overhead_gate():
         f"per sample > budget {budget * 1e6:.1f}us (calibration {cal:.2f})")
 
 
+def test_request_span_overhead_gate():
+    """The request-tracing hot path runs on EVERY serving request,
+    sampled or not (tail sampling is a head-side decision): one root
+    span enter/exit with an event plus two retro emits must stay well
+    under 50us at calibration 1.0 (~5-15us observed solo). A
+    regression — say span IDs going back to uuid4, or recording
+    growing a lock-heavy stage — fails loudly here before it taxes
+    every request."""
+    from ray_tpu.util import tracing
+
+    cal = _calibrate()
+    t_wall = time.time()
+    n = 2000
+    # Warm the id-prefix seed + ring out of the measured region.
+    with tracing.span("warm", kind="request"):
+        pass
+    tracing.drain_request_spans()
+    t0 = time.perf_counter()
+    for i in range(n):
+        with tracing.span("serve.request", kind="request",
+                          attributes={"deployment": "gate"}) as root:
+            tracing.emit("serve.proxy_queue", root.context(), t_wall,
+                         1e-4, {"deployment": "gate"})
+            tracing.emit("serve.replica_queue", root.context(), t_wall,
+                         1e-4, {"deployment": "gate"})
+            root.add_event("ttft", ms=1.0)
+        if i % 500 == 0:
+            tracing.drain_request_spans()  # steady-state ring, not full
+    per_request = (time.perf_counter() - t0) / n
+    tracing.drain_request_spans()
+    budget = 50e-6 / cal
+    assert per_request < budget, (
+        f"request-span hot path regressed: {per_request * 1e6:.1f}us "
+        f"per request > budget {budget * 1e6:.1f}us "
+        f"(calibration {cal:.2f})")
+
+
 def test_solo_cross_node_fetch_gate():
     cal = _calibrate()
     os.environ["RT_MB_FETCH_MB"] = "16"

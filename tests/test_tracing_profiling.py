@@ -14,14 +14,8 @@ import ray_tpu
 from ray_tpu.util import tracing
 
 
-@pytest.fixture
-def traced(rt):
-    tracing.enable_tracing()
-    tracing.drain_local_spans()
-    yield rt
-    os.environ.pop("RT_TRACING", None)
-    tracing._enabled = False
-    tracing.drain_local_spans()
+# The ``traced`` fixture (conftest.py) brackets each test with
+# enable_tracing()/disable_tracing() + register/unregister_exporter.
 
 
 def test_span_nesting_and_context():

@@ -189,6 +189,34 @@ class TestMetrics:
         for line in text.splitlines():
             assert not line.endswith("\\")
 
+    def test_exemplar_trace_id_escaping(self, rt):
+        """The serve-exemplar section renders trace_ids through the
+        same label escaping as every other label: an id holding a
+        backslash, a double quote, AND a newline round-trips through
+        the exposition instead of corrupting the page."""
+        import re
+
+        from ray_tpu.serve import slo
+
+        slo._reset_for_tests()
+        try:
+            hostile = 'id\\with"all\nthree'
+            slo.record_phase("execute", 0.25, deployment="exdep",
+                             trace_id=hostile)
+            text = prometheus_text()
+            assert ('rtpu_serve_exemplar_ms{deployment="exdep",'
+                    'phase="execute",'
+                    'trace_id="id\\\\with\\"all\\nthree"} 250.0'
+                    in text)
+            m = re.search(r'trace_id="((?:[^"\\]|\\.)*)"', text)
+            raw = re.sub(r"\\(.)",
+                         lambda g: {"n": "\n"}.get(g.group(1),
+                                                   g.group(1)),
+                         m.group(1))
+            assert raw == hostile
+        finally:
+            slo._reset_for_tests()
+
     def test_telemetry_latest_export(self, rt):
         import time as _time
 
