@@ -113,8 +113,14 @@ def run_bench(use_flash: bool) -> dict:
     dt = time.perf_counter() - t0
     tokens_per_sec = iters / dt * batch * (seq - 1)
     per_chip = tokens_per_sec / n_chips
-    peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak per chip
-    mfu = tokens_per_sec * cfg.flops_per_token() / (n_chips * peak)
+    # Shared cost model (util/perfmodel.py): the same peak table and
+    # 6N-rule FLOPs the live llm_mfu/train_mfu telemetry series price
+    # against, so bench MFU and the continuous series can never diverge.
+    from ray_tpu.util import perfmodel
+
+    peak = perfmodel.peak_flops(on_tpu)
+    mfu = (tokens_per_sec * perfmodel.train_flops_per_token(cfg)
+           / (n_chips * peak))
     print(
         f"cfg: {cfg.num_params()/1e6:.0f}M params flash={cfg.use_flash} "
         f"batch={batch} seq={seq} mesh={spec.shape} "
@@ -257,6 +263,18 @@ def profile_ops(cfg, mesh, batch, step, state, tokens,
 
     table = {k: round(v, 2) for k, v in table.items()}
     table["whole_step_ms"] = round(step_ms_ref, 2)
+    # Roofline verdict at the measured whole-step time, priced by the
+    # shared cost model — the same numbers the continuous train_mfu /
+    # train_hbm_util series report, so the offline table and the live
+    # plane agree by construction.
+    from ray_tpu.util import perfmodel
+
+    rl = perfmodel.roofline(
+        perfmodel.train_step_cost(cfg, tokens.shape[0], cfg.max_seq),
+        step_ms_ref / 1e3, hw=perfmodel.detect_hardware())
+    table["model_mfu_at_whole_step"] = round(rl["mfu"], 4)
+    table["model_hbm_util_at_whole_step"] = round(rl["hbm_util"], 4)
+    table["roofline_verdict"] = rl["verdict"]
     print(f"per-op table (ms): {json.dumps(table)}", file=sys.stderr)
     return table
 

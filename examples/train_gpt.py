@@ -29,7 +29,10 @@ def train_loop(config):
     params = gpt.init(jax.random.key(0), cfg)
     state = {"params": params, "opt_state": opt.init(params), "step": 0}
     state = gpt.shard_state(state, mesh, cfg)
-    step = gpt.make_train_step(cfg, opt, mesh)
+    # wrap_step: host-vs-device breakdown + MFU ride along with every
+    # report() (train_step_ms / train_device_ms / train_mfu metrics and
+    # the train_*:<trial> telemetry series).
+    step = train.wrap_step(gpt.make_train_step(cfg, opt, mesh), cfg)
 
     key = jax.random.key(train.get_context().world_rank)
     for i in range(config["steps"]):

@@ -2990,6 +2990,15 @@ class NodeService:
             p = payload if isinstance(payload, dict) else {}
             return await self.collect_profile(
                 float(p.get("duration_s", 5.0)), float(p.get("hz", 99.0)))
+        if method == "device_profile":
+            p = payload if isinstance(payload, dict) else {}
+            return await self.collect_device_profile(
+                float(p.get("duration_s", 2.0)), float(p.get("hz", 99.0)))
+        if method == "clock_probe":
+            # Clock-alignment anchor for merged traces: the caller
+            # halves the RTT around this to estimate our wall-clock
+            # offset (NTP-style midpoint).
+            return {"t_wall": time.time()}
         if method == "heap":
             p = payload if isinstance(payload, dict) else {}
             return await self.collect_heap(int(p.get("top_n", 25)))
@@ -3660,6 +3669,41 @@ class NodeService:
                     timeout=duration_s + 10)
             except Exception as e:  # noqa: BLE001 - best effort
                 return {"folded": "", "error": str(e)}
+
+        results = await asyncio.gather(me(), *(ask(w) for w in targets))
+        node = self.node_id.hex()[:8]
+        out = {f"node:{self.node_id.hex()[:12]}": results[0]}
+        for w, prof in zip(targets, results[1:]):
+            out[f"worker:{node}:{w.proc.pid}"] = prof
+        return out
+
+    async def collect_device_profile(self, duration_s: float = 2.0,
+                                     hz: float = 99.0) -> dict:
+        """Device-step capture windows (perfmodel ring + host timeline +
+        best-effort jax.profiler trace) of this node process and every
+        live worker, concurrently — one leg of the gang-coordinated
+        `rtpu profile --device` capture."""
+        from .profiler import device_profile
+
+        loop = self.loop
+
+        async def me():
+            # Off-loop: the capture window sleeps for duration_s.
+            return await loop.run_in_executor(
+                None, lambda: device_profile(duration_s, hz))
+
+        targets = [w for w in self.workers.values()
+                   if w.state in ("IDLE", "BUSY") and w.conn is not None
+                   and w.conn.alive]
+
+        async def ask(w):
+            try:
+                return await asyncio.wait_for(
+                    w.conn.call("device_profile",
+                                {"duration_s": duration_s, "hz": hz}),
+                    timeout=duration_s + 10)
+            except Exception as e:  # noqa: BLE001 - best effort
+                return {"error": str(e)}
 
         results = await asyncio.gather(me(), *(ask(w) for w in targets))
         node = self.node_id.hex()[:8]

@@ -27,15 +27,21 @@ from collections import Counter
 
 
 def sample_profile(duration_s: float = 5.0, hz: float = 99.0,
-                   include_idle: bool = False) -> dict:
+                   include_idle: bool = False,
+                   timeline: bool = False) -> dict:
     """Self-sample every thread of THIS process. Returns
-    {"folded": str, "samples": int, "duration_s": float}."""
+    {"folded": str, "samples": int, "duration_s": float}; with
+    ``timeline=True`` also {"timeline": [[t_wall, leaf_frame], ...]}
+    (bounded) — timestamped leaf frames the merged device-trace export
+    renders as a host-CPU track alongside device events."""
     interval = 1.0 / max(1.0, hz)
     counts: Counter = Counter()
     me = threading.get_ident()
     samples = 0
+    tl: list = []
     deadline = time.monotonic() + duration_s
     while time.monotonic() < deadline:
+        t_wall = time.time()
         for tid, frame in sys._current_frames().items():
             if tid == me:
                 continue
@@ -50,18 +56,24 @@ def sample_profile(duration_s: float = 5.0, hz: float = 99.0,
             if not stack:
                 continue
             folded = ";".join(reversed(stack))
-            if not include_idle and (
+            idle = not include_idle and (
                     "wait (threading.py" in stack[0]
                     or "select (selectors.py" in stack[0]
                     or "_recv (" in stack[0]
-                    or "accept (socket.py" in stack[0]):
+                    or "accept (socket.py" in stack[0])
+            if idle:
                 folded = "[idle];" + folded
             counts[folded] += 1
+            if timeline and not idle and len(tl) < 4000:
+                tl.append([t_wall, stack[0]])
         samples += 1
         time.sleep(interval)
     lines = [f"{k} {v}" for k, v in counts.most_common()]
-    return {"folded": "\n".join(lines), "samples": samples,
-            "duration_s": duration_s}
+    out = {"folded": "\n".join(lines), "samples": samples,
+           "duration_s": duration_s}
+    if timeline:
+        out["timeline"] = tl
+    return out
 
 
 def merge_folded(parts: list[str]) -> str:
@@ -144,6 +156,223 @@ def render_flamegraph_svg(folded: str, title: str = "rtpu flamegraph",
             f'height="{height}" viewBox="0 0 {width} {height}" '
             f'style="background:#faf9f5">{header}'
             + "".join(rects) + "</svg>")
+
+
+# ---------------------------------------------------------------------------
+# Gang-coordinated device capture (the `rtpu profile --device` unit)
+# ---------------------------------------------------------------------------
+# Each process answers a ``device_profile`` RPC with three layers for
+# the window:
+#   * device_steps — the deterministic spine: every accounted engine /
+#     train step from the perfmodel ring (name, wall time, device/host
+#     split, MFU, verdict). Always present, backend or not.
+#   * host.timeline — sampling-profiler leaf frames with timestamps
+#     (what the host was doing between device spans) + folded stacks.
+#   * jax_trace — raw Chrome events from a ``jax.profiler`` trace
+#     session when the backend supports it (best-effort: interpret-mode
+#     CPU runs and jax-less workers degrade to the layers above).
+# The driver merges windows from every process into one Chrome/Perfetto
+# export, aligning each host's wall clock by RPC-measured RTT offsets.
+
+_MAX_JAX_EVENTS = 20000
+
+
+def _collect_jax_trace(tmpdir: str) -> dict:
+    """Locate + parse the Chrome-format artifact a jax.profiler trace
+    session left under ``tmpdir`` (perfetto_trace.json.gz or
+    *.trace.json.gz). Returns {"events": [...]} or {"error": ...}."""
+    import glob
+    import gzip
+    import json as _json
+    import os
+
+    paths = sorted(
+        glob.glob(os.path.join(tmpdir, "**", "*.json.gz"), recursive=True),
+        key=lambda p: ("perfetto" not in p, p))
+    for path in paths:
+        try:
+            with gzip.open(path, "rt") as f:
+                data = _json.load(f)
+        except Exception:  # noqa: BLE001 - partial/foreign artifact
+            continue
+        events = (data.get("traceEvents", [])
+                  if isinstance(data, dict) else data)
+        if isinstance(events, list):
+            return {"events": events[:_MAX_JAX_EVENTS],
+                    "file": os.path.basename(path)}
+    return {"error": "no chrome-format trace artifact produced"}
+
+
+def _start_xla_trace():
+    """An XLA profiler session with the PYTHON tracer OFF. The default
+    python tracer (PEP 523 eval hook) permanently hides threads that
+    were alive during the session from ``sys._current_frames()`` —
+    which would blind the host sampling profiler (`rtpu stack --flame`,
+    the ``profile`` RPC) for the rest of the worker's life after one
+    device capture. We carry our own host timeline anyway, so only the
+    C++ host/device tracers run. Returns the session or raises."""
+    from jax._src import xla_bridge
+    from jax._src.lib import xla_client
+
+    xla_bridge.get_backend()  # libtpu must init before the tracer
+    opts = xla_client.profiler.ProfileOptions()
+    opts.python_tracer_level = 0
+    return xla_client.profiler.ProfilerSession(opts)
+
+
+def device_profile(duration_s: float = 2.0, hz: float = 99.0,
+                   include_jax: bool = True) -> dict:
+    """One capture window for THIS process: start an XLA profiler trace
+    session, run the host sampling profiler for the window, stop the
+    trace, and return all three layers plus the process's wall clock at
+    the window edges (the driver's clock-alignment anchors)."""
+    import shutil
+    import tempfile
+
+    from ray_tpu.util import perfmodel
+
+    t0_wall = time.time()
+    sess = None
+    jax_err = None
+    if include_jax:
+        try:
+            sess = _start_xla_trace()
+        except Exception as e:  # noqa: BLE001 - capture must not kill
+            jax_err = f"xla trace unavailable: {e!r}"
+    host = sample_profile(duration_s, hz, timeline=True)
+    jax_trace: dict = {"error": jax_err or "jax trace disabled"}
+    if sess is not None:
+        tmpdir = tempfile.mkdtemp(prefix="rtpu-devprof-")
+        try:
+            sess.export(sess.stop(), tmpdir)
+            from jax._src.profiler import _write_perfetto_trace_file
+
+            _write_perfetto_trace_file(tmpdir)
+            jax_trace = _collect_jax_trace(tmpdir)
+        except Exception as e:  # noqa: BLE001
+            jax_trace = {"error": f"trace export failed: {e!r}"}
+        finally:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+    return {
+        "t0_wall": t0_wall,
+        "t1_wall": time.time(),
+        "host": host,
+        "device_steps": perfmodel.device_step_events(since=t0_wall - 1.0),
+        "jax_trace": jax_trace,
+    }
+
+
+def build_merged_trace(profiles: dict, offsets: dict | None = None,
+                       spans: list | None = None) -> dict:
+    """One Chrome/Perfetto trace from per-process capture windows.
+
+    ``profiles``: {source_key: device_profile() result} as returned by
+    cluster_device_profile (keys ``node:<id12>`` / ``worker:<node8>:<pid>``).
+    ``offsets``: {node8_or_node12_prefix: seconds} to ADD to a host's
+    wall timestamps to land on the driver's clock (from
+    Runtime.clock_offsets(), RTT-midpoint estimates). ``spans``: request
+    spans (tracing-ring dicts with start/duration/name/trace_id) merged
+    onto their own track.
+
+    Tracks per process: ``device-steps`` (accounted engine/train steps,
+    colored by roofline verdict), ``host-cpu`` (sampling-profiler leaf
+    frames), and the raw jax trace events re-based onto the aligned
+    clock. Times are Chrome-trace microseconds."""
+    offsets = offsets or {}
+    events: list = []
+    pids: dict = {}
+
+    def pid_for(source: str) -> int:
+        if source not in pids:
+            pids[source] = len(pids) + 1
+            events.append({"ph": "M", "pid": pids[source], "tid": 0,
+                           "name": "process_name",
+                           "args": {"name": source}})
+        return pids[source]
+
+    def offset_for(source: str) -> float:
+        # source keys carry the node id prefix: node:<id12> or
+        # worker:<node8>:<pid> — match either prefix length.
+        for key, off in offsets.items():
+            if key and key in source:
+                return off
+        return 0.0
+
+    for source, prof in sorted(profiles.items()):
+        if not isinstance(prof, dict) or "t0_wall" not in prof:
+            continue
+        pid = pid_for(source)
+        shift_us = offset_for(source) * 1e6
+
+        for ev in prof.get("device_steps", []):
+            dur_ms = float(ev.get("step_ms", 0.0))
+            dev_ms = float(ev.get("device_ms", 0.0))
+            t_us = ev["t_wall"] * 1e6 + shift_us
+            args = {k: v for k, v in ev.items()
+                    if k not in ("name", "t_wall")}
+            events.append({"ph": "X", "pid": pid, "tid": 1,
+                           "name": ev.get("name", "step"),
+                           "ts": t_us, "dur": max(dur_ms * 1e3, 1.0),
+                           "args": args,
+                           "cname": {"host": "terrible_input_latency",
+                                     "hbm": "thread_state_iowait",
+                                     }.get(ev.get("verdict"),
+                                           "thread_state_running")})
+            if 0.0 < dev_ms < dur_ms:
+                events.append({"ph": "X", "pid": pid, "tid": 1,
+                               "name": "device", "ts": t_us,
+                               "dur": dev_ms * 1e3,
+                               "args": {"device_ms": dev_ms}})
+        host = prof.get("host", {})
+        tl = host.get("timeline", [])
+        # Leaf-frame samples render as fixed-width slices at the sample
+        # cadence — a poor man's timeline flamegraph next to the device
+        # track.
+        interval_us = (prof["t1_wall"] - prof["t0_wall"]) * 1e6 \
+            / max(len(tl), 1)
+        for t_wall, leaf in tl:
+            events.append({"ph": "X", "pid": pid, "tid": 2,
+                           "name": leaf, "ts": t_wall * 1e6 + shift_us,
+                           "dur": max(min(interval_us, 20000.0), 1.0)})
+        events.append({"ph": "M", "pid": pid, "tid": 1,
+                       "name": "thread_name",
+                       "args": {"name": "device-steps"}})
+        events.append({"ph": "M", "pid": pid, "tid": 2,
+                       "name": "thread_name",
+                       "args": {"name": "host-cpu"}})
+        for ev in prof.get("jax_trace", {}).get("events", []):
+            if not isinstance(ev, dict):
+                continue
+            ev = dict(ev)
+            # Re-namespace jax pids under this process and shift onto
+            # the aligned clock.
+            ev["pid"] = pid * 1000 + int(ev.get("pid", 0)) % 1000
+            if "ts" in ev:
+                ev["ts"] = float(ev["ts"]) + shift_us
+            events.append(ev)
+
+    if spans:
+        pid = pid_for("requests")
+        tids: dict = {}
+        for sp in spans:
+            trace = sp.get("trace_id", "?")[:8]
+            if trace not in tids:
+                tids[trace] = len(tids) + 1
+                events.append({"ph": "M", "pid": pid, "tid": tids[trace],
+                               "name": "thread_name",
+                               "args": {"name": f"trace {trace}"}})
+            start = float(sp.get("start", 0.0))
+            dur_s = float(sp.get("duration",
+                                 float(sp.get("end", start)) - start))
+            events.append({
+                "ph": "X", "pid": pid, "tid": tids[trace],
+                "name": sp.get("name", "span"),
+                "ts": start * 1e6,
+                "dur": max(dur_s * 1e6, 1.0),
+                "args": dict(sp.get("attributes") or {},
+                             trace_id=sp.get("trace_id")),
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
 # ---------------------------------------------------------------------------

@@ -787,6 +787,51 @@ class Runtime:
             lambda: self.node.collect_profile(duration_s, hz),
             max(timeout, duration_s + 15))
 
+    def cluster_device_profile(self, duration_s: float = 2.0,
+                               hz: float = 99.0,
+                               timeout: float = 60.0) -> dict:
+        """Gang-coordinated device-step capture cluster-wide: every node
+        + worker records one window of accounted device steps (perfmodel
+        ring), a host-CPU sample timeline, and a best-effort
+        jax.profiler trace. Merge with profiler.build_merged_trace /
+        `rtpu profile --device`."""
+        payload = {"duration_s": duration_s, "hz": hz}
+        return self._node_fanout(
+            "device_profile", payload,
+            lambda: self.node.collect_device_profile(duration_s, hz),
+            max(timeout, duration_s + 15))
+
+    def clock_offsets(self, timeout: float = 5.0) -> dict:
+        """Per-node wall-clock offset estimates relative to THIS
+        process, keyed by node-id prefix (12 hex chars, matching the
+        node: keys of the capture dicts). NTP-style midpoint: offset =
+        (t_send + t_recv)/2 - peer_time, so a peer timestamp PLUS its
+        offset lands on our clock. The local node's offset is 0 by
+        construction."""
+        import time as _time
+
+        async def probe(n):
+            nid = n["node_id"].hex()[:12]
+            if tuple(n["address"]) == tuple(self.node.peer_address):
+                return nid, 0.0
+            try:
+                conn = await self.node._addr_conn(tuple(n["address"]))
+                t0 = _time.time()
+                out = await asyncio.wait_for(
+                    conn.call("clock_probe", None), timeout)
+                t1 = _time.time()
+                return nid, (t0 + t1) / 2 - float(out["t_wall"])
+            except Exception:  # noqa: BLE001 - best effort
+                return nid, 0.0
+
+        async def gather():
+            nodes = await self.head_client().list_nodes()
+            pairs = await asyncio.gather(
+                *(probe(n) for n in nodes if n["state"] == "ALIVE"))
+            return dict(pairs)
+
+        return self._run(gather(), timeout=timeout + 5)
+
     def cluster_heap(self, top_n: int = 25, timeout: float = 30.0) -> dict:
         """tracemalloc heap snapshots cluster-wide (reference: memray
         heap profiles from the dashboard agent)."""

@@ -255,24 +255,53 @@ class TelemetrySampler:
 
         return {"ts": time.time(), "metrics": m}
 
-    # Generation-engine gauges (serve/llm.py replicas): metric name ->
-    # (series prefix, cross-replica reduction). Rates and batch sizes
-    # sum over replicas; pool utilization takes the hottest replica.
+    # Generation-engine + train-lane gauges: metric name ->
+    # (series prefix, cross-source reduction). Rates and batch sizes
+    # sum over replicas; utilizations and step breakdowns take the
+    # hottest source (the binding replica/trial is the one you chase).
+    # Series key is <prefix>:<deployment-or-trial tag>.
     _LLM_GAUGES = {
         "rtpu_llm_tokens_per_s": ("llm_tokens_per_s", "sum"),
         "rtpu_llm_batch_size": ("llm_batch_size", "sum"),
         "rtpu_llm_kv_util": ("llm_kv_util", "max"),
+        # Device-step performance plane (llm/engine.py step accounting).
+        "rtpu_llm_step_ms": ("llm_step_ms", "max"),
+        "rtpu_llm_device_ms": ("llm_device_ms", "max"),
+        "rtpu_llm_host_gap_ms": ("llm_host_gap_ms", "max"),
+        "rtpu_llm_mfu": ("llm_mfu", "max"),
+        "rtpu_llm_hbm_util": ("llm_hbm_util", "max"),
+        # Train-session equivalents (train/session.py wrap_step+report).
+        "rtpu_train_step_ms": ("train_step_ms", "max"),
+        "rtpu_train_device_ms": ("train_device_ms", "max"),
+        "rtpu_train_host_gap_ms": ("train_host_gap_ms", "max"),
+        "rtpu_train_mfu": ("train_mfu", "max"),
+        "rtpu_train_hbm_util": ("train_hbm_util", "max"),
     }
+
+    def _iter_metric_snaps(self):
+        """(source, snapshot) pairs: worker pushes PLUS this process's
+        own registry. Device-lane actors (and the driver in local mode)
+        share the node's interpreter, so their gauges never ride a
+        metrics_push — without the local snapshot an engine running on
+        the TPU lane would produce no perf series at all."""
+        try:
+            from ray_tpu.util.metrics import _registry
+
+            yield "_node_local", _registry.snapshot()
+        except Exception:  # noqa: BLE001
+            pass
+        yield from self.node.user_metrics.items()
 
     def _sample_serve(self, m: Dict[str, float], dt: float):
         depth_by_dep: Dict[str, float] = {}
         hists: Dict[tuple, list] = {}
-        for source, snap in self.node.user_metrics.items():
+        for source, snap in self._iter_metric_snaps():
             for r in snap.get("rows", ()):
                 name = r.get("name", "")
                 if name in self._LLM_GAUGES:
                     prefix, red = self._LLM_GAUGES[name]
-                    dep = r.get("tags", {}).get("deployment", "?")
+                    tags = r.get("tags", {})
+                    dep = tags.get("deployment") or tags.get("trial", "?")
                     key = f"{prefix}:{dep}"
                     val = float(r.get("value", 0.0))
                     if red == "max":

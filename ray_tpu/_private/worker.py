@@ -476,6 +476,13 @@ class WorkerContext:
             return sample_profile(
                 duration_s=float((payload or {}).get("duration_s", 5.0)),
                 hz=float((payload or {}).get("hz", 99.0)))
+        if method == "device_profile":
+            from .profiler import device_profile
+
+            p = payload or {}
+            return device_profile(
+                duration_s=float(p.get("duration_s", 2.0)),
+                hz=float(p.get("hz", 99.0)))
         if method == "heap":
             from .profiler import heap_snapshot
 

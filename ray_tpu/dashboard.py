@@ -48,6 +48,8 @@ _PAGE = """<!doctype html>
 <h2>Tasks</h2><table id="tasks"></table>
 <h2>Cluster health <span id="tssum" style="color:#888;font-size:.8rem"></span></h2>
 <div id="health" style="background:#fff;padding:.6rem;box-shadow:0 1px 2px #0002;font-size:.8rem"></div>
+<h2>Device-step performance <span id="perfsum" style="color:#888;font-size:.8rem"></span></h2>
+<div id="perf" style="background:#fff;padding:.6rem;box-shadow:0 1px 2px #0002;font-size:.8rem"></div>
 <h2>Throughput &amp; phase latency</h2>
 <div id="spark" style="background:#fff;padding:.6rem;box-shadow:0 1px 2px #0002;font-size:.8rem"></div>
 <h2>Data exchange <span id="xsum" style="color:#888;font-size:.8rem"></span></h2>
@@ -166,6 +168,41 @@ async function refresh(){
     document.getElementById('health').innerHTML=hh||'(telemetry disabled)';
     document.getElementById('tssum').textContent=
       'resolution '+(hs.resolution||'?')+'s';
+    // Roofline / MFU pane: one row per deployment or trial, fed by the
+    // continuous llm_*/train_* device-step series. The verdict is
+    // recomputed client-side with the perfmodel.roofline rule from the
+    // latest points (host-bound if the host gap exceeds device time,
+    // else compute- vs HBM-bound by MFU vs HBM utilisation).
+    const maxNodes=byNode=>{
+      const lists=Object.values(byNode||{}).map(pts=>pts.map(p=>p[1]));
+      const L=Math.max.apply(null,lists.map(l=>l.length).concat([0]));
+      const out=[];
+      for(let i=0;i<L;i++){let m=0;
+        for(const l of lists){const v=l[l.length-L+i];
+          if(v!==undefined)m=Math.max(m,v);}
+        out.push(m);}
+      return out;};
+    const last=v=>v.length?v[v.length-1]:0;
+    const perfKeys=Object.keys(hs.series||{});
+    const ids=[...new Set(perfKeys
+      .filter(k=>/^(llm|train)_mfu:/.test(k)).map(k=>k.split(':')[1]))].sort();
+    let ph='';
+    for(const id of ids){
+      const lane=perfKeys.some(k=>k==='llm_mfu:'+id)?'llm':'train';
+      const pick=m=>maxNodes(hs.series[lane+'_'+m+':'+id]||{});
+      const mfu=pick('mfu'),hbm=pick('hbm_util'),
+        dev=pick('device_ms'),gap=pick('host_gap_ms'),step=pick('step_ms');
+      const verdict=last(gap)>last(dev)?'host':
+        (last(mfu)>=last(hbm)?'compute':'hbm');
+      ph+='<div><b>'+esc(id)+'</b> ('+lane+') bound: <b>'+verdict+'</b></div>'+
+        '<div>MFU '+spark(mfu,240,34,'#36c')+' '+(last(mfu)*100).toFixed(1)+'%'+
+        '  HBM '+spark(hbm,240,34,'#939')+' '+(last(hbm)*100).toFixed(1)+'%</div>'+
+        '<div>step ms '+spark(step,240,34,'#393')+' '+last(step).toFixed(1)+
+        '  host gap ms '+spark(gap,240,34,'#c63')+' '+last(gap).toFixed(1)+'</div>';}
+    document.getElementById('perf').innerHTML=
+      ph||'(no accounted engine/train steps yet)';
+    document.getElementById('perfsum').textContent=ph?
+      'MFU / roofline, per deployment & trial':'';
     const tl = await (await fetch('api/timeline')).json();
     drawSpark(tl.series); drawTimeline(tl.events);
     const xs=tl.series, xr=xs.exchange_rounds||[], xm=xs.exchange_mb||[];

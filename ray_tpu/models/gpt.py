@@ -80,8 +80,12 @@ class GPTConfig:
         return self.vocab_size * m + self.max_seq * m + L * (attn + mlp + 2 * m) + m
 
     def flops_per_token(self) -> float:
-        """Training FLOPs/token ≈ 6*N + attention term."""
-        return 6.0 * self.num_params() + 12.0 * self.n_layer * self.d_model * self.max_seq
+        """Training FLOPs/token ≈ 6*N + attention term (delegates to
+        util/perfmodel.py — the shared cost model bench.py and the live
+        llm_mfu/train_mfu telemetry series also price against)."""
+        from ..util import perfmodel
+
+        return perfmodel.train_flops_per_token(self)
 
 
 # Tiny/small presets used by tests, bench and the graft entry.

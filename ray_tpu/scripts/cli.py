@@ -362,6 +362,19 @@ def _print_top(rt):
         for metric, by_node in serve_rows:
             val = sum(by_node.values())
             print(f"  {metric:<44} {val:10.2f}")
+    # Device-step performance plane: where did my step go, live.
+    perf_rows = sorted((m, by_node) for m, by_node in latest.items()
+                       if m.startswith(("llm_mfu:", "llm_host_gap_ms:",
+                                        "train_mfu:",
+                                        "train_host_gap_ms:")))
+    if perf_rows:
+        print("perf:")
+        for metric, by_node in perf_rows:
+            val = max(by_node.values())
+            if metric.startswith(("llm_mfu:", "train_mfu:")):
+                print(f"  {metric:<44} {val:10.2%}")
+            else:
+                print(f"  {metric:<44} {val:10.2f}")
 
 
 def cmd_top(args):
@@ -583,6 +596,72 @@ def cmd_stack(args):
         print()
 
 
+def cmd_profile(args):
+    """Cluster-wide capture. Default: host CPU sampling profile ->
+    flamegraph SVG (same engine as `rtpu stack --flame`). With
+    --device: gang-coordinated device-step capture — every node+worker
+    records accounted engine/train steps (device-vs-host split, MFU,
+    roofline verdict), a host-CPU sample timeline, and a best-effort
+    jax.profiler trace for one shared window; the driver aligns each
+    host's clock by RTT midpoint and merges everything, plus the
+    window's request spans, into ONE chrome://tracing / Perfetto
+    JSON."""
+    _attach(args)
+    from ray_tpu._private import context as context_mod
+
+    rt = context_mod.require_context()
+    if not getattr(args, "device", False):
+        from ray_tpu._private.profiler import (merge_folded,
+                                               render_flamegraph_svg)
+
+        profs = rt.cluster_profile(duration_s=args.duration, hz=args.hz)
+        folded = merge_folded([p.get("folded", "") for p in profs.values()
+                               if isinstance(p, dict)])
+        if not folded:
+            sys.exit("no samples collected (cluster idle or unreachable)")
+        out = args.out or "rtpu-profile.svg"
+        with open(out, "w") as f:
+            f.write(render_flamegraph_svg(
+                folded, title=f"rtpu cluster profile "
+                              f"({args.duration:.0f}s @ {args.hz:.0f}Hz)"))
+        print(f"wrote {out}")
+        return
+
+    import json
+
+    from ray_tpu._private.profiler import build_merged_trace
+    from ray_tpu.util import state
+
+    t0 = time.time()
+    print(f"capturing {args.duration:.0f}s device window across the "
+          f"cluster...")
+    profs = rt.cluster_device_profile(duration_s=args.duration, hz=args.hz)
+    offsets = rt.clock_offsets()
+    # Request spans that overlap the window ride along on their own
+    # track, so a slow decode step lines up with the request above it.
+    spans = []
+    try:
+        for tr in state.list_traces(limit=50):
+            if tr.get("start", 0.0) + tr.get("duration_ms", 0.0) / 1e3 \
+                    < t0 - 1.0:
+                continue
+            spans.extend(state.get_trace(tr["trace_id"]) or [])
+    except Exception:  # noqa: BLE001 - tracing disabled is fine
+        pass
+    merged = build_merged_trace(profs, offsets, spans)
+    captured = [k for k, v in profs.items()
+                if isinstance(v, dict) and "t0_wall" in v]
+    out = args.out or "rtpu-device-trace.json"
+    with open(out, "w") as f:
+        json.dump(merged, f)
+    n_steps = sum(len(v.get("device_steps", [])) for v in profs.values()
+                  if isinstance(v, dict))
+    print(f"wrote {out}: {len(merged['traceEvents'])} events from "
+          f"{len(captured)} process(es), {n_steps} accounted device "
+          f"step(s), {len(spans)} request span(s)")
+    print("open in chrome://tracing or https://ui.perfetto.dev")
+
+
 def cmd_heap(args):
     """Per-process tracemalloc top allocation sites (reference: memray
     heap profiles via the dashboard agent)."""
@@ -778,6 +857,23 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--out", default=None,
                     help="flamegraph output path (default rtpu-flame.svg)")
     sp.set_defaults(fn=cmd_stack)
+
+    sp = sub.add_parser(
+        "profile",
+        help="cluster CPU flamegraph; --device for a merged "
+             "device-step + host + request-span trace")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--device", action="store_true",
+                    help="gang-coordinated device-step capture -> one "
+                         "chrome://tracing JSON")
+    sp.add_argument("--duration", type=float, default=5.0,
+                    help="capture window seconds")
+    sp.add_argument("--hz", type=float, default=99.0,
+                    help="host sampling rate")
+    sp.add_argument("--out", "-o", default=None,
+                    help="output path (default rtpu-profile.svg / "
+                         "rtpu-device-trace.json)")
+    sp.set_defaults(fn=cmd_profile)
 
     sp = sub.add_parser("heap",
                         help="tracemalloc heap snapshot per process")

@@ -13,6 +13,7 @@ from .session import (  # noqa: F401
     get_context,
     get_dataset_shard,
     report,
+    wrap_step,
 )
 from .trainer import (  # noqa: F401
     CheckpointConfig,

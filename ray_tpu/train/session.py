@@ -6,12 +6,23 @@ results that the trainable polls back; `get_context` exposes ranks). Here the
 session is a module-global bound inside each TrainWorker; ``report`` enqueues
 (metrics, checkpoint) pairs that the trainer's fit-loop drains via actor
 polling.
+
+Device-step performance plane: ``wrap_step`` instruments a jitted train
+step (dispatch-to-``block_until_ready`` timed apart from the host work
+around it, FLOPs/bytes priced by util/perfmodel.py) and ``report``
+folds the accumulated spans into a host-vs-device breakdown — reported
+metrics gain ``train_step_ms``/``train_device_ms``/``train_host_gap_ms``/
+``train_mfu``/``train_hbm_util``, the same values ride the worker
+metrics flusher into head telemetry series (``train_mfu:<trial>``, ...),
+and every step lands in the perfmodel device-step ring where
+``rtpu profile --device`` collects it.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -57,9 +68,106 @@ class _TrainSession:
         self.ctx = ctx
         self.reports: queue.Queue = queue.Queue()
         self.stop_event = threading.Event()
+        # Device spans recorded by wrap_step() since the last report:
+        # [accumulated device seconds, flops, hbm bytes, tokens].
+        self._step_perf = [0.0, 0.0, 0.0, 0]
+        self._last_report_t: Optional[float] = None
+        self._perf_gauges = None
+        self._hw = None
+
+    def record_device(self, seconds: float, cost=None):
+        """wrap_step's sink: one timed dispatch->block_until_ready span
+        (plus its priced StepCost) folded into the next report()."""
+        sp = self._step_perf
+        sp[0] += float(seconds)
+        if cost is not None:
+            sp[1] += cost.flops
+            sp[2] += cost.hbm_bytes
+            sp[3] += cost.tokens
+
+    def _drain_step_perf(self) -> Optional[dict]:
+        """Fold the device spans since the last report into a host-vs-
+        device breakdown (None when nothing was recorded — loops that
+        don't use wrap_step report exactly as before)."""
+        now = time.perf_counter()
+        wall, self._last_report_t = (
+            (now - self._last_report_t) if self._last_report_t is not None
+            else None, now)
+        sp = self._step_perf
+        device_s, flops, hbm_bytes, tokens = sp
+        self._step_perf = [0.0, 0.0, 0.0, 0]
+        if device_s <= 0.0 or wall is None:
+            return None
+        from ..util import perfmodel
+
+        if self._hw is None:
+            self._hw = perfmodel.detect_hardware()
+        wall = max(wall, device_s)
+        rl = perfmodel.roofline(
+            perfmodel.StepCost(flops, hbm_bytes, tokens),
+            device_s, wall - device_s, hw=self._hw)
+        out = {
+            "train_step_ms": wall * 1e3,
+            "train_device_ms": device_s * 1e3,
+            "train_host_gap_ms": (wall - device_s) * 1e3,
+            "train_mfu": rl["mfu"],
+            "train_hbm_util": rl["hbm_util"],
+            "train_roofline": rl["verdict"],
+        }
+        perfmodel.record_device_step(
+            "train.step", time.time() - wall,
+            {"step_ms": out["train_step_ms"],
+             "device_ms": out["train_device_ms"],
+             "host_gap_ms": out["train_host_gap_ms"],
+             "mfu": rl["mfu"], "hbm_util": rl["hbm_util"],
+             "verdict": rl["verdict"], "tokens": tokens},
+            {"trial": self.ctx.trial_name})
+        self._publish_perf_gauges(out)
+        return out
+
+    def _publish_perf_gauges(self, perf: dict):
+        """train_* breakdown onto the telemetry plane (worker flusher ->
+        node user_metrics -> head series train_mfu:<trial>, ...)."""
+        try:
+            if self._perf_gauges is None:
+                from ray_tpu.util.metrics import Gauge
+
+                keys = ("trial",)
+                self._perf_gauges = {
+                    "train_step_ms": Gauge(
+                        "rtpu_train_step_ms",
+                        "Report-to-report train step wall time (ms)",
+                        tag_keys=keys),
+                    "train_device_ms": Gauge(
+                        "rtpu_train_device_ms",
+                        "Train step device time, dispatch to "
+                        "block_until_ready (ms)", tag_keys=keys),
+                    "train_host_gap_ms": Gauge(
+                        "rtpu_train_host_gap_ms",
+                        "Train step host time around the device span "
+                        "(ms)", tag_keys=keys),
+                    "train_mfu": Gauge(
+                        "rtpu_train_mfu",
+                        "Model FLOPs utilization of the train step's "
+                        "device span [0,1]", tag_keys=keys),
+                    "train_hbm_util": Gauge(
+                        "rtpu_train_hbm_util",
+                        "HBM-bandwidth utilization of the train step's "
+                        "device span [0,1]", tag_keys=keys),
+                }
+            tags = {"trial": self.ctx.trial_name or "?"}
+            for key, gauge in self._perf_gauges.items():
+                gauge.set(float(perf[key]), tags=tags)
+        except Exception:  # noqa: BLE001 - telemetry is best-effort
+            pass
 
     def report(self, metrics: dict, checkpoint: Optional[Checkpoint] = None):
-        self.reports.put(("report", dict(metrics), checkpoint))
+        metrics = dict(metrics)
+        perf = self._drain_step_perf()  # _step_perf -> breakdown
+        if perf is not None:
+            for k, v in perf.items():
+                metrics.setdefault(k, v)
+        self.reports.put(("report", metrics, checkpoint))
         if self.stop_event.is_set():
             raise StopIteration("training stopped by the controller")
 
@@ -97,6 +205,60 @@ def get_checkpoint() -> Optional[Checkpoint]:
     """The checkpoint to resume from (set on gang restart after failure)."""
     s = _get()
     return s.ctx.loaded_checkpoint if s else None
+
+
+def wrap_step(step_fn, cfg=None):
+    """Instrument a jitted train step for the device-step performance
+    plane: each call is timed dispatch-to-``block_until_ready`` (the
+    device span, as opposed to the host work between steps), and priced
+    by the shared cost model when ``cfg`` (a GPTConfig-shaped object) is
+    given — the (batch, seq) shape is taken from the integer token batch
+    among the arguments. The next ``report()`` then carries
+    ``train_step_ms``/``train_device_ms``/``train_host_gap_ms``/
+    ``train_mfu``/``train_hbm_util`` and publishes the same values as
+    telemetry series.
+
+        step = train.wrap_step(gpt.make_train_step(cfg, opt, mesh), cfg)
+        state, metrics = step(state, tokens)
+        train.report({"loss": float(metrics["loss"])})
+
+    Outside a training loop the wrapper still times the call but records
+    nowhere — safe for bench/offline use."""
+
+    def timed_step(*args, **kwargs):
+        import jax
+
+        from ..util import perfmodel
+
+        t0 = time.perf_counter()
+        out = step_fn(*args, **kwargs)
+        jax.block_until_ready(out)
+        device_s = time.perf_counter() - t0
+        cost = None
+        if cfg is not None:
+            shape = _token_batch_shape(args)
+            if shape is not None:
+                cost = perfmodel.train_step_cost(cfg, shape[0], shape[1])
+        s = _get()
+        if s is not None:
+            s.record_device(device_s, cost)
+        return out
+
+    return timed_step
+
+
+def _token_batch_shape(args) -> Optional[tuple]:
+    """(batch, seq) of the first 2-D integer array in the argument
+    pytree — make_train_step's ``tokens`` operand."""
+    import jax
+    import numpy as np
+
+    for leaf in jax.tree_util.tree_leaves(args):
+        dtype = getattr(leaf, "dtype", None)
+        if dtype is not None and np.issubdtype(dtype, np.integer) \
+                and getattr(leaf, "ndim", 0) == 2:
+            return tuple(int(x) for x in leaf.shape)
+    return None
 
 
 def get_dataset_shard(name: str = "train"):

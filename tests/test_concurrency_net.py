@@ -324,6 +324,38 @@ def test_every_request_hop_forwards_trace_context():
         f"waterfall breaks at that hop: {missing}")
 
 
+# Every device-dispatch site in the engine scheduler and the train
+# session must feed the step accounting (util/perfmodel.py), or the
+# continuous llm_*/train_* MFU/step-breakdown series silently go
+# stale/partial: a step that skips accounting reads as ZERO device
+# time, which the roofline then misclassifies as host-bound.
+_PERF_EMIT_SITES = (
+    # Engine: both dispatch paths price their device span, step() opens
+    # and closes the accounting, and the gauge publisher reads it.
+    ("ray_tpu/llm/engine.py", "LLMEngine._run_prefills", "_step_perf"),
+    ("ray_tpu/llm/engine.py", "LLMEngine._run_decode", "_step_perf"),
+    ("ray_tpu/llm/engine.py", "LLMEngine.step", "_step_perf"),
+    ("ray_tpu/llm/engine.py", "LLMEngine._publish_gauges",
+     "_step_perf"),
+    # Train: report() drains the accumulated device spans into the
+    # metrics dict, and the public wrap_step feeds them.
+    ("ray_tpu/train/session.py", "_TrainSession.report",
+     "_drain_step_perf"),
+    ("ray_tpu/train/session.py", "wrap_step", "record_device"),
+)
+
+
+def test_every_device_dispatch_site_feeds_step_accounting():
+    missing = []
+    for rel, func, ident in _PERF_EMIT_SITES:
+        missing += [f"{rel}:{f} (no {ident})" for f in
+                    _funcs_missing_name(REPO / rel, (func,), ident)]
+    assert not missing, (
+        f"device-dispatch site(s) bypass the step accounting — the "
+        f"MFU/step-breakdown series go stale or misattribute the step "
+        f"to host time: {missing}")
+
+
 def test_trace_lint_catches_a_dropping_hop(tmp_path):
     """The net itself is live: a forwarding method that drops the
     context is flagged, one that carries it is not, and a REMOVED

@@ -165,3 +165,89 @@ def test_greedy_generation_is_reproducible():
     _, h1 = _run_once(64, REQS[2:])
     _, h2 = _run_once(64, REQS[2:])
     assert h1[0].output == h2[0].output
+
+
+# ---------------------------------------------------------------------------
+# Device-step performance plane (util/perfmodel.py accounting)
+# ---------------------------------------------------------------------------
+def test_step_breakdown_in_stats_spans_and_ring():
+    """Every working step prices its device spans through the shared
+    cost model: stats()["last_step"] carries the host-vs-device split +
+    roofline, each traced request's llm.decode_step span carries the
+    per-step breakdown, and the step lands in the process-local
+    device-step ring the gang profiler drains."""
+    from ray_tpu.util import perfmodel, tracing
+
+    perfmodel.clear_device_steps()
+    tracing.drain_request_spans()
+    t0 = __import__("time").time()
+    eng = LLMEngine(PARAMS, CFG, num_blocks=64, block_size=8)
+    ctx = {"trace_id": tracing.new_trace_id(),
+           "span_id": tracing.new_span_id()}
+    h = eng.add_request([1, 2, 3, 4], max_tokens=4, trace_ctx=ctx)
+    _drain(eng)
+    assert h.finish_reason == "length"
+
+    last = eng.stats()["last_step"]
+    for key in ("step_ms", "device_ms", "host_gap_ms", "mfu",
+                "hbm_util", "verdict", "hardware", "tokens"):
+        assert key in last, key
+    assert last["step_ms"] >= last["device_ms"] > 0.0
+    assert last["host_gap_ms"] == pytest.approx(
+        last["step_ms"] - last["device_ms"], abs=1e-6)
+    assert 0.0 < last["mfu"] < 1.5  # cpu-interpret peak is nominal
+    assert last["verdict"] in ("compute", "hbm", "host")
+
+    steps = [s for s in tracing.drain_request_spans()
+             if s["name"] == "llm.decode_step"]
+    # One per decode step; the prefill itself samples token 1, so a
+    # 4-token generation decodes 3 times.
+    assert len(steps) >= 3
+    attrs = steps[0]["attributes"]
+    for key in ("device_ms", "host_ms", "mfu", "hbm_util", "verdict",
+                "rid", "decode", "kv_util"):
+        assert key in attrs, key
+    assert attrs["rid"] == h.rid
+
+    ring = [e for e in perfmodel.device_step_events(since=t0)
+            if e["name"] == "llm.step"]
+    assert ring, "accounted steps must land in the device-step ring"
+    assert all(e["device_ms"] > 0 for e in ring)
+    perfmodel.clear_device_steps()
+
+
+def test_idle_engine_decays_perf_gauges_to_zero():
+    """Acceptance: a drained engine must publish zeroed gauges from its
+    background loop's idle ticks — the MFU/step series decay instead of
+    freezing at the last busy value."""
+    import time
+
+    from ray_tpu.util.metrics import _registry
+
+    eng = LLMEngine(PARAMS, CFG, num_blocks=32, block_size=8,
+                    name="decay_test")
+    eng.start()
+    try:
+        h = eng.add_request([3, 1, 4], max_tokens=4)
+        assert len(list(h.tokens())) == 4
+
+        def perf_rows():
+            return {r["name"]: r["value"]
+                    for r in _registry.snapshot()["rows"]
+                    if r.get("tags", {}).get("deployment") == "decay_test"
+                    and r["name"].startswith("rtpu_llm_")}
+
+        deadline = time.monotonic() + 10
+        rows = {}
+        while time.monotonic() < deadline:
+            rows = perf_rows()
+            if rows and all(v == 0.0 for v in rows.values()):
+                break
+            time.sleep(0.05)
+        assert rows, "engine never published its gauges"
+        for name in ("rtpu_llm_step_ms", "rtpu_llm_device_ms",
+                     "rtpu_llm_host_gap_ms", "rtpu_llm_mfu",
+                     "rtpu_llm_hbm_util", "rtpu_llm_tokens_per_s"):
+            assert rows.get(name) == 0.0, (name, rows)
+    finally:
+        eng.stop()

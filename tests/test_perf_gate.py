@@ -222,6 +222,36 @@ def test_request_span_overhead_gate():
         f"(calibration {cal:.2f})")
 
 
+def test_step_accounting_overhead_gate():
+    """The device-step accounting runs inside the engine's scheduler
+    step, under the engine lock, on EVERY decode: one begin + one
+    priced add_device (an 8-lane decode_step_cost through the shape
+    cache) + finish must stay well under 50us at calibration 1.0
+    (~2-6us observed solo). A regression — the shape cache degenerating
+    to per-call recompute, finish growing allocation-heavy — taxes
+    every generated token, so it fails loudly here."""
+    from ray_tpu.models.gpt import GPT2_SMALL
+    from ray_tpu.util import perfmodel
+
+    cal = _calibrate()
+    acc = perfmodel.StepAccounting(
+        hw=perfmodel.HARDWARE_PEAKS["cpu-interpret"])
+    ctx = [100, 200, 300, 400, 500, 600, 700, 800]
+    # Warm the per-config shape cache out of the measured region.
+    perfmodel.decode_step_cost(GPT2_SMALL, ctx)
+    n = 5000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        acc.begin()
+        acc.add_device(1e-3, perfmodel.decode_step_cost(GPT2_SMALL, ctx))
+        acc.finish()
+    per_step = (time.perf_counter() - t0) / n
+    budget = 50e-6 / cal
+    assert per_step < budget, (
+        f"step-accounting hot path regressed: {per_step * 1e6:.1f}us "
+        f"per step > budget {budget * 1e6:.1f}us (calibration {cal:.2f})")
+
+
 def test_solo_cross_node_fetch_gate():
     cal = _calibrate()
     os.environ["RT_MB_FETCH_MB"] = "16"

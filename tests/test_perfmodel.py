@@ -1,0 +1,191 @@
+"""The analytic device-step cost model (util/perfmodel.py): FLOP/byte
+formulas checked against hand-expanded arithmetic for GPT-2-small,
+roofline verdict boundaries, the hardware peak table, StepAccounting's
+begin/add/finish lifecycle, and the process-local device-step ring the
+gang profiler drains.
+
+The FLOP identities matter beyond this file: GPTConfig.flops_per_token,
+bench.py's MFU report, and the live llm_mfu/train_mfu telemetry series
+all price against these exact formulas, so a drift here is a lie in
+every MFU number the system prints.
+"""
+
+import time
+
+import pytest
+
+from ray_tpu.models.gpt import GPT2_SMALL, GPTConfig, TINY
+from ray_tpu.util import perfmodel
+from ray_tpu.util.perfmodel import (
+    HARDWARE_PEAKS,
+    StepAccounting,
+    StepCost,
+    decode_step_cost,
+    detect_hardware,
+    prefill_cost,
+    roofline,
+    train_flops_per_token,
+    train_step_cost,
+)
+
+
+# ---------------------------------------------------------------------------
+# Hand-expanded GPT-2-small constants (vocab 50304 padded, seq 1024,
+# d_model 768, 12 layers, 12 heads, ff 3072). Everything below is
+# written out longhand on purpose: these tests must not share the
+# formulas they check.
+# ---------------------------------------------------------------------------
+M, F, L, V, S = 768, 3072, 12, 50304, 1024
+H = HK = 12
+D = 64  # head_dim
+# num_params: wte + wpe + L*(wq+wk+wv+wo + wi+wm + 2 layernorms) + ln_f
+N_PARAMS = (V * M + S * M
+            + L * (M * M * 2 + 2 * M * HK * D + 2 * M * F + 2 * M) + M)
+# matmul weights (no embeddings/layernorms): per layer
+# wq (m*h*d) + wk+wv (2*m*hk*d) + wo (h*d*m) + wi+wm (2*m*f), + unembed.
+W_MATMUL = L * (M * H * D + 2 * M * HK * D + H * D * M + 2 * M * F) + V * M
+
+
+def test_gpt2_small_hand_constants():
+    assert GPT2_SMALL.num_params() == N_PARAMS
+    assert N_PARAMS == 124_373_760  # the familiar "124M"
+    assert perfmodel._shape(GPT2_SMALL)["matmul_weights"] == W_MATMUL
+
+
+def test_train_flops_per_token_is_6n_plus_attention():
+    want = 6.0 * N_PARAMS + 12.0 * L * M * S
+    assert train_flops_per_token(GPT2_SMALL) == want
+    assert want == 859_488_768.0
+    # GPTConfig.flops_per_token delegates here (bench.py parity).
+    assert GPT2_SMALL.flops_per_token() == want
+    # Explicit shorter sequence shrinks only the quadratic term.
+    assert train_flops_per_token(GPT2_SMALL, seq=256) == \
+        6.0 * N_PARAMS + 12.0 * L * M * 256
+
+
+def test_decode_step_cost_hand_computed():
+    ctx = [100, 200, 300]
+    c = decode_step_cost(GPT2_SMALL, ctx)
+    # 2 MACs per weight per lane + 4*m*L per context position.
+    assert c.flops == 2.0 * W_MATMUL * 3 + 4.0 * M * L * 600
+    kvb = 2 * L * HK * D * 2  # k+v elements/token at bf16
+    assert c.hbm_bytes == N_PARAMS * 4 + 600 * kvb + 3 * kvb
+    assert c.tokens == 3
+    # Batching amortizes the weight read: per-token HBM must drop.
+    solo = decode_step_cost(GPT2_SMALL, [200])
+    assert c.hbm_bytes / 3 < solo.hbm_bytes
+
+
+def test_prefill_cost_hand_computed():
+    T = 128
+    c = prefill_cost(GPT2_SMALL, T)
+    # Causal: position i attends i+1 keys -> sum = T*(T+1)/2.
+    assert c.flops == 2.0 * W_MATMUL * T + 4.0 * M * L * T * (T + 1) / 2
+    kvb = 2 * L * HK * D * 2
+    assert c.hbm_bytes == N_PARAMS * 4 + 2.0 * T * kvb
+    assert c.tokens == T
+
+
+def test_train_step_cost_hand_computed():
+    c = train_step_cost(GPT2_SMALL, batch=4, seq=512)
+    tokens = 4 * 512
+    assert c.flops == train_flops_per_token(GPT2_SMALL, 512) * tokens
+    assert c.hbm_bytes == 8.0 * N_PARAMS * 4 + 14.0 * M * L * tokens * 2
+    assert c.tokens == tokens
+
+
+def test_step_cost_addition():
+    a = StepCost(1.0, 2.0, 3) + StepCost(10.0, 20.0, 30)
+    assert (a.flops, a.hbm_bytes, a.tokens) == (11.0, 22.0, 33)
+
+
+# ---------------------------------------------------------------------------
+# Hardware table + roofline verdicts
+# ---------------------------------------------------------------------------
+def test_hardware_table_and_detection():
+    assert HARDWARE_PEAKS["v5e"].flops_per_s == 197e12
+    assert HARDWARE_PEAKS["cpu-interpret"].flops_per_s == 1e12
+    # CPU backend (the test environment) falls back, never raises.
+    assert detect_hardware().name in HARDWARE_PEAKS
+    assert detect_hardware(device=object()).name == "cpu-interpret"
+    # bench.py's historical on_tpu toggle maps to v5e / cpu-interpret.
+    assert perfmodel.peak_flops(on_tpu=True) == 197e12
+    assert perfmodel.peak_flops(on_tpu=False) == 1e12
+
+
+def test_roofline_verdicts():
+    hw = HARDWARE_PEAKS["v5e"]
+    # Pure compute: lots of flops, no bytes.
+    r = roofline(StepCost(197e12 * 0.5, 0.0), 1.0, 0.0, hw=hw)
+    assert r["mfu"] == pytest.approx(0.5)
+    assert r["verdict"] == "compute"
+    # Bandwidth-bound: bytes dominate the roof.
+    r = roofline(StepCost(197e12 * 0.01, 819e9 * 0.8), 1.0, 0.0, hw=hw)
+    assert r["hbm_util"] == pytest.approx(0.8)
+    assert r["verdict"] == "hbm"
+    # Host-bound wins regardless of the device-side ratio.
+    r = roofline(StepCost(197e12 * 0.5, 0.0), 1.0, 2.0, hw=hw)
+    assert r["verdict"] == "host"
+    # Multi-chip denominators scale both utilizations.
+    r4 = roofline(StepCost(197e12, 0.0), 1.0, 0.0, hw=hw, n_chips=4)
+    assert r4["mfu"] == pytest.approx(0.25)
+    # Degenerate device span must not divide by zero.
+    assert roofline(StepCost(1.0, 1.0), 0.0, hw=hw)["mfu"] > 0
+
+
+# ---------------------------------------------------------------------------
+# StepAccounting + the device-step ring
+# ---------------------------------------------------------------------------
+def test_step_accounting_lifecycle():
+    acc = StepAccounting(hw=HARDWARE_PEAKS["v5e"])
+    acc.begin()
+    out = acc.finish()
+    assert out is None and acc.last is None  # idle tick: not a step
+
+    acc.begin()
+    acc.add_device(0.010, StepCost(197e12 * 0.010 * 0.4, 0.0, 7))
+    out = acc.finish()
+    assert out["mfu"] == pytest.approx(0.4, rel=1e-6)
+    assert out["tokens"] == 7
+    assert out["step_ms"] >= out["device_ms"] == pytest.approx(10.0)
+    assert out["host_gap_ms"] == pytest.approx(
+        out["step_ms"] - out["device_ms"])
+    assert acc.last is out
+
+    # Device spans accumulate across multiple dispatches in one step.
+    acc.begin()
+    acc.add_device(0.004, StepCost(1e9, 1e6, 2))
+    acc.add_device(0.006, StepCost(1e9, 1e6, 3))
+    out = acc.finish()
+    assert out["device_ms"] == pytest.approx(10.0)
+    assert out["tokens"] == 5
+
+
+def test_device_step_ring_records_and_filters():
+    perfmodel.clear_device_steps()
+    t0 = time.time()
+    acc = StepAccounting(hw=HARDWARE_PEAKS["cpu-interpret"])
+    acc.begin()
+    acc.add_device(0.001, StepCost(1e6, 1e5, 1))
+    acc.finish(record_as="llm.step", attrs={"deployment": "d1"})
+    perfmodel.record_device_step("train.step", time.time(),
+                                 {"step_ms": 3.0}, {"trial": "t1"})
+    evs = perfmodel.device_step_events(since=t0 - 1.0)
+    assert [e["name"] for e in evs] == ["llm.step", "train.step"]
+    assert evs[0]["deployment"] == "d1"
+    assert evs[0]["mfu"] > 0
+    assert evs[1]["trial"] == "t1"
+    # since= filters out the past.
+    assert perfmodel.device_step_events(since=time.time() + 60) == []
+    perfmodel.clear_device_steps()
+    assert perfmodel.device_step_events() == []
+
+
+def test_shape_cache_handles_id_reuse():
+    """id() reuse after GC must not serve a stale entry."""
+    for _ in range(5):
+        cfg = GPTConfig(d_model=128, n_layer=2, n_head=4,
+                        vocab_size=512, max_seq=128)
+        got = perfmodel._shape(cfg)["num_params"]
+        assert got == cfg.num_params()
+    assert perfmodel._shape(TINY)["num_params"] == TINY.num_params()

@@ -217,6 +217,79 @@ def test_sampler_serve_histograms_become_quantiles():
     assert "serve_p50_ms:D:execute" not in m3
 
 
+def test_sampler_device_step_perf_gauges_become_series():
+    """The device-step performance plane rides the same worker-flusher
+    path as the serve gauges: rtpu_llm_*/rtpu_train_* gauge rows keyed
+    by deployment/trial tag become llm_*:<dep> / train_*:<trial>
+    series. Utilizations and step breakdowns reduce with MAX across
+    sources (the binding replica is the one you chase), token rates
+    with SUM."""
+    node = _fake_node()
+    sampler = TelemetrySampler(node)
+
+    def gauge(name, value, **tags):
+        return {"name": name, "type": "gauge", "tags": tags,
+                "value": value}
+
+    node.user_metrics = {
+        "w1": {"rows": [
+            gauge("rtpu_llm_mfu", 0.31, deployment="chat"),
+            gauge("rtpu_llm_hbm_util", 0.62, deployment="chat"),
+            gauge("rtpu_llm_step_ms", 12.0, deployment="chat"),
+            gauge("rtpu_llm_device_ms", 9.0, deployment="chat"),
+            gauge("rtpu_llm_host_gap_ms", 3.0, deployment="chat"),
+            gauge("rtpu_llm_tokens_per_s", 100.0, deployment="chat"),
+        ]},
+        "w2": {"rows": [
+            gauge("rtpu_llm_mfu", 0.25, deployment="chat"),
+            gauge("rtpu_llm_tokens_per_s", 50.0, deployment="chat"),
+            gauge("rtpu_train_mfu", 0.4, trial="trial_0"),
+            gauge("rtpu_train_host_gap_ms", 7.5, trial="trial_0"),
+        ]},
+    }
+    m = sampler.sample()["metrics"]
+    assert m["llm_mfu:chat"] == 0.31            # max across replicas
+    assert m["llm_hbm_util:chat"] == 0.62
+    assert m["llm_step_ms:chat"] == 12.0
+    assert m["llm_device_ms:chat"] == 9.0
+    assert m["llm_host_gap_ms:chat"] == 3.0
+    assert m["llm_tokens_per_s:chat"] == 150.0  # sum across replicas
+    assert m["train_mfu:trial_0"] == 0.4
+    assert m["train_host_gap_ms:trial_0"] == 7.5
+
+    # Idle decay: once the engine publishes zeros (drained queue), the
+    # series must follow to zero rather than freeze at the last busy
+    # value.
+    node.user_metrics = {
+        "w1": {"rows": [
+            gauge("rtpu_llm_mfu", 0.0, deployment="chat"),
+            gauge("rtpu_llm_tokens_per_s", 0.0, deployment="chat"),
+        ]},
+    }
+    m2 = sampler.sample()["metrics"]
+    assert m2["llm_mfu:chat"] == 0.0
+    assert m2["llm_tokens_per_s:chat"] == 0.0
+
+
+def test_sampler_sees_node_local_registry_gauges():
+    """Device-lane actors and the local-mode driver share the node's
+    interpreter: their gauges never ride a metrics_push, so the sampler
+    must ALSO read this process's own registry — otherwise an engine on
+    the TPU lane produces no perf series at all."""
+    from ray_tpu.util import metrics
+
+    node = _fake_node()
+    node.user_metrics = {}
+    sampler = TelemetrySampler(node)
+    metrics.Gauge("rtpu_llm_mfu", "perf", tag_keys=("deployment",)).set(
+        0.37, tags={"deployment": "inproc_eng"})
+    metrics.Gauge("rtpu_train_host_gap_ms", "perf",
+                  tag_keys=("trial",)).set(4.25, tags={"trial": "t_loc"})
+    m = sampler.sample()["metrics"]
+    assert m["llm_mfu:inproc_eng"] == 0.37
+    assert m["train_host_gap_ms:t_loc"] == 4.25
+
+
 # ---------------------------------------------------------------------------
 # End to end: solo burst, then the 2-node acceptance run
 # ---------------------------------------------------------------------------

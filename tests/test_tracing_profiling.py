@@ -256,6 +256,112 @@ def test_cluster_profile_covers_workers(rt):
     assert "busy_worker_fn_abc" in merged, merged[:800]
 
 
+# ---------------------------------------------------------------------------
+# Gang-coordinated device capture (`rtpu profile --device`): every
+# process returns one window of accounted device steps + host timeline;
+# the driver aligns clocks and merges into one Chrome trace.
+# ---------------------------------------------------------------------------
+def test_cluster_device_profile_merges_processes(rt):
+    import json
+    import time
+
+    import ray_tpu
+    from ray_tpu._private.profiler import build_merged_trace
+    from ray_tpu.util import perfmodel
+
+    @ray_tpu.remote
+    def stepper_xyz(sec):
+        # A worker acting like an engine: accounted device steps land
+        # in its process-local ring while the capture window runs.
+        import time as _t
+
+        from ray_tpu.util import perfmodel as pm
+
+        t0 = _t.monotonic()
+        n = 0
+        while _t.monotonic() - t0 < sec:
+            pm.record_device_step(
+                "llm.step", _t.time(),
+                {"step_ms": 2.0, "device_ms": 1.5, "host_gap_ms": 0.5,
+                 "mfu": 0.3, "hbm_util": 0.2, "verdict": "compute"},
+                {"deployment": "capture_test"})
+            n += 1
+            _t.sleep(0.05)
+        return n
+
+    perfmodel.clear_device_steps()
+    ref = stepper_xyz.remote(8.0)
+    time.sleep(0.5)
+    # The driver/node process steps too (train-session shape).
+    perfmodel.record_device_step(
+        "train.step", time.time(),
+        {"step_ms": 10.0, "device_ms": 8.0}, {"trial": "t0"})
+    profs = rt.cluster_device_profile(duration_s=1.0, hz=50.0)
+    offsets = rt.clock_offsets()
+    assert ray_tpu.get(ref, timeout=60) > 0
+
+    captured = {k: v for k, v in profs.items()
+                if isinstance(v, dict) and "t0_wall" in v}
+    assert any(k.startswith("node:") for k in captured), profs.keys()
+    assert any(k.startswith("worker:") for k in captured), profs.keys()
+    with_steps = [k for k, v in captured.items() if v["device_steps"]]
+    assert len(with_steps) >= 2, (
+        "expected accounted steps from >= 2 processes",
+        {k: len(v["device_steps"]) for k, v in captured.items()})
+    # Single host: every node offset must be 0 by construction.
+    assert offsets and all(off == 0.0 for off in offsets.values())
+
+    merged = build_merged_trace(profs, offsets)
+    evs = merged["traceEvents"]
+    pids_with_steps = {e["pid"] for e in evs
+                       if e.get("name") == "llm.step"} | \
+                      {e["pid"] for e in evs
+                       if e.get("name") == "train.step"}
+    assert len(pids_with_steps) >= 2, "steps from >= 2 merged processes"
+    # Step slices carry the breakdown and land on the Chrome schema.
+    step_ev = next(e for e in evs if e.get("name") == "llm.step")
+    assert step_ev["ph"] == "X" and step_ev["dur"] > 0
+    assert step_ev["args"]["deployment"] == "capture_test"
+    assert step_ev["args"]["verdict"] == "compute"
+    names = {e["args"]["name"] for e in evs
+             if e.get("name") == "thread_name"}
+    assert "device-steps" in names and "host-cpu" in names
+    json.dumps(merged)  # one serializable Chrome/Perfetto export
+    perfmodel.clear_device_steps()
+
+
+def test_build_merged_trace_applies_clock_offsets_and_spans():
+    """Per-host wall-clock offsets shift that host's events onto the
+    driver's clock; request spans ride on their own track."""
+    from ray_tpu._private.profiler import build_merged_trace
+
+    base = 1000.0
+    prof = {"t0_wall": base, "t1_wall": base + 1.0,
+            "host": {"timeline": [[base + 0.5, "leaf_fn (m.py:1)"]]},
+            "device_steps": [
+                {"name": "llm.step", "t_wall": base + 0.1,
+                 "step_ms": 4.0, "device_ms": 3.0, "verdict": "hbm"}],
+            "jax_trace": {"error": "disabled"}}
+    spans = [{"trace_id": "aabbccdd" * 4, "name": "serve.request",
+              "start": base + 0.05, "end": base + 0.30,
+              "attributes": {"deployment": "d"}}]
+    merged = build_merged_trace(
+        {"node:aaaabbbbcccc": prof, "worker:ddddeeee:7": prof},
+        offsets={"aaaabbbbcccc": 0.25, "ddddeeee": -0.5}, spans=spans)
+    evs = merged["traceEvents"]
+    steps = sorted(e["ts"] for e in evs if e.get("name") == "llm.step")
+    # node shifted +0.25s, worker -0.5s from the same t_wall.
+    assert steps == [pytest.approx((base + 0.1 - 0.5) * 1e6),
+                     pytest.approx((base + 0.1 + 0.25) * 1e6)]
+    hbm_ev = next(e for e in evs if e.get("name") == "llm.step")
+    assert hbm_ev["cname"] == "thread_state_iowait"  # hbm verdict color
+    span_ev = next(e for e in evs if e.get("name") == "serve.request")
+    assert span_ev["dur"] == pytest.approx(0.25 * 1e6)
+    assert span_ev["args"]["trace_id"] == "aabbccdd" * 4
+    leafs = [e for e in evs if e.get("name") == "leaf_fn (m.py:1)"]
+    assert len(leafs) == 2  # one host-cpu slice per process
+
+
 def test_heap_snapshot_reports_allocations():
     import tracemalloc
 

@@ -217,6 +217,35 @@ class TestMetrics:
         finally:
             slo._reset_for_tests()
 
+    def test_perf_gauge_deployment_label_escaping(self, rt):
+        """The device-step perf gauges carry a user-chosen deployment
+        name as a label: a hostile name (backslash, quote, newline)
+        must round-trip through the exposition like every other label
+        — these are the exact gauges llm/engine.py publishes."""
+        import re
+
+        from ray_tpu.util import metrics
+
+        hostile = 'dep\\with"all\nthree'
+        for name, val in (("rtpu_llm_mfu", 0.42),
+                          ("rtpu_llm_host_gap_ms", 3.5),
+                          ("rtpu_llm_hbm_util", 0.7)):
+            metrics.Gauge(name, "perf", tag_keys=("deployment",)).set(
+                val, tags={"deployment": hostile})
+        text = prometheus_text()
+        assert ('rtpu_llm_mfu{deployment="dep\\\\with\\"all\\nthree"}'
+                ' 0.42' in text)
+        assert 'rtpu_llm_host_gap_ms{deployment=' in text
+        # Anchor on the value: other tests in the session may have
+        # published the same gauge under their own deployment names.
+        m = re.search(
+            r'rtpu_llm_hbm_util\{deployment="((?:[^"\\]|\\.)*)"\} 0\.7',
+            text)
+        raw = re.sub(r"\\(.)",
+                     lambda g: {"n": "\n"}.get(g.group(1), g.group(1)),
+                     m.group(1))
+        assert raw == hostile
+
     def test_telemetry_latest_export(self, rt):
         import time as _time
 
