@@ -137,7 +137,7 @@ def _device_count(timeout_s: float | None = None) -> int:
             if jax.config.jax_platforms == "cpu":
                 _cached = 0
                 return 0
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - probe fallback: unknown backend reports 0 devices
             pass
     if _jax_backend_ready():
         import jax

@@ -283,7 +283,7 @@ class SessionHost:
                 try:
                     _keep_task(asyncio.ensure_future(
                         conn.notify("log", line)))
-                except Exception:
+                except Exception:  # lint: allow-swallow(client stream gone; log line dropped)
                     self._log_conns.discard(conn)
         try:
             loop.call_soon_threadsafe(send)

@@ -348,7 +348,7 @@ class ClientRuntime:
         self._flush_decrefs()
         try:
             self._conn.close()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - best-effort teardown
             pass
         if context_mod.get_context() is self:
             context_mod.set_context(None)

@@ -125,7 +125,7 @@ class ClientProxy:
     async def _reap(self, sess: _Session):
         try:
             await sess.host_conn.close()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - reaping an already-dead session
             pass
         try:
             os.killpg(sess.proc.pid, signal.SIGTERM)

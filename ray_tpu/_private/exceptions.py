@@ -40,7 +40,7 @@ class TaskError(RayTpuError):
 
             cloudpickle.dumps(e)
             cause = e
-        except Exception:
+        except Exception:  # lint: allow-swallow(unpicklable cause; message+traceback still carried)
             cause = None
         return cls(f"{type(e).__name__}: {e}", cause=cause,
                    remote_traceback=tb, task_name=task_name)

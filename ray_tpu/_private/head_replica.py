@@ -240,7 +240,7 @@ class ReplicatedHeadStore(HeadStore):
                 if conn is not None:
                     try:
                         await conn.close()
-                    except Exception:  # noqa: BLE001
+                    except Exception:  # noqa: BLE001 - replica probe failed; next replica is tried
                         pass
 
         async def all_():

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import sys
 import threading
 import time
 from typing import Any, Dict, Optional
@@ -67,7 +68,11 @@ class FileHeadStore(HeadStore):
             return None
         except Exception:
             # Torn/corrupt snapshot (crash mid-rename cannot cause this,
-            # but disk issues can): start fresh rather than refuse to boot.
+            # but disk issues can): start fresh rather than refuse to boot
+            # — but say so, silent state loss is undebuggable.
+            sys.stderr.write(
+                f"ray_tpu: corrupt head store {self.path}; starting "
+                f"fresh\n")
             return None
 
     def save(self, tables):
@@ -148,7 +153,12 @@ class AppendLogHeadStore(HeadStore):
         except FileNotFoundError:
             return None, 0
         except Exception:
-            return None, 0  # corrupt snapshot: rebuild from log alone
+            # Corrupt snapshot: rebuild from the append log alone, and
+            # say so — silent state loss is undebuggable.
+            sys.stderr.write(
+                f"ray_tpu: corrupt head snapshot {self.path}; "
+                f"rebuilding from log\n")
+            return None, 0
 
     def _read_log(self):
         try:
@@ -166,7 +176,7 @@ class AppendLogHeadStore(HeadStore):
                     return  # torn tail record (crash mid-append): drop
                 try:
                     yield pickle.loads(body)
-                except Exception:
+                except Exception:  # lint: allow-swallow(torn tail record after crash; replay stops here)
                     return
 
     @staticmethod

@@ -590,7 +590,7 @@ class NodeService:
                         {"phase": phase})
                     cache[phase] = tags
                 items.append((tags, max(0.0, float(dur))))
-            except Exception:
+            except Exception:  # lint: allow-swallow(malformed phase tag must not fail the task)
                 pass  # a malformed phase must not fail the task
         if items:
             self._phase_hist.observe_normalized(items)
@@ -707,7 +707,7 @@ class NodeService:
                 r["node_id"] = self.node_id.hex()
                 r["ts"] = local["ts"]
                 rows.append(r)
-        except Exception:
+        except Exception:  # lint: allow-swallow(local metrics snapshot is advisory)
             pass
         for source, snap in self.user_metrics.items():
             for r in snap.get("rows", []):
@@ -735,7 +735,7 @@ class NodeService:
         if callable(native):
             try:
                 stats.update(native())
-            except Exception:
+            except Exception:  # lint: allow-swallow(native shm stats are optional)
                 pass
         return stats
 

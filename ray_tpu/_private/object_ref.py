@@ -103,7 +103,7 @@ class ObjectRef:
                 ctx = _current_context()
                 if ctx is not None:
                     ctx.decref(self._id, self._owner_addr)
-            except Exception:
+            except Exception:  # lint: allow-swallow(__del__ during interpreter teardown)
                 pass
 
 

@@ -291,7 +291,7 @@ def _check_hello(kind: int, enc: int, body_raw: bytes,
     try:
         hello = msgpack.unpackb(body_raw, raw=False)
         magic, ver, tok = hello["m"], hello["v"], hello["t"]
-    except Exception:
+    except Exception:  # lint: allow-swallow(malformed HELLO surfaced as protocol-error reply)
         return "protocol error: malformed HELLO"
     if magic != MAGIC:
         return "protocol error: bad magic"

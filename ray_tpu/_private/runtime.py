@@ -182,11 +182,11 @@ class Runtime:
             try:
                 self.loop.call_soon_threadsafe(self.loop.stop)
                 self._loop_thread.join(timeout=5)
-            except Exception:
+            except Exception:  # lint: allow-swallow(bring-up cleanup; startup error re-raised below)
                 pass
             try:
                 self.shm.destroy()
-            except Exception:
+            except Exception:  # lint: allow-swallow(bring-up cleanup; startup error re-raised below)
                 pass
             raise self._startup_error
         atexit.register(self.shutdown)
@@ -507,7 +507,7 @@ class Runtime:
                                   "tables": tables})
                 return await asyncio.wait_for(ask(),
                                               max(1.0, timeout - 1.0))
-            except Exception:
+            except Exception:  # lint: allow-swallow(node died mid-query; head will notice)
                 return None  # node died/hung mid-query; the head will notice
 
         async def gather():
@@ -936,12 +936,12 @@ class Runtime:
         self._shut = True
         try:
             self._run(self.node.shutdown(), timeout=10)
-        except Exception:
+        except Exception:  # lint: allow-swallow(best-effort teardown)
             pass
         if self.head is not None:
             try:
                 self._run(self.head.shutdown(), timeout=5)
-            except Exception:
+            except Exception:  # lint: allow-swallow(best-effort teardown)
                 pass
         self.loop.call_soon_threadsafe(self.loop.stop)
         self._loop_thread.join(timeout=5)

@@ -288,7 +288,7 @@ class TelemetrySampler:
             from ray_tpu.util.metrics import _registry
 
             yield "_node_local", _registry.snapshot()
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - one bad sampler must not kill the sweep
             pass
         yield from self.node.user_metrics.items()
 

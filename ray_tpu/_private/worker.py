@@ -151,7 +151,7 @@ class WorkerContext:
                 break
         try:
             self.client.notify("task_events_push", batch)
-        except Exception:
+        except Exception:  # lint: allow-swallow(connection gone; worker is dying)
             pass  # connection gone; worker is dying
 
     def _flush_drops(self) -> bool:
@@ -162,7 +162,7 @@ class WorkerContext:
         try:
             self.client.notify("ref_drop_batch", batch)
             return True
-        except Exception:
+        except Exception:  # lint: allow-swallow(connection gone; worker is dying)
             return False  # connection gone; worker is dying
 
     # -- context protocol --------------------------------------------------
@@ -189,7 +189,7 @@ class WorkerContext:
             self.client.notify("ref_hold", {
                 "oid": oid.binary(),
                 "owner": list(owner_addr) if owner_addr else None})
-        except Exception:
+        except Exception:  # lint: allow-swallow(connection gone; worker is dying)
             pass
 
     def decref(self, oid: ObjectID, owner_addr=None):
@@ -203,7 +203,7 @@ class WorkerContext:
         try:
             self.client.notify("free_objects", [
                 (oid.binary(), list(owner_addr) if owner_addr else None)])
-        except Exception:
+        except Exception:  # lint: allow-swallow(connection gone; worker is dying)
             pass  # connection gone; worker is dying
 
     # -- pubsub --------------------------------------------------------
@@ -248,7 +248,7 @@ class WorkerContext:
                     "pubsub_unsubscribe",
                     {"channel": channel,
                      "sub_id": "w:" + self.worker_id.hex()})
-            except Exception:
+            except Exception:  # lint: allow-swallow(connection gone; worker is dying)
                 pass  # connection gone; worker is dying
 
     def pubsub_publish(self, channel: str, message) -> int:
@@ -535,7 +535,7 @@ class WorkerContext:
             # anchored to real execution, not the push.
             try:
                 self.client.notify("task_running", p["task_id"])
-            except Exception:
+            except Exception:  # lint: allow-swallow(connection gone; worker is dying)
                 pass  # connection gone; worker is dying
         tok = _running_task.set(task_id)
         tracer = None
@@ -610,7 +610,7 @@ class WorkerContext:
         if spans:
             try:
                 self.client.call("spans_push", spans)
-            except Exception:
+            except Exception:  # lint: allow-swallow(span flush is fire-and-forget)
                 pass
 
     def _flush_request_spans(self):
@@ -624,7 +624,7 @@ class WorkerContext:
         if spans:
             try:
                 self.client.notify("request_spans_push", spans)
-            except Exception:
+            except Exception:  # lint: allow-swallow(span flush is fire-and-forget)
                 pass
 
     def _create_actor(self, p: dict):

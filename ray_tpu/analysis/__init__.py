@@ -1,0 +1,26 @@
+"""``ray_tpu.analysis`` — the runtime's static-analysis pass.
+
+Public surface::
+
+    from ray_tpu.analysis import run_lint, format_json, format_text
+
+    report = run_lint(repo_root)        # full pass, baseline applied
+    assert not report.findings          # what tests/test_lint.py gates
+
+CLI: ``rtpu lint [paths...] [--format json] [--select C101,device]
+[--changed-only] [--write-baseline] [--no-baseline]``.
+
+See ``core.py`` for the architecture and the suppression surfaces,
+``invariants.py`` for how to add a new invariant lint.
+"""
+
+from .baseline import default_path as default_baseline_path
+from .core import (Checker, Context, Finding, Module, Report,
+                   all_checkers, changed_files, format_json,
+                   format_text, register, run_lint)
+
+__all__ = [
+    "Checker", "Context", "Finding", "Module", "Report",
+    "all_checkers", "changed_files", "default_baseline_path",
+    "format_json", "format_text", "register", "run_lint",
+]

@@ -1,0 +1,77 @@
+"""Reviewed baseline (suppression) file for ``rtpu lint``.
+
+The baseline holds findings that are REAL but accepted — each entry
+carries a reviewer-written reason and a count. Matching is by
+``Finding.key()`` (checker + file + symbol + normalized snippet), so
+ordinary edits above a finding don't invalidate entries, while the
+finding disappearing (fixed!) makes its entry STALE. Stale entries
+fail ``tests/test_lint.py`` until pruned — that is the mechanism that
+makes every baselined count monotonically decrease.
+
+Format (JSON, sorted, diff-reviewable)::
+
+    {"version": 1,
+     "entries": {"<key>": {"count": 1, "reason": "why it's accepted"}}}
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Optional
+
+VERSION = 1
+
+
+def default_path(repo_root: Path) -> Path:
+    return Path(repo_root) / "ray_tpu" / "analysis" / "baseline.json"
+
+
+def load(path: Optional[Path]) -> dict:
+    if path is None:
+        return {}
+    try:
+        raw = json.loads(Path(path).read_text())
+    except (OSError, json.JSONDecodeError):
+        return {}
+    if raw.get("version") != VERSION:
+        return {}
+    return dict(raw.get("entries", {}))
+
+
+def save(path: Path, findings, reasons: Optional[dict] = None) -> dict:
+    """Write a baseline absorbing ``findings``. ``reasons`` maps key →
+    reviewer reason; existing reasons are preserved when regenerating
+    over an old file."""
+    old = load(path) if Path(path).exists() else {}
+    entries: dict = {}
+    for f in findings:
+        k = f.key()
+        if k not in entries:
+            reason = (reasons or {}).get(k) \
+                or old.get(k, {}).get("reason") \
+                or f"TODO review: {f.message[:80]}"
+            entries[k] = {"count": 0, "reason": reason}
+        entries[k]["count"] += 1
+    blob = {"version": VERSION, "entries": dict(sorted(entries.items()))}
+    Path(path).parent.mkdir(parents=True, exist_ok=True)
+    Path(path).write_text(json.dumps(blob, indent=1, sort_keys=True)
+                          + "\n")
+    return entries
+
+
+def apply(findings, entries: dict):
+    """Split ``findings`` into (unsuppressed, suppressed) against the
+    baseline and report stale keys (entries matching nothing, or more
+    counts than live findings)."""
+    budget = {k: v.get("count", 1) for k, v in entries.items()}
+    kept, suppressed = [], []
+    for f in findings:
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            suppressed.append(f)
+        else:
+            kept.append(f)
+    stale = [k for k, left in budget.items() if left > 0]
+    return kept, suppressed, stale

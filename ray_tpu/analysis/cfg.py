@@ -1,0 +1,220 @@
+"""Per-function control-flow walk with lock-context tracking.
+
+This is the shared substrate of the concurrency checker family: walk a
+function body statement by statement, tracking which locks are held at
+every point (``with``-statement acquisition, explicit
+``acquire()``/``release()`` pairs, multi-item ``with a, b:``), resolve
+lock expressions to *canonical names* that are stable across functions
+and files (``ClassName._lock`` / ``module.py::_lock``) so the
+whole-repo acquisition graph can join them, and follow simple local
+aliases (``l = self._lock; with l:`` guards the same lock).
+
+Lock-ness is decided two ways, union'd:
+
+* constructor evidence — any ``self.X = threading.Lock()`` /
+  ``RLock()`` / ``Condition(...)`` / ``asyncio.Lock()`` assignment seen
+  anywhere in the class marks ``X`` as a lock attribute, and the same
+  for module-level names;
+* name heuristic — identifiers matching ``lock`` / ``mutex`` / a
+  ``_cond`` suffix are treated as locks even without constructor
+  evidence (fixtures, cross-module attributes).
+
+``Condition`` objects count as locks (``with self._cond:`` holds the
+underlying lock); ``cond.wait(timeout=...)`` *releases* while waiting,
+which the blocking-call checker accounts for.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+from .core import Module
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_LOCK_NAME_RE = re.compile(r"lock|mutex|(^|_)cond($|_)", re.IGNORECASE)
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str          # "ClassName.method" or "function"
+    node: ast.AST          # FunctionDef | AsyncFunctionDef
+    class_name: Optional[str]
+    is_async: bool
+
+
+def iter_functions(module: Module) -> Iterator[FunctionInfo]:
+    """Every def/async def with its enclosing class name (one level —
+    the runtime does not nest classes)."""
+
+    def walk(node, class_name, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from walk(child, child.name, child.name + ".")
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                yield FunctionInfo(
+                    qualname=prefix + child.name, node=child,
+                    class_name=class_name,
+                    is_async=isinstance(child, ast.AsyncFunctionDef))
+                # Nested defs keep the outer qualname prefix.
+                yield from walk(child, class_name,
+                                prefix + child.name + ".")
+
+    yield from walk(module.tree, None, "")
+
+
+def declared_locks(module: Module) -> tuple[set, set]:
+    """(class attrs, module globals) with constructor evidence of being
+    a lock: {"ClassName.attr", ...}, {"name", ...}."""
+    class_attrs: set = set()
+    mod_names: set = set()
+
+    def is_lock_ctor(value) -> bool:
+        if not isinstance(value, ast.Call):
+            return False
+        fn = value.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else getattr(
+            fn, "id", "")
+        return name in _LOCK_CTORS
+
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Assign) or not is_lock_ctor(
+                node.value):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Attribute) and isinstance(
+                    tgt.value, ast.Name) and tgt.value.id == "self":
+                # Find the enclosing class.
+                p = node
+                while p is not None and not isinstance(p, ast.ClassDef):
+                    p = getattr(p, "_rt_parent", None)
+                if p is not None:
+                    class_attrs.add(f"{p.name}.{tgt.attr}")
+            elif isinstance(tgt, ast.Name):
+                mod_names.add(tgt.id)
+    return class_attrs, mod_names
+
+
+def _name_is_lockish(name: str) -> bool:
+    return bool(_LOCK_NAME_RE.search(name))
+
+
+class LockResolver:
+    """Resolves a lock expression inside one function to a canonical
+    cross-file name, or None if the expression is not lock-like."""
+
+    def __init__(self, module: Module, info: FunctionInfo,
+                 class_locks: set, module_locks: set):
+        self.module = module
+        self.info = info
+        self.class_locks = class_locks
+        self.module_locks = module_locks
+        # local name -> canonical lock name (l = self._lock aliasing;
+        # also lock-like parameters).
+        self.aliases: dict[str, str] = {}
+        args = info.node.args
+        for a in (*args.posonlyargs, *args.args, *args.kwonlyargs):
+            if a.arg != "self" and _name_is_lockish(a.arg):
+                self.aliases[a.arg] = f"{info.qualname}({a.arg})"
+        for stmt in ast.walk(info.node):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                    and isinstance(stmt.targets[0], ast.Name):
+                src = self.resolve(stmt.value, follow_alias=False)
+                if src is not None:
+                    self.aliases[stmt.targets[0].id] = src
+
+    def resolve(self, expr, follow_alias: bool = True) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) and isinstance(
+                expr.value, ast.Name) and expr.value.id == "self":
+            cls = self.info.class_name or "?"
+            key = f"{cls}.{expr.attr}"
+            if key in self.class_locks or _name_is_lockish(expr.attr):
+                return key
+            return None
+        if isinstance(expr, ast.Name):
+            if follow_alias and expr.id in self.aliases:
+                return self.aliases[expr.id]
+            if expr.id in self.module_locks or _name_is_lockish(expr.id):
+                return f"{self.module.relpath}::{expr.id}"
+            return None
+        return None
+
+
+@dataclass
+class HeldSite:
+    """One point where ``lock`` is held while ``node`` executes.
+    ``acquired_at`` is the with/acquire line for diagnostics."""
+    lock: str
+    acquired_at: int
+
+
+def walk_locked(module: Module, info: FunctionInfo,
+                resolver: LockResolver
+                ) -> Iterator[tuple[ast.AST, tuple]]:
+    """Yield ``(node, held)`` for every AST node in the function body,
+    where ``held`` is the tuple of HeldSite active at that node —
+    lexical ``with`` blocks plus statement-level ``acquire()`` /
+    ``release()`` pairs. Nested function/class definitions run in a
+    different dynamic context (usually another thread) and are NOT
+    walked under the outer lock set."""
+
+    held: list[HeldSite] = []
+
+    def visit(node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)) \
+                and node is not info.node:
+            return  # different execution context
+        if isinstance(node, ast.With):   # async with never holds a
+            got = []                     # *sync* lock
+            for item in node.items:
+                name = resolver.resolve(item.context_expr)
+                if name is not None:
+                    site = HeldSite(name, node.lineno)
+                    held.append(site)
+                    got.append(site)
+            for item in node.items:
+                yield from visit(item.context_expr)
+            for stmt in node.body:
+                yield from visit(stmt)
+            for site in got:
+                held.remove(site)
+            return
+        yield node, tuple(held)
+        # Statement-level acquire()/release() tracking, best effort:
+        # a bare `x.acquire()` expression statement opens a region that
+        # a later `x.release()` (incl. inside try/finally) closes.
+        if isinstance(node, ast.Expr) and isinstance(node.value,
+                                                     ast.Call):
+            call = node.value
+            if isinstance(call.func, ast.Attribute):
+                name = resolver.resolve(call.func.value)
+                if name is not None:
+                    if call.func.attr == "acquire":
+                        held.append(HeldSite(name, node.lineno))
+                        return
+                    if call.func.attr == "release":
+                        for site in reversed(held):
+                            if site.lock == name:
+                                held.remove(site)
+                                break
+                        return
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child)
+
+    for stmt in info.node.body:
+        yield from visit(stmt)
+
+
+def function_lock_walk(module: Module, class_locks: set,
+                       module_locks: set
+                       ) -> Iterator[tuple]:
+    """Convenience wrapper: for every function in ``module`` yield
+    ``(info, resolver, walk_iterator)``."""
+    for info in iter_functions(module):
+        resolver = LockResolver(module, info, class_locks, module_locks)
+        yield info, resolver, walk_locked(module, info, resolver)

@@ -1,0 +1,373 @@
+"""Core of the ``rtpu lint`` static-analysis framework.
+
+The reference runtime keeps its control plane honest with C++
+sanitizers and TSan CI jobs; this package is the Python/JAX
+reproduction's equivalent: a declarative AST/CFG lint pass that runs
+in tier-1 (``tests/test_lint.py``) and via ``rtpu lint``.
+
+Layering:
+
+* ``core``       — Finding / Module / Checker registry + the runner.
+* ``cfg``        — per-function control-flow walk with lock-context
+                   tracking (the shared machinery every concurrency
+                   checker builds on).
+* ``locks``      — checker family C1xx: blocking calls under a held
+                   lock, ``await`` under a sync lock, lock-order
+                   inversion cycles, lock/attribute guard inference.
+* ``exceptions`` — family E2xx: swallowed broad excepts.
+* ``device``     — family D3xx: host-sync hazards in device hot loops,
+                   jit retrace hazards.
+* ``invariants`` — family I4xx: declarative site tables (spawn
+                   strength, transition events, gauge hooks, trace
+                   propagation, step-accounting feeds) migrated from
+                   ``tests/test_concurrency_net.py``.
+* ``baseline``   — reviewed suppression file so the pass can gate CI
+                   while legacy findings are burned down.
+
+Suppression surfaces, narrowest first:
+
+* ``# lint: disable=C101`` on the offending line (or the ``lint:
+  disable=C101,D301`` comma form) — point suppression, visible in
+  review.
+* ``# lint: allow-swallow(<reason>)`` — E201's dedicated annotation
+  for intentionally-swallowed exceptions (``# noqa: BLE001`` with a
+  trailing reason is accepted as the pre-framework spelling).
+* The baseline file — for findings that are real but accepted, with a
+  per-entry reason, counted so the number can only go down.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Iterable, Optional
+
+SEVERITIES = ("P0", "P1", "P2")
+
+#: Packages the default pass covers. scripts/ and rllib/ are included
+#: for the exception-hygiene family but excluded from the concurrency
+#: families by each checker's own target list where noted.
+DEFAULT_TARGET = "ray_tpu"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint finding. ``key()`` is the stable identity the baseline
+    file matches on: checker + file + enclosing symbol + normalized
+    source snippet — line numbers are deliberately excluded so
+    unrelated edits above a finding don't invalidate the baseline."""
+
+    checker: str          # e.g. "C101"
+    family: str           # concurrency | exceptions | device | invariants
+    severity: str         # P0 | P1 | P2
+    path: str             # repo-relative posix path
+    line: int
+    col: int
+    message: str
+    symbol: str = ""      # enclosing Class.method / function qualname
+    snippet: str = ""     # offending source segment (first line)
+
+    def key(self) -> str:
+        norm = " ".join(self.snippet.split())[:160]
+        return f"{self.checker}::{self.path}::{self.symbol}::{norm}"
+
+    def to_dict(self) -> dict:
+        return {
+            "checker": self.checker, "family": self.family,
+            "severity": self.severity, "path": self.path,
+            "line": self.line, "col": self.col,
+            "symbol": self.symbol, "message": self.message,
+            "snippet": self.snippet, "key": self.key(),
+        }
+
+
+class Module:
+    """A parsed source file: AST with parent links, raw lines, and the
+    repo-relative path every finding is reported against."""
+
+    def __init__(self, path: Path, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._rt_parent = node
+
+    def segment(self, node: ast.AST) -> str:
+        seg = ast.get_source_segment(self.source, node) or ""
+        return seg.splitlines()[0] if seg else ""
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+def parent(node: ast.AST):
+    return getattr(node, "_rt_parent", None)
+
+
+def load_module(path: Path, repo_root: Path) -> Optional[Module]:
+    try:
+        source = path.read_text()
+        rel = path.resolve().relative_to(repo_root.resolve()).as_posix()
+        return Module(path, rel, source)
+    except (SyntaxError, UnicodeDecodeError, ValueError, OSError):
+        return None
+
+
+class Context:
+    """Shared state for one lint run: every loaded module (whole-repo
+    checkers like the lock-order graph need all of them) plus checker
+    configuration overrides (tests point device-lane checkers at
+    fixture modules through ``config``)."""
+
+    def __init__(self, repo_root: Path, modules: list[Module],
+                 config: Optional[dict] = None):
+        self.repo_root = repo_root
+        self.modules = modules
+        self.by_relpath = {m.relpath: m for m in modules}
+        self.config = dict(config or {})
+
+
+class Checker:
+    """Base class. ``scope`` is "module" (ran once per file) or "repo"
+    (ran once per pass with the full Context)."""
+
+    id: str = ""
+    family: str = ""
+    severity: str = "P1"
+    scope: str = "module"
+
+    def check_module(self, module: Module,
+                     ctx: Context) -> Iterable[Finding]:
+        return ()
+
+    def check_repo(self, ctx: Context) -> Iterable[Finding]:
+        return ()
+
+
+_REGISTRY: dict[str, Checker] = {}
+
+
+def register(checker_cls):
+    inst = checker_cls()
+    assert inst.id and inst.id not in _REGISTRY, inst.id
+    _REGISTRY[inst.id] = inst
+    return checker_cls
+
+
+def all_checkers() -> dict[str, Checker]:
+    _ensure_loaded()
+    return dict(_REGISTRY)
+
+
+_loaded = False
+
+
+def _ensure_loaded():
+    global _loaded
+    if not _loaded:
+        # Importing the checker modules populates the registry.
+        from . import device, exceptions, invariants, locks  # noqa: F401
+        _loaded = True
+
+
+def _select_checkers(select: Optional[str]) -> list[Checker]:
+    _ensure_loaded()
+    if not select:
+        return list(_REGISTRY.values())
+    wanted = {s.strip() for s in select.split(",") if s.strip()}
+    out = []
+    for c in _REGISTRY.values():
+        if c.id in wanted or c.family in wanted:
+            out.append(c)
+    unknown = wanted - {c.id for c in out} - {c.family for c in out}
+    if unknown:
+        raise ValueError(f"unknown checker/family selector(s): "
+                         f"{sorted(unknown)}")
+    return out
+
+
+def _inline_suppressed(finding: Finding, module: Optional[Module]) -> bool:
+    """``# lint: disable=<id>`` on the finding's line (or its logical
+    continuation start) point-suppresses it."""
+    if module is None:
+        return False
+    text = module.line_text(finding.line)
+    marker = "lint: disable="
+    idx = text.find(marker)
+    if idx < 0:
+        return False
+    ids = text[idx + len(marker):].split("#")[0]
+    return finding.checker in {s.strip() for s in ids.split(",")}
+
+
+@dataclass
+class Report:
+    """Result of one pass: what fires now, what the baseline absorbed,
+    and which baseline entries no longer match anything (stale entries
+    MUST be pruned — that is how "the count only goes down" is
+    enforced by tests/test_lint.py)."""
+
+    findings: list = field(default_factory=list)       # unsuppressed
+    suppressed: list = field(default_factory=list)     # baselined
+    stale_baseline: list = field(default_factory=list)  # keys
+    files_checked: int = 0
+    checkers_run: list = field(default_factory=list)
+
+    def counts(self) -> dict:
+        by_sev: dict = {}
+        for f in self.findings:
+            by_sev[f.severity] = by_sev.get(f.severity, 0) + 1
+        return {"total": len(self.findings),
+                "suppressed": len(self.suppressed),
+                "stale_baseline": len(self.stale_baseline),
+                "by_severity": by_sev}
+
+
+def iter_python_files(root: Path) -> list[Path]:
+    return sorted(p for p in root.rglob("*.py")
+                  if "__pycache__" not in p.parts)
+
+
+def run_lint(repo_root: Path | str, paths: Optional[list] = None,
+             select: Optional[str] = None,
+             baseline_path: Optional[Path] = None,
+             use_baseline: bool = True,
+             config: Optional[dict] = None,
+             changed_only: bool = False) -> Report:
+    """Run the pass. ``paths``: files/dirs to lint (default: the
+    ``ray_tpu`` package under ``repo_root``). Repo-scope checkers always
+    see every loaded module; ``changed_only``/``paths`` restrict which
+    files *module-scope* checkers report on and which files repo-scope
+    checkers may *report into* (the analysis itself stays whole-repo so
+    cross-file facts like the lock graph stay sound)."""
+    from . import baseline as baseline_mod
+
+    repo_root = Path(repo_root)
+    target_root = repo_root / DEFAULT_TARGET
+    all_files = iter_python_files(target_root) \
+        if target_root.is_dir() else iter_python_files(repo_root)
+
+    if paths:
+        requested: list[Path] = []
+        for p in paths:
+            p = Path(p)
+            if not p.is_absolute():
+                p = repo_root / p
+            requested.extend(iter_python_files(p) if p.is_dir() else [p])
+        report_set = {p.resolve() for p in requested}
+        # Whole-repo facts still need every module loaded.
+        load_files = sorted({*all_files, *report_set})
+    else:
+        report_set = {p.resolve() for p in all_files}
+        load_files = all_files
+
+    if changed_only:
+        changed = changed_files(repo_root)
+        report_set &= {(repo_root / c).resolve() for c in changed}
+
+    modules = [m for m in (load_module(p, repo_root) for p in load_files)
+               if m is not None]
+    ctx = Context(repo_root, modules, config)
+    report_rel = {m.relpath for m in modules
+                  if m.path.resolve() in report_set}
+
+    checkers = _select_checkers(select)
+    raw: list[Finding] = []
+    for checker in checkers:
+        if checker.scope == "repo":
+            raw.extend(checker.check_repo(ctx))
+        else:
+            for m in modules:
+                if m.relpath in report_rel:
+                    raw.extend(checker.check_module(m, ctx))
+    raw = [f for f in raw if f.path in report_rel or f.path not in
+           ctx.by_relpath]
+    raw.sort(key=lambda f: (f.path, f.line, f.checker))
+    raw = [f for f in raw
+           if not _inline_suppressed(f, ctx.by_relpath.get(f.path))]
+
+    report = Report(files_checked=len(report_rel),
+                    checkers_run=sorted(c.id for c in checkers))
+    if use_baseline:
+        bl = baseline_mod.load(baseline_path or
+                               baseline_mod.default_path(repo_root))
+        kept, suppressed, stale = baseline_mod.apply(raw, bl)
+        # A restricted run (paths/--changed-only) only proves a SUBSET
+        # of baseline entries; staleness is only meaningful full-repo.
+        full_run = not changed_only and not paths
+        report.findings = kept
+        report.suppressed = suppressed
+        report.stale_baseline = stale if full_run else []
+    else:
+        report.findings = raw
+    return report
+
+
+def changed_files(repo_root: Path) -> list[str]:
+    """Repo-relative ``*.py`` paths that differ from HEAD (staged,
+    unstaged, or untracked) — the ``--changed-only`` working set."""
+    import subprocess
+    try:
+        out = subprocess.run(
+            ["git", "status", "--porcelain"], cwd=repo_root,
+            capture_output=True, text=True, timeout=30, check=True,
+        ).stdout
+    except (OSError, subprocess.SubprocessError):
+        return []
+    return parse_porcelain(out)
+
+
+def parse_porcelain(out: str) -> list[str]:
+    paths = []
+    for ln in out.splitlines():
+        if len(ln) < 4:
+            continue
+        path = ln[3:]
+        if " -> " in path:          # rename: lint the new name
+            path = path.split(" -> ", 1)[1]
+        path = path.strip().strip('"')
+        if path.endswith(".py"):
+            paths.append(path)
+    return paths
+
+
+# ---------------------------------------------------------------------------
+# Output formats
+# ---------------------------------------------------------------------------
+JSON_SCHEMA_VERSION = 1
+
+
+def format_json(report: Report) -> str:
+    """Stable machine format (schema pinned by tests/test_lint.py)."""
+    return json.dumps({
+        "version": JSON_SCHEMA_VERSION,
+        "summary": report.counts(),
+        "files_checked": report.files_checked,
+        "checkers": report.checkers_run,
+        "findings": [f.to_dict() for f in report.findings],
+        "stale_baseline": sorted(report.stale_baseline),
+    }, indent=2, sort_keys=True)
+
+
+def format_text(report: Report) -> str:
+    lines = []
+    for f in report.findings:
+        lines.append(f"{f.path}:{f.line}:{f.col}: {f.checker} "
+                     f"[{f.severity}] {f.message}")
+        if f.snippet:
+            lines.append(f"    {f.snippet.strip()}")
+    c = report.counts()
+    lines.append(
+        f"{c['total']} finding(s) ({', '.join(f'{k}={v}' for k, v in sorted(c['by_severity'].items())) or 'none'}), "
+        f"{c['suppressed']} baselined, {len(report.stale_baseline)} "
+        f"stale baseline entr(ies), {report.files_checked} file(s)")
+    for k in sorted(report.stale_baseline):
+        lines.append(f"  stale: {k}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,192 @@
+"""Device-lane checker family (D3xx).
+
+D301  host-sync in a hot loop — ``np.asarray`` / ``np.array`` /
+      ``jax.device_get`` / ``.item()`` / ``.tolist()`` inside a
+      ``for``/``while`` loop in a device hot module (the LLM engine's
+      step loops, the train session's wrapped steps). Each such call
+      forces a device→host transfer + synchronization per iteration;
+      the device idles while Python copies. Deliberate syncs (the
+      engine's post-``block_until_ready`` sampling ``device_get``) are
+      baselined with a reason.
+D302  jit-retrace hazard — Python ``if``/``while`` branching on
+      ``.shape`` / ``len(...)`` of a traced argument inside a jitted
+      function: every new shape triggers a silent retrace+recompile,
+      which in a serving step loop means multi-second stalls the step
+      scheduler cannot see. (Shape-STATIC branching is legal under
+      jit, but the runtime's step loops are built on fixed decode
+      shapes precisely so there is exactly one compile — a shape
+      branch inside them is a regression either way.)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from .core import Checker, Context, Finding, Module, register
+
+#: Default hot modules (repo-relative). Tests override via
+#: ctx.config["device_hot_modules"].
+HOT_MODULES = (
+    "ray_tpu/llm/engine.py",
+    "ray_tpu/llm/kv_cache.py",
+    "ray_tpu/train/session.py",
+)
+
+_SYNC_ATTRS = {"item", "tolist"}
+_SYNC_CALLS = {("np", "asarray"), ("np", "array"),
+               ("numpy", "asarray"), ("numpy", "array"),
+               ("jax", "device_get")}
+
+
+def _in_loop(node) -> bool:
+    p = getattr(node, "_rt_parent", None)
+    while p is not None:
+        if isinstance(p, (ast.For, ast.While, ast.AsyncFor)):
+            return True
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        p = getattr(p, "_rt_parent", None)
+    return False
+
+
+def _enclosing_function(node) -> str:
+    parts = []
+    p = getattr(node, "_rt_parent", None)
+    while p is not None:
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            parts.append(p.name)
+        p = getattr(p, "_rt_parent", None)
+    return ".".join(reversed(parts))
+
+
+@register
+class HostSyncInHotLoop(Checker):
+    id = "D301"
+    family = "device"
+    severity = "P1"
+
+    def check_module(self, module: Module,
+                     ctx: Context) -> Iterable[Finding]:
+        hot = ctx.config.get("device_hot_modules", HOT_MODULES)
+        if module.relpath not in hot:
+            return
+        hits = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call) or not _in_loop(node):
+                continue
+            fn = node.func
+            hit = None
+            if isinstance(fn, ast.Attribute):
+                recv = fn.value
+                recv_name = getattr(recv, "id", None)
+                if (recv_name, fn.attr) in _SYNC_CALLS:
+                    hit = f"{recv_name}.{fn.attr}"
+                elif fn.attr in _SYNC_ATTRS and not node.args:
+                    hit = f".{fn.attr}()"
+            if hit is not None:
+                hits.append((node, hit))
+        flagged = {id(n) for n, _ in hits}
+        for node, hit in hits:
+            # np.asarray(jax.device_get(x)) is ONE sync — report the
+            # outermost call only.
+            p = getattr(node, "_rt_parent", None)
+            nested = False
+            while p is not None and not isinstance(p, ast.stmt):
+                if id(p) in flagged:
+                    nested = True
+                    break
+                p = getattr(p, "_rt_parent", None)
+            if nested:
+                continue
+            yield Finding(
+                checker=self.id, family=self.family, severity="P1",
+                path=module.relpath, line=node.lineno,
+                col=node.col_offset,
+                symbol=_enclosing_function(node),
+                message=(f"{hit} inside a hot-loop iteration forces a "
+                         f"device→host sync per step — hoist it out of "
+                         f"the loop or batch the transfer"),
+                snippet=module.segment(node))
+
+
+def _jitted_function_defs(module: Module) -> list:
+    """FunctionDefs that end up under jax.jit: decorated (``@jax.jit``
+    / ``@partial(jax.jit, ...)``), or wrapped by name
+    (``jax.jit(step)`` / ``self._f = jax.jit(self._impl)``)."""
+
+    def is_jit_expr(e) -> bool:
+        if isinstance(e, ast.Attribute) and e.attr == "jit":
+            return True
+        if isinstance(e, ast.Name) and e.id == "jit":
+            return True
+        if isinstance(e, ast.Call):
+            # partial(jax.jit, ...) / functools.partial(jax.jit, ...)
+            name = e.func.attr if isinstance(e.func, ast.Attribute) \
+                else getattr(e.func, "id", "")
+            if name == "partial" and e.args and is_jit_expr(e.args[0]):
+                return True
+        return False
+
+    defs = {f.name: f for f in ast.walk(module.tree)
+            if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    jitted = []
+    for f in defs.values():
+        if any(is_jit_expr(d) for d in f.decorator_list):
+            jitted.append(f)
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call) and is_jit_expr(node.func) \
+                and node.args:
+            target = node.args[0]
+            name = None
+            if isinstance(target, ast.Name):
+                name = target.id
+            elif isinstance(target, ast.Attribute):
+                name = target.attr
+            if name in defs and defs[name] not in jitted:
+                jitted.append(defs[name])
+    return jitted
+
+
+@register
+class JitRetraceHazard(Checker):
+    id = "D302"
+    family = "device"
+    severity = "P2"
+
+    def check_module(self, module: Module,
+                     ctx: Context) -> Iterable[Finding]:
+        for fdef in _jitted_function_defs(module):
+            params = {a.arg for a in (*fdef.args.posonlyargs,
+                                      *fdef.args.args,
+                                      *fdef.args.kwonlyargs)} - {"self"}
+            for node in ast.walk(fdef):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                reason = self._shape_branch(node.test, params)
+                if reason is None:
+                    continue
+                yield Finding(
+                    checker=self.id, family=self.family,
+                    severity="P2", path=module.relpath,
+                    line=node.lineno, col=node.col_offset,
+                    symbol=fdef.name,
+                    message=(f"Python branch on {reason} inside a "
+                             f"jitted function — every new shape "
+                             f"retraces and recompiles silently"),
+                    snippet=module.segment(node.test))
+
+    def _shape_branch(self, test, params):
+        for n in ast.walk(test):
+            if isinstance(n, ast.Attribute) and n.attr in ("shape",
+                                                           "ndim",
+                                                           "size"):
+                base = n.value
+                if isinstance(base, ast.Name) and base.id in params:
+                    return f"{base.id}.{n.attr}"
+            if isinstance(n, ast.Call) and getattr(n.func, "id", "") \
+                    == "len" and n.args and isinstance(
+                    n.args[0], ast.Name) and n.args[0].id in params:
+                return f"len({n.args[0].id})"
+        return None

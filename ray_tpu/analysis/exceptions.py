@@ -1,0 +1,126 @@
+"""Exception-hygiene checker family (E2xx).
+
+E201  swallowed broad except — an ``except Exception:`` (or bare
+      ``except:``) whose handler neither re-raises, nor logs, nor
+      records the error anywhere observable, silently converts a bug
+      into a wrong answer. Allowed when annotated::
+
+          except Exception:  # lint: allow-swallow(dead handle)
+
+      The pre-framework spelling ``# noqa: BLE001 - <reason>`` (the
+      repo's existing idiom) is accepted as equivalent, but only WITH
+      a trailing reason. Unannotated swallows are findings; the
+      baseline file tracks any remaining legacy sites so the count can
+      only go down.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable
+
+from .core import Checker, Context, Finding, Module, register
+
+_ALLOW_RE = re.compile(r"lint:\s*allow-swallow\(([^)]*)\)")
+_NOQA_RE = re.compile(r"noqa:\s*BLE001\s*[-—:]\s*\S")
+
+#: Call names (bare or attribute) whose presence in a handler counts
+#: as "the error was surfaced somewhere".
+_LOG_CALLS = {"print", "warn", "warning", "error", "exception",
+              "critical", "debug", "info", "log", "print_exc",
+              "write", "format_exc", "mark_error", "set_exception",
+              "record_error", "fail", "_fail_task"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True                      # bare except:
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [getattr(e, "id", getattr(e, "attr", ""))
+                 for e in t.elts]
+    else:
+        names = [getattr(t, "id", getattr(t, "attr", ""))]
+    return "Exception" in names or "BaseException" in names
+
+
+def _handler_surfaces_error(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) \
+                else getattr(fn, "id", "")
+            if name in _LOG_CALLS:
+                return True
+        # Reading the bound exception var at all (packaging it into a
+        # reply, an error record, an _on_error(...) call) surfaces it —
+        # the silent-swallow hazard is the handler that never looks at
+        # what it caught.
+        if handler.name and isinstance(node, ast.Name) \
+                and node.id == handler.name \
+                and isinstance(node.ctx, ast.Load):
+            return True
+    return False
+
+
+def allow_reason(module: Module, handler: ast.ExceptHandler):
+    """The allow-swallow reason for this handler, or None. Looked for
+    on the ``except`` line itself and on the first body line (long
+    reasons wrap)."""
+    for lineno in (handler.lineno,
+                   handler.body[0].lineno if handler.body else 0):
+        text = module.line_text(lineno)
+        m = _ALLOW_RE.search(text)
+        if m:
+            return m.group(1).strip() or "(unstated)"
+        if _NOQA_RE.search(text):
+            return text.split("noqa: BLE001", 1)[1].lstrip(" -—:")
+    return None
+
+
+@register
+class SwallowedException(Checker):
+    id = "E201"
+    family = "exceptions"
+    severity = "P2"
+
+    def check_module(self, module: Module,
+                     ctx: Context) -> Iterable[Finding]:
+        # Walk with enclosing-function attribution.
+        func_stack: list[str] = []
+
+        def visit(node, qual):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                qual = (qual + "." if qual else "") + node.name
+            if isinstance(node, ast.ExceptHandler) and _is_broad(node):
+                if not _handler_surfaces_error(node) \
+                        and allow_reason(module, node) is None:
+                    yield Finding(
+                        checker=self.id, family=self.family,
+                        severity="P2", path=module.relpath,
+                        line=node.lineno, col=node.col_offset,
+                        symbol=qual,
+                        message=("broad except swallows the error — "
+                                 "log it, re-raise, or annotate "
+                                 "'# lint: allow-swallow(<reason>)'"),
+                        snippet=module.line_text(node.lineno).strip())
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, qual)
+
+        yield from visit(module.tree, "")
+
+
+def count_allowed(module: Module) -> int:
+    """Annotated (intentional) swallow sites in a module — used by
+    tests to report triage coverage."""
+    n = 0
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ExceptHandler) and _is_broad(node) \
+                and allow_reason(module, node) is not None:
+            n += 1
+    return n

@@ -1,0 +1,340 @@
+"""Invariant-site checker family (I4xx) — the five AST lints that
+grew up ad hoc in ``tests/test_concurrency_net.py`` (PR 1/2/3/6/8/9/10
+satellites), re-homed as declarative site tables. Coverage is
+preserved exactly: every package, file, method, and identifier the
+test-file lints enforced is enforced here; the test file now just runs
+this pass.
+
+I401  weak spawn site — an ``ensure_future``/``create_task`` whose
+      task object is discarded can be GC'd mid-await (r4's lost-reply
+      bug class). Scans the asyncio-bearing runtime packages.
+I402  missing transition event — every task/exchange/engine
+      state-transition method must emit into its lifecycle stream
+      (``self._event`` / ``self._task_event``), including methods that
+      NO LONGER EXIST (a rename silently dropping its event is exactly
+      the bug class).
+I403  missing gauge refresh — every dispatch-queue / pipeline-window
+      mutation site must refresh the telemetry high-water gauges.
+I404  dropped trace context — every request-forwarding hop must carry
+      the trace context or the waterfall breaks at that hop.
+I405  missing step-accounting feed — every device-dispatch site must
+      feed util/perfmodel's step accounting or the MFU/step series go
+      stale and the roofline misattributes the step to host time.
+
+Adding a new invariant lint = appending a row to the right table (or a
+new table + ~10-line checker below). New site families go through this
+module from now on, not through new ad-hoc test code.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from .core import Checker, Context, Finding, Module, register
+
+# ---------------------------------------------------------------------------
+# Reusable AST predicates (public: tests and future checkers use them)
+# ---------------------------------------------------------------------------
+
+
+def weak_spawn_sites(module: Module) -> list:
+    """(line, src) of ensure_future/create_task calls whose task object
+    is DISCARDED — not kept via _keep_task/spawn, assignment, await,
+    return, or a container append/add."""
+
+    def is_spawnish(call: ast.Call) -> bool:
+        fn = call.func
+        name = fn.attr if isinstance(fn, ast.Attribute) else getattr(
+            fn, "id", "")
+        return name in ("ensure_future", "create_task")
+
+    def kept(call: ast.Call) -> bool:
+        p = getattr(call, "_rt_parent", None)
+        if isinstance(p, ast.Call):
+            # Argument of another call: _keep_task(...), spawn-like
+            # wrappers, list.append(...), set.add(...) all KEEP it.
+            return True
+        if isinstance(p, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                          ast.Await, ast.Return, ast.NamedExpr)):
+            return True
+        if isinstance(p, ast.Attribute):
+            # task = loop.create_task(...).<something> chains
+            return True
+        if isinstance(p, (ast.ListComp, ast.GeneratorExp, ast.List,
+                          ast.Tuple, ast.comprehension)):
+            return True
+        return False
+
+    return [(n.lineno, module.segment(n))
+            for n in ast.walk(module.tree)
+            if isinstance(n, ast.Call) and is_spawnish(n)
+            and not kept(n)]
+
+
+def methods_missing_call(module: Module, methods, callee: str) -> list:
+    """Names from ``methods`` whose body never calls
+    ``self.<callee>(...)`` — including methods that no longer exist
+    (a rename silently dropping its emit is exactly the bug class)."""
+    has_call: dict = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in methods:
+            calls = {
+                c.func.attr for c in ast.walk(node)
+                if isinstance(c, ast.Call)
+                and isinstance(c.func, ast.Attribute)
+                and isinstance(c.func.value, ast.Name)
+                and c.func.value.id == "self"}
+            has_call[node.name] = (has_call.get(node.name, False)
+                                   or callee in calls)
+    return [m for m in methods if not has_call.get(m, False)]
+
+
+def funcs_missing_name(module: Module, funcs, name: str) -> list:
+    """Entries from ``funcs`` ("func" or "Class.method") whose body
+    never references identifier ``name`` (bare name, attribute,
+    parameter, or keyword argument) — including functions that no
+    longer exist."""
+
+    def refs(node) -> bool:
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and n.id == name:
+                return True
+            if isinstance(n, ast.Attribute) and n.attr == name:
+                return True
+            if isinstance(n, ast.keyword) and n.arg == name:
+                return True
+            if isinstance(n, ast.arg) and n.arg == name:
+                return True
+        return False
+
+    found: dict = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ClassDef):
+            for ch in node.body:
+                if isinstance(ch, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                    key = f"{node.name}.{ch.name}"
+                    if key in funcs:
+                        found[key] = found.get(key, False) or refs(ch)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in funcs:
+                found[node.name] = (found.get(node.name, False)
+                                    or refs(node))
+    return [f for f in funcs if not found.get(f, False)]
+
+
+# ---------------------------------------------------------------------------
+# Site tables (the declarative part — append here to extend coverage)
+# ---------------------------------------------------------------------------
+
+#: Packages whose asyncio spawn sites must keep a strong reference.
+SPAWN_PACKAGES = ("ray_tpu/_private", "ray_tpu/serve", "ray_tpu/data",
+                  "ray_tpu/util", "ray_tpu/llm")
+
+#: (path, callee, (methods...), why) — every method must call
+#: ``self.<callee>(...)``.
+EVENT_SITE_TABLES = (
+    ("ray_tpu/_private/node_service.py", "_event", (
+        "submit",                 # SUBMITTED
+        "_start_reconstruction",  # RECONSTRUCTING
+        "_run_on_worker",    # RUNNING (cpu lane, head of a fresh lease)
+        "_on_task_running",  # RUNNING (pipelined spec starts worker-side)
+        "_requeue_unstarted",  # SUBMITTED (unstarted spec, dead worker)
+        "_run_on_device",    # RUNNING + FINISHED (device lane)
+        "_run_actor_task",   # RUNNING (actor call)
+        "_handle_task_reply",  # FINISHED (cpu lane)
+        "_fail_task",        # FAILED
+        "_execute_remotely",  # FORWARDED
+        "_handle_remote_reply",  # FINISHED/FAILED (owner side)
+        "_actor_alive",      # FINISHED (actor creation)
+    ), "task state-transition site emits no lifecycle event — the "
+       "task_events stream (state API, timeline, phase metrics) "
+       "silently loses that transition"),
+    ("ray_tpu/_private/worker.py", "_task_event", (
+        "_execute",          # ARGS_FETCHED + OUTPUT_SERIALIZED
+    ), "worker-side task phase site emits no lifecycle event"),
+    ("ray_tpu/data/exchange.py", "_event", (
+        "_submit_map_round",    # MAP_ROUND_SUBMITTED
+        "_submit_merge_round",  # MERGE_ROUND_SUBMITTED
+        "_drain_round",         # ROUND_COMPLETED
+        "_submit_reduce",       # REDUCE_SUBMITTED
+        "_finish",              # FINISHED
+    ), "exchange merge-round state change emits no event — "
+       "list_exchanges/the dashboard pane silently lose it"),
+    ("ray_tpu/llm/engine.py", "_event", (
+        "add_request",  # WAITING
+        "_admit",       # PREFILL (joined the in-flight batch)
+        "_activate",    # RUNNING (prefill done, decoding)
+        "_preempt",     # PREEMPTED (pool exhausted, blocks freed)
+        "_finish",      # FINISHED (stop token / length / abort)
+    ), "engine scheduler state-transition site emits no lifecycle "
+       "event — the preempt+resume determinism tests and the request "
+       "trace silently lose transitions"),
+)
+
+#: Dispatch-queue / pipeline-window mutation sites that must refresh
+#: the telemetry high-water gauges.
+GAUGE_SITE_TABLES = (
+    ("ray_tpu/_private/node_service.py", "_gauge_queues", (
+        "_enqueue_local",      # pending_cpu.append (local submit)
+        "_dispatch",           # pending_cpu = still_pending
+        "_try_spill",          # pending_cpu.append (spill bounce-back)
+        "_requeue_unstarted",  # pending_cpu re-queue off a dead worker
+        "_retry_or_fail",      # pending_cpu.append (retry)
+        "_handle_task_reply",  # pending_cpu.append (retry_exceptions)
+        "_run_on_device",      # pending_cpu.append (device retry)
+        "_handle_rpc",         # pending_cpu = keep (register setup_err)
+        "_acquire_worker",     # inflight[...] = spec (pipelined lease)
+        "_run_on_worker",      # inflight[...] = spec (fresh lease)
+        "_run_actor_task",     # inflight[...] = spec (actor lane)
+    ), "dispatch-queue/pipeline-window mutation site never refreshes "
+       "the telemetry gauges — dispatch_queue_hw/pipeline_inflight_hw "
+       "miss between-sample bursts"),
+)
+
+#: (path, identifier, (funcs...), why) — every func must reference the
+#: identifier.
+REF_SITE_TABLES = (
+    ("ray_tpu/serve/http_proxy.py", "copy_context", (
+        "HTTPProxy._handle_routed",
+    ), "the proxy's executor handoff drops contextvars — trace context "
+       "does not cross run_in_executor without copy_context"),
+    ("ray_tpu/serve/deployment.py", "trace_ctx", (
+        "DeploymentHandle.remote", "DeploymentResponse.result",
+    ), "request-forwarding hop drops the trace context — the "
+       "waterfall breaks at that hop"),
+    ("ray_tpu/serve/replica.py", "trace_ctx", (
+        "Replica.handle_request",
+    ), "request-forwarding hop drops the trace context"),
+    ("ray_tpu/serve/batching.py", "trace_ctx", (
+        "_Pending.__init__", "_Batcher._run_batch",
+    ), "request-forwarding hop drops the trace context"),
+    ("ray_tpu/llm/engine.py", "trace_ctx", (
+        "LLMEngine.add_request",
+    ), "request-forwarding hop drops the trace context"),
+    ("ray_tpu/serve/llm.py", "trace_ctx", (
+        "_LLMServer.__call__",
+    ), "request-forwarding hop drops the trace context"),
+)
+
+#: Device-dispatch sites that must feed perfmodel's step accounting.
+PERF_SITE_TABLES = (
+    ("ray_tpu/llm/engine.py", "_step_perf", (
+        "LLMEngine._run_prefills", "LLMEngine._run_decode",
+        "LLMEngine.step", "LLMEngine._publish_gauges",
+    ), "device-dispatch site bypasses the step accounting — the "
+       "MFU/step-breakdown series go stale or misattribute the step "
+       "to host time"),
+    ("ray_tpu/train/session.py", "_drain_step_perf", (
+        "_TrainSession.report",
+    ), "train report() does not drain the accumulated device spans"),
+    ("ray_tpu/train/session.py", "record_device", (
+        "wrap_step",
+    ), "the public wrap_step does not feed the step accounting"),
+)
+
+
+# ---------------------------------------------------------------------------
+# Checkers
+# ---------------------------------------------------------------------------
+@register
+class WeakSpawnSite(Checker):
+    id = "I401"
+    family = "invariants"
+    severity = "P0"
+    scope = "repo"
+
+    def check_repo(self, ctx: Context) -> Iterable[Finding]:
+        pkgs = ctx.config.get("spawn_packages", SPAWN_PACKAGES)
+        for module in ctx.modules:
+            if not any(module.relpath.startswith(p + "/")
+                       or module.relpath == p for p in pkgs):
+                continue
+            for line, src in weak_spawn_sites(module):
+                yield Finding(
+                    checker=self.id, family=self.family, severity="P0",
+                    path=module.relpath, line=line, col=0,
+                    symbol="", snippet=src,
+                    message=("fire-and-forget task with no strong "
+                             "reference — asyncio may GC it mid-await "
+                             "(wrap in _keep_task()/spawn())"))
+
+
+class _TableChecker(Checker):
+    """Shared driver for the site-table checkers: report every table
+    entry whose method/function is missing its required call/ref —
+    including entries whose file is gone entirely."""
+
+    scope = "repo"
+    tables: tuple = ()
+    mode = "method_call"   # or "name_ref"
+
+    def check_repo(self, ctx: Context) -> Iterable[Finding]:
+        tables = ctx.config.get(f"{self.id}_tables", self.tables)
+        for path, needle, entries, why in tables:
+            module = ctx.by_relpath.get(path)
+            if module is None:
+                yield Finding(
+                    checker=self.id, family=self.family, severity="P0",
+                    path=path, line=1, col=0, symbol="",
+                    message=(f"file named by an invariant site table "
+                             f"is missing — {why}"),
+                    snippet=f"expected: {path}")
+                continue
+            if self.mode == "method_call":
+                missing = methods_missing_call(module, entries, needle)
+            else:
+                missing = funcs_missing_name(module, entries, needle)
+            for m in missing:
+                yield Finding(
+                    checker=self.id, family=self.family, severity="P0",
+                    path=path, line=_site_line(module, m), col=0,
+                    symbol=m, snippet=f"required: {needle}",
+                    message=f"{m}: {why}")
+
+
+def _site_line(module: Module, entry: str) -> int:
+    name = entry.rsplit(".", 1)[-1]
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == name:
+            return node.lineno
+    return 1
+
+
+@register
+class MissingTransitionEvent(_TableChecker):
+    id = "I402"
+    family = "invariants"
+    severity = "P0"
+    tables = EVENT_SITE_TABLES
+    mode = "method_call"
+
+
+@register
+class MissingGaugeRefresh(_TableChecker):
+    id = "I403"
+    family = "invariants"
+    severity = "P0"
+    tables = GAUGE_SITE_TABLES
+    mode = "method_call"
+
+
+@register
+class DroppedTraceContext(_TableChecker):
+    id = "I404"
+    family = "invariants"
+    severity = "P0"
+    tables = REF_SITE_TABLES
+    mode = "name_ref"
+
+
+@register
+class MissingStepAccounting(_TableChecker):
+    id = "I405"
+    family = "invariants"
+    severity = "P0"
+    tables = PERF_SITE_TABLES
+    mode = "name_ref"

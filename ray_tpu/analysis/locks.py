@@ -1,0 +1,448 @@
+"""Concurrency checker family (C1xx).
+
+C101  blocking call while holding a lock — socket send/recv, RPC
+      submit/collect (``rpc.call``, ``ray_tpu.get``/``wait``/``kv_*``),
+      ``time.sleep``, untimed ``Future.result()``, untimed
+      ``queue.get/put``, untimed ``Thread.join``, untimed
+      ``Condition.wait``, subprocess execution. Severity P0 when the
+      wait is unbounded (no timeout anywhere), P1 when bounded (a slow
+      peer still stalls every other taker of that lock for the
+      timeout).
+C102  ``await`` while holding a *sync* lock in an async function — the
+      event loop parks the coroutine with the lock held; any other
+      coroutine (or thread) touching the lock deadlocks the loop.
+C103  lock-order inversion — whole-repo acquisition graph (lock B
+      taken while A held, lexically or one call deep within the same
+      class) must stay acyclic.
+C104  guard inference — an attribute written under the same lock at
+      ≥2 sites is inferred guarded-by; a write outside any lock
+      (outside ``__init__``) is flagged. Follows ``l = self._lock``
+      aliasing via cfg.LockResolver.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Optional
+
+from . import cfg
+from .core import Checker, Context, Finding, Module, register
+
+_SOCK_RECV_RE = re.compile(r"sock|conn|peer", re.IGNORECASE)
+_RPC_RECV_RE = re.compile(r"rpc|client|conn|stub|channel", re.IGNORECASE)
+_QUEUE_RECV_RE = re.compile(r"(^|_)(in|out)?q(ueue)?$", re.IGNORECASE)
+_THREAD_RECV_RE = re.compile(r"thread|reader|writer|flusher|worker",
+                             re.IGNORECASE)
+
+
+def _call_name(call: ast.Call) -> tuple[str, str]:
+    """(receiver-source, attr/func name)."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        try:
+            recv = ast.unparse(fn.value)
+        except Exception:  # pragma: no cover - lint: allow-swallow(unparse fallback)
+            recv = ""
+        return recv, fn.attr
+    return "", getattr(fn, "id", "")
+
+
+def _has_kw(call: ast.Call, *names) -> bool:
+    return any(k.arg in names for k in call.keywords)
+
+
+def _classify_blocking(call: ast.Call,
+                       held: tuple) -> Optional[tuple[str, str]]:
+    """(severity, description) if this call can block, else None."""
+    recv, name = _call_name(call)
+    timed = _has_kw(call, "timeout", "block")
+
+    if recv == "time" and name == "sleep":
+        return "P1", "time.sleep() under a held lock"
+    if name == "result" and not call.args and not timed:
+        return "P0", "untimed Future.result() under a held lock"
+    if name in ("recv", "recv_into", "recvfrom", "sendall", "sendmsg",
+                "accept", "connect") and _SOCK_RECV_RE.search(recv):
+        return "P0", f"blocking socket {name}() under a held lock"
+    if name == "send" and _SOCK_RECV_RE.search(recv):
+        return "P0", "blocking socket send() under a held lock"
+    if name in ("call", "call_with_retry") and _RPC_RECV_RE.search(recv):
+        sev = "P1" if timed else "P0"
+        return sev, f"RPC {recv}.{name}() under a held lock"
+    if recv == "ray_tpu" and name in ("get", "wait"):
+        if timed:
+            return "P1", f"ray_tpu.{name}(timeout=...) under a held " \
+                         f"lock (bounded, but stalls the lock)"
+        return "P0", f"untimed ray_tpu.{name}() under a held lock"
+    if recv == "ray_tpu" and name in ("get_actor", "kv_put", "kv_get",
+                                      "kv_del", "kv_keys", "nodes"):
+        return "P1", f"ray_tpu.{name}() RPC under a held lock"
+    if name in ("get", "put") and _QUEUE_RECV_RE.search(
+            recv.rsplit(".", 1)[-1]) and not timed:
+        return "P0", f"untimed queue {name}() under a held lock"
+    if name == "join" and not call.args and not timed \
+            and _THREAD_RECV_RE.search(recv):
+        return "P0", f"untimed {recv}.join() under a held lock"
+    if name == "wait" and not call.args and not timed:
+        held_names = {h.lock for h in held}
+        # cond.wait() RELEASES the lock it was built on — only flag a
+        # wait on an object we are NOT treating as the held lock, or an
+        # untimed wait (unbounded even though it releases: the caller
+        # still parks forever on a lost notify).
+        suffix = recv.rsplit(".", 1)[-1].replace("self.", "")
+        is_held_cond = any(h.split(".")[-1].split("::")[-1] == suffix
+                           for h in held_names)
+        if is_held_cond:
+            return "P1", "untimed Condition.wait() — lost notify " \
+                         "parks the thread forever"
+        return "P0", f"untimed {recv}.wait() under a held lock"
+    if recv == "subprocess" and name in ("run", "check_output",
+                                         "check_call", "call"):
+        return "P0", f"subprocess.{name}() under a held lock"
+    return None
+
+
+@register
+class BlockingUnderLock(Checker):
+    """Direct: a blocking call lexically under a held lock. One-hop: a
+    ``self.method()`` call under a held lock where the callee (same
+    class) contains blocking calls it does not itself guard behind a
+    lock release — ``with self._lock: self._helper()`` is just as
+    wedged as inlining the helper."""
+
+    id = "C101"
+    family = "concurrency"
+    severity = "P0"
+
+    def check_module(self, module: Module,
+                     ctx: Context) -> Iterable[Finding]:
+        class_locks, module_locks = cfg.declared_locks(module)
+        # (class, method) -> [(severity, why, line)] blocking calls in
+        # the callee body (any lock context — holding more locks there
+        # doesn't make the caller's lock safer).
+        method_blocking: dict[tuple, list] = {}
+        deferred: list = []   # one-hop candidates, resolved after pass 1
+        for info, resolver, walk in cfg.function_lock_walk(
+                module, class_locks, module_locks):
+            mkey = (info.class_name, info.qualname.rsplit(".", 1)[-1])
+            for node, held in walk:
+                if not isinstance(node, ast.Call):
+                    continue
+                hit = _classify_blocking(node, held)
+                if hit is not None:
+                    method_blocking.setdefault(mkey, []).append(
+                        (hit[0], hit[1], node.lineno))
+                if not held:
+                    continue
+                if hit is not None:
+                    sev, why = hit
+                    locks = ", ".join(sorted({h.lock for h in held}))
+                    yield Finding(
+                        checker=self.id, family=self.family,
+                        severity=sev, path=module.relpath,
+                        line=node.lineno, col=node.col_offset,
+                        symbol=info.qualname,
+                        message=f"{why} (holding {locks})",
+                        snippet=module.segment(node))
+                elif isinstance(node.func, ast.Attribute) \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id == "self":
+                    deferred.append(
+                        (info, node, tuple(sorted({h.lock
+                                                   for h in held}))))
+        for info, node, locks in deferred:
+            callee = (info.class_name, node.func.attr)
+            for sev, why, bline in method_blocking.get(callee, ()):
+                yield Finding(
+                    checker=self.id, family=self.family, severity=sev,
+                    path=module.relpath, line=node.lineno,
+                    col=node.col_offset, symbol=info.qualname,
+                    message=(f"{why} — inside self.{node.func.attr}() "
+                             f"(line {bline}) called while holding "
+                             f"{', '.join(locks)}"),
+                    snippet=module.segment(node))
+
+
+@register
+class AwaitUnderSyncLock(Checker):
+    id = "C102"
+    family = "concurrency"
+    severity = "P0"
+
+    def check_module(self, module: Module,
+                     ctx: Context) -> Iterable[Finding]:
+        class_locks, module_locks = cfg.declared_locks(module)
+        for info, resolver, walk in cfg.function_lock_walk(
+                module, class_locks, module_locks):
+            if not info.is_async:
+                continue
+            for node, held in walk:
+                if held and isinstance(node, ast.Await):
+                    locks = ", ".join(sorted({h.lock for h in held}))
+                    yield Finding(
+                        checker=self.id, family=self.family,
+                        severity="P0", path=module.relpath,
+                        line=node.lineno, col=node.col_offset,
+                        symbol=info.qualname,
+                        message=(
+                            f"await while holding sync lock {locks} — "
+                            f"the event loop parks this coroutine with "
+                            f"the lock held (deadlocks the loop)"),
+                        snippet=module.segment(node))
+
+
+@register
+class LockOrderInversion(Checker):
+    """Whole-repo acquisition graph: edge A→B when lock B is acquired
+    while A is held. Edges come from lexical nesting plus ONE level of
+    same-class method calls under a lock (``with self._a:
+    self._helper()`` where ``_helper`` takes ``self._b`` — nested defs
+    inside the callee are excluded, they run elsewhere). Any cycle is a
+    potential deadlock: two threads entering the cycle at different
+    points wedge forever."""
+
+    id = "C103"
+    family = "concurrency"
+    severity = "P0"
+    scope = "repo"
+
+    def check_repo(self, ctx: Context) -> Iterable[Finding]:
+        # lock -> {other lock: (path, line, via)}
+        edges: dict[str, dict] = {}
+        # (class, method) -> [(lock, line)] top-level acquisitions,
+        # for the one-hop interprocedural expansion.
+        acquires: dict[tuple, list] = {}
+        calls_under: list = []  # (holder, class, callee, path, line)
+
+        for module in ctx.modules:
+            class_locks, module_locks = cfg.declared_locks(module)
+            for info, resolver, walk in cfg.function_lock_walk(
+                    module, class_locks, module_locks):
+                key = (info.class_name, info.qualname.rsplit(".", 1)[-1])
+                seen_sites: list = []
+                for node, held in walk:
+                    if isinstance(node, ast.Call) and held \
+                            and isinstance(node.func, ast.Attribute) \
+                            and isinstance(node.func.value, ast.Name) \
+                            and node.func.value.id == "self":
+                        for h in held:
+                            calls_under.append(
+                                (h.lock, info.class_name,
+                                 node.func.attr, module.relpath,
+                                 node.lineno))
+                    for i, outer in enumerate(held):
+                        for inner in held[i + 1:]:
+                            if inner.lock != outer.lock:
+                                edges.setdefault(outer.lock, {})\
+                                    .setdefault(inner.lock,
+                                                (module.relpath,
+                                                 inner.acquired_at,
+                                                 "nested with"))
+                    for h in held:
+                        if (h.lock, h.acquired_at) not in seen_sites:
+                            seen_sites.append((h.lock, h.acquired_at))
+                acquires.setdefault(key, []).extend(
+                    lk for lk, _ in seen_sites)
+
+        # One-hop expansion: a self-method call under lock A whose
+        # callee (same class) acquires B adds edge A→B.
+        for holder, cls, callee, path, line in calls_under:
+            for lk in acquires.get((cls, callee), ()):
+                if lk != holder:
+                    edges.setdefault(holder, {}).setdefault(
+                        lk, (path, line, f"call to self.{callee}()"))
+
+        yield from _report_cycles(self, edges)
+
+
+def _report_cycles(checker, edges: dict) -> Iterable[Finding]:
+    # Iterative DFS cycle detection with path recovery.
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in edges}
+    reported = set()
+
+    def dfs(start):
+        stack = [(start, iter(sorted(edges.get(start, {}))))]
+        path = [start]
+        color[start] = GREY
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for nxt in it:
+                if color.get(nxt, WHITE) == GREY:
+                    cyc = path[path.index(nxt):] + [nxt]
+                    key = tuple(sorted(set(cyc)))
+                    if key not in reported:
+                        reported.add(key)
+                        yield cyc
+                elif color.get(nxt, WHITE) == WHITE:
+                    color[nxt] = GREY
+                    stack.append((nxt, iter(sorted(edges.get(nxt,
+                                                             {})))))
+                    path.append(nxt)
+                    advanced = True
+                    break
+            if not advanced:
+                color[node] = BLACK
+                stack.pop()
+                path.pop()
+
+    for start in sorted(edges):
+        if color.get(start, WHITE) == WHITE:
+            for cyc in dfs(start):
+                sites = []
+                for a, b in zip(cyc, cyc[1:]):
+                    p, ln, via = edges[a][b]
+                    sites.append(f"{a}→{b} at {p}:{ln} ({via})")
+                p0, l0, _ = edges[cyc[0]][cyc[1]]
+                yield Finding(
+                    checker=checker.id, family=checker.family,
+                    severity="P0", path=p0, line=l0, col=0,
+                    symbol="(lock graph)",
+                    message=("lock-order inversion cycle: "
+                             + "; ".join(sites)),
+                    snippet=" → ".join(cyc))
+
+
+@register
+class UnguardedAttribute(Checker):
+    """Guard inference: if ``self.X`` is mutated under lock L at two or
+    more distinct sites of a class, a mutation of ``self.X`` outside
+    any lock (outside ``__init__``) is a candidate data race."""
+
+    id = "C104"
+    family = "concurrency"
+    severity = "P2"
+
+    _MUTATORS = {"append", "appendleft", "add", "remove", "discard",
+                 "pop", "popleft", "clear", "update", "extend",
+                 "insert", "setdefault"}
+
+    def check_module(self, module: Module,
+                     ctx: Context) -> Iterable[Finding]:
+        class_locks, module_locks = cfg.declared_locks(module)
+        # class -> attr -> {"locks": {lock: count},
+        #                   "bare": [(line, col, func, method, snippet)]}
+        table: dict = {}
+        # (class, callee) -> [(caller_method, frozenset(lex locks))]
+        callsites: dict[tuple, list] = {}
+        methods_of: dict[str, set] = {}
+        for info, resolver, walk in cfg.function_lock_walk(
+                module, class_locks, module_locks):
+            if info.class_name is None:
+                continue
+            in_init = info.qualname.endswith(".__init__")
+            method = info.qualname.rsplit(".", 1)[-1]
+            methods_of.setdefault(info.class_name, set()).add(method)
+            for node, held in walk:
+                if isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Attribute) and isinstance(
+                        node.func.value, ast.Name) \
+                        and node.func.value.id == "self":
+                    callsites.setdefault(
+                        (info.class_name, node.func.attr), []).append(
+                        (method, frozenset(h.lock for h in held)))
+                attr = self._mutated_attr(node)
+                if attr is None:
+                    continue
+                if f"{info.class_name}.{attr}" in class_locks:
+                    continue  # the lock itself
+                rec = table.setdefault(info.class_name, {}).setdefault(
+                    attr, {"locks": {}, "bare": []})
+                if held:
+                    for h in held:
+                        rec["locks"][h.lock] = \
+                            rec["locks"].get(h.lock, 0) + 1
+                elif not in_init:
+                    # Defer segment() — O(file) per call, and almost no
+                    # bare write survives the guard-count filter below.
+                    rec["bare"].append((node.lineno, node.col_offset,
+                                        info.qualname, method, node))
+        entered = {cls: self._entered_holding(cls, methods_of[cls],
+                                              callsites)
+                   for cls in methods_of}
+        for cls, attrs in sorted(table.items()):
+            for attr, rec in sorted(attrs.items()):
+                best = max(rec["locks"].values(), default=0)
+                if best < 2 or not rec["bare"]:
+                    continue
+                guard = max(rec["locks"], key=rec["locks"].get)
+                for line, col, func, method, node in rec["bare"]:
+                    if guard in entered[cls].get(method, ()):
+                        # Every visible call path enters this method
+                        # with the guard already held.
+                        continue
+                    snippet = module.segment(node)
+                    yield Finding(
+                        checker=self.id, family=self.family,
+                        severity="P2", path=module.relpath, line=line,
+                        col=col, symbol=func,
+                        message=(f"self.{attr} is guarded by {guard} "
+                                 f"at {best} site(s) but mutated here "
+                                 f"with no lock held"),
+                        snippet=snippet)
+
+    def _entered_holding(self, cls: str, methods: set,
+                         callsites: dict) -> dict:
+        """Greatest-fixpoint dataflow: the set of locks held on EVERY
+        entry into each method. Public (non-underscore) methods and
+        methods with no visible call site can be entered externally →
+        empty set. Private methods: intersection over call sites of
+        (lexical locks ∪ caller's entry set) — recursion (e.g. a
+        ``_deploy_node`` that recurses under its caller's lock)
+        converges because sets only shrink from the optimistic top."""
+        universe = frozenset().union(
+            *(locks for (c, _), sites in callsites.items()
+              if c == cls for _, locks in sites)) \
+            if any(c == cls for c, _ in callsites) else frozenset()
+        status = {}
+        for m in methods:
+            sites = callsites.get((cls, m), [])
+            if not m.startswith("_") or not sites \
+                    or m.startswith("__"):
+                status[m] = frozenset()
+            else:
+                status[m] = universe
+        for _ in range(len(methods) + 1):
+            changed = False
+            for m in methods:
+                sites = callsites.get((cls, m), [])
+                if status[m] == frozenset() and not sites:
+                    continue
+                if not m.startswith("_") or m.startswith("__"):
+                    continue
+                new = None
+                for caller, locks in sites:
+                    eff = locks | status.get(caller, frozenset())
+                    new = eff if new is None else (new & eff)
+                new = new if new is not None else frozenset()
+                if new != status[m]:
+                    status[m] = new
+                    changed = True
+            if not changed:
+                break
+        return status
+
+
+    def _mutated_attr(self, node) -> Optional[str]:
+        """self.X = .../augassign/del, or self.X.<mutator>(...) — the
+        write sites guard inference counts."""
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                base = t
+                if isinstance(base, ast.Subscript):
+                    base = base.value
+                if isinstance(base, ast.Attribute) and isinstance(
+                        base.value, ast.Name) and base.value.id == "self":
+                    return base.attr
+        if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute):
+            owner = node.func.value
+            if node.func.attr in self._MUTATORS and isinstance(
+                    owner, ast.Attribute) and isinstance(
+                    owner.value, ast.Name) and owner.value.id == "self":
+                return owner.attr
+        return None

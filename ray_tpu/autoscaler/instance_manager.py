@@ -158,7 +158,7 @@ class InstanceManager:
                 elif now - inst.state_since > self.launch_timeout_s:
                     try:
                         self.provider.terminate_slice(inst.slice.slice_id)
-                    except Exception:
+                    except Exception:  # lint: allow-swallow(terminate best-effort; slice requeued)
                         pass
                     requeue_or_fail(inst, "launch timed out")
 
@@ -170,14 +170,14 @@ class InstanceManager:
                     # Gang semantics: one dead member kills the slice.
                     try:
                         self.provider.terminate_slice(inst.slice.slice_id)
-                    except Exception:
+                    except Exception:  # lint: allow-swallow(terminate best-effort; slice already dead)
                         pass
                     move(inst, TERMINATED, "slice died")
 
             elif inst.state == DRAINING:
                 try:
                     self.provider.terminate_slice(inst.slice.slice_id)
-                except Exception:
+                except Exception:  # lint: allow-swallow(terminate best-effort; drained anyway)
                     pass
                 move(inst, TERMINATED, "drained")
         return events

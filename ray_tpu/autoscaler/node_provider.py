@@ -108,7 +108,7 @@ class LocalNodeProvider(NodeProvider):
             try:
                 proc.kill()
                 proc.wait(timeout=5)
-            except Exception:
+            except Exception:  # lint: allow-swallow(already terminated)
                 pass
 
     def non_terminated_slices(self) -> List[SliceHandle]:

@@ -131,7 +131,7 @@ class Cluster:
             try:
                 node.proc.kill()
                 node.proc.wait(timeout=5)
-            except Exception:
+            except Exception:  # lint: allow-swallow(best-effort teardown)
                 pass
         self.nodes.clear()
         ray_tpu.shutdown()

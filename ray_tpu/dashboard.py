@@ -401,7 +401,7 @@ def _logs() -> dict:
         rows = [{"worker": k, "tail": v[-2000:]}
                 for k, v in sorted(logs.items())]
         return {"logs": rows[:30]}
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 - panel degrades to empty
         return {"logs": []}
 
 
@@ -419,7 +419,7 @@ def _sched_stats() -> Optional[dict]:
     try:
         rt = context_mod.require_context()
         return rt._run(rt.head_client().sched_stats(), 5.0)
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 - panel degrades to empty
         return None
 
 
@@ -535,7 +535,7 @@ def _trace_api(trace_id: str) -> dict:
         rt = context_mod.require_context()
         return {"trace_id": trace_id,
                 "spans": rt.get_trace(trace_id) or []}
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 - panel degrades to empty
         return {"trace_id": trace_id, "spans": []}
 
 
@@ -553,7 +553,7 @@ def _jobs() -> dict:
                 end = j.get("end_time") or _t.time()
                 j["runtime_s"] = round(end - j["start_time"], 1)
         return {"jobs": jobs}
-    except Exception:
+    except Exception:  # lint: allow-swallow(panel degrades to empty)
         return {"jobs": []}
 
 

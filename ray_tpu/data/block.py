@@ -296,7 +296,7 @@ def arrow_to_block(table) -> Block:
         if arr is None:
             try:
                 arr = col.to_numpy(zero_copy_only=False)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - copy fallback; non-numeric handled below
                 arr = None
             if arr is None or arr.dtype == object or arr.dtype.kind in "US":
                 out[name] = col

@@ -129,7 +129,7 @@ def shard_like(tree, params, pspec_tree, mesh: Mesh):
     def is_param_tree(x):
         try:
             return jax.tree_util.tree_structure(x) == ptreedef
-        except Exception:
+        except Exception:  # lint: allow-swallow(not a param tree)
             return False
 
     def place(sub):

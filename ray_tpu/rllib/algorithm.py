@@ -316,7 +316,7 @@ class Algorithm:
             try:
                 r.stop.remote()
                 ray_tpu.kill(r)
-            except Exception:
+            except Exception:  # lint: allow-swallow(best-effort actor teardown)
                 pass
 
 

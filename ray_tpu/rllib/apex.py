@@ -161,6 +161,6 @@ class ApexDQN(DQN):
         for s in self.shards:
             try:
                 ray_tpu.kill(s)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - best-effort actor teardown
                 pass
         super().stop()

@@ -292,5 +292,5 @@ class MultiAgentPPO:
                 try:
                     r.stop.remote()
                     ray_tpu.kill(r)
-                except Exception:
+                except Exception:  # lint: allow-swallow(best-effort actor teardown)
                     pass

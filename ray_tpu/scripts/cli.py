@@ -773,6 +773,50 @@ def cmd_job_logs(args):
     print(_job_client(args).get_job_logs(args.id), end="")
 
 
+def cmd_lint(args):
+    """Static analysis over the runtime's own source. Needs no cluster."""
+    from pathlib import Path
+
+    from ray_tpu import analysis
+
+    root = Path.cwd()
+    if not (root / "ray_tpu").is_dir():
+        # Running from outside a checkout: lint the installed package.
+        import ray_tpu as _pkg
+
+        root = Path(_pkg.__file__).resolve().parent.parent
+    baseline_path = Path(args.baseline) if args.baseline else None
+    if args.write_baseline:
+        report = analysis.run_lint(root, paths=args.paths or None,
+                                   select=args.select, use_baseline=False)
+        from ray_tpu.analysis import baseline as baseline_mod
+
+        if isinstance(args.write_baseline, str):
+            path = Path(args.write_baseline)
+        else:
+            path = baseline_path or analysis.default_baseline_path(root)
+        entries = baseline_mod.save(path, report.findings)
+        print(f"wrote {path}: {len(entries)} entries covering "
+              f"{len(report.findings)} findings")
+        todo = sum(1 for v in entries.values()
+                   if v["reason"].startswith("TODO"))
+        if todo:
+            print(f"{todo} entries need a reviewer reason "
+                  f"(grep 'TODO review')")
+        return
+    report = analysis.run_lint(root, paths=args.paths or None,
+                               select=args.select,
+                               baseline_path=baseline_path,
+                               use_baseline=not args.no_baseline,
+                               changed_only=args.changed_only)
+    if args.format == "json":
+        print(analysis.format_json(report))
+    else:
+        print(analysis.format_text(report), end="")
+    if report.findings or report.stale_baseline:
+        sys.exit(1)
+
+
 # ---------------------------------------------------------------------------
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
@@ -956,6 +1000,30 @@ def build_parser() -> argparse.ArgumentParser:
         if name != "list":
             sp.add_argument("id")
         sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser(
+        "lint", help="static analysis over the runtime source "
+                     "(concurrency/exception/device/invariant checkers)")
+    sp.add_argument("paths", nargs="*",
+                    help="files or directories (default: ray_tpu/)")
+    sp.add_argument("--format", choices=["text", "json"], default="text")
+    sp.add_argument("--select", default=None,
+                    help="comma-separated checker ids or families "
+                         "(e.g. C101,device)")
+    sp.add_argument("--baseline", default=None,
+                    help="baseline file (default: "
+                         "ray_tpu/analysis/baseline.json)")
+    sp.add_argument("--no-baseline", action="store_true",
+                    help="report baselined findings too")
+    sp.add_argument("--write-baseline", nargs="?", const=True,
+                    default=None, metavar="PATH",
+                    help="absorb current findings into the baseline "
+                         "(entries need reviewer reasons); optional "
+                         "PATH writes elsewhere than --baseline")
+    sp.add_argument("--changed-only", action="store_true",
+                    help="only report on files with uncommitted changes "
+                         "(git status)")
+    sp.set_defaults(fn=cmd_lint)
 
     return p
 

@@ -32,7 +32,7 @@ def _get_controller(create: bool = True):
         if ray_tpu.is_initialized():
             try:
                 _controller = ray_tpu.get_actor(CONTROLLER_NAME)
-            except Exception:
+            except Exception:  # lint: allow-swallow(controller not registered yet)
                 _controller = None
     if _controller is None and create:
         if not ray_tpu.is_initialized():
@@ -216,7 +216,7 @@ def _wait_controller_alive(timeout: float = 60) -> bool:
             controller = ray_tpu.get_actor(CONTROLLER_NAME)
             if ray_tpu.get(controller.ping.remote(), timeout=5):
                 return True
-        except Exception:
+        except Exception:  # lint: allow-swallow(controller not up yet; retried until deadline)
             time.sleep(0.2)
     return False
 
@@ -242,7 +242,7 @@ def shutdown():
             ray_tpu.get(controller.shutdown_deployments.remote(),
                         timeout=60)
             ray_tpu.kill(controller, no_restart=True)
-        except Exception:
+        except Exception:  # lint: allow-swallow(best-effort shutdown)
             pass
     _controller = None
     _clear_routers()

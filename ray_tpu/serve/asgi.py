@@ -102,7 +102,7 @@ class _ASGILoop:
 
         try:
             asyncio.run_coroutine_threadsafe(setup(), self.loop).result(20)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - lifespan is optional per ASGI spec
             pass
 
     def _finish_lifespan(self):
@@ -117,7 +117,7 @@ class _ASGILoop:
 
         try:
             asyncio.run_coroutine_threadsafe(teardown(), self.loop).result(15)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 - lifespan is optional per ASGI spec
             pass
 
     def handle(self, req: dict, timeout: Optional[float] = None) -> dict:
@@ -196,7 +196,7 @@ def ingress(asgi_app):
             def __del__(self):
                 try:
                     self._asgi.shutdown()
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 - __del__ during interpreter teardown
                     pass
 
         ASGIIngress.__name__ = getattr(cls, "__name__", "ASGIIngress")

@@ -57,7 +57,7 @@ def _drain_and_kill(victims, drain_timeout_s: float = 30.0):
             try:
                 if ray_tpu.get(v.stats.remote(), timeout=5)["ongoing"] > 0:
                     still.append(v)
-            except Exception:
+            except Exception:  # lint: allow-swallow(draining a dying replica)
                 pass  # dead already — nothing to drain
         pending = still
         if pending:
@@ -65,7 +65,7 @@ def _drain_and_kill(victims, drain_timeout_s: float = 30.0):
     for v in victims:
         try:
             ray_tpu.kill(v)
-        except Exception:
+        except Exception:  # lint: allow-swallow(kill best-effort; actor may be gone)
             pass
 
 
@@ -137,7 +137,7 @@ class ServeController:
                     handle = None
                     try:
                         handle = ray_tpu.get_actor(rn)
-                    except Exception:
+                    except Exception:  # lint: allow-swallow(dead handle; reconcile replaces it)
                         pass  # dead/unregistered — reconcile replaces it
                     if handle is not None:
                         state.replicas.append(handle)
@@ -148,7 +148,7 @@ class ServeController:
             for rn in ckpt.get("draining", ()):
                 try:
                     ray_tpu.kill(ray_tpu.get_actor(rn))
-                except Exception:
+                except Exception:  # lint: allow-swallow(dead handle; reconcile replaces it)
                     pass  # already gone
             for state in self._deployments.values():
                 self._reconcile_one(state)
@@ -165,21 +165,30 @@ class ServeController:
         """Deploy the app's deployment graph (children bound as init args
         deploy first, parents get handles to them). Returns the ingress
         deployment's name — callers build handles client-side."""
+        import ray_tpu
+
+        # Reconfigure RPCs are collected under the lock but COLLECTED
+        # outside it: a replica hanging in reconfigure() must not wedge
+        # status()/get_replicas()/route queries behind self._lock
+        # (rtpu lint C101 — blocking RPC under the controller lock).
+        reconfigs: list = []
         with self._lock:
-            ingress = self._deploy_node(app)
+            ingress = self._deploy_node(app, reconfigs)
             self._apps[name] = ingress
             self._ensure_loop()
+        if reconfigs:
+            ray_tpu.get(reconfigs, timeout=60)
         self._checkpoint()
         return ingress
 
-    def _deploy_node(self, app: Application) -> str:
+    def _deploy_node(self, app: Application, reconfigs: list) -> str:
         d = app.deployment
         init_args = tuple(
-            DeploymentHandle(self._deploy_node(a))
+            DeploymentHandle(self._deploy_node(a, reconfigs))
             if isinstance(a, Application) else a
             for a in d.init_args)
         init_kwargs = {
-            k: (DeploymentHandle(self._deploy_node(v))
+            k: (DeploymentHandle(self._deploy_node(v, reconfigs))
                 if isinstance(v, Application) else v)
             for k, v in d.init_kwargs.items()}
         d = Deployment(**{**d.__dict__, "init_args": init_args,
@@ -194,10 +203,8 @@ class ServeController:
             state.deployment = d
             state.target_replicas = target
             if d.user_config is not None:
-                import ray_tpu
-
-                ray_tpu.get([r.reconfigure.remote(d.user_config)
-                             for r in state.replicas])
+                reconfigs.extend(r.reconfigure.remote(d.user_config)
+                                 for r in state.replicas)
         self._reconcile_one(state)
         return d.name
 
@@ -279,7 +286,7 @@ class ServeController:
                             ray_tpu.ActorUnavailableError,
                             ray_tpu.WorkerCrashedError):
                         dead.append(r)
-                    except Exception:
+                    except Exception:  # lint: allow-swallow(probe timeout marks the replica slow)
                         slow = True
                 with self._lock:
                     if self._deployments.get(
@@ -439,7 +446,7 @@ class ServeController:
         if refs:
             try:
                 ray_tpu.wait(refs, num_returns=len(refs), timeout=10)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - dead handle
                 pass
 
     def _reconcile_proxies(self):
@@ -501,7 +508,7 @@ class ServeController:
                 table = self._routes_for_broadcast()
             try:
                 ray_tpu.get(actor.set_routes.remote(table), timeout=10)
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - proxy probe; reconcile replaces it
                 pass
 
     def ping(self) -> bool:
@@ -521,7 +528,7 @@ class ServeController:
                 for r in state.replicas:
                     try:
                         ray_tpu.kill(r)
-                    except Exception:
+                    except Exception:  # lint: allow-swallow(best-effort shutdown)
                         pass
             self._deployments.clear()
             self._apps.clear()
@@ -533,11 +540,11 @@ class ServeController:
         for p in proxies:
             try:
                 ray_tpu.get(p.shutdown.remote(), timeout=10)
-            except Exception:
+            except Exception:  # lint: allow-swallow(best-effort shutdown)
                 pass
             try:
                 ray_tpu.kill(p)
-            except Exception:
+            except Exception:  # lint: allow-swallow(best-effort shutdown)
                 pass
         ray_tpu.kv_del(CHECKPOINT_KEY)
         return True

@@ -188,7 +188,7 @@ class DeploymentResponse:
             # Early consumer exit: free the parked generator.
             try:
                 actor.stream_cancel.remote(sid)
-            except Exception:
+            except Exception:  # lint: allow-swallow(cancel on a gone replica)
                 pass
 
     def _to_object_ref(self):
@@ -206,7 +206,7 @@ class DeploymentResponse:
         # toward replicas that never served an unsettled request.
         try:
             self._settle()
-        except Exception:
+        except Exception:  # lint: allow-swallow(__del__ during interpreter teardown)
             pass
 
 

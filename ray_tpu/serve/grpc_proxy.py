@@ -58,7 +58,7 @@ class GRPCProxy:
             try:
                 context.set_trailing_metadata(
                     (("x-rtpu-trace-id", root.trace_id),))
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - trailing metadata unsupported by transport
                 pass
             try:
                 try:

@@ -100,7 +100,7 @@ class HTTPProxy:
                 # prepared (headers sent) — skip, the id still lands in
                 # the store.
                 resp.headers["x-rtpu-trace-id"] = root.trace_id
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 - headers already sent on a stream
                 pass
             return resp
         except BaseException as e:
@@ -306,7 +306,7 @@ class HTTPProxy:
         try:
             asyncio.run_coroutine_threadsafe(stop(), self._loop)
             self._thread.join(timeout=5)
-        except Exception:
+        except Exception:  # lint: allow-swallow(best-effort shutdown)
             pass
         self._stream_pool.shutdown(wait=False)
 

@@ -134,7 +134,7 @@ def set_queue_depth(depth: int, deployment: Optional[str] = None):
         _, gauge, _ = _metrics()
         gauge.set(float(depth),
                   tags={"deployment": deployment or _deployment or "?"})
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 - gauge update is advisory
         pass
 
 
@@ -146,7 +146,7 @@ def proxy_inflight(delta: int) -> int:
     try:
         _, _, gauge = _metrics()
         gauge.set(float(_proxy_inflight))
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 - gauge update is advisory
         pass
     return _proxy_inflight
 

@@ -38,7 +38,7 @@ class WorkerKiller:
                 rows = [w for w in state.list_workers()
                         if w["state"] in ("IDLE", "BUSY")
                         and not w.get("actor_id")]
-            except Exception:
+            except Exception:  # lint: allow-swallow(chaos loop; kill races are expected)
                 continue
             if not rows:
                 continue
@@ -83,7 +83,7 @@ class NodeKiller:
             try:
                 self.cluster.remove_node(node, force=True)
                 self.kills += 1
-            except Exception:
+            except Exception:  # lint: allow-swallow(chaos loop; kill races are expected)
                 pass
 
     def __enter__(self):

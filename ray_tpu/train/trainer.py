@@ -397,7 +397,7 @@ class JaxTrainer:
                 try:
                     w.stop.remote()  # cooperative stop for loops still running
                     ray_tpu.kill(w)
-                except Exception:
+                except Exception:  # lint: allow-swallow(cooperative stop of a dying gang)
                     pass
             if not gang_failed:
                 break

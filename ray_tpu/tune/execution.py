@@ -90,7 +90,7 @@ class TuneController:
             try:
                 trial.actor.stop.remote()
                 ray_tpu.kill(trial.actor)
-            except Exception:
+            except Exception:  # lint: allow-swallow(best-effort teardown)
                 pass
             trial.actor = None
 

@@ -50,7 +50,7 @@ class _TrialFileLogger(Callback):
         for f in self._files.values():
             try:
                 f.close()
-            except Exception:
+            except Exception:  # lint: allow-swallow(close on a torn file)
                 pass
         self._files.clear()
 
@@ -150,7 +150,7 @@ class TensorBoardLoggerCallback(_TrialFileLogger):
         for w in self._writers.values():
             try:
                 w.close()
-            except Exception:
+            except Exception:  # lint: allow-swallow(close on a torn writer)
                 pass
         self._writers.clear()
         super().on_experiment_end(results)

@@ -47,7 +47,7 @@ class _TaskResult:
 
 try:
     from joblib.parallel import ParallelBackendBase
-except Exception:  # pragma: no cover - joblib always in this image
+except Exception:  # pragma: no cover - lint: allow-swallow(joblib optional)
     ParallelBackendBase = object
 
 

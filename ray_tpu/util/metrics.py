@@ -146,7 +146,7 @@ class _Registry:
                                     {"source": source, "snapshot": snap})
                 except (ConnectionLost, OSError):
                     return  # node gone; worker is dying anyway
-                except Exception:
+                except Exception:  # lint: allow-swallow(transient push failure; retried next tick)
                     continue  # transient (e.g. saturated node): retry next tick
 
         threading.Thread(target=flush_loop, daemon=True,

@@ -73,7 +73,7 @@ class AsyncResult:
         try:
             self.get(timeout=0)
             return True
-        except Exception:
+        except Exception:  # lint: allow-swallow(successful() is a predicate per stdlib contract)
             return False
 
 

@@ -1,30 +1,29 @@
-"""Concurrency net (VERDICT r4 item 10): systematic nets for the bug
+"""Concurrency net (VERDICT r4 item 10): runtime nets for the bug
 classes that chaos tests only catch by luck.
 
-1. STRUCTURAL: asyncio holds only weak refs to tasks — a fire-and-
-   forget `ensure_future`/`create_task` whose result is discarded can
-   be GC'd mid-await (r4's lost-reply bug, fixed in e8387d4 by
-   spawn()/_keep_task). The AST lint below red-flags any reintroduced
-   weak spawn site in the runtime packages.
-2. FUZZ: a reply-path interleaving storm — task bursts racing forced
+1. FUZZ: a reply-path interleaving storm — task bursts racing forced
    gc.collect() from another thread, under full asyncio debug mode —
-   the exact conditions that made r4's bug visible.
-3. WATCHDOG: the blocked-event-loop watchdog (conftest arms it for the
+   the exact conditions that made r4's lost-reply bug visible.
+2. WATCHDOG: the blocked-event-loop watchdog (conftest arms it for the
    whole suite) names the culprit when a callback stalls the loop.
+
+The STRUCTURAL nets that used to live here — the weak-spawn lint, the
+transition-event/gauge emission lints, the trace-propagation and
+step-accounting lints — are now checkers I401..I405 in
+``ray_tpu.analysis`` (declarative site tables, same coverage), gated
+by ``tests/test_lint.py`` and exercised against known-bad fixtures in
+``tests/test_analysis.py``. New invariant lints go through
+``ray_tpu/analysis/invariants.py``, not this file.
 """
 
-import ast
 import gc
 import os
 import threading
 import time
-from pathlib import Path
 
 import pytest
 
 import ray_tpu
-
-REPO = Path(__file__).resolve().parent.parent
 
 
 @pytest.fixture(autouse=True)
@@ -37,359 +36,7 @@ def async_debug(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
-# 1. Weak-spawn-site lint
-# ---------------------------------------------------------------------------
-def _weak_spawn_sites(path: Path) -> list:
-    """(line, src) of ensure_future/create_task calls whose task object
-    is DISCARDED — not kept via _keep_task/spawn, assignment, await,
-    return, or a container append/add."""
-    tree = ast.parse(path.read_text())
-    # Annotate parents.
-    for node in ast.walk(tree):
-        for child in ast.iter_child_nodes(node):
-            child._parent = node
-
-    def is_spawnish(call: ast.Call) -> bool:
-        fn = call.func
-        name = fn.attr if isinstance(fn, ast.Attribute) else getattr(
-            fn, "id", "")
-        return name in ("ensure_future", "create_task")
-
-    def kept(call: ast.Call) -> bool:
-        p = getattr(call, "_parent", None)
-        if isinstance(p, ast.Call):
-            # Argument of another call: _keep_task(...), spawn-like
-            # wrappers, list.append(...), set.add(...) all KEEP it.
-            return True
-        if isinstance(p, (ast.Assign, ast.AnnAssign, ast.AugAssign,
-                          ast.Await, ast.Return, ast.NamedExpr)):
-            return True
-        if isinstance(p, ast.Attribute):
-            # task = loop.create_task(...).<something> chains
-            return True
-        if isinstance(p, (ast.ListComp, ast.GeneratorExp, ast.List,
-                          ast.Tuple, ast.comprehension)):
-            return True
-        return False
-
-    offenders = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call) and is_spawnish(node) \
-                and not kept(node):
-            offenders.append((node.lineno, ast.get_source_segment(
-                path.read_text(), node)))
-    return offenders
-
-
-def test_no_weak_fire_and_forget_spawn_sites():
-    """Every ensure_future/create_task in the runtime keeps a strong
-    reference (r4's GC'd-pending-task bug class). A reintroduced
-    `asyncio.ensure_future(coro())` statement fails here with its
-    file:line."""
-    offenders = {}
-    for pkg in ("ray_tpu/_private", "ray_tpu/serve", "ray_tpu/data",
-                "ray_tpu/util", "ray_tpu/llm"):
-        for path in sorted((REPO / pkg).rglob("*.py")):
-            found = _weak_spawn_sites(path)
-            if found:
-                offenders[str(path.relative_to(REPO))] = found
-    assert not offenders, (
-        f"fire-and-forget task(s) with no strong reference — asyncio "
-        f"may GC them mid-await (wrap in _keep_task()/spawn()): "
-        f"{offenders}")
-
-
-def test_lint_catches_a_weak_site(tmp_path):
-    """The net itself is live: a synthetic weak spawn site is flagged,
-    a kept one is not."""
-    bad = tmp_path / "bad.py"
-    bad.write_text(
-        "import asyncio\n"
-        "def f(loop, coro):\n"
-        "    asyncio.ensure_future(coro)\n")
-    assert _weak_spawn_sites(bad)
-    good = tmp_path / "good.py"
-    good.write_text(
-        "import asyncio\n"
-        "def keep(t):\n"
-        "    return t\n"
-        "def f(loop, coro):\n"
-        "    keep(asyncio.ensure_future(coro))\n"
-        "    t = loop.create_task(coro)\n"
-        "    return t\n")
-    assert not _weak_spawn_sites(good)
-
-
-# ---------------------------------------------------------------------------
-# 1b. Task-lifecycle event-emission lint
-# ---------------------------------------------------------------------------
-def _methods_missing_call(path: Path, methods, callee: str) -> list:
-    """Names from ``methods`` whose body in ``path`` never calls
-    ``self.<callee>(...)`` — including methods that no longer exist
-    (a rename silently dropping its event is exactly the bug class)."""
-    tree = ast.parse(path.read_text())
-    has_call: dict = {}
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                and node.name in methods:
-            calls = {
-                c.func.attr for c in ast.walk(node)
-                if isinstance(c, ast.Call)
-                and isinstance(c.func, ast.Attribute)
-                and isinstance(c.func.value, ast.Name)
-                and c.func.value.id == "self"}
-            has_call[node.name] = (has_call.get(node.name, False)
-                                   or callee in calls)
-    return [m for m in methods if not has_call.get(m, False)]
-
-
-# Every task state-transition site in the node service and the worker:
-# each must emit a lifecycle event, or the task_events stream (state
-# API, timeline, phase metrics) silently loses that transition.
-_NODE_TRANSITION_SITES = (
-    "submit",              # SUBMITTED
-    "_start_reconstruction",  # RECONSTRUCTING
-    "_run_on_worker",      # RUNNING (cpu lane, head of a fresh lease)
-    "_on_task_running",    # RUNNING (pipelined spec starts on the worker)
-    "_requeue_unstarted",  # SUBMITTED (unstarted spec off a dead worker)
-    "_run_on_device",      # RUNNING + FINISHED (device lane)
-    "_run_actor_task",     # RUNNING (actor call)
-    "_handle_task_reply",  # FINISHED (cpu lane)
-    "_fail_task",          # FAILED
-    "_execute_remotely",   # FORWARDED
-    "_handle_remote_reply",  # FINISHED/FAILED (owner side)
-    "_actor_alive",        # FINISHED (actor creation)
-)
-_WORKER_TRANSITION_SITES = (
-    "_execute",            # ARGS_FETCHED + OUTPUT_SERIALIZED
-)
-# Every merge-round state change in the push-based exchange coordinator
-# (data/exchange.py): each must emit into the exchange registry or
-# list_exchanges/the dashboard pane silently lose that transition.
-_EXCHANGE_TRANSITION_SITES = (
-    "_submit_map_round",    # MAP_ROUND_SUBMITTED
-    "_submit_merge_round",  # MERGE_ROUND_SUBMITTED
-    "_drain_round",         # ROUND_COMPLETED
-    "_submit_reduce",       # REDUCE_SUBMITTED
-    "_finish",              # FINISHED
-)
-
-
-def test_every_task_transition_site_emits_an_event():
-    missing = _methods_missing_call(
-        REPO / "ray_tpu/_private/node_service.py",
-        _NODE_TRANSITION_SITES, "_event")
-    missing += [
-        f"worker.{m}" for m in _methods_missing_call(
-            REPO / "ray_tpu/_private/worker.py",
-            _WORKER_TRANSITION_SITES, "_task_event")]
-    assert not missing, (
-        f"task state-transition site(s) emit no lifecycle event "
-        f"(self._event / self._task_event): {missing}")
-
-
-def test_every_exchange_transition_site_emits_an_event():
-    missing = [
-        f"exchange.{m}" for m in _methods_missing_call(
-            REPO / "ray_tpu/data/exchange.py",
-            _EXCHANGE_TRANSITION_SITES, "_event")]
-    assert not missing, (
-        f"exchange merge-round state-transition site(s) emit no "
-        f"lifecycle event (self._event): {missing}")
-
-
-# Every request state-transition site in the generation engine's
-# scheduler (llm/engine.py): WAITING/PREFILL/RUNNING/PREEMPTED/FINISHED
-# must emit events, or the engine's lifecycle trace (and the
-# preempt+resume determinism tests built on it) silently lose
-# transitions.
-_ENGINE_TRANSITION_SITES = (
-    "add_request",  # WAITING
-    "_admit",       # PREFILL (joined the in-flight batch)
-    "_activate",    # RUNNING (prefill done, decoding)
-    "_preempt",     # PREEMPTED (pool exhausted, blocks freed)
-    "_finish",      # FINISHED (stop token / length / abort)
-)
-
-
-def test_every_engine_transition_site_emits_an_event():
-    missing = [
-        f"engine.{m}" for m in _methods_missing_call(
-            REPO / "ray_tpu/llm/engine.py",
-            _ENGINE_TRANSITION_SITES, "_event")]
-    assert not missing, (
-        f"engine scheduler state-transition site(s) emit no lifecycle "
-        f"event (self._event): {missing}")
-
-
-# Every site that mutates the CPU dispatch queue (pending_cpu) or a
-# worker's pipeline window (inflight): each must refresh the telemetry
-# high-water gauges, or the sampler's dispatch_queue_hw /
-# pipeline_inflight_hw silently miss between-sample bursts.
-_DISPATCH_QUEUE_SITES = (
-    "_enqueue_local",      # pending_cpu.append (local submit)
-    "_dispatch",           # pending_cpu = still_pending
-    "_try_spill",          # pending_cpu.append (spill bounce-back)
-    "_requeue_unstarted",  # pending_cpu re-queue off a dead worker
-    "_retry_or_fail",      # pending_cpu.append (retry)
-    "_handle_task_reply",  # pending_cpu.append (retry_exceptions)
-    "_run_on_device",      # pending_cpu.append (device retry)
-    "_handle_rpc",         # pending_cpu = keep (register setup_error)
-)
-_PIPELINE_WINDOW_SITES = (
-    "_acquire_worker",     # inflight[...] = spec (pipelined lease)
-    "_run_on_worker",      # inflight[...] = spec (fresh lease)
-    "_run_actor_task",     # inflight[...] = spec (actor lane)
-)
-
-
-def test_every_queue_mutation_site_updates_its_gauge():
-    path = REPO / "ray_tpu/_private/node_service.py"
-    missing = _methods_missing_call(
-        path, _DISPATCH_QUEUE_SITES, "_gauge_queues")
-    missing += _methods_missing_call(
-        path, _PIPELINE_WINDOW_SITES, "_gauge_queues")
-    assert not missing, (
-        f"dispatch-queue/pipeline-window mutation site(s) never refresh "
-        f"the telemetry gauges (self._gauge_queues): {missing}")
-
-
-# ---------------------------------------------------------------------------
-# 1c. Request-trace propagation lint
-# ---------------------------------------------------------------------------
-def _funcs_missing_name(path: Path, funcs, name: str) -> list:
-    """Entries from ``funcs`` ("func" or "Class.method") whose body in
-    ``path`` never references identifier ``name`` (bare name,
-    attribute, parameter, or keyword argument) — including functions
-    that no longer exist (a rename silently dropping the propagation
-    is exactly the bug class)."""
-    tree = ast.parse(path.read_text())
-
-    def refs(node) -> bool:
-        for n in ast.walk(node):
-            if isinstance(n, ast.Name) and n.id == name:
-                return True
-            if isinstance(n, ast.Attribute) and n.attr == name:
-                return True
-            if isinstance(n, ast.keyword) and n.arg == name:
-                return True
-            if isinstance(n, ast.arg) and n.arg == name:
-                return True
-        return False
-
-    found: dict = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ClassDef):
-            for ch in node.body:
-                if isinstance(ch, (ast.FunctionDef,
-                                   ast.AsyncFunctionDef)):
-                    key = f"{node.name}.{ch.name}"
-                    if key in funcs:
-                        found[key] = found.get(key, False) or refs(ch)
-        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            if node.name in funcs:
-                found[node.name] = (found.get(node.name, False)
-                                    or refs(node))
-    return [f for f in funcs if not found.get(f, False)]
-
-
-# Every hop that forwards a serving request must forward its trace
-# context too, or the waterfall silently breaks at that hop: the proxy's
-# executor handoff (contextvars do NOT cross run_in_executor without
-# copy_context), the handle submit + its replica-death retry, the
-# replica entry, the batcher's collect + execute, and the engine ingest.
-_TRACE_PROPAGATION_SITES = (
-    ("ray_tpu/serve/http_proxy.py", "HTTPProxy._handle_routed",
-     "copy_context"),
-    ("ray_tpu/serve/deployment.py", "DeploymentHandle.remote",
-     "trace_ctx"),
-    ("ray_tpu/serve/deployment.py", "DeploymentResponse.result",
-     "trace_ctx"),
-    ("ray_tpu/serve/replica.py", "Replica.handle_request",
-     "trace_ctx"),
-    ("ray_tpu/serve/batching.py", "_Pending.__init__", "trace_ctx"),
-    ("ray_tpu/serve/batching.py", "_Batcher._run_batch", "trace_ctx"),
-    ("ray_tpu/llm/engine.py", "LLMEngine.add_request", "trace_ctx"),
-    ("ray_tpu/serve/llm.py", "_LLMServer.__call__", "trace_ctx"),
-)
-
-
-def test_every_request_hop_forwards_trace_context():
-    missing = []
-    for rel, func, ident in _TRACE_PROPAGATION_SITES:
-        missing += [f"{rel}:{f} (no {ident})" for f in
-                    _funcs_missing_name(REPO / rel, (func,), ident)]
-    assert not missing, (
-        f"request-forwarding hop(s) drop the trace context — the "
-        f"waterfall breaks at that hop: {missing}")
-
-
-# Every device-dispatch site in the engine scheduler and the train
-# session must feed the step accounting (util/perfmodel.py), or the
-# continuous llm_*/train_* MFU/step-breakdown series silently go
-# stale/partial: a step that skips accounting reads as ZERO device
-# time, which the roofline then misclassifies as host-bound.
-_PERF_EMIT_SITES = (
-    # Engine: both dispatch paths price their device span, step() opens
-    # and closes the accounting, and the gauge publisher reads it.
-    ("ray_tpu/llm/engine.py", "LLMEngine._run_prefills", "_step_perf"),
-    ("ray_tpu/llm/engine.py", "LLMEngine._run_decode", "_step_perf"),
-    ("ray_tpu/llm/engine.py", "LLMEngine.step", "_step_perf"),
-    ("ray_tpu/llm/engine.py", "LLMEngine._publish_gauges",
-     "_step_perf"),
-    # Train: report() drains the accumulated device spans into the
-    # metrics dict, and the public wrap_step feeds them.
-    ("ray_tpu/train/session.py", "_TrainSession.report",
-     "_drain_step_perf"),
-    ("ray_tpu/train/session.py", "wrap_step", "record_device"),
-)
-
-
-def test_every_device_dispatch_site_feeds_step_accounting():
-    missing = []
-    for rel, func, ident in _PERF_EMIT_SITES:
-        missing += [f"{rel}:{f} (no {ident})" for f in
-                    _funcs_missing_name(REPO / rel, (func,), ident)]
-    assert not missing, (
-        f"device-dispatch site(s) bypass the step accounting — the "
-        f"MFU/step-breakdown series go stale or misattribute the step "
-        f"to host time: {missing}")
-
-
-def test_trace_lint_catches_a_dropping_hop(tmp_path):
-    """The net itself is live: a forwarding method that drops the
-    context is flagged, one that carries it is not, and a REMOVED
-    method is flagged."""
-    src = tmp_path / "hop.py"
-    src.write_text(
-        "class H:\n"
-        "    def good(self, req, trace_ctx=None):\n"
-        "        return self.next(req, trace_ctx)\n"
-        "    def drops(self, req):\n"
-        "        return self.next(req)\n")
-    assert _funcs_missing_name(src, ("H.good",), "trace_ctx") == []
-    assert _funcs_missing_name(
-        src, ("H.good", "H.drops", "H.gone"), "trace_ctx") == [
-        "H.drops", "H.gone"]
-
-
-def test_event_lint_catches_a_silent_site(tmp_path):
-    """The net itself is live: a transition method without an emit is
-    flagged, one with it is not, and a REMOVED method is flagged."""
-    src = tmp_path / "svc.py"
-    src.write_text(
-        "class S:\n"
-        "    def good(self, spec):\n"
-        "        self._event(spec, 'RUNNING')\n"
-        "    def silent(self, spec):\n"
-        "        pass\n")
-    assert _methods_missing_call(src, ("good",), "_event") == []
-    assert _methods_missing_call(
-        src, ("good", "silent", "gone"), "_event") == ["silent", "gone"]
-
-
-# ---------------------------------------------------------------------------
-# 2. Reply-path GC fuzz
+# 1. Reply-path GC fuzz
 # ---------------------------------------------------------------------------
 def test_reply_path_survives_gc_storm(rt):
     """Bursts of tasks on both lanes while another thread forces full
@@ -426,7 +73,7 @@ def test_reply_path_survives_gc_storm(rt):
 
 
 # ---------------------------------------------------------------------------
-# 3. Blocked-loop watchdog
+# 2. Blocked-loop watchdog
 # ---------------------------------------------------------------------------
 def test_watchdog_red_flags_blocked_loop(capfd):
     """A callback that stalls the event loop gets NAMED: the watchdog

@@ -430,3 +430,49 @@ def test_yaml_config_deploy(serve_rt, tmp_path):
     finally:
         sys.path.remove(str(tmp_path))
         sys.modules.pop("my_serve_app", None)
+
+
+def test_status_not_blocked_by_slow_reconfigure(serve_rt):
+    """Regression (rtpu lint C101): deploy_application used to hold the
+    controller's lock across the untimed reconfigure() round-trip, so a
+    replica hanging in reconfigure() wedged every status()/routing
+    query behind the lock. The reconfigure get now happens after the
+    lock is released: status stays fast while reconfigure runs."""
+    @serve.deployment(user_config={"delay": 0.0},
+                      ray_actor_options=DEVICE)
+    class SlowReconfig:
+        def __init__(self):
+            self.delay = None
+
+        def reconfigure(self, config):
+            time.sleep(config["delay"])
+            self.delay = config["delay"]
+
+        def __call__(self, _):
+            return self.delay
+
+    handle = serve.run(SlowReconfig.bind())
+    assert handle.remote(0).result(timeout=60) == 0.0
+
+    done = threading.Event()
+
+    def redeploy():
+        serve.run(SlowReconfig.options(
+            user_config={"delay": 2.0}).bind())
+        done.set()
+
+    t = threading.Thread(target=redeploy, daemon=True)
+    t.start()
+    time.sleep(0.4)  # let the redeploy reach the reconfigure wait
+    latencies = []
+    while not done.is_set() and len(latencies) < 3:
+        t0 = time.monotonic()
+        st = serve.status()
+        latencies.append(time.monotonic() - t0)
+        assert "SlowReconfig" in st
+    t.join(timeout=30)
+    assert done.is_set()
+    # With the lock held across the 2s reconfigure, the first status
+    # call issued mid-deploy stalls for the remainder of the sleep.
+    assert latencies and min(latencies) < 1.0, latencies
+    assert handle.remote(0).result(timeout=60) == 2.0
