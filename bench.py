@@ -676,7 +676,52 @@ def run_serve_llm():
     return row
 
 
+def run_jobs_bench():
+    """Multi-tenant job plane under churn: K tenants x M gang jobs on a
+    simulated v5e fleet that shrinks mid-run, driven by the real
+    scheduler + autoscaler stack in virtual time. Appends makespan,
+    Jain fairness, and requeue counts to JOBS_BENCH.json."""
+    from ray_tpu.jobs.sim import JobPlaneSim
+
+    tenants = int(os.environ.get("RT_JOBS_BENCH_TENANTS", "4"))
+    jobs_per = int(os.environ.get("RT_JOBS_BENCH_JOBS", "8"))
+    sim = JobPlaneSim(max_slices_per_type=2, idle_timeout_ticks=4,
+                      boot_delay_ticks=1, launch_backoff_ticks=1)
+    for k in range(tenants):
+        weight = float(k + 1)  # tenant-3 deserves 4x tenant-0's service
+        for j in range(jobs_per):
+            shape = [{"TPU": 4}, {"TPU": 8}, {"TPU": 16}][j % 3]
+            sim.submit(f"tenant-{k}", weight=weight, shape=shape,
+                       duration=2 + (j % 3))
+    report = sim.run(max_ticks=2000, shrink_at=12, shrink_frac=0.5)
+    row = {
+        "tenants": tenants, "jobs": report["jobs"],
+        "finished": report["finished"],
+        "makespan_ticks": report["makespan"],
+        "requeues": report["requeues"],
+        "lost_gangs": report["lost_gangs"],
+        "jain_weighted": round(report["jain_weighted"], 4),
+        "ledger_shares": {t: round(s, 4) for t, s
+                          in sorted(report["ledger_shares"].items())},
+        "slices_killed": report["slices_killed"],
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    out = os.environ.get("RT_JOBS_BENCH_OUT", "JOBS_BENCH.json")
+    doc = {}
+    if os.path.exists(out):
+        with open(out) as f:
+            doc = json.load(f)
+    doc["churn"] = row
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    return row
+
+
 def main():
+    if "--jobs" in sys.argv:
+        print(json.dumps(run_jobs_bench()))
+        return 0
     if "--data-shuffle" in sys.argv:
         print(json.dumps(run_data_shuffle()))
         return 0

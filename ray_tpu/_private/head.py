@@ -890,8 +890,25 @@ class HeadService:
         for pg in self.placement_groups.values():
             if pg.state == "PENDING":
                 pending_bundles.extend(dict(b) for b in pg.bundles)
+        # Queued gang shapes published by the JobManager (KV rendezvous:
+        # the job plane writes autoscaler:job_demand, the autoscaler
+        # reads it here) — pending jobs drive slice launches the same
+        # way parked tasks and unplaced PG bundles do.
+        job_demand = []
+        blob = self.kv.get("autoscaler:job_demand")
+        if blob:
+            try:
+                import json
+
+                shapes = json.loads(
+                    blob.decode() if isinstance(blob, bytes) else blob)
+                job_demand = [dict(s) for s in shapes
+                              if isinstance(s, dict)]
+            except (ValueError, AttributeError, TypeError):
+                job_demand = []
         return {"nodes": nodes, "demand": demand,
-                "pending_pg_bundles": pending_bundles}
+                "pending_pg_bundles": pending_bundles,
+                "job_demand": job_demand}
 
     # ------------------------------------------------------------------
     # KV / functions / named actors

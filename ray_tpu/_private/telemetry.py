@@ -276,6 +276,14 @@ class TelemetrySampler:
         "rtpu_train_host_gap_ms": ("train_host_gap_ms", "max"),
         "rtpu_train_mfu": ("train_mfu", "max"),
         "rtpu_train_hbm_util": ("train_hbm_util", "max"),
+        # Multi-tenant job plane (job_submission.JobManager gauges,
+        # tagged by tenant): queue depths and running counts sum if a
+        # manager ever restarts mid-flush; share/served-cost are
+        # cumulative per-tenant values, so take the freshest (max).
+        "rtpu_jobs_queued": ("jobs_queued", "sum"),
+        "rtpu_jobs_running": ("jobs_running", "sum"),
+        "rtpu_tenant_share": ("tenant_share", "max"),
+        "rtpu_tenant_served_cost": ("tenant_served_cost", "max"),
     }
 
     def _iter_metric_snaps(self):
@@ -301,7 +309,8 @@ class TelemetrySampler:
                 if name in self._LLM_GAUGES:
                     prefix, red = self._LLM_GAUGES[name]
                     tags = r.get("tags", {})
-                    dep = tags.get("deployment") or tags.get("trial", "?")
+                    dep = tags.get("deployment") or tags.get("trial") \
+                        or tags.get("tenant", "?")
                     key = f"{prefix}:{dep}"
                     val = float(r.get("value", 0.0))
                     if red == "max":

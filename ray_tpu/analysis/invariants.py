@@ -172,6 +172,31 @@ EVENT_SITE_TABLES = (
     ), "engine scheduler state-transition site emits no lifecycle "
        "event — the preempt+resume determinism tests and the request "
        "trace silently lose transitions"),
+    ("ray_tpu/jobs/scheduler.py", "_event", (
+        "submit",         # admitted / rejected (+ reason)
+        "next_dispatch",  # dispatched (+ shape, cost, tenant pass)
+        "on_finish",      # finished (+ outcome)
+        "requeue",        # requeued (gang lost, back to head-of-line)
+    ), "job-plane scheduling decision site emits no ledger event — "
+       "fairness audits (ledger_shares, Jain index) and the rtpu jobs "
+       "timeline silently lose that decision"),
+    ("ray_tpu/job_submission.py", "_job_event", (
+        "submit_job",  # queued
+        "_finish",     # finished (+ return code)
+        "stop_job",    # stopped
+    ), "job lifecycle site emits no ledger event — the single "
+       "scheduler/manager timeline silently loses the transition"),
+    ("ray_tpu/autoscaler/instance_manager.py", "_record", (
+        "request",          # instance requested
+        "drain",            # drain requested
+        "requeue_or_fail",  # requeue (backoff) or give_up (reasoned)
+        "reconcile",        # FSM transitions
+    ), "instance FSM decision site emits no record — scale-up/down "
+       "forensics (why did this slice relaunch/fail?) go dark"),
+    ("ray_tpu/autoscaler/autoscaler.py", "_event", (
+        "update",  # launch / terminate decisions per pass
+    ), "autoscaler decision site emits no event — the demand-driven "
+       "launch/idle-terminate audit trail goes dark"),
 )
 
 #: Dispatch-queue / pipeline-window mutation sites that must refresh

@@ -15,19 +15,22 @@ from __future__ import annotations
 
 import asyncio
 
-from .autoscaler import (AutoscalerMonitor, AutoscalingConfig,
-                         NodeTypeConfig, ResourceDemandScheduler,
-                         ScalingActions, StandardAutoscaler)
+from .autoscaler import (V5E_TOPOLOGIES, AutoscalerMonitor,
+                         AutoscalingConfig, NodeTypeConfig,
+                         ResourceDemandScheduler, ScalingActions,
+                         StandardAutoscaler, v5e_node_types)
 from .instance_manager import (Instance, InstanceManager,
                                QueuedSliceProvider, StandardAutoscalerV2)
-from .node_provider import LocalNodeProvider, NodeProvider, SliceHandle
+from .node_provider import (LocalNodeProvider, NodeProvider,
+                            SimulatedNodeProvider, SliceHandle)
 
 __all__ = [
     "AutoscalerMonitor", "AutoscalingCluster", "AutoscalingConfig",
     "Instance", "InstanceManager", "LocalNodeProvider", "NodeProvider",
     "NodeTypeConfig", "QueuedSliceProvider", "ResourceDemandScheduler",
-    "ScalingActions", "SliceHandle", "StandardAutoscaler",
-    "StandardAutoscalerV2",
+    "ScalingActions", "SimulatedNodeProvider", "SliceHandle",
+    "StandardAutoscaler", "StandardAutoscalerV2", "V5E_TOPOLOGIES",
+    "v5e_node_types",
 ]
 
 

@@ -23,11 +23,40 @@ matching the reference's scheduler tests.
 from __future__ import annotations
 
 import asyncio
+import json
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from .node_provider import NodeProvider, SliceHandle
+
+#: Cloud TPU v5e slice topologies (1x1 … 4x8): total chips and member
+#: hosts per slice. Single-host topologies pack up to 8 chips on one VM;
+#: multi-host slices run 4 chips per host — so a 4x8 slice is a gang of
+#: 8 hosts that launches, counts, and terminates atomically.
+V5E_TOPOLOGIES = {
+    "1x1": (1, 1),
+    "2x2": (4, 1),
+    "2x4": (8, 2),
+    "4x4": (16, 4),
+    "4x8": (32, 8),
+}
+
+
+def v5e_node_types(max_workers: int = 2, min_workers: int = 0,
+                   cpu_per_host: int = 8) -> List["NodeTypeConfig"]:
+    """One launchable NodeTypeConfig per v5e topology — the standard
+    fleet the simulated provider scales over (and the feasibility
+    envelope admission control checks gang shapes against)."""
+    out = []
+    for topo, (chips, hosts) in V5E_TOPOLOGIES.items():
+        out.append(NodeTypeConfig(
+            name=f"v5e-{topo}",
+            resources={"CPU": cpu_per_host, "TPU": chips // hosts},
+            min_workers=min_workers, max_workers=max_workers,
+            hosts=hosts))
+    return out
 
 
 @dataclass
@@ -50,6 +79,14 @@ class AutoscalingConfig:
 
     def type_map(self) -> Dict[str, NodeTypeConfig]:
         return {t.name: t for t in self.node_types}
+
+    def envelope(self) -> List[dict]:
+        """Launchable slice topologies, in the shape admission control
+        consumes (jobs/admission.check_feasible) — published to the
+        cluster KV by the monitor so the job plane can reject gangs no
+        slice could ever hold."""
+        return [{"name": t.name, "resources": dict(t.resources),
+                 "hosts": t.hosts} for t in self.node_types]
 
 
 @dataclass
@@ -84,12 +121,20 @@ class ResourceDemandScheduler:
         demand: List[dict],
         free_capacity: List[dict],
         slice_counts: Dict[str, int],
+        free_slices: Optional[List[dict]] = None,
     ) -> Dict[str, int]:
         """demand: pending resource shapes; free_capacity: available dict
         per alive/launching host; slice_counts: current slices per type
         (alive + launching). Greedy first-fit-decreasing: pack each shape
         into existing free capacity, else open the smallest feasible node
-        type under its max_workers."""
+        type under its max_workers.
+
+        Shapes too big for any single host are SLICE-shaped requests —
+        gang jobs whose unit of placement is a whole slice. Those match
+        against ``free_slices`` (``{"node_type", "available"}`` rows, one
+        per wholly-idle or still-launching slice, aggregate availability)
+        one gang per slice, else open the smallest topology whose
+        AGGREGATE (per-host x hosts) covers them."""
         types = self.config.node_types
         counts = dict(slice_counts)
         bins = [dict(c) for c in free_capacity]
@@ -100,9 +145,45 @@ class ResourceDemandScheduler:
         def size(shape):
             return sum(shape.values())
 
-        for shape in sorted(demand, key=size, reverse=True):
+        def aggregate(t):
+            return {k: v * t.hosts for k, v in t.resources.items()}
+
+        host_shapes, gang_shapes = [], []
+        for shape in demand:
             if not shape or not any(shape.values()):
                 continue
+            if any(_fits(t.resources, shape) for t in types):
+                host_shapes.append(shape)
+            else:
+                gang_shapes.append(shape)
+
+        groups: List[Optional[dict]] = [dict(g)
+                                        for g in (free_slices or [])]
+        for shape in sorted(gang_shapes, key=size, reverse=True):
+            placed = False
+            for i, g in enumerate(groups):
+                if g is not None and _fits(g["available"], shape):
+                    groups[i] = None  # a slice hosts one gang
+                    placed = True
+                    break
+            if placed:
+                continue
+            for t in sorted(types, key=lambda t: size(aggregate(t))):
+                if counts.get(t.name, 0) >= t.max_workers:
+                    continue
+                if cap is not None and total >= cap:
+                    break
+                if _fits(aggregate(t), shape):
+                    # The gang owns the whole new slice: no host bins
+                    # open up for the remaining per-host demand.
+                    counts[t.name] = counts.get(t.name, 0) + 1
+                    total += 1
+                    launch[t.name] = launch.get(t.name, 0) + 1
+                    break
+            # else: no topology's aggregate covers the gang — admission
+            # control rejects such shapes up front; drop defensively.
+
+        for shape in sorted(host_shapes, key=size, reverse=True):
             placed = False
             for b in bins:
                 if _fits(b, shape):
@@ -140,6 +221,14 @@ class StandardAutoscaler:
         self.provider = provider
         self.scheduler = ResourceDemandScheduler(config)
         self._idle_since: Dict[str, float] = {}  # slice_id -> t
+        #: Scale-decision ledger (bounded): every launch/terminate the
+        #: reconcile actually executes, for the observability plane.
+        self.events: deque = deque(maxlen=256)
+
+    def _event(self, kind: str, **extra):
+        ev = {"ts": time.time(), "kind": kind}
+        ev.update(extra)
+        self.events.append(ev)
 
     # -- pure decision core -------------------------------------------------
     def plan(self, snapshot: dict, slices: List[SliceHandle],
@@ -165,17 +254,48 @@ class StandardAutoscaler:
                 if nid not in alive and t is not None:
                     launching_hosts.append(dict(t.resources))
 
-        # Demand = parked shapes + unplaced PG bundles.
-        demand = list(snapshot["demand"]) + list(
-            snapshot.get("pending_pg_bundles", []))
+        # Demand = parked shapes + unplaced PG bundles + queued gang
+        # jobs (the job plane publishes its pending shapes through the
+        # head snapshot — ISSUE 15's closed loop: pending gang demand is
+        # what drives slice-shaped scale-up).
+        demand = list(snapshot["demand"]) \
+            + list(snapshot.get("pending_pg_bundles", [])) \
+            + list(snapshot.get("job_demand", []))
 
         # Free capacity: available on alive hosts + full capacity of
         # hosts still launching (they'll absorb demand when up).
         free = [dict(n["available"]) for n in alive.values()] \
             + launching_hosts
 
+        # Whole-slice availability for gang-shaped demand: a slice whose
+        # every member host is untouched (or still launching — it will
+        # be whole when up) can absorb one pending gang; anything less
+        # cannot, since a gang owns its slice outright.
+        free_slices = []
+        for h in slices:
+            t = types.get(h.node_type)
+            if t is None or not h.node_ids:
+                continue
+            agg: Dict[str, float] = {}
+            whole = True
+            for nid in h.node_ids:
+                row = alive.get(nid)
+                if row is None:
+                    avail = t.resources  # launching: full once up
+                elif row["reservations"] == 0 \
+                        and row["available"] == row["resources"]:
+                    avail = row["available"]
+                else:
+                    whole = False
+                    break
+                for k, v in avail.items():
+                    agg[k] = agg.get(k, 0) + v
+            if whole:
+                free_slices.append({"node_type": h.node_type,
+                                    "available": agg})
+
         launch = self.scheduler.get_slices_to_launch(
-            demand, free, slice_counts)
+            demand, free, slice_counts, free_slices)
 
         # Enforce min_workers per type (on top of demand launches).
         for t in self.config.node_types:
@@ -214,16 +334,19 @@ class StandardAutoscaler:
         return actions
 
     # -- side-effecting reconcile ------------------------------------------
-    def update(self, snapshot: dict) -> ScalingActions:
+    def update(self, snapshot: dict,
+               now: Optional[float] = None) -> ScalingActions:
         slices = self.provider.non_terminated_slices()
-        actions = self.plan(snapshot, slices)
+        actions = self.plan(snapshot, slices, now)
         for type_name, count in actions.launch.items():
             t = self.config.type_map()[type_name]
             for _ in range(count):
                 self.provider.create_slice(t.name, t.resources, t.hosts)
+            self._event("launch", node_type=type_name, count=count)
         for slice_id in actions.terminate:
             self.provider.terminate_slice(slice_id)
             self._idle_since.pop(slice_id, None)
+            self._event("terminate", slice_id=slice_id, reason="idle")
         return actions
 
 
@@ -232,6 +355,13 @@ class AutoscalerMonitor:
     `monitor.py` process started by the head; here a task on the driver's
     runtime loop since the driver is the head)."""
 
+    #: KV keys tying the job plane to the autoscaler: the monitor
+    #: publishes its launchable topologies (admission feasibility), the
+    #: JobManager publishes its pending gang shapes (scale-up demand,
+    #: read back by HeadService.autoscaler_snapshot).
+    ENVELOPE_KV_KEY = "autoscaler:fleet_envelope"
+    JOB_DEMAND_KV_KEY = "autoscaler:job_demand"
+
     def __init__(self, head_service, config: AutoscalingConfig,
                  provider: NodeProvider):
         self.head = head_service
@@ -239,8 +369,18 @@ class AutoscalerMonitor:
         self._task: Optional[asyncio.Task] = None
         self._stopped = asyncio.Event()
 
+    def _publish_envelope(self):
+        self.head.kv_op(
+            "put", self.ENVELOPE_KV_KEY,
+            json.dumps(self.autoscaler.config.envelope()).encode())
+
     async def _run(self):
         interval = self.autoscaler.config.update_interval_s
+        try:
+            self._publish_envelope()
+        except Exception as e:  # noqa: BLE001 - monitor must survive
+            import sys
+            sys.stderr.write(f"autoscaler envelope publish failed: {e}\n")
         while not self._stopped.is_set():
             try:
                 snap = self.head.autoscaler_snapshot()

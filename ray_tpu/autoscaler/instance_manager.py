@@ -21,8 +21,9 @@ history — the v2 storage/observability contract.
 from __future__ import annotations
 
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set
+from typing import Callable, Dict, List, Optional, Set
 
 from .autoscaler import AutoscalingConfig, ScalingActions, StandardAutoscaler
 from .node_provider import NodeProvider, SliceHandle
@@ -43,6 +44,12 @@ class Instance:
     launch_attempts: int = 0
     state_since: float = field(default_factory=time.monotonic)
     history: List[tuple] = field(default_factory=list)  # (ts, state, reason)
+    #: Launch backoff gate: a requeued instance stays PENDING (not
+    #: resubmitted to the provider) until the reconcile clock passes this.
+    not_before: float = 0.0
+    #: Set when the FSM gives up on the instance — the reasoned failure
+    #: callers surface instead of silently looping.
+    failure: Optional[str] = None
 
     def transition(self, state: str, reason: str, now: Optional[float] = None):
         now = time.monotonic() if now is None else now
@@ -57,13 +64,28 @@ class InstanceManager:
 
     def __init__(self, provider: NodeProvider, type_map: dict,
                  max_launch_retries: int = 3,
-                 launch_timeout_s: float = 120.0):
+                 launch_timeout_s: float = 120.0,
+                 launch_backoff_s: float = 0.0):
         self.provider = provider
         self.types = type_map
         self.max_launch_retries = max_launch_retries
         self.launch_timeout_s = launch_timeout_s
+        #: Base of the exponential relaunch backoff: attempt N waits
+        #: base * 2^(N-1) before resubmitting (0 = immediate, the
+        #: pre-backoff behavior the fast in-process tests rely on).
+        self.launch_backoff_s = launch_backoff_s
         self._instances: Dict[str, Instance] = {}
         self._counter = 0
+        #: Scale-decision ledger (bounded): request/drain/requeue/
+        #: give-up and every reconcile transition, with reasons.
+        self.events: deque = deque(maxlen=512)
+
+    def _record(self, kind: str, inst: Instance, reason: str):
+        self.events.append({
+            "ts": time.time(), "kind": kind,
+            "instance_id": inst.instance_id,
+            "node_type": inst.node_type, "state": inst.state,
+            "reason": reason})
 
     # -- commands ----------------------------------------------------------
     def request(self, node_type: str) -> Instance:
@@ -72,6 +94,7 @@ class InstanceManager:
                         node_type=node_type)
         inst.transition(PENDING, "requested")
         self._instances[inst.instance_id] = inst
+        self._record("request", inst, "requested")
         return inst
 
     def drain(self, slice_id: str, reason: str = "idle"):
@@ -79,8 +102,42 @@ class InstanceManager:
             if (inst.slice is not None and inst.slice.slice_id == slice_id
                     and inst.state in (LAUNCHING, ALIVE)):
                 inst.transition(DRAINING, reason)
+                self._record("drain", inst, reason)
                 return inst
         return None
+
+    def requeue_or_fail(self, inst: Instance, what: str,
+                        now: Optional[float] = None) -> tuple:
+        """A launch attempt was lost (provider error, queued-resource
+        failure, timeout): requeue with exponential backoff, or — past
+        ``max_launch_retries`` — give up with a reasoned TERMINATED so
+        the failure surfaces instead of looping forever. Returns
+        (old_state, new_state)."""
+        now = time.monotonic() if now is None else now
+        old = inst.state
+        inst.launch_attempts += 1
+        if inst.launch_attempts > self.max_launch_retries:
+            inst.failure = (f"{what}; giving up after "
+                            f"{inst.launch_attempts - 1} retries")
+            inst.transition(TERMINATED, inst.failure, now)
+            self._record("give_up", inst, inst.failure)
+        else:
+            inst.slice = None
+            backoff = self.launch_backoff_s * (
+                2 ** (inst.launch_attempts - 1))
+            inst.not_before = now + backoff
+            reason = (f"{what}; requeued (attempt "
+                      f"{inst.launch_attempts}, backoff {backoff:g}s)")
+            inst.transition(PENDING, reason, now)
+            self._record("requeue", inst, reason)
+        return (old, inst.state)
+
+    def failures(self) -> List[dict]:
+        """Instances the FSM gave up on, with their reasons."""
+        return [{"instance_id": i.instance_id, "node_type": i.node_type,
+                 "reason": i.failure}
+                for i in self._instances.values()
+                if i.failure is not None]
 
     # -- queries -----------------------------------------------------------
     def instances(self, states: Optional[Set[str]] = None) -> List[Instance]:
@@ -118,20 +175,16 @@ class InstanceManager:
         def move(inst, state, reason):
             events.append((inst.instance_id, inst.state, state))
             inst.transition(state, reason, now)
+            self._record("transition", inst, reason)
 
-        def requeue_or_fail(inst, what: str):
-            inst.launch_attempts += 1
-            if inst.launch_attempts > self.max_launch_retries:
-                move(inst, TERMINATED,
-                     f"{what}; giving up after {inst.launch_attempts - 1} "
-                     f"retries")
-            else:
-                inst.slice = None
-                move(inst, PENDING, f"{what}; requeued "
-                     f"(attempt {inst.launch_attempts})")
+        def requeue(inst, what: str):
+            events.append(
+                (inst.instance_id, *self.requeue_or_fail(inst, what, now)))
 
         for inst in list(self._instances.values()):
             if inst.state == PENDING:
+                if now < inst.not_before:
+                    continue  # relaunch backoff still cooling down
                 t = self.types.get(inst.node_type)
                 if t is None:
                     move(inst, TERMINATED, "unknown node type")
@@ -140,7 +193,7 @@ class InstanceManager:
                     inst.slice = self.provider.create_slice(
                         t.name, t.resources, t.hosts)
                 except Exception as e:  # noqa: BLE001 - provider hiccup
-                    requeue_or_fail(inst, f"provider create failed: {e}")
+                    requeue(inst, f"provider create failed: {e}")
                     continue
                 move(inst, LAUNCHING, "submitted to provider")
 
@@ -149,7 +202,7 @@ class InstanceManager:
                 if live is None:
                     # Crashed/failed while provisioning: the core v2
                     # contract — requeue, don't leak a phantom instance.
-                    requeue_or_fail(inst, "slice lost while launching")
+                    requeue(inst, "slice lost while launching")
                     continue
                 inst.slice = live  # node ids fill in as provisioning lands
                 if live.node_ids and all(
@@ -160,7 +213,7 @@ class InstanceManager:
                         self.provider.terminate_slice(inst.slice.slice_id)
                     except Exception:  # lint: allow-swallow(terminate best-effort; slice requeued)
                         pass
-                    requeue_or_fail(inst, "launch timed out")
+                    requeue(inst, "launch timed out")
 
             elif inst.state == ALIVE:
                 live = provider_live.get(inst.slice.slice_id)
@@ -194,9 +247,11 @@ class QueuedSliceProvider(NodeProvider):
 
     QUEUED, ACTIVE, FAILED = "QUEUED", "ACTIVE", "FAILED"
 
-    def __init__(self, inner: NodeProvider, provisioning_delay_s: float = 0.0):
+    def __init__(self, inner: NodeProvider, provisioning_delay_s: float = 0.0,
+                 clock: Callable[[], float] = time.monotonic):
         self.inner = inner
         self.delay = provisioning_delay_s
+        self._clock = clock  # injectable for virtual-time sims
         self._queue: Dict[str, dict] = {}
         self._counter = 0
         self._fail_budget = 0
@@ -211,7 +266,7 @@ class QueuedSliceProvider(NodeProvider):
         self._queue[qid] = {
             "state": self.QUEUED, "node_type": node_type,
             "resources": dict(resources), "hosts": hosts,
-            "enqueued": time.monotonic(), "inner": None,
+            "enqueued": self._clock(), "inner": None,
         }
         return SliceHandle(slice_id=qid, node_type=node_type, node_ids=[])
 
@@ -220,7 +275,7 @@ class QueuedSliceProvider(NodeProvider):
     MAX_FAILED_RECORDS = 32
 
     def _step(self):
-        now = time.monotonic()
+        now = self._clock()
         for qid, q in self._queue.items():
             if q["state"] != self.QUEUED or now - q["enqueued"] < self.delay:
                 continue
@@ -276,23 +331,27 @@ class StandardAutoscalerV2:
 
     def __init__(self, config: AutoscalingConfig, provider: NodeProvider,
                  max_launch_retries: int = 3,
-                 launch_timeout_s: float = 120.0):
+                 launch_timeout_s: float = 120.0,
+                 launch_backoff_s: float = 0.0):
         self.config = config
         self.provider = provider
         self.im = InstanceManager(provider, config.type_map(),
-                                  max_launch_retries, launch_timeout_s)
+                                  max_launch_retries, launch_timeout_s,
+                                  launch_backoff_s)
         self._planner = StandardAutoscaler(config, provider)
 
-    def update(self, snapshot: dict) -> ScalingActions:
+    def update(self, snapshot: dict,
+               now: Optional[float] = None) -> ScalingActions:
         alive_ids = {n["node_id"] for n in snapshot["nodes"]
                      if n["state"] == "ALIVE"}
-        self.im.reconcile(alive_ids)
-        actions = self._planner.plan(snapshot, self.im.visible_slices())
+        self.im.reconcile(alive_ids, now)
+        actions = self._planner.plan(snapshot, self.im.visible_slices(),
+                                     now)
         for type_name, count in actions.launch.items():
             for _ in range(count):
                 self.im.request(type_name)
         for slice_id in actions.terminate:
             self.im.drain(slice_id)
         # Apply drains/launches decided this tick promptly.
-        self.im.reconcile(alive_ids)
+        self.im.reconcile(alive_ids, now)
         return actions

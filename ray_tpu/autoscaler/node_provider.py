@@ -19,8 +19,9 @@ import json
 import os
 import subprocess
 import sys
+import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ray_tpu._private.ids import NodeID
 
@@ -122,3 +123,64 @@ class LocalNodeProvider(NodeProvider):
                 # semantics); reap the rest.
                 self.terminate_slice(sid)
         return live
+
+
+class SimulatedNodeProvider(NodeProvider):
+    """Pure in-memory provider for closed-loop sims and benches
+    (reference analogue: autoscaler/v2 FakeCloud in the reference's
+    scheduler tests). A slice is a table row; its member "hosts" are
+    synthetic node ids the embedding harness reports ALIVE once
+    ``boot_delay_s`` of (possibly virtual) clock has elapsed. Supports
+    chaos (``kill_slice``) so churn tests can shrink the fleet under
+    running gangs and watch the requeue machinery, not a mock of it."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic,
+                 boot_delay_s: float = 0.0):
+        self._clock = clock
+        self.boot_delay_s = boot_delay_s
+        self._slices: Dict[str, SliceHandle] = {}
+        self._created: Dict[str, float] = {}
+        self._counter = 0
+        self.killed: List[str] = []  # chaos kills, for assertions
+
+    def create_slice(self, node_type: str, resources: dict,
+                     hosts: int = 1) -> SliceHandle:
+        self._counter += 1
+        slice_id = f"sim-{node_type}-{self._counter}"
+        handle = SliceHandle(
+            slice_id=slice_id, node_type=node_type,
+            node_ids=[f"{slice_id}-h{i}" for i in range(hosts)],
+            meta={"resources": dict(resources), "hosts": hosts})
+        self._slices[slice_id] = handle
+        self._created[slice_id] = self._clock()
+        return handle
+
+    def terminate_slice(self, slice_id: str) -> None:
+        self._slices.pop(slice_id, None)
+        self._created.pop(slice_id, None)
+
+    def kill_slice(self, slice_id: str) -> bool:
+        """Chaos: the slice dies out from under the cluster (vs. an
+        orderly terminate). Gang semantics: all member hosts vanish."""
+        if self._slices.pop(slice_id, None) is None:
+            return False
+        self._created.pop(slice_id, None)
+        self.killed.append(slice_id)
+        return True
+
+    def non_terminated_slices(self) -> List[SliceHandle]:
+        return list(self._slices.values())
+
+    def ready(self, slice_id: str) -> bool:
+        created = self._created.get(slice_id)
+        return created is not None \
+            and self._clock() - created >= self.boot_delay_s
+
+    def ready_node_ids(self) -> List[str]:
+        """Member host ids of every booted slice — what the harness
+        feeds the snapshot/reconcile as ALIVE."""
+        out: List[str] = []
+        for sid, handle in self._slices.items():
+            if self.ready(sid):
+                out.extend(handle.node_ids)
+        return out

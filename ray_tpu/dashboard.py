@@ -552,9 +552,18 @@ def _jobs() -> dict:
             if j.get("start_time"):
                 end = j.get("end_time") or _t.time()
                 j["runtime_s"] = round(end - j["start_time"], 1)
-        return {"jobs": jobs}
+        # Multi-tenant standings + the tail of the scheduler's decision
+        # ledger; both best-effort (an older manager lacks the RPCs).
+        tenants, events = {}, []
+        try:
+            tenants = ray_tpu.get(mgr.tenant_stats.remote(), timeout=10)
+            events = ray_tpu.get(mgr.list_job_events.remote(50),
+                                 timeout=10)
+        except Exception:  # lint: allow-swallow(panel degrades to jobs-only)
+            pass
+        return {"jobs": jobs, "tenants": tenants, "events": events}
     except Exception:  # lint: allow-swallow(panel degrades to empty)
-        return {"jobs": []}
+        return {"jobs": [], "tenants": {}, "events": []}
 
 
 def start_dashboard(port: int = 0, host: str = "127.0.0.1"):

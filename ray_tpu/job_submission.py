@@ -12,6 +12,18 @@ as its own process group with ``RT_ADDRESS`` pointing at the cluster
 head — ``ray_tpu.init()`` inside the entrypoint attaches as a driver.
 Job table lives in the cluster KV, so a restarted manager (or any other
 client) sees every job; logs go to files the manager serves on request.
+
+Multi-tenant plane (ISSUE 15): every job carries a tenant + fair-share
+weight + optional gang resource shape. Submission passes ADMISSION
+CONTROL (``ray_tpu.jobs.admission`` — over-quota, malformed entrypoint,
+or infeasible gang shapes are REJECTED with a machine-readable
+``JobInfo.reason``); admitted jobs queue in the weighted fair-share
+scheduler (``ray_tpu.jobs.scheduler.JobScheduler``) and a dispatcher
+thread spawns them in stride order as quota/concurrency allows. Queued
+gang shapes are published to the cluster KV
+(``autoscaler:job_demand``), where ``HeadService.autoscaler_snapshot``
+hands them to the autoscaler — pending gang demand is what drives
+slice-shaped scale-up.
 """
 
 from __future__ import annotations
@@ -26,8 +38,15 @@ import uuid
 from dataclasses import asdict, dataclass, field
 from typing import Optional
 
+from ray_tpu.jobs.quota import TenantQuota
+from ray_tpu.jobs.scheduler import JobScheduler
+
 JOB_MANAGER_NAME = "JOB_MANAGER"
 _KV_PREFIX = "job:"
+#: KV keys shared with the autoscaler (AutoscalerMonitor constants
+#: mirror these — the two planes rendezvous through the cluster KV).
+JOB_DEMAND_KV_KEY = "autoscaler:job_demand"
+FLEET_ENVELOPE_KV_KEY = "autoscaler:fleet_envelope"
 
 
 class JobStatus:
@@ -36,8 +55,11 @@ class JobStatus:
     SUCCEEDED = "SUCCEEDED"
     FAILED = "FAILED"
     STOPPED = "STOPPED"
+    #: Admission control refused the submission; ``JobInfo.reason``
+    #: holds the machine-readable why (code + detail + specifics).
+    REJECTED = "REJECTED"
 
-    TERMINAL = (SUCCEEDED, FAILED, STOPPED)
+    TERMINAL = (SUCCEEDED, FAILED, STOPPED, REJECTED)
 
 
 @dataclass
@@ -53,13 +75,19 @@ class JobInfo:
     pid: Optional[int] = None
     log_path: str = ""
     return_code: Optional[int] = None
+    # -- multi-tenant plane --
+    tenant: str = "default"
+    weight: float = 1.0
+    resources: dict = field(default_factory=dict)  # gang shape (advisory)
+    reason: Optional[dict] = None  # machine-readable rejection reason
 
 
 class JobManager:
     """Named actor owning job subprocesses (reference: job supervisor
     actors; collapsed to one manager since jobs are plain processes)."""
 
-    def __init__(self, head_address: str, log_dir: Optional[str] = None):
+    def __init__(self, head_address: str, log_dir: Optional[str] = None,
+                 max_concurrent: Optional[int] = None):
         self._head_address = head_address
         self._log_dir = log_dir or os.path.join(
             os.environ.get("TMPDIR", "/tmp"), "rtpu-jobs")
@@ -67,7 +95,99 @@ class JobManager:
         self._lock = threading.Lock()
         self._jobs: dict[str, JobInfo] = {}
         self._procs: dict[str, subprocess.Popen] = {}
+        # 0 / None = unlimited: fairness then only bites when tenant
+        # quotas (or a configured cap) create contention.
+        self._max_concurrent = max_concurrent if max_concurrent \
+            is not None else int(os.environ.get(
+                "RT_JOBS_MAX_CONCURRENT", "0"))
+        self._capacity_cache: tuple = (0.0, {})
+        self._sched = JobScheduler(capacity_fn=self._cluster_capacity,
+                                   envelope_fn=self._fleet_envelope)
+        self._gauges = self._make_gauges()
+        self._dispatch_wake = threading.Event()
         self._recover()
+        threading.Thread(target=self._dispatch_loop, daemon=True,
+                         name="rtpu-job-dispatcher").start()
+
+    # -- cluster feeds ------------------------------------------------------
+    def _cluster_capacity(self) -> dict:
+        """Total resources across alive nodes (TTL-cached): the DRF
+        denominator for dominant-share job costs."""
+        import ray_tpu
+
+        now = time.monotonic()
+        ts, cached = self._capacity_cache
+        if now - ts < 5.0:
+            return cached
+        cap: dict = {}
+        try:
+            for n in ray_tpu.util.state.list_nodes():
+                if n.get("state") == "ALIVE":
+                    for k, v in (n.get("resources") or {}).items():
+                        cap[k] = cap.get(k, 0) + v
+        except Exception:  # lint: allow-swallow(state API down mid-shutdown; stale/empty capacity only skews cost normalization)
+            cap = cached
+        self._capacity_cache = (now, cap)
+        return cap
+
+    def _fleet_envelope(self) -> list:
+        """Launchable slice topologies published by the autoscaler
+        monitor (admission's INFEASIBLE_SHAPE check). No publisher =>
+        empty => feasibility is not enforced."""
+        import ray_tpu
+
+        try:
+            blob = ray_tpu.kv_get(FLEET_ENVELOPE_KV_KEY)
+            return json.loads(blob) if blob else []
+        except Exception:  # lint: allow-swallow(no envelope published; admit and let the queue pend)
+            return []
+
+    def _publish_demand(self):
+        """Queued gang shapes -> cluster KV -> autoscaler_snapshot ->
+        slice-shaped scale-up. Callers must NOT hold self._lock."""
+        import ray_tpu
+
+        try:
+            with self._lock:
+                shapes = self._sched.pending_shapes()
+            ray_tpu.kv_put(JOB_DEMAND_KV_KEY,
+                           json.dumps(shapes).encode())
+        except Exception:  # lint: allow-swallow(KV down during shutdown; demand feed is advisory)
+            pass
+
+    # -- observability ------------------------------------------------------
+    def _make_gauges(self) -> dict:
+        from ray_tpu.util.metrics import Gauge
+
+        return {
+            "queued": Gauge("rtpu_jobs_queued",
+                            "queued jobs per tenant",
+                            tag_keys=("tenant",)),
+            "running": Gauge("rtpu_jobs_running",
+                             "running jobs per tenant",
+                             tag_keys=("tenant",)),
+            "share": Gauge("rtpu_tenant_share",
+                           "dominant share of running usage per tenant",
+                           tag_keys=("tenant",)),
+            "served": Gauge("rtpu_tenant_served_cost",
+                            "cumulative dispatched fair-share cost",
+                            tag_keys=("tenant",)),
+        }
+
+    def _job_event(self, kind: str, info: JobInfo, **extra):
+        """Manager lifecycle events join the scheduler's decision ledger
+        (one job-plane timeline) and refresh the per-tenant gauges the
+        telemetry sampler exports."""
+        self._sched.record(kind, info.submission_id, info.tenant, **extra)
+        try:
+            for tenant, row in self._sched.stats().items():
+                tags = {"tenant": tenant}
+                self._gauges["queued"].set(row["queued"], tags)
+                self._gauges["running"].set(row["running"], tags)
+                self._gauges["share"].set(row.get("share", 0.0), tags)
+                self._gauges["served"].set(row["served_cost"], tags)
+        except Exception:  # lint: allow-swallow(gauge refresh is best-effort observability)
+            pass
 
     # -- persistence --------------------------------------------------------
     def _save(self, info: JobInfo):
@@ -79,7 +199,9 @@ class JobManager:
     def _recover(self):
         """Rebuild the job table from the KV after a manager restart.
         RUNNING jobs whose process survived keep running (re-monitored
-        by pid); dead ones are marked FAILED."""
+        by pid, re-charged against their tenant's quota); RUNNING jobs
+        whose process died are FAILED; queued PENDING jobs (never
+        spawned) re-enter the fair-share queue."""
         import ray_tpu
 
         for key in ray_tpu.kv_keys(_KV_PREFIX):
@@ -88,8 +210,24 @@ class JobManager:
                 continue
             info = JobInfo(**json.loads(blob))
             self._jobs[info.submission_id] = info
-            if info.status in (JobStatus.PENDING, JobStatus.RUNNING):
+            if info.status == JobStatus.PENDING and info.pid is None:
+                # Admitted but never spawned: requeue (admission already
+                # passed once; quota state is rebuilt as we go).
+                reason = self._sched.submit(
+                    info.submission_id, tenant=info.tenant,
+                    weight=info.weight, shape=info.resources,
+                    entrypoint=info.entrypoint)
+                if reason is not None:
+                    info.status = JobStatus.REJECTED
+                    info.reason = reason
+                    info.message = reason.get("detail", reason["code"])
+                    info.end_time = time.time()
+                    self._save(info)
+            elif info.status in (JobStatus.PENDING, JobStatus.RUNNING):
                 if info.pid is not None and _pid_alive(info.pid):
+                    self._sched.adopt_running(
+                        info.submission_id, tenant=info.tenant,
+                        shape=info.resources, weight=info.weight)
                     threading.Thread(target=self._monitor_pid,
                                      args=(info,), daemon=True).start()
                 else:
@@ -103,7 +241,14 @@ class JobManager:
     def submit_job(self, entrypoint: str,
                    submission_id: Optional[str] = None,
                    runtime_env: Optional[dict] = None,
-                   metadata: Optional[dict] = None) -> str:
+                   metadata: Optional[dict] = None,
+                   tenant: str = "default",
+                   weight: float = 1.0,
+                   resources: Optional[dict] = None) -> str:
+        """Admission-checked, fair-share-queued submission. The returned
+        submission id is NOT a promise the job will run: check
+        ``get_job_info`` — a rejected job is terminal ``REJECTED`` with
+        the machine-readable ``reason`` attached."""
         sid = submission_id or f"rtpu-job-{uuid.uuid4().hex[:10]}"
         with self._lock:
             if sid in self._jobs and \
@@ -114,11 +259,62 @@ class JobManager:
                 submission_id=sid, entrypoint=entrypoint,
                 metadata=dict(metadata or {}),
                 runtime_env=dict(runtime_env or {}),
-                log_path=os.path.join(self._log_dir, f"{sid}.log"))
+                log_path=os.path.join(self._log_dir, f"{sid}.log"),
+                tenant=tenant, weight=weight,
+                resources=dict(resources or {}))
+            reason = self._sched.submit(
+                sid, tenant=tenant, weight=weight,
+                shape=info.resources, entrypoint=entrypoint)
+            if reason is not None:
+                info.status = JobStatus.REJECTED
+                info.reason = reason
+                info.message = reason.get("detail", reason["code"])
+                info.end_time = time.time()
             self._jobs[sid] = info
+            if reason is None:
+                self._job_event("queued", info)
+        self._save(info)
+        if reason is None:
+            self._publish_demand()
+            self._dispatch_wake.set()
+        return sid
+
+    def _dispatch_loop(self):
+        """The fair-share dispatcher: drains the scheduler in stride
+        order whenever capacity frees up (finish/stop/submit), spawning
+        one entrypoint subprocess per dispatch decision."""
+        while True:
+            self._dispatch_wake.wait(timeout=1.0)
+            self._dispatch_wake.clear()
+            while True:
+                with self._lock:
+                    running = sum(
+                        1 for i in self._jobs.values()
+                        if i.status == JobStatus.RUNNING)
+                    if self._max_concurrent \
+                            and running >= self._max_concurrent:
+                        break
+                    decision = self._sched.next_dispatch()
+                    if decision is None:
+                        break
+                    info = self._jobs.get(decision.job_id)
+                if info is None or info.status != JobStatus.PENDING:
+                    # Stopped (or lost) between queue and dispatch:
+                    # give the charge straight back.
+                    with self._lock:
+                        self._sched.on_finish(
+                            decision.job_id,
+                            outcome="stopped-before-start")
+                    continue
+                self._spawn(info)
+                self._publish_demand()
+
+    def _spawn(self, info: JobInfo):
+        sid = info.submission_id
         env = dict(os.environ)
         env["RT_ADDRESS"] = self._head_address
         env["RT_JOB_SUBMISSION_ID"] = sid
+        env["RT_JOB_TENANT"] = info.tenant
         # Entrypoint drivers attach to the cluster — they must not dial
         # the TPU tunnel themselves (the node's device lane owns it).
         env.setdefault("JAX_PLATFORMS", "cpu")
@@ -127,16 +323,19 @@ class JobManager:
         log = open(info.log_path, "wb")
         try:
             proc = subprocess.Popen(
-                entrypoint, shell=True, env=env, cwd=cwd,
+                info.entrypoint, shell=True, env=env, cwd=cwd,
                 stdout=log, stderr=subprocess.STDOUT,
                 start_new_session=True)  # own pgid: stop kills the tree
         except OSError as e:
-            info.status = JobStatus.FAILED
-            info.message = str(e)
-            info.end_time = time.time()
+            with self._lock:
+                info.status = JobStatus.FAILED
+                info.message = str(e)
+                info.end_time = time.time()
+            self._sched.on_finish(sid, outcome="spawn-failed")
+            self._job_event("spawn_failed", info, error=str(e))
             self._save(info)
             log.close()
-            return sid
+            return
         finally:
             log.close()
         with self._lock:
@@ -157,11 +356,13 @@ class JobManager:
             # Reap the killed child so it doesn't linger as a zombie in
             # this long-lived manager actor.
             threading.Thread(target=proc.wait, daemon=True).start()
-            return sid
+            with self._lock:
+                self._sched.on_finish(sid, outcome="stopped")
+            return
+        self._job_event("started", info, pid=proc.pid)
         self._save(info)
         threading.Thread(target=self._monitor_proc, args=(info, proc),
                          daemon=True).start()
-        return sid
 
     def _monitor_proc(self, info: JobInfo, proc: subprocess.Popen):
         rc = proc.wait()
@@ -187,17 +388,33 @@ class JobManager:
                                 "code unknown)")
             info.end_time = time.time()
             self._procs.pop(info.submission_id, None)
+            # Crash or success, the quota charge comes back the same
+            # way — release is idempotent, so a stop racing the exit
+            # cannot double-credit the tenant.
+            self._sched.on_finish(
+                info.submission_id,
+                outcome="finished" if rc == 0 else "crashed")
+        self._job_event("finished", info, return_code=rc)
         self._save(info)
+        self._dispatch_wake.set()  # freed slot/quota: dispatch next
 
     def stop_job(self, submission_id: str) -> bool:
         with self._lock:
             info = self._jobs.get(submission_id)
             if info is None or info.status in JobStatus.TERMINAL:
                 return False
+            was_queued = (info.status == JobStatus.PENDING
+                          and info.pid is None)
             info.status = JobStatus.STOPPED
             info.end_time = time.time()
             pid = info.pid
             self._procs.pop(submission_id, None)
+            if was_queued:
+                # Still in the fair-share queue: pull it out before the
+                # dispatcher can spawn it. (If the dispatcher already
+                # took the dispatch decision, _spawn's stop-race path
+                # delivers the kill and the release instead.)
+                self._sched.cancel(submission_id)
         self._save(info)
         if pid is not None:
             try:
@@ -207,7 +424,44 @@ class JobManager:
                     os.killpg(pid, signal.SIGKILL)
             except (ProcessLookupError, PermissionError):
                 pass
+            with self._lock:
+                self._sched.on_finish(submission_id, outcome="stopped")
+        self._job_event("stopped", info)
+        self._publish_demand()
+        self._dispatch_wake.set()
         return True
+
+    # -- tenant administration ----------------------------------------------
+    def set_tenant_quota(self, tenant: str,
+                         max_running_jobs: Optional[int] = None,
+                         max_pending_jobs: Optional[int] = None,
+                         resources: Optional[dict] = None) -> dict:
+        quota = TenantQuota(max_running_jobs=max_running_jobs,
+                            max_pending_jobs=max_pending_jobs,
+                            resources=dict(resources or {}) or None)
+        with self._lock:
+            self._sched.set_quota(tenant, quota)
+        return quota.to_dict()
+
+    def get_tenant_quotas(self) -> dict:
+        with self._lock:
+            return {t: q.to_dict()
+                    for t, q in self._sched.quotas.quotas().items()}
+
+    def tenant_stats(self) -> dict:
+        """Per-tenant fair-share view: weight, pass, share, queue depth,
+        running count, served cost, quota — the `rtpu jobs` feed."""
+        with self._lock:
+            return self._sched.stats()
+
+    def list_job_events(self, limit: int = 200) -> list:
+        with self._lock:
+            return self._sched.events(limit)
+
+    def set_max_concurrent(self, n: int):
+        with self._lock:
+            self._max_concurrent = max(0, int(n))
+        self._dispatch_wake.set()
 
     # -- queries ------------------------------------------------------------
     def get_job_status(self, submission_id: str) -> str:
@@ -299,11 +553,43 @@ class JobSubmissionClient:
     def submit_job(self, *, entrypoint: str,
                    submission_id: Optional[str] = None,
                    runtime_env: Optional[dict] = None,
-                   metadata: Optional[dict] = None) -> str:
+                   metadata: Optional[dict] = None,
+                   tenant: str = "default",
+                   weight: float = 1.0,
+                   resources: Optional[dict] = None) -> str:
         import ray_tpu
 
         return ray_tpu.get(self._manager.submit_job.remote(
-            entrypoint, submission_id, runtime_env, metadata), timeout=120)
+            entrypoint, submission_id, runtime_env, metadata,
+            tenant, weight, resources), timeout=120)
+
+    # -- tenant administration ----------------------------------------------
+    def set_tenant_quota(self, tenant: str,
+                         max_running_jobs: Optional[int] = None,
+                         max_pending_jobs: Optional[int] = None,
+                         resources: Optional[dict] = None) -> dict:
+        import ray_tpu
+
+        return ray_tpu.get(self._manager.set_tenant_quota.remote(
+            tenant, max_running_jobs, max_pending_jobs, resources),
+            timeout=30)
+
+    def get_tenant_quotas(self) -> dict:
+        import ray_tpu
+
+        return ray_tpu.get(self._manager.get_tenant_quotas.remote(),
+                           timeout=30)
+
+    def tenant_stats(self) -> dict:
+        import ray_tpu
+
+        return ray_tpu.get(self._manager.tenant_stats.remote(), timeout=30)
+
+    def list_job_events(self, limit: int = 200) -> list:
+        import ray_tpu
+
+        return ray_tpu.get(self._manager.list_job_events.remote(limit),
+                           timeout=30)
 
     def get_job_status(self, submission_id: str) -> str:
         import ray_tpu

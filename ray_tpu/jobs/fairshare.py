@@ -1,0 +1,210 @@
+"""Weighted fair-share dispatch order: stride scheduling over
+dominant-resource costs.
+
+Each tenant carries a *pass* value; dispatching one of its jobs advances
+the pass by ``cost / weight`` where cost is the job's dominant resource
+share (DRF: the max over resources of ``asked / cluster capacity``).
+The next job to dispatch always comes from the backlogged tenant with
+the smallest pass, so over any saturated window each tenant's share of
+dispatched cost converges to ``weight / sum(weights)`` regardless of
+job sizes or arrival order.
+
+Pure math: no clocks, no cluster, no I/O — unit-testable in isolation
+(tests/test_jobs_fairshare.py).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+#: Cost charged for a job that declares no resource shape (or whose
+#: shape is empty): one "slot". Without a floor a shapeless job would
+#: advance its tenant's pass by zero and starve everyone else.
+DEFAULT_JOB_COST = 1.0
+
+#: Floor for shaped jobs so a tiny gang on a huge fleet still advances
+#: the pass (keeps passes strictly increasing => no starvation).
+MIN_JOB_COST = 1.0 / 1024.0
+
+
+def dominant_share(shape: dict, capacity: dict) -> float:
+    """DRF dominant share of ``shape`` against cluster ``capacity``:
+    max over resources of asked/capacity. Resources absent from the
+    capacity map contribute nothing (feasibility is admission's job)."""
+    best = 0.0
+    for k, v in (shape or {}).items():
+        cap = capacity.get(k, 0)
+        if cap > 0 and v > 0:
+            best = max(best, v / cap)
+    return best
+
+
+def job_cost(shape: Optional[dict], capacity: dict) -> float:
+    if not shape or not any(shape.values()):
+        return DEFAULT_JOB_COST
+    return max(dominant_share(shape, capacity), MIN_JOB_COST)
+
+
+@dataclass
+class TenantState:
+    name: str
+    weight: float = 1.0
+    pass_value: float = 0.0
+    usage: Dict[str, float] = field(default_factory=dict)  # running gangs
+    running: int = 0
+    served_cost: float = 0.0  # cumulative dispatched cost
+    pending: deque = field(default_factory=deque)  # (job_id, shape)
+
+    def queue_depth(self) -> int:
+        return len(self.pending)
+
+
+class FairShareQueue:
+    """The stride core. Jobs are FIFO within a tenant (no intra-tenant
+    reordering); tenants compete on pass values."""
+
+    def __init__(self):
+        self._tenants: Dict[str, TenantState] = {}
+
+    # -- tenants ------------------------------------------------------------
+    def tenant(self, name: str, weight: Optional[float] = None) -> TenantState:
+        t = self._tenants.get(name)
+        if t is None:
+            t = TenantState(name=name)
+            # A newcomer joins at the current global virtual time (the
+            # minimum active pass) — stride's lag rule: idling must not
+            # bank unbounded credit against busy tenants.
+            active = [o.pass_value for o in self._tenants.values()
+                      if o.pending or o.running]
+            if active:
+                t.pass_value = min(active)
+            self._tenants[name] = t
+        if weight is not None:
+            if weight <= 0:
+                raise ValueError(f"tenant weight must be > 0, got {weight}")
+            t.weight = weight
+        return t
+
+    def tenants(self) -> List[TenantState]:
+        return list(self._tenants.values())
+
+    # -- queue --------------------------------------------------------------
+    def enqueue(self, tenant: str, job_id: str, shape: Optional[dict],
+                front: bool = False):
+        t = self.tenant(tenant)
+        if not t.pending and not t.running:
+            # Re-joining after idling: forfeit banked credit (see above).
+            active = [o.pass_value for o in self._tenants.values()
+                      if o is not t and (o.pending or o.running)]
+            if active:
+                t.pass_value = max(t.pass_value, min(active))
+        item = (job_id, dict(shape or {}))
+        if front:
+            t.pending.appendleft(item)
+        else:
+            t.pending.append(item)
+
+    def remove(self, tenant: str, job_id: str) -> bool:
+        t = self._tenants.get(tenant)
+        if t is None:
+            return False
+        for item in t.pending:
+            if item[0] == job_id:
+                t.pending.remove(item)
+                return True
+        return False
+
+    def pending_shapes(self) -> List[dict]:
+        """Every queued gang shape — the autoscaler's demand feed."""
+        out = []
+        for t in self._tenants.values():
+            out.extend(dict(shape) for _jid, shape in t.pending
+                       if shape and any(shape.values()))
+        return out
+
+    def queue_depth(self, tenant: Optional[str] = None) -> int:
+        if tenant is not None:
+            t = self._tenants.get(tenant)
+            return t.queue_depth() if t is not None else 0
+        return sum(t.queue_depth() for t in self._tenants.values())
+
+    # -- dispatch -----------------------------------------------------------
+    def next_dispatch(
+        self, capacity: dict,
+        can_dispatch: Optional[Callable[[str, str, dict], bool]] = None,
+    ):
+        """Pick (tenant, job_id, shape, cost) for the next dispatch, or
+        None. Candidates are each backlogged tenant's HEAD job (FIFO
+        within tenant); the smallest pass wins. ``can_dispatch(tenant,
+        job_id, shape)`` vetoes a candidate (quota at cap, no slice free
+        for the gang) — a vetoed tenant is skipped this round without
+        advancing its pass."""
+        best: Optional[TenantState] = None
+        for t in self._tenants.values():
+            if not t.pending:
+                continue
+            job_id, shape = t.pending[0]
+            if can_dispatch is not None \
+                    and not can_dispatch(t.name, job_id, shape):
+                continue
+            if best is None or t.pass_value < best.pass_value \
+                    or (t.pass_value == best.pass_value
+                        and t.name < best.name):
+                best = t
+        if best is None:
+            return None
+        job_id, shape = best.pending.popleft()
+        cost = job_cost(shape, capacity)
+        best.pass_value += cost / best.weight
+        best.served_cost += cost
+        best.running += 1
+        for k, v in shape.items():
+            best.usage[k] = best.usage.get(k, 0) + v
+        return (best.name, job_id, shape, cost)
+
+    def adopt(self, tenant: str, shape: Optional[dict]):
+        """Account a gang that started outside ``next_dispatch`` (a
+        manager restart re-attaching to a surviving job process): usage
+        counts, but no pass advance — the dispatch that charged it
+        happened in the previous incarnation."""
+        t = self.tenant(tenant)
+        t.running += 1
+        for k, v in (shape or {}).items():
+            t.usage[k] = t.usage.get(k, 0) + v
+
+    def on_finish(self, tenant: str, shape: Optional[dict]):
+        """A running job released its gang (finish, crash, or requeue)."""
+        t = self._tenants.get(tenant)
+        if t is None:
+            return
+        t.running = max(0, t.running - 1)
+        for k, v in (shape or {}).items():
+            left = t.usage.get(k, 0) - v
+            if left > 0:
+                t.usage[k] = left
+            else:
+                t.usage.pop(k, None)
+
+    # -- observability ------------------------------------------------------
+    def shares(self, capacity: dict) -> Dict[str, float]:
+        """Current dominant share of each tenant's RUNNING usage."""
+        return {t.name: dominant_share(t.usage, capacity)
+                for t in self._tenants.values()}
+
+    def stats(self, capacity: Optional[dict] = None) -> Dict[str, dict]:
+        out = {}
+        for t in self._tenants.values():
+            row = {
+                "weight": t.weight,
+                "pass": t.pass_value,
+                "queued": t.queue_depth(),
+                "running": t.running,
+                "served_cost": t.served_cost,
+                "usage": dict(t.usage),
+            }
+            if capacity:
+                row["share"] = dominant_share(t.usage, capacity)
+            out[t.name] = row
+        return out

@@ -1,0 +1,130 @@
+"""Per-tenant quotas: submission caps checked at admission, concurrency
+caps checked at dispatch, and an idempotent charge/release ledger so a
+job that both crashes and finishes (or is stopped twice) never
+double-releases its gang.
+
+Semantics (matching YARN/K8s ResourceQuota conventions):
+
+- ``max_pending_jobs``  — submissions beyond this many queued jobs are
+  REJECTED at admission (back-pressure with a reason, not silent queue
+  growth).
+- ``resources``         — aggregate cap over the gangs a tenant may hold
+  concurrently. A single job whose shape alone exceeds the cap can
+  never run, so it is REJECTED at admission; otherwise the cap throttles
+  dispatch (the job waits, it is not rejected).
+- ``max_running_jobs``  — concurrency cap, checked at dispatch only.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Set
+
+
+@dataclass
+class TenantQuota:
+    max_running_jobs: Optional[int] = None
+    max_pending_jobs: Optional[int] = None
+    resources: Optional[dict] = None  # aggregate cap over held gangs
+
+    def to_dict(self) -> dict:
+        return {"max_running_jobs": self.max_running_jobs,
+                "max_pending_jobs": self.max_pending_jobs,
+                "resources": dict(self.resources)
+                if self.resources else None}
+
+
+@dataclass
+class _TenantAccount:
+    quota: TenantQuota = field(default_factory=TenantQuota)
+    pending: Set[str] = field(default_factory=set)  # queued job ids
+    held: Dict[str, dict] = field(default_factory=dict)  # job_id -> shape
+
+
+class QuotaLedger:
+    def __init__(self):
+        self._accounts: Dict[str, _TenantAccount] = {}
+
+    def _acct(self, tenant: str) -> _TenantAccount:
+        acct = self._accounts.get(tenant)
+        if acct is None:
+            acct = self._accounts[tenant] = _TenantAccount()
+        return acct
+
+    # -- configuration ------------------------------------------------------
+    def set_quota(self, tenant: str, quota: TenantQuota):
+        self._acct(tenant).quota = quota
+
+    def get_quota(self, tenant: str) -> TenantQuota:
+        return self._acct(tenant).quota
+
+    def quotas(self) -> Dict[str, TenantQuota]:
+        return {t: a.quota for t, a in self._accounts.items()}
+
+    # -- admission-time checks ---------------------------------------------
+    def check_submit(self, tenant: str, shape: Optional[dict]
+                     ) -> Optional[dict]:
+        """Violation dict (machine-readable) or None if admissible."""
+        acct = self._acct(tenant)
+        q = acct.quota
+        if q.resources and shape:
+            for k, v in shape.items():
+                cap = q.resources.get(k)
+                if cap is not None and v > cap:
+                    return {"quota": "resources", "resource": k,
+                            "asked": v, "cap": cap,
+                            "detail": f"gang asks {k}={v} but tenant "
+                                      f"{tenant!r} is capped at {cap}; "
+                                      f"the job could never run"}
+        if q.max_pending_jobs is not None \
+                and len(acct.pending) >= q.max_pending_jobs:
+            return {"quota": "max_pending_jobs",
+                    "asked": len(acct.pending) + 1,
+                    "cap": q.max_pending_jobs,
+                    "detail": f"tenant {tenant!r} already has "
+                              f"{len(acct.pending)} queued job(s) "
+                              f"(cap {q.max_pending_jobs})"}
+        return None
+
+    def note_pending(self, tenant: str, job_id: str):
+        self._acct(tenant).pending.add(job_id)
+
+    def drop_pending(self, tenant: str, job_id: str):
+        self._acct(tenant).pending.discard(job_id)
+
+    # -- dispatch-time checks ----------------------------------------------
+    def can_start(self, tenant: str, shape: Optional[dict]) -> bool:
+        acct = self._acct(tenant)
+        q = acct.quota
+        if q.max_running_jobs is not None \
+                and len(acct.held) >= q.max_running_jobs:
+            return False
+        if q.resources:
+            for k, cap in q.resources.items():
+                held = sum(s.get(k, 0) for s in acct.held.values())
+                if held + (shape or {}).get(k, 0) > cap:
+                    return False
+        return True
+
+    def charge(self, tenant: str, job_id: str, shape: Optional[dict]):
+        acct = self._acct(tenant)
+        acct.pending.discard(job_id)
+        acct.held[job_id] = dict(shape or {})
+
+    def release(self, tenant: str, job_id: str) -> Optional[dict]:
+        """Idempotent: returns the released shape the FIRST time, None
+        after (finish racing crash racing stop must not double-credit)."""
+        acct = self._acct(tenant)
+        acct.pending.discard(job_id)
+        return acct.held.pop(job_id, None)
+
+    # -- observability ------------------------------------------------------
+    def usage(self, tenant: str) -> dict:
+        out: dict = {}
+        for shape in self._acct(tenant).held.values():
+            for k, v in shape.items():
+                out[k] = out.get(k, 0) + v
+        return out
+
+    def running_count(self, tenant: str) -> int:
+        return len(self._acct(tenant).held)

@@ -1,0 +1,197 @@
+"""JobScheduler: admission + quotas + weighted fair-share dispatch,
+with every decision recorded in a bounded event ledger (the job-plane
+analogue of the task-event ledger in node_service: state transitions
+are observable facts, not log lines).
+
+Embedded twice: by ``ray_tpu.job_submission.JobManager`` for real
+subprocess jobs, and by ``ray_tpu.jobs.sim`` for the virtual-time churn
+harness — same decisions, same ledger, so fairness measured in the sim
+is the fairness the live manager enforces.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from .admission import AdmissionController
+from .fairshare import FairShareQueue
+from .quota import QuotaLedger, TenantQuota
+
+
+@dataclass
+class DispatchDecision:
+    job_id: str
+    tenant: str
+    shape: dict
+    cost: float
+
+
+@dataclass
+class _JobRecord:
+    job_id: str
+    tenant: str
+    shape: dict
+    state: str  # QUEUED | RUNNING | DONE
+
+
+class JobScheduler:
+    """Not thread-safe by itself — the embedding owner (JobManager, the
+    sim loop) serializes calls under its own lock."""
+
+    def __init__(self,
+                 capacity_fn: Optional[Callable[[], dict]] = None,
+                 envelope_fn: Optional[Callable[[], List[dict]]] = None,
+                 event_cb: Optional[Callable[[dict], None]] = None,
+                 clock: Callable[[], float] = time.time,
+                 max_events: int = 4096):
+        self.queue = FairShareQueue()
+        self.quotas = QuotaLedger()
+        self.admission = AdmissionController(self.quotas, envelope_fn)
+        self._capacity_fn = capacity_fn or (lambda: {})
+        self._event_cb = event_cb
+        self._clock = clock
+        self._jobs: Dict[str, _JobRecord] = {}
+        self._ledger: deque = deque(maxlen=max_events)
+
+    # -- ledger -------------------------------------------------------------
+    def _event(self, kind: str, job_id: str, tenant: str, **extra):
+        ev = {"ts": self._clock(), "kind": kind, "job_id": job_id,
+              "tenant": tenant}
+        ev.update(extra)
+        self._ledger.append(ev)
+        if self._event_cb is not None:
+            try:
+                self._event_cb(ev)
+            except Exception:  # lint: allow-swallow(observer must not break scheduling)
+                pass
+
+    def record(self, kind: str, job_id: str, tenant: str, **extra):
+        """Public emit for the embedding owner's own lifecycle sites
+        (spawn/finish/stop live in the JobManager, not here) — one
+        ledger, one timeline."""
+        self._event(kind, job_id, tenant, **extra)
+
+    def events(self, limit: int = 0) -> List[dict]:
+        out = list(self._ledger)
+        return out[-limit:] if limit else out
+
+    # -- configuration ------------------------------------------------------
+    def set_quota(self, tenant: str, quota: TenantQuota):
+        self.quotas.set_quota(tenant, quota)
+
+    def set_weight(self, tenant: str, weight: float):
+        self.queue.tenant(tenant, weight=weight)
+
+    # -- decisions ----------------------------------------------------------
+    def submit(self, job_id: str, tenant: str = "default",
+               weight: float = 1.0, shape: Optional[dict] = None,
+               entrypoint: str = "") -> Optional[dict]:
+        """Admission decision: None => admitted and queued; else the
+        machine-readable rejection reason."""
+        reason = self.admission.check(tenant, entrypoint, shape, weight)
+        if reason is not None:
+            self._event("rejected", job_id, tenant, reason=reason)
+            return reason
+        self.queue.tenant(tenant, weight=weight)
+        self.quotas.note_pending(tenant, job_id)
+        self.queue.enqueue(tenant, job_id, shape)
+        self._jobs[job_id] = _JobRecord(job_id, tenant,
+                                        dict(shape or {}), "QUEUED")
+        self._event("admitted", job_id, tenant,
+                    shape=dict(shape or {}), weight=weight)
+        return None
+
+    def cancel(self, job_id: str) -> bool:
+        """Remove a still-QUEUED job (stop before dispatch)."""
+        rec = self._jobs.get(job_id)
+        if rec is None or rec.state != "QUEUED":
+            return False
+        removed = self.queue.remove(rec.tenant, job_id)
+        self.quotas.drop_pending(rec.tenant, job_id)
+        rec.state = "DONE"
+        if removed:
+            self._event("cancelled", job_id, rec.tenant)
+        return removed
+
+    def next_dispatch(
+        self, capacity: Optional[dict] = None,
+        can_place: Optional[Callable[[str, str, dict], bool]] = None,
+    ) -> Optional[DispatchDecision]:
+        """Fair-share pick: the backlogged tenant with the smallest
+        pass whose head job passes quota (and the owner's optional
+        placement check). Charges quota and advances the pass."""
+        cap = capacity if capacity is not None else self._capacity_fn()
+
+        def ok(tenant, job_id, shape):
+            if not self.quotas.can_start(tenant, shape):
+                return False
+            return can_place is None or can_place(tenant, job_id, shape)
+
+        picked = self.queue.next_dispatch(cap, can_dispatch=ok)
+        if picked is None:
+            return None
+        tenant, job_id, shape, cost = picked
+        self.quotas.charge(tenant, job_id, shape)
+        rec = self._jobs.get(job_id)
+        if rec is not None:
+            rec.state = "RUNNING"
+        self._event("dispatched", job_id, tenant, shape=dict(shape),
+                    cost=cost,
+                    tenant_pass=self.queue.tenant(tenant).pass_value)
+        return DispatchDecision(job_id, tenant, shape, cost)
+
+    def adopt_running(self, job_id: str, tenant: str = "default",
+                      shape: Optional[dict] = None, weight: float = 1.0):
+        """Re-attach an already-RUNNING job after a restart: restore
+        its quota charge and usage accounting without a fresh dispatch
+        decision (no pass advance — see FairShareQueue.adopt)."""
+        self.queue.tenant(tenant, weight=weight)
+        self._jobs[job_id] = _JobRecord(job_id, tenant,
+                                        dict(shape or {}), "RUNNING")
+        self.quotas.charge(tenant, job_id, shape)
+        self.queue.adopt(tenant, shape)
+        self._event("adopted", job_id, tenant)
+
+    def on_finish(self, job_id: str, outcome: str = "finished"):
+        """Release the job's gang + quota charge. Idempotent across
+        finish/crash/stop races — only the first call credits usage."""
+        rec = self._jobs.get(job_id)
+        if rec is None:
+            return
+        shape = self.quotas.release(rec.tenant, job_id)
+        if rec.state == "RUNNING" and shape is not None:
+            self.queue.on_finish(rec.tenant, shape)
+        rec.state = "DONE"
+        self._event("finished", job_id, rec.tenant, outcome=outcome)
+
+    def requeue(self, job_id: str):
+        """A dispatched job lost a gang member (slice died / drained):
+        release its gang and put it back at the FRONT of its tenant's
+        queue — requeue is recovery, not a new submission, so it keeps
+        head-of-line priority. The pass advance from the original
+        dispatch stands (the tenant did consume the capacity)."""
+        rec = self._jobs.get(job_id)
+        if rec is None or rec.state != "RUNNING":
+            return
+        shape = self.quotas.release(rec.tenant, job_id)
+        if shape is not None:
+            self.queue.on_finish(rec.tenant, shape)
+        self.quotas.note_pending(rec.tenant, job_id)
+        self.queue.enqueue(rec.tenant, job_id, rec.shape, front=True)
+        rec.state = "QUEUED"
+        self._event("requeued", job_id, rec.tenant,
+                    shape=dict(rec.shape))
+
+    # -- feeds --------------------------------------------------------------
+    def pending_shapes(self) -> List[dict]:
+        return self.queue.pending_shapes()
+
+    def stats(self, capacity: Optional[dict] = None) -> Dict[str, dict]:
+        cap = capacity if capacity is not None else self._capacity_fn()
+        stats = self.queue.stats(cap)
+        for tenant, row in stats.items():
+            row["quota"] = self.quotas.get_quota(tenant).to_dict()
+        return stats

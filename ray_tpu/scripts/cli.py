@@ -742,9 +742,17 @@ def cmd_job_submit(args):
         runtime_env.setdefault("env_vars", {})[k] = v
     import shlex
 
+    resources = json.loads(args.resources) if args.resources else None
     sid = client.submit_job(
         entrypoint=shlex.join(args.entrypoint),
-        submission_id=args.submission_id, runtime_env=runtime_env)
+        submission_id=args.submission_id, runtime_env=runtime_env,
+        tenant=args.tenant, weight=args.weight, resources=resources)
+    info = client.get_job_info(sid)
+    if info["status"] == "REJECTED":
+        reason = info.get("reason") or {}
+        print(f"job {sid} REJECTED: {reason.get('code', '?')} — "
+              f"{reason.get('detail', info.get('message', ''))}")
+        sys.exit(1)
     print(f"submitted job {sid}")
     if args.wait:
         status = client.wait_until_finish(sid, timeout=args.timeout)
@@ -771,6 +779,45 @@ def cmd_job_stop(args):
 
 def cmd_job_logs(args):
     print(_job_client(args).get_job_logs(args.id), end="")
+
+
+def cmd_jobs(args):
+    """Multi-tenant job-plane view: per-tenant fair-share standings
+    (weight, cluster share, queue depth, quota) plus the tail of the
+    scheduler's decision ledger."""
+    client = _job_client(args)
+    stats = client.tenant_stats()
+    if args.quota:
+        resources = json.loads(args.resources) if args.resources else None
+        q = client.set_tenant_quota(
+            args.quota, max_running_jobs=args.max_running,
+            max_pending_jobs=args.max_pending, resources=resources)
+        print(f"quota[{args.quota}] = {q}")
+        return
+    if not stats:
+        print("no tenants (no jobs submitted yet)")
+    else:
+        hdr = (f"{'TENANT':16s} {'WEIGHT':>6s} {'SHARE':>6s} "
+               f"{'QUEUED':>6s} {'RUNNING':>7s} {'SERVED':>8s}  QUOTA")
+        print(hdr)
+        for tenant in sorted(stats):
+            row = stats[tenant]
+            quota = {k: v for k, v in (row.get("quota") or {}).items()
+                     if v is not None}
+            share = row.get("share")
+            print(f"{tenant:16s} {row['weight']:6.1f} "
+                  f"{(f'{share:.0%}' if share is not None else '-'):>6s} "
+                  f"{row['queued']:6d} {row['running']:7d} "
+                  f"{row['served_cost']:8.3f}  "
+                  f"{quota if quota else '-'}")
+    if args.events:
+        print()
+        for ev in client.list_job_events(args.events):
+            extra = {k: v for k, v in ev.items()
+                     if k not in ("ts", "kind", "job_id", "tenant")}
+            print(f"{ev['ts']:.2f}  {ev['kind']:10s} "
+                  f"{ev['job_id']:24s} {ev['tenant']:12s} "
+                  f"{extra if extra else ''}")
 
 
 def cmd_lint(args):
@@ -984,6 +1031,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--address", default=None)
     sp.add_argument("--submission-id", default=None)
     sp.add_argument("--working-dir", default=None)
+    sp.add_argument("--tenant", default="default",
+                    help="tenant the job is billed to (fair-share + "
+                         "quota accounting)")
+    sp.add_argument("--weight", type=float, default=1.0,
+                    help="tenant fair-share weight (> 0)")
+    sp.add_argument("--resources", default=None,
+                    help='gang resource shape as JSON, e.g. '
+                         '\'{"TPU": 8, "CPU": 16}\'')
     sp.add_argument("--env", action="append", help="KEY=VALUE (repeatable)")
     sp.add_argument("--wait", action="store_true",
                     help="block until the job finishes; exit with its "
@@ -1000,6 +1055,21 @@ def build_parser() -> argparse.ArgumentParser:
         if name != "list":
             sp.add_argument("id")
         sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser(
+        "jobs", help="multi-tenant job plane: fair-share standings, "
+                     "quotas, decision ledger")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--events", type=int, default=0, metavar="N",
+                    help="also print the last N scheduler decisions")
+    sp.add_argument("--quota", default=None, metavar="TENANT",
+                    help="set TENANT's quota instead of viewing stats")
+    sp.add_argument("--max-running", type=int, default=None)
+    sp.add_argument("--max-pending", type=int, default=None)
+    sp.add_argument("--resources", default=None,
+                    help="aggregate resource cap as JSON "
+                         '(e.g. \'{"TPU": 16}\')')
+    sp.set_defaults(fn=cmd_jobs)
 
     sp = sub.add_parser(
         "lint", help="static analysis over the runtime source "

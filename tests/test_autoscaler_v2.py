@@ -184,3 +184,84 @@ def test_v2_autoscaler_end_to_end_with_queued_provider():
         a.update(snap(nodes=rows))
     assert not inner.non_terminated_slices(), "idle slice drained"
     assert a.im.instances({TERMINATED})
+
+
+def test_requeue_or_fail_exponential_backoff_gates_relaunch():
+    """A requeued instance must sit out base * 2^(attempt-1) before the
+    reconciler resubmits it to the provider."""
+    p = FakeProvider()
+    im = InstanceManager(p, TYPES, max_launch_retries=5,
+                         launch_backoff_s=4.0)
+    inst = im.request("cpu")
+    im.reconcile(set(), now=100.0)
+    assert inst.state == LAUNCHING
+    p.kill(inst.slice.slice_id)
+    im.reconcile(set(), now=101.0)  # lost -> requeue, attempt 1
+    assert inst.state == PENDING
+    assert inst.not_before == 105.0  # 101 + 4 * 2^0
+    im.reconcile(set(), now=104.9)  # still cooling down
+    assert inst.state == PENDING and p.created == 1
+    im.reconcile(set(), now=105.0)
+    assert inst.state == LAUNCHING and p.created == 2
+    p.kill(inst.slice.slice_id)
+    im.reconcile(set(), now=106.0)  # attempt 2 -> backoff doubles
+    assert inst.not_before == 114.0  # 106 + 4 * 2^1
+    assert "backoff 8s" in inst.history[-1][2]
+
+
+def test_requeue_or_fail_gives_up_with_reasoned_failure():
+    p = FakeProvider()
+    im = InstanceManager(p, TYPES, max_launch_retries=2)
+    inst = im.request("cpu")
+    for _ in range(10):
+        im.reconcile(set())
+        if inst.state == LAUNCHING:
+            p.kill(inst.slice.slice_id)
+        if inst.state == TERMINATED:
+            break
+    assert inst.state == TERMINATED
+    # The give-up is a first-class reasoned failure, not just history.
+    assert inst.failure is not None and "giving up" in inst.failure
+    assert im.failures() == [{"instance_id": inst.instance_id,
+                              "node_type": "cpu",
+                              "reason": inst.failure}]
+    kinds = [e["kind"] for e in im.events]
+    assert kinds.count("requeue") == 2 and kinds.count("give_up") == 1
+
+
+def test_queued_provider_fail_next_requeues_until_success():
+    """The Cloud-TPU QueuedResource failure shape end to end: two
+    injected provisioning failures -> two backoff requeues -> third
+    attempt activates; every decision lands on the events ledger."""
+    inner = FakeProvider()
+    qp = QueuedSliceProvider(inner)
+    im = InstanceManager(qp, TYPES, max_launch_retries=3,
+                         launch_backoff_s=2.0)
+    qp.fail_next(2)
+    inst = im.request("cpu")
+    now = 0.0
+    while inst.state not in (ALIVE, TERMINATED) and now < 60.0:
+        now += 1.0
+        live = qp.non_terminated_slices()
+        alive_ids = {nid for h in live for nid in h.node_ids}
+        im.reconcile(alive_ids, now=now)
+    assert inst.state == ALIVE
+    assert inst.launch_attempts == 2
+    assert inner.created == 1  # only the surviving attempt reached inner
+    kinds = [e["kind"] for e in im.events]
+    assert kinds.count("requeue") == 2 and kinds.count("give_up") == 0
+
+
+def test_queued_provider_fail_next_exhausts_into_reasoned_failure():
+    inner = FakeProvider()
+    qp = QueuedSliceProvider(inner)
+    im = InstanceManager(qp, TYPES, max_launch_retries=2)
+    qp.fail_next(10)  # provider never recovers
+    inst = im.request("cpu")
+    for now in range(1, 30):
+        im.reconcile(set(), now=float(now))
+        if inst.state == TERMINATED:
+            break
+    assert inst.state == TERMINATED
+    assert im.failures()[0]["reason"] == inst.failure
+    assert inner.created == 0

@@ -183,3 +183,40 @@ def test_cli_end_to_end(tmp_path):
     finally:
         out = run("stop")
     assert "stopped" in out.stdout
+
+
+def test_multi_tenant_quota_and_stats_live(rt):
+    """The tenant plane through the real manager actor: weighted
+    submission, an over-quota REJECTED with a machine-readable reason,
+    per-tenant stats, and the decision ledger."""
+    client = JobSubmissionClient()
+    # max_running_jobs=0 freezes dispatch, so the queued job stays
+    # PENDING and the pending cap binds deterministically.
+    client.set_tenant_quota("capped", max_running_jobs=0,
+                            max_pending_jobs=1)
+
+    ok = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'print(\"hi\")'",
+        tenant="capped", weight=2.0)
+    assert client.get_job_status(ok) == JobStatus.PENDING
+
+    rejected = None
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'print(1)'", tenant="capped")
+    rejected = client.get_job_info(sid)
+    assert rejected["status"] == JobStatus.REJECTED
+    assert rejected["reason"]["code"] == "QUOTA_EXCEEDED"
+    assert rejected["reason"]["quota"] == "max_pending_jobs"
+    assert rejected["status"] in JobStatus.TERMINAL
+
+    # Lift the freeze: the dispatcher picks the queued job up on its
+    # next poll and it runs to completion.
+    client.set_tenant_quota("capped", max_pending_jobs=4)
+    assert client.wait_until_finish(ok, timeout=120) == JobStatus.SUCCEEDED
+    stats = client.tenant_stats()
+    assert stats["capped"]["weight"] == 2.0
+    assert stats["capped"]["quota"]["max_pending_jobs"] == 4
+    events = client.list_job_events()
+    kinds = {e["kind"] for e in events if e["tenant"] == "capped"}
+    assert {"admitted", "rejected", "dispatched"} <= kinds
+    assert client.get_tenant_quotas()["capped"]["max_pending_jobs"] == 4

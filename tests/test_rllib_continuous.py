@@ -78,6 +78,7 @@ class TestTD3Learner:
 # ---------------------------------------------------------------------------
 # End-to-end learning
 # ---------------------------------------------------------------------------
+@pytest.mark.slow  # tier-1 budget: full learning loop, see ROADMAP
 def test_td3_pendulum_improves():
     config = (TD3.get_default_config()
               .environment("Pendulum-v1")
@@ -98,6 +99,7 @@ def test_td3_pendulum_improves():
     assert result["episode_return_mean"] > -950, result
 
 
+@pytest.mark.slow  # tier-1 budget: full learning loop, see ROADMAP
 def test_ddpg_pendulum_runs_and_improves():
     config = (DDPG.get_default_config()
               .environment("Pendulum-v1")

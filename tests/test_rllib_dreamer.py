@@ -169,6 +169,7 @@ class _RewardChainEnv:
         pass
 
 
+@pytest.mark.slow  # tier-1 budget: full learning loop, see ROADMAP
 def test_dreamer_full_loop_learns_reward_chain():
     """The COMPLETE loop (posterior-filter acting, sequence replay,
     world model, imagination actor-critic) learns a task end to end:

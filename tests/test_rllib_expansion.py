@@ -100,6 +100,7 @@ class TestSACLearner:
         assert all(np.allclose(x, y) for x, y in zip(la, lb))
 
 
+@pytest.mark.slow  # tier-1 budget: full learning loop, see ROADMAP
 def test_sac_pendulum_improves():
     """Pendulum-v1: random policy sits near -1200..-1600 per episode; a
     learning SAC clearly improves within a small CPU budget."""
