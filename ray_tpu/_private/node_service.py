@@ -2994,6 +2994,10 @@ class NodeService:
             p = payload if isinstance(payload, dict) else {}
             return await self.collect_device_profile(
                 float(p.get("duration_s", 2.0)), float(p.get("hz", 99.0)))
+        if method == "flight_records":
+            p = payload if isinstance(payload, dict) else {}
+            return await self.collect_flight_records(
+                p.get("tail", 256), bool(p.get("stacks", True)))
         if method == "clock_probe":
             # Clock-alignment anchor for merged traces: the caller
             # halves the RTT around this to estimate our wall-clock
@@ -3710,6 +3714,55 @@ class NodeService:
         out = {f"node:{self.node_id.hex()[:12]}": results[0]}
         for w, prof in zip(targets, results[1:]):
             out[f"worker:{node}:{w.proc.pid}"] = prof
+        return out
+
+    async def collect_flight_records(self, tail: Optional[int] = 256,
+                                     include_stacks: bool = True) -> dict:
+        """Flight-recorder ring snapshots (plus host stacks) of this
+        node's process and every live worker, concurrently — the
+        collection leg of the gang desync watchdog (aligned by
+        parallel/flightrec.diagnose, rendered by `rtpu gang doctor`).
+        The node's own snapshot covers in-process device-lane gang
+        members; worker snapshots cover subprocess gang members."""
+        loop = self.loop
+
+        def me_snap():
+            # sys.modules probe, NOT an import: a process that never
+            # loaded the collective plane has recorded nothing, and
+            # pulling jax in here just to say so would be absurd.
+            fr = sys.modules.get("ray_tpu.parallel.flightrec")
+            if fr is None:
+                snap = {"pid": os.getpid(), "identity": {}, "entries": [],
+                        "last_completed": {}, "next_seq": {},
+                        "in_flight": []}
+                if include_stacks:
+                    from .stack_dump import format_stacks
+
+                    snap["stacks"] = format_stacks()
+                return snap
+            return fr.snapshot(include_stacks=include_stacks, tail=tail)
+
+        async def me():
+            return await loop.run_in_executor(None, me_snap)
+
+        targets = [w for w in self.workers.values()
+                   if w.state in ("IDLE", "BUSY") and w.conn is not None
+                   and w.conn.alive]
+
+        async def ask(w):
+            try:
+                return await asyncio.wait_for(
+                    w.conn.call("flight_records",
+                                {"tail": tail, "stacks": include_stacks}),
+                    timeout=10)
+            except Exception as e:  # noqa: BLE001 - best effort
+                return {"error": str(e)}
+
+        results = await asyncio.gather(me(), *(ask(w) for w in targets))
+        node = self.node_id.hex()[:8]
+        out = {f"node:{self.node_id.hex()[:12]}": results[0]}
+        for w, snap in zip(targets, results[1:]):
+            out[f"worker:{node}:{w.proc.pid}"] = snap
         return out
 
     async def collect_heap(self, top_n: int = 25) -> dict:

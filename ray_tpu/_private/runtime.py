@@ -801,6 +801,20 @@ class Runtime:
             lambda: self.node.collect_device_profile(duration_s, hz),
             max(timeout, duration_s + 15))
 
+    def cluster_flight_records(self, tail: int = 256,
+                               include_stacks: bool = True,
+                               timeout: float = 15.0) -> dict:
+        """Gang flight-recorder ring snapshots (eager-collective entries
+        + host stacks) of every node + worker cluster-wide, keyed
+        node:<id12> / worker:<node8>:<pid> — the collection leg of the
+        desync watchdog. Align with parallel/flightrec.diagnose; render
+        with `rtpu gang doctor` / `rtpu collectives`."""
+        payload = {"tail": tail, "stacks": include_stacks}
+        return self._node_fanout(
+            "flight_records", payload,
+            lambda: self.node.collect_flight_records(tail, include_stacks),
+            timeout)
+
     def clock_offsets(self, timeout: float = 5.0) -> dict:
         """Per-node wall-clock offset estimates relative to THIS
         process, keyed by node-id prefix (12 hex chars, matching the

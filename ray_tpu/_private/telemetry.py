@@ -286,6 +286,14 @@ class TelemetrySampler:
         "rtpu_tenant_served_cost": ("tenant_served_cost", "max"),
     }
 
+    # Gang flight-recorder plane (parallel/flightrec.py, tagged by
+    # collective group): latency/seq pass through _LLM_GAUGES-style
+    # reduction, but with IDLE DECAY — a group quiet longer than this
+    # reads 0 instead of freezing at its last value (the PR 10 gauge
+    # contract). Straggler skew (max-min enter wall-ts across sources)
+    # is computed cross-source in _sample_collectives, not mapped here.
+    COLLECTIVE_DECAY_S = 10.0
+
     def _iter_metric_snaps(self):
         """(source, snapshot) pairs: worker pushes PLUS this process's
         own registry. Device-lane actors (and the driver in local mode)
@@ -303,10 +311,17 @@ class TelemetrySampler:
     def _sample_serve(self, m: Dict[str, float], dt: float):
         depth_by_dep: Dict[str, float] = {}
         hists: Dict[tuple, list] = {}
+        coll: Dict[str, Dict[str, Dict[str, float]]] = {}
         for source, snap in self._iter_metric_snaps():
             for r in snap.get("rows", ()):
                 name = r.get("name", "")
-                if name in self._LLM_GAUGES:
+                if name.startswith("rtpu_collective_"):
+                    # group -> source -> metric: skew needs the per-
+                    # source pairing of value and enter-ts preserved.
+                    g = r.get("tags", {}).get("group", "?")
+                    coll.setdefault(g, {}).setdefault(source, {})[name] = \
+                        float(r.get("value", 0.0))
+                elif name in self._LLM_GAUGES:
                     prefix, red = self._LLM_GAUGES[name]
                     tags = r.get("tags", {})
                     dep = tags.get("deployment") or tags.get("trial") \
@@ -359,6 +374,39 @@ class TelemetrySampler:
             for q in (0.50, 0.95, 0.99):
                 m[f"serve_p{int(q * 100)}_ms:{dep}:{phase}"] = \
                     quantile_from_buckets(delta, bounds, q) * 1e3
+        self._sample_collectives(m, coll)
+
+    def _sample_collectives(self, m: Dict[str, float],
+                            coll: Dict[str, Dict[str, Dict[str, float]]]):
+        """Flight-recorder series per collective group:
+
+          * ``collective_latency_ms:<g>`` — hottest fresh source's last
+            op latency; 0 once every source is idle past the decay
+            window (so a finished gang's series falls, not freezes).
+          * ``collective_last_seq:<g>`` — gang-max completed seq.
+          * ``collective_skew_ms:<g>`` — max-min enter wall-ts across
+            sources: a straggler's frozen enter-ts makes this grow in
+            real time while the rest of the gang advances. Cross-HOST
+            skew inherits wall-clock offset between hosts; the gang
+            doctor verdict (aligned by seq, never by clock) is the
+            authoritative cross-host view.
+        """
+        now = time.time()
+        for g, by_src in coll.items():
+            fresh = [d for d in by_src.values()
+                     if now - d.get("rtpu_collective_enter_ts", 0.0)
+                     <= self.COLLECTIVE_DECAY_S]
+            m[f"collective_latency_ms:{g}"] = max(
+                (d.get("rtpu_collective_latency_ms", 0.0) for d in fresh),
+                default=0.0)
+            m[f"collective_last_seq:{g}"] = max(
+                (d.get("rtpu_collective_last_seq", 0.0)
+                 for d in by_src.values()), default=0.0)
+            ts = [d["rtpu_collective_enter_ts"] for d in by_src.values()
+                  if "rtpu_collective_enter_ts" in d]
+            if len(ts) >= 2:
+                m[f"collective_skew_ms:{g}"] = \
+                    (max(ts) - min(ts)) * 1e3 if fresh else 0.0
 
 
 class TraceStore:

@@ -487,6 +487,30 @@ class WorkerContext:
             from .profiler import heap_snapshot
 
             return heap_snapshot(int((payload or {}).get("top_n", 25)))
+        if method == "flight_records":
+            # Ring snapshot for the gang desync watchdog. Deliberately
+            # NO import of ray_tpu.parallel here: a process that never
+            # loaded the (jax-heavy) collective plane has recorded
+            # nothing, so an empty snapshot is the true answer.
+            import sys as _sys
+
+            fr = _sys.modules.get("ray_tpu.parallel.flightrec")
+            p = payload or {}
+            if fr is None:
+                sess = _sys.modules.get("ray_tpu.train.session")
+                snap = {"pid": os.getpid(),
+                        "identity": dict(getattr(sess, "_worker_identity",
+                                                 None) or {}),
+                        "entries": [], "last_completed": {},
+                        "next_seq": {}, "in_flight": []}
+                if p.get("stacks", True):
+                    from .stack_dump import format_stacks
+
+                    snap["stacks"] = format_stacks()
+                return snap
+            return fr.snapshot(
+                include_stacks=bool(p.get("stacks", True)),
+                tail=p.get("tail"))
         if method == "cancel_task":
             return self._cancel_running(TaskID(payload))
         if method == "pubsub_msg":

@@ -260,6 +260,26 @@ PERF_SITE_TABLES = (
     ), "the public wrap_step does not feed the step accounting"),
 )
 
+#: Eager-collective entry/exit sites that must feed the gang flight
+#: recorder (parallel/flightrec.record_op). The module-level
+#: allreduce/broadcast/barrier wrappers delegate to these methods, so
+#: the group methods are the complete set of recording sites; in-graph
+#: collectives compile into XLA and are covered at step granularity by
+#: wrap_step's record_op (also listed here).
+FLIGHTREC_SITE_TABLES = (
+    ("ray_tpu/parallel/collectives.py", "record_op", (
+        "CollectiveGroup.allreduce", "CollectiveGroup.broadcast",
+        "CollectiveGroup.allgather", "CollectiveGroup.reducescatter",
+        "CollectiveGroup.barrier",
+    ), "eager collective site bypasses the flight recorder — the ring "
+       "gaps here, and a gang desync at this op is undiagnosable "
+       "(`rtpu gang doctor` would name the wrong op or nothing)"),
+    ("ray_tpu/train/session.py", "record_op", (
+        "wrap_step",
+    ), "the compiled-step boundary is not recorded — in-graph "
+       "collectives lose their only (step-granularity) ring coverage"),
+)
+
 
 # ---------------------------------------------------------------------------
 # Checkers
@@ -362,4 +382,13 @@ class MissingStepAccounting(_TableChecker):
     family = "invariants"
     severity = "P0"
     tables = PERF_SITE_TABLES
+    mode = "name_ref"
+
+
+@register
+class MissingFlightRecord(_TableChecker):
+    id = "I406"
+    family = "invariants"
+    severity = "P0"
+    tables = FLIGHTREC_SITE_TABLES
     mode = "name_ref"

@@ -50,6 +50,8 @@ _PAGE = """<!doctype html>
 <div id="health" style="background:#fff;padding:.6rem;box-shadow:0 1px 2px #0002;font-size:.8rem"></div>
 <h2>Device-step performance <span id="perfsum" style="color:#888;font-size:.8rem"></span></h2>
 <div id="perf" style="background:#fff;padding:.6rem;box-shadow:0 1px 2px #0002;font-size:.8rem"></div>
+<h2>Collectives <span id="collsum" style="color:#888;font-size:.8rem"></span></h2>
+<div id="coll" style="background:#fff;padding:.6rem;box-shadow:0 1px 2px #0002;font-size:.8rem"></div>
 <h2>Throughput &amp; phase latency</h2>
 <div id="spark" style="background:#fff;padding:.6rem;box-shadow:0 1px 2px #0002;font-size:.8rem"></div>
 <h2>Data exchange <span id="xsum" style="color:#888;font-size:.8rem"></span></h2>
@@ -203,6 +205,25 @@ async function refresh(){
       ph||'(no accounted engine/train steps yet)';
     document.getElementById('perfsum').textContent=ph?
       'MFU / roofline, per deployment & trial':'';
+    // Gang flight-recorder pane: per-group eager-collective latency and
+    // straggler skew (a skew line climbing in real time = one gang
+    // member stopped entering collectives — run `rtpu gang doctor`).
+    let ch='';
+    const collGroups=[...new Set(perfKeys
+      .filter(k=>/^collective_(latency|skew)_ms:/.test(k))
+      .map(k=>k.slice(k.indexOf(':')+1)))].sort();
+    for(const g of collGroups){
+      const lat=maxNodes(hs.series['collective_latency_ms:'+g]||{});
+      const skew=maxNodes(hs.series['collective_skew_ms:'+g]||{});
+      const seq=maxNodes(hs.series['collective_last_seq:'+g]||{});
+      ch+='<div><b>'+esc(g)+'</b> seq '+last(seq).toFixed(0)+
+        '  latency ms '+spark(lat,240,34,'#36c')+' '+last(lat).toFixed(2)+
+        (skew.length?'  skew ms '+spark(skew,240,34,'#c33')+' '+
+          last(skew).toFixed(1):'')+'</div>';}
+    document.getElementById('coll').innerHTML=
+      ch||'(no eager collectives recorded)';
+    document.getElementById('collsum').textContent=ch?
+      'latency & straggler skew, per group':'';
     const tl = await (await fetch('api/timeline')).json();
     drawSpark(tl.series); drawTimeline(tl.events);
     const xs=tl.series, xr=xs.exchange_rounds||[], xm=xs.exchange_mb||[];

@@ -458,6 +458,15 @@ class JobManager:
         with self._lock:
             return self._sched.events(limit)
 
+    def record_event(self, kind: str, job_id: str,
+                     tenant: str = "default", extra: dict | None = None):
+        """External event onto the job-plane ledger — e.g. the gang
+        desync watchdog's ``gang_desync`` verdict (parallel/flightrec.
+        publish_verdict), keyed by the gang/run name as job_id."""
+        with self._lock:
+            self._sched.record(kind, job_id, tenant, **(extra or {}))
+        return True
+
     def set_max_concurrent(self, n: int):
         with self._lock:
             self._max_concurrent = max(0, int(n))

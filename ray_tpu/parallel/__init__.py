@@ -7,6 +7,7 @@ absent — see SURVEY.md §2.4): here DP/FSDP/TP/SP(/PP) are first-class mesh
 axes, and collectives compile into the training step over ICI.
 """
 
+from . import flightrec  # noqa: F401  (gang flight recorder, stdlib-only)
 from .mesh import MeshSpec, ScalingConfig, get_abstract_mesh  # noqa: F401
 from .sharding import (  # noqa: F401
     DEFAULT_RULES,

@@ -13,6 +13,15 @@ over NCCL/gloo with named-actor rendezvous). On TPU there are two planes:
    jitted psum over the group's mesh, so even the "eager" API rides ICI.
    Rendezvous is the runtime KV (our GCS equivalent), not a named actor
    holding an NCCLUniqueID.
+
+Recording granularity (gang flight recorder, ``flightrec.py``): every
+eager `CollectiveGroup` call records an individual enter/exit entry in
+the per-process flight-recorder ring — that is the plane the desync
+watchdog aligns across a gang. The **in-graph** plane (1) compiles into
+the XLA program, so its collectives are NOT individually interceptable
+from Python; `train.session.wrap_step` brackets each compiled step with
+one step-boundary entry, which is the honest granularity floor for hangs
+inside jitted code.
 """
 
 from __future__ import annotations
@@ -24,6 +33,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import flightrec
 
 # ---------------------------------------------------------------------------
 # In-graph collectives (use inside shard_map/pjit-traced functions)
@@ -84,9 +95,16 @@ class CollectiveGroup:
         self.name = name
         self.mesh = mesh
         self.axis = axis
+        # Per-instance jit cache keyed (op, ndim). NOT functools.lru_cache
+        # on the bound method: that caches in a class-level table keyed by
+        # ``self``, pinning the group (and its Mesh) past
+        # destroy_collective_group forever.
+        self._fn_cache: dict = {}
 
-    @functools.lru_cache(maxsize=64)
     def _allreduce_fn(self, op: str, ndim: int):
+        cached = self._fn_cache.get((op, ndim))
+        if cached is not None:
+            return cached
         mesh, axis = self.mesh, self.axis
 
         @functools.partial(
@@ -105,6 +123,7 @@ class CollectiveGroup:
                 return stacked.min(axis=0)
             raise ValueError(op)
 
+        self._fn_cache[(op, ndim)] = f
         return f
 
     def allreduce(self, arrays: Sequence, op: str = "sum"):
@@ -112,26 +131,35 @@ class CollectiveGroup:
 
         (Single-controller eager form; the in-graph `psum` is the hot path.)
         """
-        stacked = jnp.stack([jnp.asarray(a) for a in arrays])
-        return self._allreduce_fn(op, stacked.ndim - 1)(stacked)
+        with flightrec.record_op(self.name, "allreduce", self.axis, arrays):
+            stacked = jnp.stack([jnp.asarray(a) for a in arrays])
+            return self._allreduce_fn(op, stacked.ndim - 1)(stacked)
 
     def broadcast(self, array, root: int = 0):
-        return jax.device_put(
-            jnp.asarray(array), NamedSharding(self.mesh, P())
-        )
+        with flightrec.record_op(self.name, "broadcast", self.axis, array):
+            return jax.device_put(
+                jnp.asarray(array), NamedSharding(self.mesh, P())
+            )
 
     def allgather(self, arrays: Sequence):
-        return jnp.stack([jnp.asarray(a) for a in arrays])
+        with flightrec.record_op(self.name, "allgather", self.axis, arrays):
+            return jnp.stack([jnp.asarray(a) for a in arrays])
 
     def reducescatter(self, arrays: Sequence, op: str = "sum"):
-        total = self.allreduce(arrays, op)
-        n = len(arrays)
-        return jnp.split(total, n, axis=0)
+        # The inner allreduce records its own nested ring entry too —
+        # accurate, since that is the collective actually on the wire.
+        with flightrec.record_op(self.name, "reducescatter", self.axis,
+                                 arrays):
+            total = self.allreduce(arrays, op)
+            n = len(arrays)
+            return jnp.split(total, n, axis=0)
 
     def barrier(self):
-        # All participants sync on a trivial reduction.
-        x = jnp.zeros((self.size(),))
-        jax.block_until_ready(self.allreduce([x[i] for i in range(self.size())]))
+        with flightrec.record_op(self.name, "barrier", self.axis):
+            # All participants sync on a trivial reduction.
+            x = jnp.zeros((self.size(),))
+            jax.block_until_ready(
+                self.allreduce([x[i] for i in range(self.size())]))
 
     def size(self) -> int:
         return self.mesh.shape[self.axis]

@@ -1,0 +1,335 @@
+"""Gang flight recorder: a bounded per-process ring of eager collectives.
+
+Capability model: PyTorch's NCCL flight recorder (TORCH_NCCL_TRACE_BUFFER)
+— every rank keeps a cheap in-memory ring of collective entries (op, seq,
+sizes, enter/exit times); when a gang hangs, the rings are collected and
+aligned by (group, seq) to name the rank that never entered the op the
+rest of the gang is blocked in. Here the recorded plane is the TPU-native
+eager one: every `CollectiveGroup` method in ``parallel/collectives.py``
+records an enter/exit entry, and ``train/session.wrap_step`` records one
+step-boundary entry per compiled step (in-graph ``psum``/``all_gather``
+compile into the XLA program and are NOT individually interceptable —
+step granularity is the honest floor there).
+
+Collection rides the worker RPC family (`flight_records`, same fan-out
+shape as PR 10's `device_profile`): node_service asks itself + live
+workers, runtime fans over nodes, and :func:`diagnose` turns the merged
+snapshots into a machine-readable desync verdict (lagging sources, last
+completed seq, the op they never entered, host stacks). The trainer's
+stale-heartbeat watchdog publishes that verdict to the runtime KV
+(``gang_doctor/<gang>``) and the job-plane event ledger; ``rtpu gang
+doctor`` renders it after the fact.
+
+This module is intentionally stdlib-only (no jax import): the hot path is
+two dict/deque writes under a lock and must stay well under 5us/op (gated
+by tests/test_perf_gate.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+# Seconds between gauge publishes per group: the telemetry plane needs
+# ~1Hz freshness, not one publish per collective.
+_PUBLISH_INTERVAL_S = 0.2
+
+KV_PREFIX = "gang_doctor/"
+
+
+class FlightRecorder:
+    """Bounded ring of collective entries with per-group seq counters.
+
+    One instance per process (module singleton via :func:`get_recorder`);
+    separate instances exist only in tests. Thread-safe: gang loops run
+    on worker threads while the RPC thread snapshots concurrently.
+    """
+
+    def __init__(self, capacity: int = 1024):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=capacity)
+        self._seq: Dict[str, int] = {}            # group -> next seq - 1
+        self._last_completed: Dict[str, int] = {}  # group -> last ok seq
+        self.identity: Dict[str, Any] = {}        # rank/world_size/gang
+        self._gauges = None
+        self._last_publish: Dict[str, float] = {}
+
+    # -- hot path ------------------------------------------------------
+    def record_enter(self, group: str, op: str, axis: Optional[str] = None,
+                     shape: Optional[tuple] = None, nbytes: int = 0) -> dict:
+        """Append an in-flight entry; returns it for record_exit."""
+        entry = {"group": group, "op": op, "axis": axis,
+                 "shape": tuple(shape) if shape else None,
+                 "nbytes": int(nbytes), "t0": time.monotonic(),
+                 "w0": time.time(), "t1": None, "ok": None, "seq": 0}
+        with self._lock:
+            seq = self._seq.get(group, 0) + 1
+            self._seq[group] = seq
+            entry["seq"] = seq
+            self._ring.append(entry)
+        return entry
+
+    def record_exit(self, entry: dict, ok: bool = True):
+        entry["t1"] = time.monotonic()
+        entry["ok"] = bool(ok)
+        if ok:
+            g = entry["group"]
+            with self._lock:
+                if entry["seq"] > self._last_completed.get(g, 0):
+                    self._last_completed[g] = entry["seq"]
+        self._maybe_publish(entry)
+
+    def _maybe_publish(self, entry: dict):
+        """Throttled gauge publish (latency / last-seq / enter wall-ts,
+        tagged by group) feeding the telemetry sampler's head series."""
+        g = entry["group"]
+        now = entry["t1"]
+        if now - self._last_publish.get(g, 0.0) < _PUBLISH_INTERVAL_S:
+            return
+        self._last_publish[g] = now
+        try:
+            if self._gauges is None:
+                from ray_tpu.util.metrics import Gauge
+
+                keys = ("group",)
+                self._gauges = {
+                    "lat": Gauge("rtpu_collective_latency_ms",
+                                 "Eager collective enter-to-exit latency "
+                                 "(ms), last recorded op of the group",
+                                 tag_keys=keys),
+                    "seq": Gauge("rtpu_collective_last_seq",
+                                 "Last completed flight-recorder seq of "
+                                 "the group", tag_keys=keys),
+                    "ts": Gauge("rtpu_collective_enter_ts",
+                                "Wall-clock enter time of the group's "
+                                "last recorded op (s); the sampler "
+                                "derives straggler skew and idle decay "
+                                "from it", tag_keys=keys),
+                }
+            tags = {"group": g}
+            self._gauges["lat"].set(
+                (entry["t1"] - entry["t0"]) * 1e3, tags=tags)
+            self._gauges["seq"].set(
+                float(self._last_completed.get(g, 0)), tags=tags)
+            self._gauges["ts"].set(entry["w0"], tags=tags)
+        except Exception:  # noqa: BLE001 - telemetry is best-effort
+            pass
+
+    # -- snapshot plane ------------------------------------------------
+    def snapshot(self, include_stacks: bool = False,
+                 tail: Optional[int] = None) -> dict:
+        """RPC-shippable view of this process's ring, with the clock
+        anchors (`mono`/`wall`) a reader needs to age the entries."""
+        with self._lock:
+            entries = [dict(e) for e in self._ring]
+            last = dict(self._last_completed)
+            nxt = dict(self._seq)
+        if tail is not None:
+            entries = entries[-int(tail):]
+        out = {
+            "pid": os.getpid(),
+            "identity": _identity(self),
+            "mono": time.monotonic(),
+            "wall": time.time(),
+            "entries": entries,
+            "last_completed": last,
+            "next_seq": nxt,
+            "in_flight": [e for e in entries if e["t1"] is None],
+        }
+        if include_stacks:
+            try:
+                from ray_tpu._private.stack_dump import format_stacks
+
+                out["stacks"] = format_stacks()
+            except Exception:  # noqa: BLE001 - stacks are best-effort
+                out["stacks"] = ""
+        return out
+
+
+_RECORDER = FlightRecorder()
+
+
+def get_recorder() -> FlightRecorder:
+    return _RECORDER
+
+
+def set_identity(rank: Optional[int] = None,
+                 world_size: Optional[int] = None,
+                 gang: Optional[str] = None):
+    """Tag this process's ring with its gang coordinates so the desync
+    verdict can name ranks, not just pids."""
+    ident = _RECORDER.identity
+    if rank is not None:
+        ident["rank"] = int(rank)
+    if world_size is not None:
+        ident["world_size"] = int(world_size)
+    if gang is not None:
+        ident["gang"] = str(gang)
+
+
+def _identity(rec: FlightRecorder) -> dict:
+    """The recorder's own identity, else the train-worker identity
+    published by trainer.py (kept in train.session so a CPU-lane worker
+    never has to import this jax-adjacent package just to be nameable)."""
+    ident = dict(rec.identity)
+    if not ident:
+        s = sys.modules.get("ray_tpu.train.session")
+        if s is not None:
+            ident = dict(getattr(s, "_worker_identity", None) or {})
+    return ident
+
+
+class _OpRecord:
+    """Context manager pairing record_enter/record_exit; an exception in
+    the body marks the entry failed instead of leaving it in-flight."""
+
+    __slots__ = ("_entry",)
+
+    def __init__(self, entry: dict):
+        self._entry = entry
+
+    def __enter__(self):
+        return self._entry
+
+    def __exit__(self, et, ev, tb):
+        _RECORDER.record_exit(self._entry, ok=et is None)
+        return False
+
+
+def record_op(group: str, op: str, axis: Optional[str] = None,
+              arrays: Any = None) -> _OpRecord:
+    """The one-line instrumentation point for collective call sites::
+
+        with flightrec.record_op(self.name, "allreduce", self.axis, arrays):
+            ... do the collective ...
+
+    Shapes/bytes are taken from ``arrays`` (a sequence of array-likes or
+    a single array) without materializing anything.
+    """
+    shape = None
+    nbytes = 0
+    if arrays is not None:
+        seq = arrays if isinstance(arrays, (list, tuple)) else (arrays,)
+        for a in seq:
+            nbytes += int(getattr(a, "nbytes", 0) or 0)
+        if seq:
+            shape = getattr(seq[0], "shape", None)
+    return _OpRecord(_RECORDER.record_enter(group, op, axis, shape, nbytes))
+
+
+def snapshot(include_stacks: bool = False,
+             tail: Optional[int] = None) -> dict:
+    return _RECORDER.snapshot(include_stacks=include_stacks, tail=tail)
+
+
+# ---------------------------------------------------------------------------
+# Desync diagnosis: align rings by (group, seq) across sources
+# ---------------------------------------------------------------------------
+
+def diagnose(records: Dict[str, Any], gang: Optional[str] = None) -> dict:
+    """Machine-readable desync verdict from a `cluster_flight_records`
+    merge (keys ``node:<id12>`` / ``worker:<node8>:<pid>``, values ring
+    snapshots or error strings).
+
+    Alignment is by (group, seq): for each group, the per-source last
+    completed seq is compared to the gang max; sources behind the max are
+    *lagging*, and the leader's ring names the op a straggler never
+    entered (its last_seq + 1). Wall clocks are never compared across
+    sources, so cross-host clock skew cannot fake a desync.
+    """
+    snaps = {src: s for src, s in records.items()
+             if isinstance(s, dict) and ("entries" in s
+                                         or "last_completed" in s)}
+    groups: Dict[str, dict] = {}
+    for src, s in snaps.items():
+        for g in set(s.get("last_completed", {})) | set(s.get("next_seq", {})):
+            groups.setdefault(g, {"sources": {}})["sources"][src] = \
+                int(s.get("last_completed", {}).get(g, 0))
+
+    lagging: List[dict] = []
+    for g, info in sorted(groups.items()):
+        by_src = info["sources"]
+        info["max_seq"] = max(by_src.values(), default=0)
+        if len(by_src) < 2:
+            continue  # sole participant: nothing to align against
+        leader = max(by_src, key=lambda k: by_src[k])
+        leader_ring = {e["seq"]: e
+                       for e in snaps[leader].get("entries", [])
+                       if e.get("group") == g}
+        for src, last in sorted(by_src.items()):
+            if last >= info["max_seq"]:
+                continue
+            snap = snaps[src]
+            nxt = leader_ring.get(last + 1)
+            lagging.append({
+                "source": src,
+                "rank": snap.get("identity", {}).get("rank"),
+                "group": g,
+                "last_seq": last,
+                "max_seq": info["max_seq"],
+                "gap": info["max_seq"] - last,
+                "next_op": ({"op": nxt["op"], "seq": nxt["seq"],
+                             "axis": nxt.get("axis"),
+                             "shape": nxt.get("shape")} if nxt else None),
+                "in_flight": [e for e in snap.get("in_flight", [])
+                              if e.get("group") == g],
+                "stack": snap.get("stacks"),
+            })
+
+    lagging.sort(key=lambda l: -l["gap"])
+    if lagging:
+        worst = lagging[0]
+        rank = worst["rank"]
+        who = (f"rank {rank} ({worst['source']})" if rank is not None
+               else worst["source"])
+        nxt = worst["next_op"]
+        summary = (
+            f"desync at group '{worst['group']}': {who} stuck at seq "
+            f"{worst['last_seq']}/{worst['max_seq']}"
+            + (f", never entered {nxt['op']} seq {nxt['seq']}" if nxt
+               else ""))
+    else:
+        summary = (f"no collective desync detected across "
+                   f"{len(snaps)} source(s)")
+    return {
+        "gang": gang,
+        "ts": time.time(),
+        "summary": summary,
+        "groups": groups,
+        "lagging": lagging,
+        "sources": sorted(snaps),
+        "errors": {src: str(s) for src, s in records.items()
+                   if src not in snaps},
+    }
+
+
+def publish_verdict(verdict: dict) -> None:
+    """Durably record a verdict: runtime KV (``gang_doctor/<gang>``, the
+    `rtpu gang doctor` read path) plus a ``gang_desync`` event on the
+    job-plane ledger when a JobManager exists (the watchdog never
+    *creates* the job plane as a side effect of a failure)."""
+    gang = verdict.get("gang") or "unknown"
+    try:
+        import ray_tpu
+
+        if ray_tpu.is_initialized():
+            ray_tpu.kv_put(KV_PREFIX + str(gang),
+                           json.dumps(verdict, default=str).encode())
+    except Exception:  # lint: allow-swallow(verdict KV write is advisory)
+        pass
+    try:
+        import ray_tpu
+        from ray_tpu.job_submission import JOB_MANAGER_NAME
+
+        mgr = ray_tpu.get_actor(JOB_MANAGER_NAME)  # raises when absent
+        slim = {"summary": verdict.get("summary"),
+                "lagging": [{k: v for k, v in l.items() if k != "stack"}
+                            for l in verdict.get("lagging", [])]}
+        mgr.record_event.remote("gang_desync", str(gang), "default", slim)
+    except Exception:  # lint: allow-swallow(no job plane -> KV only)
+        pass

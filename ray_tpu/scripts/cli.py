@@ -375,6 +375,16 @@ def _print_top(rt):
                 print(f"  {metric:<44} {val:10.2%}")
             else:
                 print(f"  {metric:<44} {val:10.2f}")
+    # Gang flight-recorder plane: per-group collective latency and
+    # straggler skew (a growing skew = one member stopped entering).
+    coll_rows = sorted((m, by_node) for m, by_node in latest.items()
+                       if m.startswith(("collective_latency_ms:",
+                                        "collective_skew_ms:",
+                                        "collective_last_seq:")))
+    if coll_rows:
+        print("collectives:")
+        for metric, by_node in coll_rows:
+            print(f"  {metric:<44} {max(by_node.values()):10.2f}")
 
 
 def cmd_top(args):
@@ -547,12 +557,32 @@ def cmd_trace_show(args):
         print(f"chrome trace written to {args.output}")
 
 
+def _tail_lines(fetch, n: int, max_bytes: int = 1 << 24) -> dict:
+    """Byte-tail fetches sized to GUARANTEE n lines per source: start
+    with a generous estimate and refetch with a larger window until
+    every source either has >= n lines or stopped growing (file shorter
+    than the window). Replaces the old fixed n*100-byte guess, which
+    silently under-read logs with long lines."""
+    tail_bytes = max(4096, 256 * n)
+    logs = fetch(tail_bytes)
+    while tail_bytes < max_bytes:
+        short = [name for name, text in logs.items()
+                 if isinstance(text, str) and text.count("\n") < n
+                 and len(text) >= tail_bytes]
+        if not short:
+            break
+        tail_bytes = min(tail_bytes * 4, max_bytes)
+        logs = fetch(tail_bytes)
+    return logs
+
+
 def cmd_logs(args):
     _attach(args)
     from ray_tpu._private import context as context_mod
 
     rt = context_mod.require_context()
-    logs = rt.cluster_logs(tail_bytes=args.tail * 100)
+    logs = _tail_lines(lambda tb: rt.cluster_logs(tail_bytes=tb),
+                       args.tail)
     for name, text in sorted(logs.items()):
         lines = text.splitlines()[-args.tail:]
         print(f"===== {name} =====")
@@ -820,6 +850,107 @@ def cmd_jobs(args):
                   f"{extra if extra else ''}")
 
 
+def _print_verdict(verdict: dict, json_mode: bool = False):
+    if json_mode:
+        print(json.dumps(verdict, indent=2, default=str))
+        return
+    ts = verdict.get("ts")
+    when = (time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(ts))
+            if ts else "?")
+    print(f"gang: {verdict.get('gang') or '?'}   diagnosed: {when}")
+    print(verdict.get("summary", ""))
+    for lag in verdict.get("lagging", []):
+        rank = lag.get("rank")
+        who = f"rank {rank}" if rank is not None else "rank ?"
+        print(f"\n  {who}  {lag['source']}  group={lag['group']}  "
+              f"last completed seq {lag['last_seq']}/{lag['max_seq']} "
+              f"(behind by {lag['gap']})")
+        nxt = lag.get("next_op")
+        if nxt:
+            shape = f" shape={nxt['shape']}" if nxt.get("shape") else ""
+            print(f"    never entered: {nxt['op']} seq={nxt['seq']} "
+                  f"axis={nxt.get('axis')}{shape}")
+        for e in lag.get("in_flight", []):
+            print(f"    in flight: {e['op']} seq={e['seq']} "
+                  f"(entered, never exited)")
+        stack = lag.get("stack")
+        if stack:
+            print("    host stacks:")
+            for line in str(stack).splitlines():
+                print(f"      {line}")
+    errs = verdict.get("errors") or {}
+    for src, err in sorted(errs.items()):
+        print(f"  (no snapshot from {src}: {err})")
+
+
+def cmd_gang_doctor(args):
+    """Render a gang desync verdict: the recorded one from the runtime
+    KV (written by the trainer's stale-heartbeat watchdog), or — with
+    --live — collect + align flight-recorder rings right now."""
+    _attach(args)
+    from ray_tpu.util import state
+
+    if args.live:
+        from ray_tpu._private import context as context_mod
+        from ray_tpu.parallel import flightrec
+
+        rt = context_mod.require_context()
+        records = rt.cluster_flight_records()
+        verdict = flightrec.diagnose(records, gang=args.name)
+    elif args.name:
+        verdict = state.get_gang_verdict(args.name)
+        if verdict is None:
+            print(f"no desync verdict recorded for gang {args.name!r} "
+                  f"(use --live to diagnose the cluster now)")
+            return
+    else:
+        verdicts = state.list_gang_verdicts()
+        if not verdicts:
+            print("no desync verdicts recorded (no gang watchdog has "
+                  "fired; use --live to diagnose the cluster now)")
+            return
+        verdict = verdicts[0]
+    _print_verdict(verdict, json_mode=args.json)
+
+
+def cmd_collectives(args):
+    """Tail of every process's flight-recorder ring: the raw eager-
+    collective timeline `rtpu gang doctor` aligns."""
+    _attach(args)
+    from ray_tpu._private import context as context_mod
+
+    rt = context_mod.require_context()
+    records = rt.cluster_flight_records(tail=args.tail,
+                                        include_stacks=False)
+    now = time.time()
+    shown = 0
+    for src, snap in sorted(records.items()):
+        if not isinstance(snap, dict) or not snap.get("entries"):
+            continue
+        ident = snap.get("identity") or {}
+        rank = (f" rank={ident['rank']}/{ident.get('world_size', '?')}"
+                if "rank" in ident else "")
+        print(f"===== {src}{rank} =====")
+        wall = snap.get("wall", now)
+        for e in snap["entries"][-args.tail:]:
+            if e.get("t1") is not None:
+                dur = f"{(e['t1'] - e['t0']) * 1e3:9.2f}ms"
+                status = "ok" if e.get("ok") else "FAILED"
+            else:
+                dur = f"{max(0.0, wall - e['w0']):8.1f}s+"
+                status = "IN-FLIGHT"
+            shape = f" {e['shape']}" if e.get("shape") else ""
+            print(f"  {e['group']:<20} seq={e['seq']:<5} "
+                  f"{e['op']:<14} axis={str(e.get('axis') or '-'):<6} "
+                  f"{dur} {status}{shape}")
+        shown += 1
+        print()
+    if not shown:
+        print("no eager collectives recorded anywhere (in-graph "
+              "collectives compile into the XLA step and are covered "
+              "at step granularity by wrap_step entries)")
+
+
 def cmd_lint(args):
     """Static analysis over the runtime's own source. Needs no cluster."""
     from pathlib import Path
@@ -1008,6 +1139,30 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--tail", type=int, default=100,
                     help="lines per worker")
     sp.set_defaults(fn=cmd_logs)
+
+    gp = sub.add_parser("gang",
+                        help="hung-gang diagnostics (flight recorder)")
+    gsub = gp.add_subparsers(dest="gang_cmd", required=True)
+    sp = gsub.add_parser(
+        "doctor", help="desync verdict: who desynced, at which "
+                       "collective, with host stacks")
+    sp.add_argument("name", nargs="?", default=None,
+                    help="gang/run name (default: newest verdict)")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--live", action="store_true",
+                    help="collect + align rings now instead of reading "
+                         "the recorded verdict")
+    sp.add_argument("--json", action="store_true",
+                    help="machine-readable verdict")
+    sp.set_defaults(fn=cmd_gang_doctor)
+
+    sp = sub.add_parser(
+        "collectives",
+        help="per-process flight-recorder ring tails (eager collectives)")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--tail", type=int, default=20,
+                    help="ring entries per process")
+    sp.set_defaults(fn=cmd_collectives)
 
     sp = sub.add_parser("memory", help="object store usage summary")
     sp.add_argument("--address", default=None)

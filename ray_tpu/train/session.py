@@ -33,6 +33,12 @@ from .checkpoint import Checkpoint
 # training-loop thread.
 _tls = threading.local()
 
+# Process-wide gang coordinates, written by TrainWorker.__init__ and read
+# lazily by the flight recorder (parallel/flightrec.py) when it snapshots:
+# kept HERE so CPU-lane workers never import the jax-heavy parallel
+# package just to be nameable in a desync verdict.
+_worker_identity: dict = {}
+
 
 @dataclass
 class TrainContext:
@@ -222,24 +228,40 @@ def wrap_step(step_fn, cfg=None):
         state, metrics = step(state, tokens)
         train.report({"loss": float(metrics["loss"])})
 
+    Inside a training loop each call also records one step-boundary
+    entry (group ``step/<experiment>``) in the gang flight recorder —
+    the in-graph collectives inside the compiled step are not
+    individually interceptable, so this entry is what the desync
+    watchdog aligns for jitted loops (see parallel/flightrec.py).
+
     Outside a training loop the wrapper still times the call but records
     nowhere — safe for bench/offline use."""
 
     def timed_step(*args, **kwargs):
+        import contextlib
+
         import jax
 
         from ..util import perfmodel
 
-        t0 = time.perf_counter()
-        out = step_fn(*args, **kwargs)
-        jax.block_until_ready(out)
-        device_s = time.perf_counter() - t0
+        s = _get()
+        if s is not None:
+            from ..parallel import flightrec
+
+            rec = flightrec.record_op(
+                f"step/{s.ctx.experiment_name or 'train'}", "train_step")
+        else:
+            rec = contextlib.nullcontext()
+        with rec:
+            t0 = time.perf_counter()
+            out = step_fn(*args, **kwargs)
+            jax.block_until_ready(out)
+            device_s = time.perf_counter() - t0
         cost = None
         if cfg is not None:
             shape = _token_batch_shape(args)
             if shape is not None:
                 cost = perfmodel.train_step_cost(cfg, shape[0], shape[1])
-        s = _get()
         if s is not None:
             s.record_device(device_s, cost)
         return out

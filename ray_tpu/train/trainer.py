@@ -85,8 +85,13 @@ class TrainWorker:
             loaded_checkpoint=(Checkpoint(resume_ckpt_path)
                                if resume_ckpt_path else None),
         )
+        from . import session as session_mod
         from .session import _TrainSession
 
+        # Gang coordinates for the flight recorder's desync verdicts
+        # (read lazily by parallel/flightrec.py — no jax import here).
+        session_mod._worker_identity.update(
+            rank=rank, world_size=world_size, gang=experiment)
         self._session = _TrainSession(ctx)
         self._done = False
         self._error: Optional[str] = None
@@ -308,6 +313,28 @@ class JaxTrainer:
                     f"(streaming_split) or a list")
         return out
 
+    def _diagnose_hang(self, gang: str) -> Optional[dict]:
+        """Stale-heartbeat watchdog: fan the `flight_records` RPC over
+        every node + worker (the PR 10 device_profile shape), align the
+        rings by (group, seq), and durably publish the desync verdict
+        (runtime KV `gang_doctor/<gang>` + job-plane ledger). Must run
+        BEFORE gang teardown — the straggler's ring and host stack live
+        in the stuck process. Best-effort: diagnosis failing must never
+        mask the underlying gang failure."""
+        try:
+            from ..parallel import flightrec
+            from .._private import context as context_mod
+
+            rt = context_mod.get_context()
+            if rt is None or not hasattr(rt, "cluster_flight_records"):
+                return None
+            records = rt.cluster_flight_records()
+            verdict = flightrec.diagnose(records, gang=gang)
+            flightrec.publish_verdict(verdict)
+            return verdict
+        except Exception:  # lint: allow-swallow(diagnosis must not mask the gang failure)
+            return None
+
     def fit(self) -> Result:
         import ray_tpu
 
@@ -389,6 +416,13 @@ class JaxTrainer:
                         f"{self.worker_health_timeout_s}: " + ", ".join(
                             f"rank {r} last reported {age:.0f}s ago"
                             for r, age in stale))
+                    # Desync watchdog: while the gang is still alive,
+                    # collect + align the flight-recorder rings so the
+                    # failure names WHO desynced at WHICH collective,
+                    # not just that heartbeats went stale.
+                    verdict = self._diagnose_hang(name)
+                    if verdict is not None and verdict.get("summary"):
+                        worker_error += "; " + verdict["summary"]
                 if stop_requested:
                     break  # stop criteria met: cooperative gang stop below
                 if not all(done_flags) and not gang_failed:

@@ -253,6 +253,36 @@ def timeseries_metrics() -> list[str]:
     return sorted(timeseries().get("series", {}))
 
 
+def list_gang_verdicts() -> list[dict]:
+    """Desync verdicts published by the gang watchdog (one per gang,
+    newest first): what `rtpu gang doctor` renders. Each carries
+    ``summary``, ``lagging`` (source/rank/group/last_seq/next_op/stack),
+    ``groups``, and the collection timestamp ``ts``."""
+    import ray_tpu
+    from ray_tpu.parallel import flightrec
+
+    out = []
+    for key in ray_tpu.kv_keys(flightrec.KV_PREFIX):
+        try:
+            out.append(json.loads(ray_tpu.kv_get(key)))
+        except Exception:  # lint: allow-swallow(skip a torn verdict blob)
+            continue
+    out.sort(key=lambda v: v.get("ts", 0.0), reverse=True)
+    return out
+
+
+def get_gang_verdict(gang: str) -> Optional[dict]:
+    """The recorded desync verdict for one gang (RunConfig.name), or
+    None if its watchdog never fired."""
+    import ray_tpu
+    from ray_tpu.parallel import flightrec
+
+    blob = ray_tpu.kv_get(flightrec.KV_PREFIX + gang)
+    if blob is None:
+        return None
+    return json.loads(blob)
+
+
 def get_trace(trace_id: str) -> Optional[list]:
     """One retained serving-lane request trace: its spans (dicts with
     trace_id/span_id/parent_id/name/start/end/attributes/events),

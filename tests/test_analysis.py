@@ -506,6 +506,21 @@ def test_i405_catches_a_bypassed_step_accounting_feed(tmp_path):
     assert [f.symbol for f in rep.findings] == ["E.decode"]
 
 
+def test_i406_catches_an_unrecorded_collective_site(tmp_path):
+    tables = (("svc.py", "record_op", ("G.allreduce", "G.barrier"),
+               "why"),)
+    rep = lint(tmp_path, {"svc.py": """\
+        class G:
+            def allreduce(self, arrays):
+                with record_op(self.name, "allreduce", self.axis, arrays):
+                    return sum(arrays)
+
+            def barrier(self):
+                return None
+        """}, select="I406", config={"I406_tables": tables})
+    assert [f.symbol for f in rep.findings] == ["G.barrier"]
+
+
 # ---------------------------------------------------------------------------
 # Suppression surfaces
 # ---------------------------------------------------------------------------

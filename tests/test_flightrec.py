@@ -1,0 +1,344 @@
+"""Gang flight recorder + desync watchdog.
+
+Units: ring bounds/seq accounting, in-flight and failed entries,
+(group, seq) alignment in ``flightrec.diagnose``, the CollectiveGroup
+instrumentation sites, and the satellite leak fix (a destroyed group
+must be collectable).
+
+End-to-end: a 2-worker CPU gang where rank 1 stalls before a barrier is
+auto-diagnosed by the trainer's stale-heartbeat watchdog — the failure
+carries the desync summary, `rtpu gang doctor` renders the recorded
+verdict (lagging rank, last completed seq, host stack), and the
+job-plane ledger gains a ``gang_desync`` event.
+
+Capability model: PyTorch's NCCL flight recorder, rebuilt over the
+TPU-native eager collective plane.
+"""
+
+import gc
+import os
+import time
+import weakref
+
+import pytest
+
+import ray_tpu
+from ray_tpu.parallel import flightrec
+
+
+# ---------------------------------------------------------------------------
+# Ring units
+# ---------------------------------------------------------------------------
+
+def test_ring_bounds_and_seq():
+    rec = flightrec.FlightRecorder(capacity=8)
+    for _ in range(20):
+        e = rec.record_enter("r", "allreduce", "dp", (4,), 16)
+        rec.record_exit(e)
+    snap = rec.snapshot()
+    assert len(snap["entries"]) == 8  # bounded: oldest entries evicted
+    assert [e["seq"] for e in snap["entries"]] == list(range(13, 21))
+    assert snap["last_completed"]["r"] == 20
+    assert snap["next_seq"]["r"] == 20
+    assert snap["in_flight"] == []
+
+
+def test_in_flight_and_failed_entries():
+    rec = flightrec.FlightRecorder()
+    e1 = rec.record_enter("g", "allreduce", "dp")
+    snap = rec.snapshot()
+    assert [e["seq"] for e in snap["in_flight"]] == [1]
+    assert snap["last_completed"] == {}
+    rec.record_exit(e1, ok=False)  # failure must NOT advance completion
+    assert rec.snapshot()["last_completed"] == {}
+    assert rec.snapshot()["entries"][0]["ok"] is False
+    e2 = rec.record_enter("g", "barrier")
+    rec.record_exit(e2)
+    assert rec.snapshot()["last_completed"]["g"] == 2
+
+
+def test_record_op_context_manager_marks_failure():
+    before = flightrec.snapshot()["last_completed"].get("cm-fail", 0)
+    with pytest.raises(ValueError):
+        with flightrec.record_op("cm-fail", "allreduce"):
+            raise ValueError("boom")
+    snap = flightrec.snapshot()
+    assert snap["last_completed"].get("cm-fail", 0) == before
+    entry = [e for e in snap["entries"] if e["group"] == "cm-fail"][-1]
+    assert entry["ok"] is False and entry["t1"] is not None
+
+
+def test_snapshot_tail_and_stacks():
+    rec = flightrec.FlightRecorder()
+    for _ in range(5):
+        rec.record_exit(rec.record_enter("t", "op"))
+    snap = rec.snapshot(include_stacks=True, tail=2)
+    assert len(snap["entries"]) == 2
+    assert snap["entries"][-1]["seq"] == 5
+    assert str(os.getpid()) in snap["stacks"]
+
+
+# ---------------------------------------------------------------------------
+# Alignment / diagnosis units
+# ---------------------------------------------------------------------------
+
+def _snap(last, entries=(), identity=None, stacks=None):
+    return {"pid": 1, "identity": identity or {}, "entries": list(entries),
+            "last_completed": dict(last), "next_seq": dict(last),
+            "in_flight": [e for e in entries if e.get("t1") is None],
+            "stacks": stacks}
+
+
+def test_diagnose_names_the_straggler():
+    leader = [{"group": "g", "seq": s,
+               "op": "allreduce" if s % 2 == 0 else "barrier",
+               "axis": "dp", "shape": (8,), "nbytes": 32,
+               "t0": float(s), "w0": float(s), "t1": s + 0.1, "ok": True}
+              for s in range(1, 6)]
+    records = {
+        "worker:aa:1": _snap({"g": 5}, leader, {"rank": 0}),
+        "worker:aa:2": _snap({"g": 3}, identity={"rank": 1},
+                             stacks="File x.py, in sleep"),
+        "node:deadbeef": "<unreachable: boom>",
+    }
+    v = flightrec.diagnose(records, gang="job1")
+    assert v["gang"] == "job1"
+    assert len(v["lagging"]) == 1
+    lag = v["lagging"][0]
+    assert lag["source"] == "worker:aa:2"
+    assert lag["rank"] == 1
+    assert (lag["last_seq"], lag["max_seq"], lag["gap"]) == (3, 5, 2)
+    # The op the straggler never entered, from the leader's ring.
+    assert lag["next_op"]["op"] == "allreduce"
+    assert lag["next_op"]["seq"] == 4
+    assert lag["stack"] == "File x.py, in sleep"
+    assert "rank 1" in v["summary"] and "seq 3/5" in v["summary"]
+    assert "never entered allreduce seq 4" in v["summary"]
+    assert v["errors"]["node:deadbeef"].startswith("<unreachable")
+
+
+def test_diagnose_aligned_gang_is_clean():
+    v = flightrec.diagnose({"a": _snap({"g": 4}), "b": _snap({"g": 4})})
+    assert v["lagging"] == []
+    assert "no collective desync" in v["summary"]
+
+
+def test_diagnose_sole_participant_is_not_lagging():
+    # The driver's own unit-test groups must never read as desyncs.
+    v = flightrec.diagnose({"a": _snap({"solo": 2}), "b": _snap({})})
+    assert v["lagging"] == []
+
+
+# ---------------------------------------------------------------------------
+# CollectiveGroup instrumentation + leak fix
+# ---------------------------------------------------------------------------
+
+def test_collective_group_feeds_recorder():
+    import jax.numpy as jnp
+
+    from ray_tpu.parallel import collectives
+
+    g = collectives.create_collective_group("rec-unit", axis="dp")
+    try:
+        n = g.size()
+        g.allreduce([jnp.ones((2,)) for _ in range(n)])
+        g.barrier()
+        g.broadcast(jnp.ones((2,)))
+        g.allgather([jnp.ones((2,)) for _ in range(n)])
+        g.reducescatter([jnp.ones((n,)) for _ in range(n)])
+        snap = flightrec.snapshot()
+        mine = [e for e in snap["entries"] if e["group"] == "rec-unit"]
+        ops = {e["op"] for e in mine}
+        assert {"allreduce", "barrier", "broadcast", "allgather",
+                "reducescatter"} <= ops
+        seqs = [e["seq"] for e in mine]
+        assert seqs == sorted(seqs)  # per-group monotone seq
+        assert snap["last_completed"]["rec-unit"] == max(seqs)
+        ar = next(e for e in mine if e["op"] == "allreduce")
+        assert ar["axis"] == "dp" and ar["nbytes"] > 0 and ar["ok"]
+        assert ar["shape"] == (2,)
+    finally:
+        collectives.destroy_collective_group("rec-unit")
+
+
+def test_destroyed_group_is_collectable():
+    """Satellite: lru_cache on the bound method pinned the group (and
+    its Mesh) in a class-level table forever — the per-instance cache
+    must die with the group."""
+    import jax.numpy as jnp
+
+    from ray_tpu.parallel import collectives
+
+    g = collectives.create_collective_group("collectable", axis="dp")
+    g.allreduce([jnp.ones((2,)) for _ in range(g.size())])  # warm the cache
+    assert g._fn_cache  # the jitted reduction is cached per-instance
+    ref = weakref.ref(g)
+    collectives.destroy_collective_group("collectable")
+    del g
+    gc.collect()
+    assert ref() is None, "destroyed CollectiveGroup must be collectable"
+
+
+def test_wrap_step_records_step_boundary():
+    from ray_tpu.train import session as sess_mod
+
+    s = sess_mod._TrainSession(
+        sess_mod.TrainContext(experiment_name="stepx"))
+    sess_mod._bind(s)
+    try:
+        step = sess_mod.wrap_step(lambda x: x + 1)
+        assert step(1) == 2
+        assert step(2) == 3
+        snap = flightrec.snapshot()
+        mine = [e for e in snap["entries"] if e["group"] == "step/stepx"]
+        assert len(mine) == 2
+        assert all(e["op"] == "train_step" and e["ok"] for e in mine)
+    finally:
+        sess_mod._unbind()
+
+
+# ---------------------------------------------------------------------------
+# Telemetry plane
+# ---------------------------------------------------------------------------
+
+def test_collective_series_reach_head(rt):
+    """Driver-side collectives publish gauges into the local registry;
+    the node sampler turns them into head series queryable via
+    state.timeseries()."""
+    import jax.numpy as jnp
+
+    from ray_tpu.parallel import collectives
+    from ray_tpu.util import state
+
+    g = collectives.create_collective_group("series-g", axis="dp")
+    try:
+        deadline = time.monotonic() + 20
+        found = set()
+        while time.monotonic() < deadline:
+            g.allreduce([jnp.ones((2,)) for _ in range(g.size())])
+            found = {m for m in state.timeseries().get("series", {})
+                     if m.endswith(":series-g")}
+            if "collective_latency_ms:series-g" in found \
+                    and "collective_last_seq:series-g" in found:
+                break
+            time.sleep(0.3)
+        assert "collective_latency_ms:series-g" in found, found
+        assert "collective_last_seq:series-g" in found, found
+    finally:
+        collectives.destroy_collective_group("series-g")
+
+
+def test_sampler_skew_and_idle_decay(rt):
+    """Straggler skew = max-min enter wall-ts across sources of a
+    group; latency decays to 0 once every source is idle past the
+    window (PR 10 gauge contract)."""
+    from ray_tpu._private.telemetry import TelemetrySampler
+
+    sampler = TelemetrySampler(rt.node)
+    sampler.sample()  # prime anchors
+    now = time.time()
+
+    def rows(lat, seq, ts):
+        return {"rows": [
+            {"name": "rtpu_collective_latency_ms", "type": "gauge",
+             "tags": {"group": "skewg"}, "value": lat},
+            {"name": "rtpu_collective_last_seq", "type": "gauge",
+             "tags": {"group": "skewg"}, "value": seq},
+            {"name": "rtpu_collective_enter_ts", "type": "gauge",
+             "tags": {"group": "skewg"}, "value": ts},
+        ]}
+
+    # One source entered 0.5s before the other: skew ~500ms.
+    rt.node.user_metrics["w1"] = rows(3.0, 10, now - 0.5)
+    rt.node.user_metrics["w2"] = rows(1.0, 12, now)
+    m = sampler.sample()["metrics"]
+    assert m["collective_latency_ms:skewg"] == 3.0
+    assert m["collective_last_seq:skewg"] == 12
+    assert 300 <= m["collective_skew_ms:skewg"] < 5000
+    # Idle decay: both sources stale -> latency and skew read 0.
+    old = now - 1000
+    rt.node.user_metrics["w1"] = rows(3.0, 10, old)
+    rt.node.user_metrics["w2"] = rows(1.0, 12, old - 1)
+    m = sampler.sample()["metrics"]
+    assert m["collective_latency_ms:skewg"] == 0.0
+    assert m["collective_skew_ms:skewg"] == 0.0
+    del rt.node.user_metrics["w1"], rt.node.user_metrics["w2"]
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: the watchdog diagnoses an injected hang
+# ---------------------------------------------------------------------------
+
+def test_watchdog_diagnoses_hung_gang(rt, tmp_path, capsys):
+    from ray_tpu.job_submission import JobSubmissionClient
+    from ray_tpu.scripts import cli
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+    from ray_tpu.util import state
+
+    def _hang_loop(config):
+        import time as _t
+
+        from ray_tpu import train as rt_train
+        from ray_tpu.parallel import collectives
+
+        ctx = rt_train.get_context()
+        g = collectives.create_collective_group("gang-e2e", axis="dp")
+        rt_train.report({"step": 0, "rank": ctx.get_world_rank()})
+        for _ in range(3):
+            g.barrier()
+        if ctx.get_world_rank() == 1:
+            _t.sleep(120)  # stall BEFORE the 4th barrier: injected hang
+        g.barrier()
+        rt_train.report({"step": 1, "rank": ctx.get_world_rank()})
+
+    # The ledger must exist BEFORE the hang: the watchdog records onto
+    # an existing job plane, it never creates one as a failure side
+    # effect.
+    client = JobSubmissionClient()
+
+    trainer = JaxTrainer(
+        _hang_loop,
+        scaling_config=ScalingConfig(num_workers=2, use_tpu=False),
+        run_config=RunConfig(name="hang-e2e", storage_path=str(tmp_path)),
+        worker_health_timeout_s=2.0,
+    )
+    result = trainer.fit()
+
+    # 1. The gang failure itself carries the verdict summary.
+    assert result.error is not None
+    err = str(result.error)
+    assert "rank 1" in err and "worker_health_timeout_s" in err
+    assert "desync at group 'gang-e2e'" in err
+    assert "never entered barrier" in err
+
+    # 2. The machine-readable verdict names the straggler, its last
+    #    completed (group, seq), and carries its host stack.
+    verdict = state.get_gang_verdict("hang-e2e")
+    assert verdict is not None, "watchdog must publish a verdict"
+    lags = [l for l in verdict["lagging"] if l["group"] == "gang-e2e"]
+    assert lags, verdict["summary"]
+    lag = lags[0]
+    assert lag["rank"] == 1
+    # 3 completed barriers, each with its nested allreduce: seq 6.
+    assert lag["last_seq"] == 6 and lag["max_seq"] == 8
+    assert lag["next_op"]["op"] == "barrier"
+    assert lag["stack"] and "sleep" in lag["stack"]
+
+    # 3. Queryable after the fact via `rtpu gang doctor`.
+    cli.main(["gang", "doctor", "hang-e2e"])
+    out = capsys.readouterr().out
+    assert "desync at group 'gang-e2e'" in out
+    assert "rank 1" in out and "host stacks:" in out
+
+    # 4. And on the job-plane event ledger.
+    deadline = time.monotonic() + 10
+    evs = []
+    while time.monotonic() < deadline:
+        evs = [ev for ev in client.list_job_events(200)
+               if ev["kind"] == "gang_desync"
+               and ev["job_id"] == "hang-e2e"]
+        if evs:
+            break
+        time.sleep(0.2)
+    assert evs, "gang_desync event must land on the job ledger"
+    assert "desync at group 'gang-e2e'" in evs[0]["summary"]

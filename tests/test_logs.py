@@ -61,6 +61,37 @@ def test_logs_streamed_to_driver_stderr():
     assert "(pid=" in out.stderr
 
 
+def test_tail_lines_accurate_with_long_lines():
+    """`rtpu logs --tail N` must yield N LINES regardless of line
+    length. The old fixed tail_bytes=N*100 guess silently under-read
+    logs with long lines (a 1000-char traceback line ate 10 lines of
+    budget); _tail_lines refetches with a growing byte window until
+    every source has enough."""
+    from ray_tpu.scripts.cli import _tail_lines
+
+    lines = [f"line-{i:02d} " + "x" * 1000 for i in range(50)]
+    text = "\n".join(lines) + "\n"
+    calls = []
+
+    def fetch(tail_bytes):
+        calls.append(tail_bytes)
+        return {"worker:a:1": text[-tail_bytes:]}
+
+    logs = _tail_lines(fetch, 20)
+    got = logs["worker:a:1"].splitlines()[-20:]
+    assert len(got) == 20
+    # Every returned line is COMPLETE (the old byte-guess could only
+    # ever return ~2 full lines for this input).
+    assert got == lines[30:]
+    assert len(calls) > 1, "must refetch when the window is too small"
+    assert calls == sorted(calls)  # growing windows
+
+    # Asking for more lines than the file has terminates and returns
+    # the whole file (source stops growing before reaching n lines).
+    logs = _tail_lines(fetch, 500)
+    assert logs["worker:a:1"].splitlines() == lines
+
+
 def test_log_to_driver_off(rt):
     rt.cfg.log_to_driver = False  # config knob honored by the tail loop
     # (capture to files still happens; only streaming is suppressed)

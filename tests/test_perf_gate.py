@@ -252,6 +252,31 @@ def test_step_accounting_overhead_gate():
         f"per step > budget {budget * 1e6:.1f}us (calibration {cal:.2f})")
 
 
+def test_flight_recorder_overhead_gate():
+    """The flight recorder brackets EVERY eager collective: one
+    record_enter + record_exit pair (two dict/deque writes under a
+    lock, throttled gauge publish) must stay under 5us at calibration
+    1.0 (~1-2us observed solo). A regression — say the ring growing a
+    per-op snapshot, or the gauge publish losing its throttle — taxes
+    every collective, so it fails loudly here."""
+    from ray_tpu.parallel import flightrec
+
+    cal = _calibrate()
+    rec = flightrec.FlightRecorder(capacity=1024)
+    # Warm one pair outside the measured region (lazy gauge creation).
+    rec.record_exit(rec.record_enter("gate", "allreduce", "dp", (8,), 32))
+    n = 20000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        e = rec.record_enter("gate", "allreduce", "dp", (8,), 32)
+        rec.record_exit(e)
+    per_op = (time.perf_counter() - t0) / n
+    budget = 5e-6 / cal
+    assert per_op < budget, (
+        f"flight-recorder hot path regressed: {per_op * 1e6:.2f}us "
+        f"per op > budget {budget * 1e6:.2f}us (calibration {cal:.2f})")
+
+
 def test_solo_cross_node_fetch_gate():
     cal = _calibrate()
     os.environ["RT_MB_FETCH_MB"] = "16"
