@@ -676,6 +676,94 @@ def run_serve_llm():
     return row
 
 
+def run_data_llm():
+    """Offline batch inference (``bench.py --data-llm``): Dataset blocks
+    of prompts through the LLMProcessor actor-pool operator
+    (ray_tpu/data/llm.py) — same TINY engine as the serve-llm bench but
+    throughput-greedy with no HTTP/SLO path, so its tokens/s should meet
+    or beat SERVE_BENCH.json's llm row. The row lands in DATA_BENCH.json
+    with the locality hit-rate and the store's spilled bytes."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import ray_tpu
+    from ray_tpu import data as rd
+    from ray_tpu.data.execution import last_run_stats
+    from ray_tpu.data.llm import build_llm_processor
+    from ray_tpu.models.gpt import TINY
+
+    rows = int(os.environ.get("RT_DATA_LLM_ROWS", "96"))
+    batch = int(os.environ.get("RT_DATA_LLM_BATCH", "8"))
+    max_tokens = int(os.environ.get("RT_DATA_LLM_TOKENS", "24"))
+    rt = ray_tpu.init(num_cpus=2)
+    try:
+        def to_prompts(b):
+            # Serve-bench prompt mix: 4-12 token prompts over ids 1..200.
+            return {"prompt": np.asarray(
+                [[int(i) % 200 + 1] * (4 + int(i) % 9) for i in b["id"]],
+                dtype=object),
+                "row_id": b["id"]}
+
+        proc = build_llm_processor(
+            TINY,
+            sampling={"max_tokens": max_tokens, "temperature": 0.8,
+                      "seed": 0},
+            num_blocks=64, block_size=16, max_batch=batch,
+            name="data_llm")
+        # One source block per engine batch; the prompt-building map
+        # stage rides the locality-aware task router.
+        ds = (rd.range(rows, override_num_blocks=max(1, rows // batch))
+              .map_batches(to_prompts)
+              .map_batches(proc))
+
+        # The first output block pays the prefill+decode compiles (the
+        # serve bench warms them with an untimed request); the measured
+        # window opens when it lands.
+        t_first = None
+        tokens = blocks = 0
+        t0 = time.perf_counter()
+        for blk in ds.iter_blocks():
+            now = time.perf_counter()
+            if t_first is None:
+                t_first = now
+                continue
+            tokens += int(np.sum(blk["num_generated_tokens"]))
+            blocks += 1
+        dt = time.perf_counter() - t_first
+        st = last_run_stats()
+        hits = st.get("locality_hits", 0)
+        misses = st.get("locality_misses", 0)
+        store = rt.shm.stats()
+        row = {
+            "rows": rows, "batch": batch, "max_tokens": max_tokens,
+            "measured_blocks": blocks,
+            "tokens": tokens,
+            "seconds": round(dt, 3),
+            "tokens_per_s": round(tokens / dt, 1),
+            "wall_seconds": round(time.perf_counter() - t0, 3),
+            "locality_hit_rate": round(hits / (hits + misses), 4)
+            if hits + misses else None,
+            "locality_hits": hits, "locality_misses": misses,
+            "store_spilled_bytes": store.get("spilled_bytes", 0),
+            "note": ("tokens/s over post-compile blocks; comparable to "
+                     "SERVE_BENCH.json llm tokens_per_s (same TINY "
+                     "engine, CPU interpret, no HTTP path)"),
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        }
+    finally:
+        ray_tpu.shutdown()
+    out = os.environ.get("RT_DATA_BENCH_OUT", "DATA_BENCH.json")
+    doc = {}
+    if os.path.exists(out):
+        with open(out) as f:
+            doc = json.load(f)
+    doc["data_llm"] = row
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    return row
+
+
 def run_jobs_bench():
     """Multi-tenant job plane under churn: K tenants x M gang jobs on a
     simulated v5e fleet that shrinks mid-run, driven by the real
@@ -721,6 +809,9 @@ def run_jobs_bench():
 def main():
     if "--jobs" in sys.argv:
         print(json.dumps(run_jobs_bench()))
+        return 0
+    if "--data-llm" in sys.argv:
+        print(json.dumps(run_data_llm()))
         return 0
     if "--data-shuffle" in sys.argv:
         print(json.dumps(run_data_shuffle()))

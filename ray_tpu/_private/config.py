@@ -96,8 +96,17 @@ class Config:
     # Parallel raw connections per bulk pull (sendfile lane); ranges of
     # the object stream concurrently into disjoint slices of the
     # destination segment (reference: PushManager multiplexing,
-    # object_manager.h:117).
-    object_transfer_bulk_conns: int = _cfg(2)
+    # object_manager.h:117). This is the CAP; the actual fan-out is
+    # ceil(size / fetch_chunk_bytes).
+    object_transfer_bulk_conns: int = _cfg(8)
+    # Range span per bulk connection: a pull opens one raw connection per
+    # fetch_chunk_bytes of payload (up to the cap above). 0 disables
+    # range splitting — the whole object rides one stream (the A/B
+    # baseline in microbench's cross_node_fetch). Default picked by that
+    # A/B: 16MB (4 conns at the 64MB bench payload) measured best on the
+    # loopback box, where finer chunks just add thread contention; real
+    # per-stream-limited networks want the fan-out.
+    fetch_chunk_bytes: int = _cfg(16 * 1024 * 1024)
     # Owner-side concurrent outbound transfers per object before new
     # pullers are asked to wait for a peer copy (broadcast becomes a tree
     # instead of N pulls from the owner).

@@ -350,6 +350,11 @@ class NodeService:
         # dead workers' counters fold into the retired accumulator.
         self.user_metrics: dict[str, dict] = {}
         self._retired_metrics: dict[tuple, dict] = {}
+        # Dead workers' final gauge snapshots, visible to the telemetry
+        # sampler for exactly one beat (then discarded): a batch job
+        # shorter than the sampler interval still surfaces its final
+        # llm_tokens_per_s:<op> values instead of dying unsampled.
+        self.dying_metrics: dict[str, dict] = {}
         # Trace spans pushed by workers (bounded; tracing is opt-in).
         self.trace_spans: collections.deque = collections.deque(maxlen=10_000)
         # Device-lane tasks currently executing (best-effort cancel).
@@ -673,6 +678,12 @@ class NodeService:
         snap = self.user_metrics.pop(source, None)
         if snap is None:
             return
+        # Final gauge values stay readable for one sampler beat (the
+        # sampler drains dying_metrics as it reads it); bounded so a
+        # churn storm with telemetry disabled cannot grow it.
+        if len(self.dying_metrics) >= 64:
+            self.dying_metrics.pop(next(iter(self.dying_metrics)))
+        self.dying_metrics[source] = snap
         acc = self._retired_metrics
         for r in snap.get("rows", []):
             kind = r.get("type")
@@ -1006,6 +1017,10 @@ class NodeService:
                 return
             writer.write(ln.to_bytes(8, "little"))
             if st.location == "shm":
+                # The raw-path open below bypasses shm.get(): restore the
+                # segment first if the store spilled it to disk.
+                if not self.shm.ensure_resident(oid):
+                    return
                 path = self.shm._path(oid)
                 loop = asyncio.get_running_loop()
                 with open(path, "rb") as f:
@@ -1056,8 +1071,14 @@ class NodeService:
             # Fall back to the chunked path, whose heap-buffer ingest
             # goes through put() and its eviction machinery.
             return None
-        n_conns = max(1, self.cfg.object_transfer_bulk_conns)
-        if size < 8 << 20:
+        # Fan-out scales with payload: one raw connection per
+        # fetch_chunk_bytes range, capped by bulk_conns. fetch_chunk_bytes=0
+        # forces the single-stream path (the microbench A/B baseline).
+        chunk = self.cfg.fetch_chunk_bytes
+        if chunk > 0 and size > chunk:
+            n_conns = min(-(-size // chunk),
+                          max(1, self.cfg.object_transfer_bulk_conns))
+        else:
             n_conns = 1
         span = -(-size // n_conns)
 

@@ -165,6 +165,10 @@ class TelemetrySampler:
         self._prev_t: Optional[float] = None
         self._prev: Dict[str, float] = {}
         self._store_hw = 0.0
+        # Spill-plane idle decay state: last observed event count and
+        # when it last moved.
+        self._spill_prev_ev = 0.0
+        self._spill_last_t = 0.0
 
     def _rate(self, name: str, cum: float, dt: float,
               out: Dict[str, float]):
@@ -244,6 +248,30 @@ class TelemetrySampler:
         m["store_hw_bytes"] = float(self._store_hw)
         m["store_num_objects"] = float(len(node.objects))
 
+        # Spill plane: session-wide spill/restore counters from the
+        # store backend (both backends implement stats(); the Python
+        # store folds in the shared .spill_log, so worker-process spills
+        # show up here too). Idle decay per the PR-10 gauge contract:
+        # a store quiet longer than SPILL_DECAY_S reads 0 instead of
+        # freezing the series at its last cumulative value.
+        try:
+            st = node.shm.stats()
+        except Exception:  # noqa: BLE001 - telemetry is best-effort
+            st = None
+        if st is not None:
+            now = time.time()
+            ev = float(st.get("spilled", 0) + st.get("restored", 0))
+            if ev != self._spill_prev_ev:
+                self._spill_prev_ev = ev
+                self._spill_last_t = now
+            active = (ev > 0
+                      and now - self._spill_last_t <= self.SPILL_DECAY_S)
+            m["store_spill_events"] = ev if active else 0.0
+            m["store_spilled_bytes"] = (
+                float(st.get("spilled_bytes", 0)) if active else 0.0)
+            m["store_restored_bytes"] = (
+                float(st.get("restored_bytes", 0)) if active else 0.0)
+
         # Serving-path signals from worker metric pushes (replicas and
         # proxy actors flush cumulative snapshots every 1s): queue-depth
         # gauges sum across sources; request histograms become
@@ -294,6 +322,11 @@ class TelemetrySampler:
     # is computed cross-source in _sample_collectives, not mapped here.
     COLLECTIVE_DECAY_S = 10.0
 
+    # Spill-plane series go quiet the same way: counters are cumulative,
+    # so without decay a single early spill would read as permanent
+    # pressure on every dashboard forever.
+    SPILL_DECAY_S = 10.0
+
     def _iter_metric_snaps(self):
         """(source, snapshot) pairs: worker pushes PLUS this process's
         own registry. Device-lane actors (and the driver in local mode)
@@ -307,6 +340,14 @@ class TelemetrySampler:
         except Exception:  # noqa: BLE001 - one bad sampler must not kill the sweep
             pass
         yield from self.node.user_metrics.items()
+        # Dead workers' final snapshots: consumed exactly once, so a
+        # short-lived batch operator's last gauge flush lands in one
+        # sample instead of vanishing with the worker (and a dead
+        # worker's gauges can never freeze a series at its last value).
+        dying = getattr(self.node, "dying_metrics", None)
+        if dying:
+            drained, self.node.dying_metrics = dict(dying), {}
+            yield from drained.items()
 
     def _sample_serve(self, m: Dict[str, float], dt: float):
         depth_by_dep: Dict[str, float] = {}

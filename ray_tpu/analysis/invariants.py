@@ -20,6 +20,12 @@ I404  dropped trace context — every request-forwarding hop must carry
 I405  missing step-accounting feed — every device-dispatch site must
       feed util/perfmodel's step accounting or the MFU/step series go
       stale and the roofline misattributes the step to host time.
+I407  silent batch-inference / spill transition — every batch-inference
+      operator state transition (data/llm.py lifecycle) and every
+      object-store spill/restore site must emit an event; a silent
+      transition means the operator trace or the cross-process spill
+      ledger (``stats()`` counters, ``rtpu memory`` spill plane)
+      quietly diverges from what actually happened.
 
 Adding a new invariant lint = appending a row to the right table (or a
 new table + ~10-line checker below). New site families go through this
@@ -197,6 +203,30 @@ EVENT_SITE_TABLES = (
         "update",  # launch / terminate decisions per pass
     ), "autoscaler decision site emits no event — the demand-driven "
        "launch/idle-terminate audit trail goes dark"),
+)
+
+#: Batch-inference operator lifecycle + object-store spill/restore
+#: sites: every state transition / spill event must emit. The llm.py
+#: rows cover the INIT/SUBMIT/DRAIN/EMIT/STOPPED lifecycle; the
+#: object_store.py rows keep the cross-process ``.spill_log`` ledger
+#: (and therefore ``stats()`` and the ``rtpu memory`` spill plane)
+#: coherent with the files actually moved.
+BATCH_SPILL_SITE_TABLES = (
+    ("ray_tpu/data/llm.py", "_event", (
+        "__init__",  # INIT (engine up, worker ready)
+        "_submit",   # SUBMIT (block admitted, throughput-greedy burst)
+        "_drain",    # DRAIN (blocking on engine completion)
+        "apply",     # EMIT (output block built)
+        "stop",      # STOPPED
+    ), "batch-inference operator state transition emits no lifecycle "
+       "event — the operator trace (stats()/events) silently loses "
+       "the transition"),
+    ("ray_tpu/_private/object_store.py", "_spill_event", (
+        "_spill_one",  # S <bytes> (victim moved shm -> spill_dir)
+        "_restore",    # R <bytes> (spill_dir -> shm on access)
+    ), "spill/restore site bypasses the event ledger — the "
+       "cross-process spill counters (stats(), telemetry series, "
+       "rtpu memory) silently diverge from the bytes actually moved"),
 )
 
 #: Dispatch-queue / pipeline-window mutation sites that must refresh
@@ -392,3 +422,12 @@ class MissingFlightRecord(_TableChecker):
     severity = "P0"
     tables = FLIGHTREC_SITE_TABLES
     mode = "name_ref"
+
+
+@register
+class SilentBatchSpillTransition(_TableChecker):
+    id = "I407"
+    family = "invariants"
+    severity = "P0"
+    tables = BATCH_SPILL_SITE_TABLES
+    mode = "method_call"

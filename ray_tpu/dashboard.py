@@ -155,6 +155,8 @@ async function refresh(){
       ['pipeline in-flight','pipeline_inflight','#393',1],
       ['pipeline occupancy','pipeline_occupancy','#939',1],
       ['store MB','store_used_bytes','#09c',1e-6],
+      ['spilled MB','store_spilled_bytes','#c33',1e-6],
+      ['restored MB','store_restored_bytes','#3c9',1e-6],
       ['pull MB/s','object_bytes_pulled_per_s','#c09',1e-6]];
     let hh='';
     for(const [label,m,color,scale] of HEALTH){

@@ -8,6 +8,7 @@ yields batches already device_put onto a mesh (the Data→Train ingest path
 feeds sharded jax arrays straight into the compiled step).
 """
 
+from . import llm  # noqa: F401  (ray.data.llm parity namespace)
 from .context import DataContext
 from .dataset import (  # noqa: F401
     Dataset,
@@ -29,6 +30,6 @@ range = range_  # ray.data.range parity (shadows the builtin in this namespace)
 
 __all__ = [
     "DataContext", "Dataset", "DatasetShard", "from_arrow", "from_items",
-    "from_numpy", "from_pandas", "range", "read_binary_files", "read_csv",
-    "read_images", "read_json", "read_parquet", "read_text",
+    "from_numpy", "from_pandas", "llm", "range", "read_binary_files",
+    "read_csv", "read_images", "read_json", "read_parquet", "read_text",
 ]

@@ -33,6 +33,12 @@ class DataContext:
     # default min(num_blocks, 32); P = num_blocks made the partition-ref
     # fan-out quadratic on wide datasets).
     sort_num_partitions: int | None = None
+    # Locality-aware map scheduling: route each map task to the node
+    # already holding its input block (soft node affinity — falls back
+    # to normal placement when the owner is gone). Reference:
+    # locality_with_output / actor-locality ranking in the streaming
+    # executor's scheduling loop.
+    locality_aware_scheduling: bool = True
 
     _current = None
 

@@ -293,6 +293,15 @@ class Dataset:
         three (reference: map_batches batch_format)."""
         if batch_format not in ("numpy", "pandas", "pyarrow"):
             raise ValueError(f"unknown batch_format {batch_format!r}")
+        from .llm import LLMProcessor
+
+        if isinstance(fn, LLMProcessor):
+            # Batch-inference operator: the processor record IS the
+            # config — it compiles to a dedicated actor-pool operator
+            # (one continuous-batching engine per member; data/llm.py).
+            return self._with(_Stage(
+                "llm_map", fn, batch_size,
+                pool=concurrency or fn.concurrency))
         if isinstance(fn, type):
             return self._with(_Stage(
                 "actor_map", fn, batch_size, pool=concurrency or 1,
@@ -510,11 +519,12 @@ class Dataset:
         segments: list = []
         cur: list[_Stage] = []
         for st in self._stages:
-            if st.kind == "actor_map":
+            if st.kind in ("actor_map", "llm_map"):
                 if cur:
                     segments.append(("map", cur))
                     cur = []
-                segments.append(("actor", st))
+                segments.append((("actor" if st.kind == "actor_map"
+                                  else "llm"), st))
             else:
                 cur.append(st)
         if cur:
@@ -546,6 +556,12 @@ class Dataset:
                 specs.append(MapSpec(_fuse(payload), _remote_opts(),
                                      name="MapBlocks",
                                      max_concurrency=conc))
+            elif seg_kind == "llm":
+                from .llm import _operator_spec
+
+                st = payload
+                specs.append(_operator_spec(st.fn, st.pool,
+                                            _remote_opts()))
             else:
                 st = payload
                 specs.append(ActorPoolSpec(

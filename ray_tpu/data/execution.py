@@ -37,13 +37,78 @@ device steps without unbounded prefetch.
 from __future__ import annotations
 
 import collections
+import time
 from typing import Any, Callable, Iterator, Optional
 
 from .context import DataContext
 
 __all__ = [
-    "MapSpec", "ActorPoolSpec", "StreamingExecutor",
+    "MapSpec", "ActorPoolSpec", "StreamingExecutor", "last_run_stats",
 ]
+
+#: Stats dict of the most recent StreamingExecutor.run() on this driver
+#: (locality hit/miss counters etc.) — executions are driver-serial per
+#: dataset consumption, so a module slot is enough for bench/tests.
+_LAST_RUN_STATS: dict = {}
+
+
+def last_run_stats() -> dict:
+    """Stats of the most recently completed streaming execution."""
+    return dict(_LAST_RUN_STATS)
+
+
+class _LocalityResolver:
+    """owner_addr -> node_id map for locality-aware task routing.
+
+    Block refs carry the peer address of the owning node service; the
+    scheduler wants a NodeID. The cluster membership table is snapshotted
+    once and refreshed at most every REFRESH_S on a miss (nodes joining
+    mid-pipeline), so the per-block cost on the scheduling hot loop is
+    two dict lookups. Reference: the streaming executor's locality
+    ranking (`locality_with_output`) over the object location directory.
+    """
+
+    REFRESH_S = 5.0
+
+    def __init__(self):
+        self._map: dict[tuple, bytes] = {}
+        self._next_refresh = 0.0
+        self.hits = 0
+        self.misses = 0
+
+    def _refresh(self) -> None:
+        import ray_tpu
+
+        try:
+            rows = ray_tpu.nodes()
+        except Exception:  # noqa: BLE001 - no cluster: locality off
+            return
+        m = {}
+        for n in rows:
+            if n.get("state") != "ALIVE":
+                continue
+            addr = n.get("address")
+            if addr:
+                m[tuple(addr)] = n["node_id"]
+        self._map = m
+
+    def node_of(self, owner_addr) -> Optional[bytes]:
+        """NodeID bytes for the node owning `owner_addr`, else None."""
+        if owner_addr is None:
+            self.misses += 1
+            return None
+        nid = self._map.get(tuple(owner_addr))
+        if nid is None:
+            now = time.monotonic()
+            if now >= self._next_refresh:
+                self._next_refresh = now + self.REFRESH_S
+                self._refresh()
+                nid = self._map.get(tuple(owner_addr))
+        if nid is None:
+            self.misses += 1
+        else:
+            self.hits += 1
+        return nid
 
 
 class MapSpec:
@@ -70,21 +135,29 @@ class ActorPoolSpec:
 
     def __init__(self, cls: type, pool_size: int, opts: dict,
                  ctor_args: tuple = (), ctor_kwargs: dict | None = None,
-                 name: str = "ActorMap"):
+                 name: str = "ActorMap", stop_method: str | None = None):
         self.cls = cls
         self.pool_size = max(1, int(pool_size))
         self.opts = opts
         self.ctor_args = ctor_args
         self.ctor_kwargs = ctor_kwargs or {}
         self.name = name
+        # Optional graceful teardown hook, called (briefly, best-effort)
+        # before the actor is killed: LLM workers use it to emit their
+        # STOPPED lifecycle event and flush final engine gauges — a
+        # batch job shorter than the 1s metrics beat would otherwise
+        # never surface its llm_tokens_per_s:<name> series.
+        self.stop_method = stop_method
 
 
 class _OpState:
     """Runtime state of one physical operator in the topology."""
 
-    def __init__(self, spec, index: int, ctx: DataContext):
+    def __init__(self, spec, index: int, ctx: DataContext,
+                 locality: Optional[_LocalityResolver] = None):
         self.spec = spec
         self.index = index
+        self._locality = locality
         self.inq: collections.deque = collections.deque()  # (seq, ref)
         self.inflight: dict[Any, int] = {}                  # out_ref -> seq
         self.input_of: dict[Any, Any] = {}                  # out_ref -> in ref
@@ -104,6 +177,10 @@ class _OpState:
         self.max_outbuf = max(ctx.max_buffered_blocks, self.max_tasks)
         # lazily-built executable handle (remote fn / actor pool)
         self._remote = None
+        # node_id -> RemoteFunction with soft node affinity baked in;
+        # built once per node so the hot loop pays dict lookups, not
+        # .options() re-wraps, per scheduled block.
+        self._remote_by_node: dict[bytes, Any] = {}
         self._actors: list = []
         self._actor_load: list[int] = []
         self._ref_actor: dict[Any, int] = {}
@@ -122,7 +199,7 @@ class _OpState:
         if isinstance(spec, MapSpec):
             if self._remote is None:
                 self._remote = ray_tpu.remote(**spec.opts)(spec.fn)
-            out = self._remote.remote(ref)
+            out = self._pick_remote(ref).remote(ref)
         else:  # ActorPoolSpec
             if not self._actors:
                 acls = ray_tpu.remote(**spec.opts)(spec.cls)
@@ -140,6 +217,29 @@ class _OpState:
         self.inflight[out] = seq
         self.input_of[out] = ref
         self.submitted += 1
+
+    def _pick_remote(self, ref):
+        """The remote handle to dispatch `ref` through: the node-affine
+        variant for the node holding the input block when locality
+        routing is on, the plain handle otherwise. Device-lane ops keep
+        their resource-driven placement (affinity would fight it)."""
+        if (self._locality is None
+                or self.spec.opts.get("scheduling_strategy") is not None):
+            return self._remote
+        nid = self._locality.node_of(getattr(ref, "owner_addr", None))
+        if nid is None:
+            return self._remote
+        fn = self._remote_by_node.get(nid)
+        if fn is None:
+            from ray_tpu.util.scheduling_strategies import (
+                NodeAffinitySchedulingStrategy,
+            )
+
+            fn = self._remote.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    nid, soft=True))
+            self._remote_by_node[nid] = fn
+        return fn
 
     def complete(self, out_ref) -> None:
         import ray_tpu
@@ -176,7 +276,13 @@ class _OpState:
         if self._actors:
             import ray_tpu
 
+            stop = getattr(self.spec, "stop_method", None)
             for a in self._actors:
+                if stop:
+                    try:
+                        ray_tpu.get(getattr(a, stop).remote(), timeout=5)
+                    except Exception:  # noqa: BLE001 - teardown is best-effort
+                        pass
                 try:
                     ray_tpu.kill(a)
                 except Exception:  # noqa: BLE001 - already dead
@@ -200,7 +306,9 @@ class StreamingExecutor:
                  owns_input_blocks: bool = True):
         self._source = source
         self._ctx = ctx or DataContext.get_current()
-        self._ops = [_OpState(s, i, self._ctx)
+        self._locality = (_LocalityResolver()
+                          if self._ctx.locality_aware_scheduling else None)
+        self._ops = [_OpState(s, i, self._ctx, locality=self._locality)
                      for i, s in enumerate(specs)]
         if self._ops:
             # First-op inputs are the SOURCE blocks: only freeable when
@@ -307,5 +415,10 @@ class StreamingExecutor:
                             and not self._tail_out):
                         return
         finally:
+            if self._locality is not None:
+                self.stats["locality_hits"] = self._locality.hits
+                self.stats["locality_misses"] = self._locality.misses
+            global _LAST_RUN_STATS
+            _LAST_RUN_STATS = self.stats
             for op in self._ops:
                 op.shutdown()

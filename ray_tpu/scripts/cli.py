@@ -331,6 +331,8 @@ _TOP_COLUMNS = (
     ("inflight", "pipeline_inflight", "{:.0f}"),
     ("occ", "pipeline_occupancy", "{:.0%}"),
     ("store MB", "store_used_bytes", None),
+    ("spill MB", "store_spilled_bytes", None),
+    ("restore MB", "store_restored_bytes", None),
     ("frames/fl", "writer_frames_per_flush", "{:.1f}"),
 )
 
@@ -716,7 +718,7 @@ def cmd_heap(args):
 
 
 def cmd_memory(args):
-    _attach(args)
+    rt = _attach(args)
     from collections import defaultdict
 
     from ray_tpu.util import state
@@ -750,6 +752,22 @@ def cmd_memory(args):
             print(f"  {r['object_id'][:16]}  {r.get('size') or 0:>12}  "
                   f"{r['status']:<8} refs={r.get('refcount', '?')}  "
                   f"owner={r.get('owner', '?')}")
+    # Spill plane: per-node store spill/restore counters off the
+    # timeseries sampler (0s mean idle-decayed, not never-spilled).
+    try:
+        latest = _telemetry_latest(rt)
+    except Exception:  # noqa: BLE001 - no head telemetry: skip the section
+        latest = {}
+    ev = latest.get("store_spill_events", {})
+    sb = latest.get("store_spilled_bytes", {})
+    rb = latest.get("store_restored_bytes", {})
+    nids = sorted(set(ev) | set(sb) | set(rb))
+    if nids:
+        print("spill plane (idle series decay to 0):")
+        for nid in nids:
+            print(f"  node {nid[:12]}: events={ev.get(nid, 0):.0f} "
+                  f"spilled={sb.get(nid, 0) / 1e6:.2f} MB "
+                  f"restored={rb.get(nid, 0) / 1e6:.2f} MB")
 
 
 # ---------------------------------------------------------------------------

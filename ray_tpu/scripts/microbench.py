@@ -305,16 +305,26 @@ def _membership_churn(n_nodes: int) -> dict:
     return row
 
 
-def _cross_node_fetch(payload_mb: int = 64) -> dict:
+def _cross_node_fetch(payload_mb: int = 64, *,
+                      fetch_chunk_bytes: int | None = None,
+                      name: str = "cross_node_fetch_mb_s") -> dict:
     """Driver→node object-plane bandwidth: a task on another node consumes
     a driver-owned payload_mb array (arg pull over the chunked transfer
     path). The no-arg task round trip is measured on the same warm worker
-    and subtracted, isolating the transfer."""
+    and subtracted, isolating the transfer.
+
+    ``fetch_chunk_bytes`` overrides the chunked-pull span for the A/B row
+    (0 = one connection per pull, the pre-chunking baseline). The PULLING
+    side is the added node, which boots its config from env, so the
+    override goes through RT_FETCH_CHUNK_BYTES."""
     import ray_tpu
     from ray_tpu.cluster_utils import Cluster
 
     mb = float(os.environ.get("RT_MB_FETCH_MB", payload_mb))
     n = int(mb * 1024 * 1024 // 8)
+    saved_env = os.environ.get("RT_FETCH_CHUNK_BYTES")
+    if fetch_chunk_bytes is not None:
+        os.environ["RT_FETCH_CHUNK_BYTES"] = str(fetch_chunk_bytes)
 
     @ray_tpu.remote(resources={"src": 1})
     def consume(a):
@@ -324,7 +334,11 @@ def _cross_node_fetch(payload_mb: int = 64) -> dict:
     def noop():
         return 0
 
-    cluster = Cluster(init_args={"num_cpus": 1})
+    init_args: dict = {"num_cpus": 1}
+    if fetch_chunk_bytes is not None:
+        init_args["system_config"] = {"fetch_chunk_bytes":
+                                      fetch_chunk_bytes}
+    cluster = Cluster(init_args=init_args)
     try:
         cluster.add_node(num_cpus=1, resources={"src": 1})
         cluster.wait_for_nodes(2)
@@ -348,14 +362,25 @@ def _cross_node_fetch(payload_mb: int = 64) -> dict:
             dt = max(1e-6, time.perf_counter() - t0 - base)
             rates.append(payload.nbytes / 1e6 / dt)
             del ref, payload
-        row = {"name": "cross_node_fetch_mb_s",
+        row = {"name": name,
                "per_s": round(statistics.fmean(rates), 2),
                "sd": round(statistics.pstdev(rates), 2)}
-        print(f"cross_node_fetch_mb_s: {row['per_s']:,.1f} MB/s",
-              flush=True)
+        if fetch_chunk_bytes is not None:
+            row["fetch_chunk_bytes"] = fetch_chunk_bytes
+        print(f"{name}: {row['per_s']:,.1f} MB/s", flush=True)
         return row
     finally:
         cluster.shutdown()
+        if fetch_chunk_bytes is not None:
+            if saved_env is None:
+                os.environ.pop("RT_FETCH_CHUNK_BYTES", None)
+            else:
+                os.environ["RT_FETCH_CHUNK_BYTES"] = saved_env
+            # init(system_config=...) mutates the process-wide config
+            # singleton; undo so later benches see the declared default.
+            from ray_tpu._private.config import Config, get_config
+
+            get_config().fetch_chunk_bytes = Config().fetch_chunk_bytes
 
 
 def main():
@@ -368,6 +393,11 @@ def main():
         ray_tpu.shutdown()
     # The cluster benchmark owns its own init/shutdown cycle.
     results.append(_cross_node_fetch())
+    # A/B: the same pull with chunk splitting disabled (one connection
+    # per fetch) — the gap is what fetch_chunk_bytes buys.
+    results.append(_cross_node_fetch(
+        fetch_chunk_bytes=0,
+        name="cross_node_fetch_single_stream_mb_s"))
 
     doc = {
         "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),

@@ -521,6 +521,35 @@ def test_i406_catches_an_unrecorded_collective_site(tmp_path):
     assert [f.symbol for f in rep.findings] == ["G.barrier"]
 
 
+def test_i407_catches_a_silent_batch_or_spill_site(tmp_path):
+    # Two-table shape mirrors the real rows: the batch-inference
+    # operator lifecycle (_event) and the store spill ledger
+    # (_spill_event) are audited by the same checker.
+    tables = (
+        ("op.py", "_event", ("apply", "stop"), "why"),
+        ("store.py", "_spill_event", ("spill", "restore"), "why"),
+    )
+    rep = lint(tmp_path, {"op.py": """\
+        class W:
+            def apply(self, blk):
+                self._event("EMIT", rows=1)
+                return blk
+
+            def stop(self):
+                return None
+        """, "store.py": """\
+        class S:
+            def spill(self, oid):
+                self._spill_event("S", oid, 4)
+
+            def restore(self, oid):
+                return open(oid)
+        """}, select="I407", config={"I407_tables": tables})
+    missing = sorted((f.path, f.symbol) for f in rep.findings)
+    assert missing == [("op.py", "stop"), ("store.py", "restore")]
+    assert all(f.severity == "P0" for f in rep.findings)
+
+
 # ---------------------------------------------------------------------------
 # Suppression surfaces
 # ---------------------------------------------------------------------------

@@ -1,0 +1,152 @@
+"""Offline batch inference (ray_tpu/data/llm.py): the LLMProcessor ->
+actor-pool operator bridge, operator lifecycle events, telemetry
+naming, and the executor's locality-aware routing.
+
+Capability parity target: ray.data.llm's build_llm_processor — batch
+inference as a first-class Data workload on the continuous-batching
+engine.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+from ray_tpu.data.llm import (
+    DRAIN,
+    EMIT,
+    INIT,
+    SUBMIT,
+    LLMProcessor,
+    _decode_tokens,
+    _encode_prompt,
+    _LLMWorker,
+    build_llm_processor,
+)
+
+
+# ---------------------------------------------------------------------------
+# Processor record / helpers (no engine, no cluster)
+# ---------------------------------------------------------------------------
+def test_processor_rejects_unknown_sampling_keys():
+    with pytest.raises(ValueError, match="unknown sampling keys"):
+        LLMProcessor(sampling={"max_tokens": 4, "beam_width": 2})
+
+
+def test_build_llm_processor_is_reference_shaped():
+    proc = build_llm_processor(None, sampling={"max_tokens": 3},
+                               concurrency=2, name="score")
+    assert isinstance(proc, LLMProcessor)
+    assert proc.concurrency == 2
+    assert proc.name == "score"
+    assert proc.sampling == {"max_tokens": 3}
+
+
+def test_prompt_encoding_roundtrip():
+    assert _encode_prompt("hi") == [104, 105]
+    assert _encode_prompt(b"\x01\x02") == [1, 2]
+    assert _encode_prompt([7, 8, 9]) == [7, 8, 9]
+    assert _decode_tokens([104, 105]) == "hi"
+    assert _decode_tokens([300]) == ""  # out-of-byte-range ids
+
+
+def test_map_batches_compiles_llm_stage_as_operator():
+    """An LLMProcessor handed to map_batches becomes a dedicated stage
+    (a fusion barrier like actor stages), not a plain function map."""
+    proc = build_llm_processor(sampling={"max_tokens": 2})
+    ds = rd.range(8).map_batches(proc)
+    kinds = [st.kind for st in ds._stages]
+    assert "llm_map" in kinds
+
+
+# ---------------------------------------------------------------------------
+# The worker + the full operator path (engine on the CPU-interpret mesh)
+# ---------------------------------------------------------------------------
+def test_llm_worker_lifecycle_and_output_block():
+    proc = build_llm_processor(
+        sampling={"max_tokens": 4, "seed": 7}, name="unit")
+    w = _LLMWorker(proc)
+    try:
+        blk = {"prompt": np.asarray(["ab", "cd"], dtype=object),
+               "row_id": np.asarray([10, 11])}
+        out = w.apply(blk)
+        # Row order, passthrough columns, and generation columns.
+        np.testing.assert_array_equal(out["row_id"], [10, 11])
+        assert list(out["num_generated_tokens"]) == [4, 4]
+        assert all(r == "length" for r in out["finish_reason"])
+        assert all(isinstance(t, str) for t in out["generated_text"])
+        # Lifecycle: INIT then SUBMIT -> DRAIN -> EMIT per block, every
+        # transition evented (the I407 contract).
+        states = [s for _, s, _ in w.events]
+        assert states[:4] == [INIT, SUBMIT, DRAIN, EMIT]
+        st = w.stats()
+        assert st["blocks"] == 1 and st["rows"] == 2
+        # Engine telemetry is named after the operator.
+        assert w.engine.name == "unit"
+        # Empty block short-circuits; missing prompt column is loud.
+        assert w.apply({}) == {}
+        with pytest.raises(KeyError, match="prompt"):
+            w.apply({"text": np.asarray(["x"], dtype=object)})
+    finally:
+        w.stop()
+    assert w.state == "STOPPED"
+
+
+def test_dataset_map_batches_end_to_end(rt):
+    proc = build_llm_processor(
+        sampling={"max_tokens": 3, "seed": 1}, name="e2e")
+    out = (rd.from_items([{"prompt": "hello"}, {"prompt": "world"},
+                          {"prompt": [72, 73]}])
+           .map_batches(proc)
+           .take_all())
+    assert len(out) == 3
+    assert all(r["num_generated_tokens"] == 3 for r in out)
+    assert all(r["finish_reason"] == "length" for r in out)
+
+
+# ---------------------------------------------------------------------------
+# Locality-aware routing
+# ---------------------------------------------------------------------------
+def test_locality_resolver_maps_addr_to_node(rt):
+    from ray_tpu.data.execution import _LocalityResolver
+
+    res = _LocalityResolver()
+    rows = ray_tpu.nodes()
+    addr = tuple(rows[0]["address"])
+    nid = res.node_of(addr)
+    assert nid == rows[0]["node_id"]
+    assert res.hits >= 1
+    # Unknown addresses miss without thrashing the membership table:
+    # the refresh is rate-limited, so back-to-back misses do one scan.
+    assert res.node_of(("198.51.100.9", 1)) is None
+    before = res._next_refresh
+    assert res.node_of(("198.51.100.9", 2)) is None
+    assert res._next_refresh == before
+    assert res.misses >= 2
+
+
+def test_executor_records_locality_stats(rt):
+    from ray_tpu.data.execution import last_run_stats
+
+    ds = rd.range(32, override_num_blocks=4).map_batches(
+        lambda b: {"id": b["id"] * 2})
+    ds.materialize()
+    st = last_run_stats()
+    assert "locality_hits" in st and "locality_misses" in st
+    # Single-node cluster: every block's owner is this node.
+    assert st["locality_hits"] > 0
+
+
+def test_locality_can_be_disabled(rt):
+    from ray_tpu.data.execution import last_run_stats
+
+    ctx = rd.DataContext.get_current()
+    old = ctx.locality_aware_scheduling
+    ctx.locality_aware_scheduling = False
+    try:
+        ds = rd.range(8, override_num_blocks=2).map_batches(
+            lambda b: {"id": b["id"]})
+        ds.materialize()
+        assert "locality_hits" not in last_run_stats()
+    finally:
+        ctx.locality_aware_scheduling = old

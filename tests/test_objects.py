@@ -14,9 +14,9 @@ import ray_tpu
 from ray_tpu._private import serialization
 
 def _segments(d):
-    """Object segments in a store dir (the native store keeps a .pins
-    bookkeeping subdir that is not an object)."""
-    return [f for f in os.listdir(d) if not f.startswith(".")]
+    """Object segments in a store dir (sidecars, ``<oid>.pin`` markers,
+    and the native store's .pins bookkeeping subdir are not objects)."""
+    return [f for f in os.listdir(d) if "." not in f]
 
 
 
@@ -147,3 +147,51 @@ def test_orphan_session_dirs_reaped_on_init():
         ray_tpu.shutdown()
         shutil.rmtree(live, ignore_errors=True)
         shutil.rmtree(fake, ignore_errors=True)
+
+
+def test_orphan_reap_follows_spill_sidecar(tmp_path):
+    """A dead session's custom RT_SPILL_DIR (recorded in its ``.spill``
+    sidecar) is reaped with it — but a spill dir SHARED with a live
+    session must never be removed out from under the running cluster."""
+    import shutil
+
+    from ray_tpu._private.object_store import (
+        SHM_DIR, _proc_start_time, reap_orphan_sessions)
+
+    ray_tpu.shutdown()
+
+    def make_session(name, owner_line, spill_dir):
+        prefix = os.path.join(SHM_DIR, name)
+        os.makedirs(prefix, exist_ok=True)
+        with open(os.path.join(prefix, ".owner"), "w") as f:
+            f.write(owner_line)
+        with open(os.path.join(prefix, ".spill"), "w") as f:
+            f.write(str(spill_dir))
+        return prefix
+
+    def make_spill(name):
+        d = tmp_path / name
+        d.mkdir()
+        (d / ("aa" * 14)).write_bytes(b"x" * 4096)  # a spilled segment
+        return d
+
+    dead_pid = "4194000 1"  # impossible pid + bogus start = dead owner
+    live_pid = f"{os.getpid()} {_proc_start_time(os.getpid()) or 0}"
+
+    own_spill = make_spill("spill-dead-only")
+    shared_spill = make_spill("spill-shared")
+    dead1 = make_session("rtpu-deadspilla000", dead_pid, own_spill)
+    dead2 = make_session("rtpu-deadspillb000", dead_pid, shared_spill)
+    live = make_session("rtpu-livespill0000", live_pid, shared_spill)
+    try:
+        reap_orphan_sessions()
+        assert not os.path.exists(dead1), "dead session dir must be reaped"
+        assert not os.path.exists(dead2), "dead session dir must be reaped"
+        assert not own_spill.exists(), \
+            "dead session's sidecar spill dir must be reaped with it"
+        assert os.path.exists(live), "live session dir must survive"
+        assert shared_spill.exists() and any(shared_spill.iterdir()), \
+            "spill dir shared with a live session must be preserved"
+    finally:
+        for p in (dead1, dead2, live):
+            shutil.rmtree(p, ignore_errors=True)

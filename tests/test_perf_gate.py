@@ -277,6 +277,51 @@ def test_flight_recorder_overhead_gate():
         f"per op > budget {budget * 1e6:.2f}us (calibration {cal:.2f})")
 
 
+def test_locality_and_spill_bookkeeping_gate():
+    """The data plane's locality routing and the store's capacity
+    bookkeeping both sit on the per-block scheduling path: one
+    owner_addr -> NodeID resolve, one per-node handle-cache lookup, and
+    one _ensure_capacity pass (cached-used fast path, amortizing the
+    every-32-puts scandir resync) must together stay under 20us per
+    scheduled block at calibration 1.0 (~1-3us observed solo). A
+    regression — the resolver refreshing membership per call, the
+    handle cache degenerating to per-call .options() re-wraps, or
+    capacity checks scanning the arena on every put — taxes every
+    block, so it fails loudly here."""
+    import secrets
+
+    from ray_tpu._private.object_store import ObjectID, SharedMemoryStore
+    from ray_tpu.data.execution import _LocalityResolver
+
+    cal = _calibrate()
+    resolver = _LocalityResolver()
+    addr = ("10.0.0.1", 7001)
+    resolver._map = {addr: b"n" * 28}
+    handle_cache = {b"n" * 28: object()}  # _remote_by_node stand-in
+    store = SharedMemoryStore(secrets.token_hex(6),
+                              capacity_bytes=1 << 30)
+    try:
+        # A populated arena so the periodic scandir resync has real work.
+        for _ in range(32):
+            store.put(ObjectID(secrets.token_bytes(28)), b"x" * 4096)
+        # Warm the fast path out of the measured region.
+        resolver.node_of(addr)
+        store._ensure_capacity(1024)
+        n = 20000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            nid = resolver.node_of(addr)
+            handle_cache.get(nid)
+            store._ensure_capacity(1024)
+        per_block = (time.perf_counter() - t0) / n
+    finally:
+        store.destroy()
+    budget = 20e-6 / cal
+    assert per_block < budget, (
+        f"locality/spill bookkeeping regressed: {per_block * 1e6:.2f}us "
+        f"per block > budget {budget * 1e6:.2f}us (calibration {cal:.2f})")
+
+
 def test_solo_cross_node_fetch_gate():
     cal = _calibrate()
     os.environ["RT_MB_FETCH_MB"] = "16"

@@ -468,3 +468,50 @@ def test_telemetry_disabled_by_config():
         assert state.timeseries()["series"] == {}
     finally:
         ray_tpu.shutdown()
+
+
+def test_spill_series_sampled_with_idle_decay(rt):
+    """The sampler surfaces the store's session-wide spill/restore
+    ledger as store_spill_events / store_spilled_bytes /
+    store_restored_bytes, and an idle store decays the series to 0
+    (the PR-10 gauge contract) instead of freezing it at the last
+    cumulative value."""
+    sampler = TelemetrySampler(rt.node)
+    m = sampler.sample()["metrics"]
+    assert m["store_spill_events"] == 0.0  # quiet store reads 0
+
+    rt.node.shm._spill_event("S", "ab" * 14, 2048)
+    rt.node.shm._spill_event("R", "cd" * 14, 1024)
+    m = sampler.sample()["metrics"]
+    assert m["store_spill_events"] == 2.0
+    assert m["store_spilled_bytes"] == 2048.0
+    assert m["store_restored_bytes"] == 1024.0
+
+    # No new events for longer than the decay window -> back to 0.
+    sampler._spill_last_t -= sampler.SPILL_DECAY_S + 1
+    m = sampler.sample()["metrics"]
+    assert m["store_spill_events"] == 0.0
+    assert m["store_spilled_bytes"] == 0.0
+    assert m["store_restored_bytes"] == 0.0
+
+
+def test_dying_worker_gauges_visible_for_one_beat(rt):
+    """A worker that pushes its final gauge snapshot and dies between
+    sampler beats (a batch-inference pool shorter than the sampler
+    interval) still lands in exactly one sample: retirement parks the
+    snapshot in dying_metrics, the next sample consumes it, and the one
+    after no longer sees it (dead gauges must never freeze a series)."""
+    sampler = TelemetrySampler(rt.node)
+    snap = {"ts": time.time(), "rows": [
+        {"name": "rtpu_llm_tokens_per_s", "type": "gauge",
+         "tags": {"deployment": "ephemeral"}, "value": 123.0}]}
+    rt.node.user_metrics["deadbeef"] = snap
+    rt.node._retire_worker_metrics("deadbeef")
+    assert "deadbeef" not in rt.node.user_metrics
+
+    m = sampler.sample()["metrics"]
+    assert m["llm_tokens_per_s:ephemeral"] == 123.0
+    assert not rt.node.dying_metrics  # consumed by that sample
+
+    m = sampler.sample()["metrics"]
+    assert "llm_tokens_per_s:ephemeral" not in m
