@@ -654,22 +654,33 @@ def run_serve_llm():
 
     jax.config.update("jax_platforms", "cpu")
     import ray_tpu
-    from ray_tpu.scripts.serve_bench import run_serve_llm as _bench
+    from ray_tpu.scripts.serve_bench import (run_serve_llm as _bench,
+                                             run_serve_llm_mixed,
+                                             run_serve_llm_prefix)
 
     duration = float(os.environ.get("RT_SERVE_BENCH_S", "6"))
     clients = int(os.environ.get("RT_SERVE_BENCH_CLIENTS", "6"))
+    ts = time.strftime("%Y-%m-%dT%H:%M:%S")
     ray_tpu.init(num_cpus=2)
     try:
         row = _bench(duration_s=duration, clients=clients)
+        row["ts"] = ts
+        # Prefix-cache acceptance workloads: shared-system-prompt TTFT
+        # flatness and the mixed chunked-admission A/B.
+        prefix_row = run_serve_llm_prefix()
+        prefix_row["ts"] = ts
+        mixed_row = run_serve_llm_mixed(duration_s=duration)
+        mixed_row["ts"] = ts
     finally:
         ray_tpu.shutdown()
-    row["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
     out = os.environ.get("RT_SERVE_BENCH_OUT", "SERVE_BENCH.json")
     doc = {}
     if os.path.exists(out):
         with open(out) as f:
             doc = json.load(f)
     doc["llm"] = row
+    doc["llm_prefix"] = prefix_row
+    doc["llm_mixed"] = mixed_row
     with open(out, "w") as f:
         json.dump(doc, f, indent=2)
         f.write("\n")

@@ -298,6 +298,12 @@ class TelemetrySampler:
         "rtpu_llm_host_gap_ms": ("llm_host_gap_ms", "max"),
         "rtpu_llm_mfu": ("llm_mfu", "max"),
         "rtpu_llm_hbm_util": ("llm_hbm_util", "max"),
+        # Prefix-cache plane (llm/kv_cache.py PrefixPool + chunked
+        # admission): hit rate is a cumulative ratio (freshest wins);
+        # shared blocks and chunk dispatches sum over replicas.
+        "rtpu_llm_kv_hit_rate": ("kv_cache_hit_rate", "max"),
+        "rtpu_llm_kv_shared_blocks": ("kv_shared_blocks", "sum"),
+        "rtpu_llm_prefill_chunks": ("prefill_chunks", "sum"),
         # Train-session equivalents (train/session.py wrap_step+report).
         "rtpu_train_step_ms": ("train_step_ms", "max"),
         "rtpu_train_device_ms": ("train_device_ms", "max"),

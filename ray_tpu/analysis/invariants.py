@@ -229,6 +229,22 @@ BATCH_SPILL_SITE_TABLES = (
        "rtpu memory) silently diverge from the bytes actually moved"),
 )
 
+#: Prefix-pool state changes that must land in the pool's event ring:
+#: sharing (refcount bump on a cache hit), registration (new index
+#: keys), COW splits and evictions are exactly the transitions the
+#: cache-debugging story (prefix_stats(), kv_cache_hit_rate series)
+#: is built on — a silent one makes hit/eviction telemetry lie.
+PREFIX_POOL_SITE_TABLES = (
+    ("ray_tpu/llm/kv_cache.py", "_event", (
+        "admit",       # "share" (cache-hit blocks acquired, ref++)
+        "register",    # "register" (new chunk keys indexed)
+        "cow",         # "cow" (shared block split before divergent write)
+        "_evict_one",  # "evict" (LRU parked block dropped for space)
+    ), "prefix-pool state change emits no event — prefix_stats() and "
+       "the kv_cache_hit_rate/kv_shared_blocks series silently diverge "
+       "from what the allocator actually shared, split or evicted"),
+)
+
 #: Dispatch-queue / pipeline-window mutation sites that must refresh
 #: the telemetry high-water gauges.
 GAUGE_SITE_TABLES = (
@@ -430,4 +446,13 @@ class SilentBatchSpillTransition(_TableChecker):
     family = "invariants"
     severity = "P0"
     tables = BATCH_SPILL_SITE_TABLES
+    mode = "method_call"
+
+
+@register
+class SilentPrefixPoolTransition(_TableChecker):
+    id = "I408"
+    family = "invariants"
+    severity = "P0"
+    tables = PREFIX_POOL_SITE_TABLES
     mode = "method_call"

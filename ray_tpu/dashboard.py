@@ -202,7 +202,16 @@ async function refresh(){
         '<div>MFU '+spark(mfu,240,34,'#36c')+' '+(last(mfu)*100).toFixed(1)+'%'+
         '  HBM '+spark(hbm,240,34,'#939')+' '+(last(hbm)*100).toFixed(1)+'%</div>'+
         '<div>step ms '+spark(step,240,34,'#393')+' '+last(step).toFixed(1)+
-        '  host gap ms '+spark(gap,240,34,'#c63')+' '+last(gap).toFixed(1)+'</div>';}
+        '  host gap ms '+spark(gap,240,34,'#c63')+' '+last(gap).toFixed(1)+'</div>';
+      // Prefix-cache line (LLM lane only; series appear once the
+      // engine runs with a PrefixPool): hit rate + shared/COW pressure.
+      const hit=maxNodes(hs.series['kv_cache_hit_rate:'+id]||{});
+      const shared=maxNodes(hs.series['kv_shared_blocks:'+id]||{});
+      if(hit.length||shared.length){
+        ph+='<div>KV hit '+spark(hit,240,34,'#093')+' '+
+          (last(hit)*100).toFixed(1)+'%'+
+          '  shared blocks '+spark(shared,240,34,'#909')+' '+
+          last(shared).toFixed(0)+'</div>';}}
     document.getElementById('perf').innerHTML=
       ph||'(no accounted engine/train steps yet)';
     document.getElementById('perfsum').textContent=ph?

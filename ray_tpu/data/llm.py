@@ -65,6 +65,9 @@ class LLMProcessor:
                  concurrency: int = 1,
                  num_blocks: int = 64, block_size: int = 16,
                  max_batch: int = 8, seed: int = 0,
+                 prefill_chunk_tokens: Optional[int] = None,
+                 prefix_cache: bool = True,
+                 system_prompt=None,
                  name: Optional[str] = None):
         sampling = dict(sampling or {})
         unknown = set(sampling) - {"max_tokens", "temperature", "top_k",
@@ -80,6 +83,16 @@ class LLMProcessor:
         self.block_size = int(block_size)
         self.max_batch = int(max_batch)
         self.seed = int(seed)
+        # Batch scoring is throughput-greedy, so chunked admission stays
+        # OFF by default (no decode stream to protect); prefix caching
+        # stays ON — a shared instruction prefix across the batch's rows
+        # prefills once per actor, not once per row.
+        self.prefill_chunk_tokens = (None if prefill_chunk_tokens is None
+                                     else int(prefill_chunk_tokens))
+        self.prefix_cache = bool(prefix_cache)
+        if isinstance(system_prompt, str):
+            system_prompt = list(system_prompt.encode("utf-8"))
+        self.system_prompt = [int(t) for t in (system_prompt or ())]
         self.name = name or "data_llm"
 
     # The record must cross the task-spec pickle boundary; GPTConfig is a
@@ -136,9 +149,11 @@ class _LLMWorker:
         # writes flow through the _LLM_GAUGES telemetry path and land as
         # llm_tokens_per_s:<operator> etc. — same series family as an
         # online deployment.
-        self.engine = LLMEngine(params, cfg, num_blocks=proc.num_blocks,
-                                block_size=proc.block_size,
-                                max_batch=proc.max_batch, name=proc.name)
+        self.engine = LLMEngine(
+            params, cfg, num_blocks=proc.num_blocks,
+            block_size=proc.block_size, max_batch=proc.max_batch,
+            prefill_chunk_tokens=proc.prefill_chunk_tokens,
+            prefix_cache=proc.prefix_cache, name=proc.name)
         self.engine.start()
         self.state = INIT
         self.events: list[tuple] = []
@@ -158,10 +173,11 @@ class _LLMWorker:
         per-request pacing."""
         self._event(SUBMIT, n=len(prompts))
         s = self.proc.sampling
+        sys_prefix = self.proc.system_prompt
         reqs = []
         for i, p in enumerate(prompts):
             reqs.append(self.engine.add_request(
-                _encode_prompt(p),
+                sys_prefix + _encode_prompt(p),
                 max_tokens=int(s.get("max_tokens", 16)),
                 temperature=float(s.get("temperature", 0.0)),
                 top_k=int(s.get("top_k", 0)),

@@ -4,7 +4,9 @@ Reference layer map: where the reference runtime fronts external
 inference engines (vLLM et al.), this package is the TPU-native engine
 itself, built from the repo's own layers:
 
-  * llm/kv_cache.py      — paged KV pool (PagedAttention block manager)
+  * llm/kv_cache.py      — paged KV pool (PagedAttention block
+                            manager) + PrefixPool (hash-indexed,
+                            ref-counted prefix cache with COW)
   * ops/pallas/paged_decode.py — decode-attention kernel gathering K/V
                             through block tables (interpret mode on CPU)
   * models/gpt.py        — forward_prefill / forward_decode modes
@@ -22,5 +24,5 @@ from .engine import (  # noqa: F401
     LLMEngine,
     Request,
 )
-from .kv_cache import PagedKVCache  # noqa: F401
+from .kv_cache import PagedKVCache, PrefixPool  # noqa: F401
 from .sampling import sample  # noqa: F401

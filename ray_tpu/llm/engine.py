@@ -26,6 +26,20 @@ re-prefills prompt + generated-so-far. Sampling is keyed by
 (seed, position) only (llm/sampling.py), so a resumed sequence produces
 bit-identical output — admission beyond pool capacity degrades latency,
 never correctness, and never OOMs.
+
+Two admission-path optimizations (both on by default for serving):
+
+  * PREFIX CACHING (prefix_cache=True): the pool is a PrefixPool —
+    released blocks keep their content hash-indexed by token-prefix
+    chain, so an equal prefix (shared system prompt, multi-turn
+    history, or a preempted request resuming) is re-acquired by
+    refcount bump instead of recomputed; divergence on a shared
+    partially-filled tail block is handled copy-on-write.
+  * CHUNKED PREFILL (prefill_chunk_tokens=N): at most N uncached
+    prompt tokens prefill per step, a per-request ``prefilled_upto``
+    cursor carrying across steps, so running decode streams emit a
+    token EVERY step instead of stalling behind a long prompt
+    (Sarathi-style stall-free admission).
 """
 
 from __future__ import annotations
@@ -42,9 +56,10 @@ from typing import Deque, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
-from ..models.gpt import GPTConfig, forward_decode, forward_prefill
+from ..models.gpt import (GPTConfig, forward_decode, forward_prefill,
+                          forward_prefill_chunk)
 from ..util import perfmodel, tracing
-from .kv_cache import PagedKVCache
+from .kv_cache import PagedKVCache, PrefixPool
 from .sampling import sample
 
 # Request states (the event vocabulary).
@@ -67,6 +82,8 @@ class Request:
     state: str = WAITING
     block_table: List[int] = field(default_factory=list)
     context_len: int = 0          # tokens resident in the KV pool
+    prefilled_upto: int = 0       # prompt tokens computed OR cache-hit
+    cached_tokens: int = 0        # prefix-cache hit span at admission
     output: List[int] = field(default_factory=list)
     emitted: int = 0              # tokens already pushed to the consumer
     finish_reason: Optional[str] = None
@@ -98,12 +115,26 @@ class LLMEngine:
 
     def __init__(self, params, cfg: GPTConfig, *, num_blocks: int = 64,
                  block_size: int = 16, max_batch: int = 8,
+                 prefill_chunk_tokens: Optional[int] = None,
+                 prefix_cache: bool = True,
                  mesh=None, rules=None, name: str = "llm"):
         self.cfg = cfg
         self.name = name
         self.max_batch = int(max_batch)
-        self.kv = PagedKVCache(cfg, num_blocks=num_blocks,
-                               block_size=block_size)
+        # prefix_cache -> PrefixPool: freed blocks keep their content
+        # hash-indexed so an equal prompt prefix (shared system prompt,
+        # multi-turn history, preempt/resume) skips prefill for the
+        # cached span. Refcounts + COW keep sharing transparent.
+        pool_cls = PrefixPool if prefix_cache else PagedKVCache
+        self.kv = pool_cls(cfg, num_blocks=num_blocks,
+                           block_size=block_size)
+        self._prefix = prefix_cache
+        # Sarathi-style chunked prefill admission: at most this many
+        # UNCACHED prompt tokens run per step (None = whole prompt at
+        # once), so running decode streams emit a token every step even
+        # while a long prompt prefills.
+        self.prefill_chunk_tokens = (None if prefill_chunk_tokens is None
+                                     else int(prefill_chunk_tokens))
         self.params = params
         # Fixed decode shapes — one compile: batch padded to max_batch,
         # tables padded to the worst-case blocks/sequence.
@@ -116,6 +147,12 @@ class LLMEngine:
         # block multiple, so at most max_seq/block_size variants).
         self._prefill = jax.jit(
             functools.partial(forward_prefill, cfg=cfg, mesh=mesh,
+                              rules=rules))
+        # Incremental prefill over resident context (chunked admission
+        # and partial cache hits); pools are read-only inputs here, the
+        # chunk's K/V is written back via write_prefill afterwards.
+        self._prefill_chunk = jax.jit(
+            functools.partial(forward_prefill_chunk, cfg=cfg, mesh=mesh,
                               rules=rules))
 
         self._lock = threading.Lock()
@@ -135,6 +172,8 @@ class LLMEngine:
         self._thread: Optional[threading.Thread] = None
         self._stop = False
         self._gauges = None
+        self._prefill_chunks = 0      # chunk dispatches (whole=1 chunk)
+        self._kv_util_peak = 0.0      # high-water pool utilization
         # Device-step accounting: every step's dispatch->block_until_ready
         # span is timed apart from the host work around it and priced by
         # the shared cost model (util/perfmodel.py) into MFU / HBM-util /
@@ -201,12 +240,38 @@ class LLMEngine:
         capacity check)."""
         while self._waiting and len(self._active) < self.max_batch:
             req = self._waiting[0]
-            seq_len = len(req.prompt) + len(req.output)
-            grant = self.kv.alloc(self.kv.blocks_for_tokens(seq_len + 1))
-            if grant is None:
-                break
+            seq = req.prompt + req.output
+            if self._prefix:
+                got = self.kv.admit(seq, len(seq) + 1)
+                if got is None:
+                    break
+                grant, cached = got
+                req.block_table = grant
+                req.cached_tokens = cached
+                if cached >= len(seq):
+                    # Full hit: every token is already resident. Hold
+                    # the LAST position back — there is no prefill
+                    # output to sample from, so the next decode step
+                    # recomputes its logits via write-then-attend
+                    # (COW-splitting the shared tail block first).
+                    req.context_len = len(seq) - 1
+                    req.prefilled_upto = len(seq)
+                else:
+                    # Cached spans are whole blocks (the exact-tail key
+                    # only matches a FULL hit), so the chunked prefill
+                    # below resumes block-aligned at `cached`.
+                    req.context_len = cached
+                    req.prefilled_upto = cached
+            else:
+                grant = self.kv.alloc(
+                    self.kv.blocks_for_tokens(len(seq) + 1))
+                if grant is None:
+                    break
+                req.block_table = grant
+                req.cached_tokens = 0
+                req.context_len = 0
+                req.prefilled_upto = 0
             self._waiting.popleft()
-            req.block_table = grant
             self._active.append(req)
             self._event(req, PREFILL)
             if req.preemptions and req.trace_ctx is not None:
@@ -219,18 +284,37 @@ class LLMEngine:
 
     def _activate(self, req: Request, logits_row):
         """Prefill done: sample the first (or first-since-resume) token
-        and enter the decode batch."""
+        and enter the decode batch. ``logits_row=None`` marks a FULL
+        prefix-cache hit — nothing was computed, so there is nothing to
+        sample yet; the same step's decode recomputes the last
+        position's logits and samples there."""
         self._event(req, RUNNING)
-        self._sample_into(req, logits_row)
+        if logits_row is not None:
+            self._sample_into(req, logits_row)
+
+    def _release_blocks(self, req: Request):
+        """Return req's blocks to the pool. With the prefix pool the
+        resident span — pool slot j holds seq[j]'s K/V for
+        j < context_len — is registered first, so a resumed (or
+        identical later) request re-acquires those blocks as cache hits
+        instead of recomputing them."""
+        if self._prefix:
+            seq = (req.prompt + req.output)[:req.context_len]
+            self.kv.release(req.block_table, seq=seq)
+        else:
+            self.kv.free(req.block_table)
 
     def _preempt(self, req: Request):
-        """Evict req from the batch, free its blocks, requeue at the
-        FRONT (resume priority beats fresh admissions — bounds each
-        request's preemption count)."""
+        """Evict req from the batch, release its blocks (registered in
+        the prefix index — resume is then mostly cache hits, not a full
+        recompute), requeue at the FRONT (resume priority beats fresh
+        admissions — bounds each request's preemption count)."""
         self._active.remove(req)
-        self.kv.free(req.block_table)
+        self._release_blocks(req)
         req.block_table = []
         req.context_len = 0
+        req.prefilled_upto = 0
+        req.cached_tokens = 0
         req.preemptions += 1
         self._waiting.appendleft(req)
         self._event(req, PREEMPTED)
@@ -247,7 +331,7 @@ class LLMEngine:
         if req in self._active:
             self._active.remove(req)
         if req.block_table:
-            self.kv.free(req.block_table)
+            self._release_blocks(req)
             req.block_table = []
         req.finish_reason = reason
         req.finish_t = time.time()
@@ -281,59 +365,149 @@ class LLMEngine:
     def _run_prefills(self):
         """Prefill newly admitted requests one sequence at a time
         (prompt lengths are ragged; padding to a block multiple bounds
-        recompiles to max_seq/block_size variants)."""
+        recompiles to max_seq/block_size variants).
+
+        Two refinements over run-the-whole-prompt:
+          * the prefix-cached span was skipped at admission —
+            ``prefilled_upto`` starts there, and a FULL hit computes
+            nothing at all (the decode step samples it);
+          * with ``prefill_chunk_tokens`` set, at most that many
+            uncached tokens run per STEP across all prefilling
+            requests, the cursor carrying over — decode lanes keep
+            emitting a token every step under long-prompt arrivals.
+        """
         prefills = [r for r in self._active if r.state == PREFILL]
         self._last_prefill_count = len(prefills)
+        bs = self.kv.block_size
+        budget = self.prefill_chunk_tokens
         for req in prefills:
             t0 = time.time()
             seq = req.prompt + req.output
             T = len(seq)
-            pad = -T % self.kv.block_size or 0
-            toks = np.zeros((1, T + pad), np.int32)
-            toks[0, :T] = seq
+            if req.prefilled_upto >= T:
+                # Full prefix-cache hit: zero prefill compute.
+                self._activate(req, None)
+                if req.trace_ctx is not None:
+                    tracing.emit("llm.prefill", req.trace_ctx, t0, 0.0,
+                                 {"rid": req.rid, "tokens": T,
+                                  "cached": req.cached_tokens,
+                                  "resumed": bool(req.preemptions),
+                                  "device_ms": 0.0, "host_ms": 0.0})
+                continue
+            if budget is not None and budget <= 0:
+                break       # out of chunk budget; cursor resumes next step
+            upto = req.prefilled_upto
+            rem = T - upto
+            c = rem if budget is None else min(rem, budget)
+            if c < rem:
+                # Mid-prompt chunks stay block-aligned (write_prefill
+                # scatters whole blocks); a budget below one block still
+                # makes one block of progress.
+                c = (c // bs) * bs or min(bs, rem)
+            if budget is not None:
+                budget -= c
+            pad = -c % bs or 0
             t_disp = time.perf_counter()
-            logits, k, v = self._prefill(self.params, toks)
-            # Export the cache: [L, 1, s, Hkv, d] -> [L, T, Hkv, d].
-            self.kv.write_prefill(k[:, 0, :T], v[:, 0, :T],
-                                  req.block_table)
-            req.context_len = T
-            row = np.asarray(jax.device_get(logits[0, T - 1]), np.float32)
+            if upto == 0 and c == T:
+                # Cold whole-prompt prefill: the classic one-shot path.
+                toks = np.zeros((1, T + pad), np.int32)
+                toks[0, :T] = seq
+                logits, k, v = self._prefill(self.params, toks)
+            else:
+                # Incremental span [upto, upto+c) attending resident
+                # context (earlier chunks and/or prefix-cache hits).
+                toks = np.zeros((1, c + pad), np.int32)
+                toks[0, :c] = seq[upto:upto + c]
+                positions = np.minimum(
+                    upto + np.arange(c + pad, dtype=np.int32),
+                    self.cfg.max_seq - 1)
+                table = np.zeros((self.max_nb,), np.int32)
+                table[:len(req.block_table)] = req.block_table
+                logits, k, v = self._prefill_chunk(
+                    self.params, toks, positions, self.kv.k, self.kv.v,
+                    table, np.int32(upto))
+            # Export the chunk's cache: [L, 1, c, Hkv, d] -> pool blocks
+            # upto/bs onward (upto is block-aligned by construction).
+            self.kv.write_prefill(
+                k[:, 0, :c], v[:, 0, :c],
+                req.block_table[upto // bs: upto // bs + (c + pad) // bs])
+            req.prefilled_upto = upto + c
+            req.context_len = req.prefilled_upto
+            self._prefill_chunks += 1
+            done = req.prefilled_upto >= T
+            if done:
+                row = np.asarray(jax.device_get(logits[0, c - 1]),
+                                 np.float32)
+            else:
+                jax.block_until_ready(logits)
             # Dispatch-to-logits-ready is the device span (the pool
             # write may still overlap the host work that follows —
-            # deliberately uncounted, it hides behind sampling).
+            # deliberately uncounted, it hides behind sampling). Only
+            # the UNCACHED span is priced: ctx_tokens covers what was
+            # skipped or ran in earlier chunks, keeping MFU honest.
             device_s = time.perf_counter() - t_disp
             self._step_perf.add_device(
-                device_s, perfmodel.prefill_cost(self.cfg, T + pad))
-            self._activate(req, row)
+                device_s, perfmodel.prefill_cost(self.cfg, c + pad,
+                                                 ctx_tokens=upto))
+            if done:
+                if self._prefix:
+                    # Index the prompt's chunks for later arrivals
+                    # (shared system prompts hit from here on).
+                    self.kv.register(seq, req.block_table)
+                self._activate(req, row)
             if req.trace_ctx is not None:
                 dur = time.time() - t0
                 tracing.emit("llm.prefill", req.trace_ctx, t0, dur,
-                             {"rid": req.rid, "tokens": T,
+                             {"rid": req.rid, "tokens": c,
+                              "upto": req.prefilled_upto, "total": T,
+                              "cached": req.cached_tokens,
+                              "done": done,
                               "resumed": bool(req.preemptions),
                               "device_ms": round(device_s * 1e3, 3),
                               "host_ms": round(
                                   max(dur - device_s, 0.0) * 1e3, 3)})
 
-    def _ensure_decode_slot(self, req: Request) -> bool:
-        """Guarantee req's next token has a pool slot, preempting LIFO
-        victims if the pool is dry. Returns False if req itself was
-        preempted (the last resort when it is the newest — and possibly
-        only — sequence)."""
-        slot = req.context_len
-        if slot // self.kv.block_size < len(req.block_table):
+    def _preempt_for(self, req: Request) -> bool:
+        """Free pool blocks by preempting a LIFO victim; req itself is
+        the last resort (returns False then — req left the batch)."""
+        victims = [r for r in self._active
+                   if r.state == RUNNING and r is not req]
+        if victims:
+            self._preempt(victims[-1])
             return True
+        self._preempt(req)
+        return False
+
+    def _ensure_decode_slot(self, req: Request) -> bool:
+        """Guarantee req's next token has a WRITABLE pool slot,
+        preempting LIFO victims if the pool is dry. With the prefix
+        pool the slot's block must also be private: a block with
+        co-readers, or one whose registered span covers the write
+        offset (the shared partially-filled tail a diverging request
+        hits), is COW-split first — the write never corrupts what other
+        requests or the index can still read. Returns False if req
+        itself was preempted (the last resort when it is the newest —
+        and possibly only — sequence)."""
+        slot = req.context_len
+        bi = slot // self.kv.block_size
         while True:
-            grant = self.kv.alloc(1)
-            if grant is not None:
+            if bi >= len(req.block_table):
+                grant = self.kv.alloc(1)
+                if grant is None:
+                    if not self._preempt_for(req):
+                        return False
+                    continue
                 req.block_table.extend(grant)
-                return True
-            victims = [r for r in self._active
-                       if r.state == RUNNING and r is not req]
-            if victims:
-                self._preempt(victims[-1])
-                continue
-            self._preempt(req)
-            return False
+            if self._prefix:
+                bid = req.block_table[bi]
+                if self.kv.needs_cow(bid, slot % self.kv.block_size):
+                    nb = self.kv.cow(bid)
+                    if nb is None:
+                        if not self._preempt_for(req):
+                            return False
+                        continue
+                    req.block_table[bi] = nb
+            return True
 
     def _run_decode(self):
         batch = [r for r in self._active if r.state == RUNNING]
@@ -359,7 +533,13 @@ class LLMEngine:
         tables = np.zeros((B, self.max_nb), np.int32)
         for i, req in enumerate(batch):
             slot = req.context_len
-            tokens[i] = req.output[-1]
+            # Steady-state lanes feed their last sampled token; a FULL
+            # prefix-cache hit enters decode holding the last sequence
+            # position back (nothing was computed at admission), so its
+            # first step re-feeds that token — write-then-attend then
+            # recomputes its logits for the first sample.
+            tokens[i] = (req.prompt[slot] if slot < len(req.prompt)
+                         else req.output[slot - len(req.prompt)])
             positions[i] = slot
             slot_blocks[i] = req.block_table[slot // bs]
             slot_offsets[i] = slot % bs
@@ -413,8 +593,15 @@ class LLMEngine:
         with self._lock:
             self._step_perf.begin()
             self._admit()
+            # High-water utilization INSIDE the step: post-admission and
+            # post-decode, before finishes drain it — the end-of-run
+            # stats() reading alone always relaxes back to ~0 (every
+            # block freed), which is why SERVE_BENCH read 0.0 for years.
+            util_hw = self.kv.utilization()
             self._run_prefills()
             self._run_decode()
+            self._kv_util_peak = max(self._kv_util_peak, util_hw,
+                                     self.kv.utilization())
             self._steps += 1
             # Finalize the step breakdown (None on a no-work step) into
             # the process-local device-step ring, where the gang
@@ -445,9 +632,16 @@ class LLMEngine:
             "in_flight": len(self._active),
             "finished": self._finished_count,
             "kv_utilization": self.kv.utilization(),
+            "kv_util_peak": self._kv_util_peak,
             "kv_free_blocks": self.kv.num_free,
             "tokens_per_s": self.tokens_per_s(),
+            "prefill_chunks": self._prefill_chunks,
         }
+        if self._prefix:
+            ps = self.kv.prefix_stats()
+            out["kv_cache_hit_rate"] = ps["hit_rate"]
+            out["kv_shared_blocks"] = ps["shared_blocks"]
+            out["prefix"] = ps
         if self._step_perf.last is not None:
             out["last_step"] = dict(self._step_perf.last)
         return out
@@ -485,13 +679,33 @@ class LLMEngine:
                     Gauge("rtpu_llm_hbm_util",
                           "HBM-bandwidth utilization of the last step's "
                           "device span [0,1]", tag_keys=keys),
+                    Gauge("rtpu_llm_kv_hit_rate",
+                          "Prefix-cache hit rate (cached / looked-up "
+                          "tokens) [0,1]", tag_keys=keys),
+                    Gauge("rtpu_llm_kv_shared_blocks",
+                          "KV blocks referenced by >1 sequence",
+                          tag_keys=keys),
+                    Gauge("rtpu_llm_prefill_chunks",
+                          "Cumulative prefill chunk dispatches",
+                          tag_keys=keys),
                 )
             tags = {"deployment": self.name}
             (tps, util, bsz, step_ms, dev_ms, gap_ms, mfu,
-             hbm) = self._gauges
+             hbm, hitr, shared, chunks) = self._gauges
             tps.set(self.tokens_per_s(), tags=tags)
             util.set(self.kv.utilization(), tags=tags)
             bsz.set(float(len(self._active)), tags=tags)
+            if self._active:
+                hitr.set(self.kv.hit_rate() if self._prefix else 0.0,
+                         tags=tags)
+                shared.set(float(self.kv.shared_blocks())
+                           if self._prefix else 0.0, tags=tags)
+                chunks.set(float(self._prefill_chunks), tags=tags)
+            else:
+                # Idle decay, like the step-breakdown series below.
+                hitr.set(0.0, tags=tags)
+                shared.set(0.0, tags=tags)
+                chunks.set(0.0, tags=tags)
             perf = self._step_perf.last if self._active else None
             if perf is None:
                 # Idle (or no-work step): the breakdown series decay to

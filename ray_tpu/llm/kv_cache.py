@@ -26,9 +26,11 @@ XLA aliases the buffers so steady-state decode does not copy the pool.
 
 from __future__ import annotations
 
+import collections
 import functools
 import math
-from typing import List, Optional
+import time
+from typing import Deque, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +44,14 @@ def _scatter_blocks(k_pool, v_pool, k_blocks, v_blocks, ids):
     [L, Hkv, nb, BS, d], ids [nb] int32."""
     return (k_pool.at[:, :, ids].set(k_blocks),
             v_pool.at[:, :, ids].set(v_blocks))
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _copy_block(k_pool, v_pool, src, dst):
+    """Copy-on-write split: duplicate one block's K/V (src/dst are
+    traced scalars, so every split shares one compile)."""
+    return (k_pool.at[:, :, dst].set(k_pool[:, :, src]),
+            v_pool.at[:, :, dst].set(v_pool[:, :, src]))
 
 
 class PagedKVCache:
@@ -92,9 +102,15 @@ class PagedKVCache:
         return grant
 
     def free(self, blocks: List[int]):
+        seen = set(self._free)
         for b in blocks:
             if b == 0:
                 raise ValueError("block 0 is reserved, never allocated")
+            if b in seen:
+                # A duplicate on the list-based free stack would let the
+                # allocator hand the same block to two sequences.
+                raise ValueError(f"double free of KV block {b}")
+            seen.add(b)
         self._free.extend(blocks)
 
     # -- writes ------------------------------------------------------------
@@ -130,3 +146,288 @@ class PagedKVCache:
         k = k.transpose(0, 2, 3, 1, 4).reshape(L, nb * bs, hkv, d)
         v = v.transpose(0, 2, 3, 1, 4).reshape(L, nb * bs, hkv, d)
         return k[:, :length], v[:, :length]
+
+
+class PrefixPool(PagedKVCache):
+    """Ref-counted, hash-indexed prefix cache over the paged pool
+    (vLLM-style automatic prefix caching, Kwon et al. SOSP '23).
+
+    A sequence's tokens are split into block-sized chunks; each chunk
+    is keyed by ``hash((parent_key, chunk_tokens))`` so equal prefixes
+    of different requests chain to the SAME keys. The index maps a key
+    to the pool block already holding that chunk's K/V:
+
+      * ``admit()`` walks the chain, bumps the refcount of every hit
+        block (prefill for that span is skipped entirely) and allocates
+        fresh blocks for the remainder — all-or-nothing like ``alloc``;
+      * ``release()`` registers the sequence's now-computed chunks and
+        decrements refs; refcount-0 blocks with index keys park on an
+        LRU list (still matchable — a hot system prompt survives
+        across requests) instead of the free list;
+      * allocation pressure evicts LRU parked blocks (dropping their
+        keys) — referenced blocks are never evicted;
+      * a shared block about to be written in a registered span (the
+        partially-filled tail a new request diverges from, or a block
+        with live co-readers) is split copy-on-write via ``cow()``.
+
+    Index entries store the full (parent_key, chunk_tokens) and are
+    verified on lookup, so hash collisions degrade to misses, never to
+    wrong-content hits. The partial prompt tail is registered with its
+    exact remainder as the chunk, so a tail hit is always the WHOLE
+    remaining prompt (an unfinished-block hit mid-prompt would force a
+    mid-block prefill start).
+
+    Every state change (share, COW split, evict, register) emits into
+    ``events`` — the I408 lint row holds these sites to it.
+    """
+
+    def __init__(self, cfg: GPTConfig, num_blocks: int = 64,
+                 block_size: int = 16, dtype=None):
+        super().__init__(cfg, num_blocks=num_blocks,
+                         block_size=block_size, dtype=dtype)
+        self._ref: Dict[int, int] = {}        # bid -> live references
+        self._keys_of: Dict[int, List[int]] = {}  # bid -> index keys
+        # key -> (parent_key, chunk_tokens, bid, span)
+        self._index: Dict[int, Tuple] = {}
+        # ref-0 registered blocks, eviction order (oldest first).
+        self._lru: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()
+        # Memoized chain walks (verified against the stored tuple, so
+        # hash collisions cannot alias). _match_cache: seq-hash ->
+        # (seqt, bids, covered); _reg_cache: seq-hash -> seqt for
+        # sequences whose FULL chain is known indexed. Both are
+        # invalidated whenever an eviction drops index keys; the match
+        # cache additionally whenever registration adds them.
+        self._match_cache: Dict[int, Tuple] = {}
+        self._reg_cache: Dict[int, Tuple] = {}
+        self.events: Deque[tuple] = collections.deque(maxlen=4096)
+        self.hit_tokens = 0
+        self.lookup_tokens = 0
+        self.evictions = 0
+        self.cow_splits = 0
+        self.registrations = 0
+
+    def _event(self, kind: str, **attrs) -> None:
+        self.events.append((time.time(), kind, attrs))
+
+    # -- allocator overrides ----------------------------------------------
+
+    @property
+    def num_free(self) -> int:
+        """Allocatable blocks: truly free + parked (evictable) cached
+        blocks. Keeps the engine invariant 'everything returned after
+        drain' meaningful while hot prefixes stay resident."""
+        return len(self._free) + len(self._lru)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n blocks (refcount 1 each), evicting LRU parked blocks as
+        needed; None if free + evictable cannot cover them."""
+        free = self._free
+        if n == 1 and free:             # decode/COW fast path
+            b = free.pop()
+            self._ref[b] = 1
+            return [b]
+        if n > len(free) + len(self._lru):
+            return None
+        while len(free) < n:
+            self._evict_one()
+        grant = super().alloc(n)
+        for b in grant:
+            self._ref[b] = 1
+        return grant
+
+    def _evict_one(self) -> None:
+        bid, _ = self._lru.popitem(last=False)
+        for key in self._keys_of.pop(bid, ()):
+            e = self._index.get(key)
+            if e is not None and e[2] == bid:
+                del self._index[key]
+        self._free.append(bid)
+        self._match_cache.clear()       # cached chains may now be broken
+        self._reg_cache.clear()
+        self.evictions += 1
+        self._event("evict", block=bid)
+
+    def free(self, blocks: List[int]):
+        """Alias of release(): engine teardown paths call free() on
+        either pool flavor."""
+        self.release(blocks)
+
+    # -- prefix index ------------------------------------------------------
+
+    def _match(self, seq: List[int]) -> Tuple[List[int], int]:
+        """Longest cached chain for ``seq``: (block ids, tokens
+        covered). Full block-sized chunks must match contiguously; the
+        ragged tail only matches as the exact whole remainder."""
+        bs = self.block_size
+        index = self._index
+        seqt = tuple(seq)             # one tuple; slices below are cheap
+        sh = hash(seqt)
+        hit = self._match_cache.get(sh)
+        if hit is not None and hit[0] == seqt:
+            return list(hit[1]), hit[2]
+        parent = 0
+        bids: List[int] = []
+        covered = 0
+        nfull = len(seqt) // bs
+        for _ in range(nfull):
+            chunk = seqt[covered:covered + bs]
+            key = hash((parent, chunk))
+            e = index.get(key)
+            if e is None or e[0] != parent or e[1] != chunk \
+                    or e[3] != bs:
+                break
+            bids.append(e[2])
+            covered += bs
+            parent = key
+        else:
+            rem = seqt[covered:]
+            if rem:
+                key = hash((parent, rem))
+                e = index.get(key)
+                if e is not None and e[0] == parent and e[1] == rem \
+                        and e[3] == len(rem):
+                    bids.append(e[2])
+                    covered += len(rem)
+        if len(self._match_cache) > 256:
+            self._match_cache.clear()
+        self._match_cache[sh] = (seqt, tuple(bids), covered)
+        return bids, covered
+
+    def admit(self, seq: List[int],
+              need_tokens: int) -> Optional[Tuple[List[int], int]]:
+        """Build a block table for a sequence: cached-chain blocks are
+        acquired (ref++), the remainder freshly allocated. Returns
+        (block_table, cached_tokens) or None if the pool cannot cover
+        the fresh remainder (nothing acquired in that case)."""
+        bids, cached = self._match(seq)
+        self.lookup_tokens += len(seq)
+        ref, lru = self._ref, self._lru
+        for b in bids:
+            r = ref.get(b, 0)
+            if r == 0:
+                lru.pop(b, None)
+            ref[b] = r + 1
+        fresh_n = self.blocks_for_tokens(need_tokens) - len(bids)
+        grant = self.alloc(fresh_n) if fresh_n else []
+        if grant is None:
+            self._unref(bids)
+            return None
+        self.hit_tokens += cached
+        if bids:
+            self._event("share", blocks=len(bids), tokens=cached)
+        return bids + grant, cached
+
+    def register(self, seq: List[int], table: List[int]) -> None:
+        """Index a sequence's computed chunks so later requests can
+        reuse them. First writer wins per key; blocks already indexed
+        for this chain are left as-is."""
+        bs = self.block_size
+        index = self._index
+        seqt = tuple(seq)
+        sh = hash(seqt)
+        if self._reg_cache.get(sh) == seqt:
+            return                    # full chain known indexed already
+        parent = 0
+        newly = 0
+        nfull = len(seqt) // bs
+        complete = True
+        for i in range(nfull + 1):
+            if i >= len(table):
+                complete = False      # table shorter than the chain
+                break
+            if i < nfull:
+                chunk = seqt[i * bs:(i + 1) * bs]
+            else:
+                chunk = seqt[nfull * bs:]
+                if not chunk:
+                    break
+            key = hash((parent, chunk))
+            if key not in index:
+                index[key] = (parent, chunk, table[i], len(chunk))
+                self._keys_of.setdefault(table[i], []).append(key)
+                newly += 1
+            parent = key
+        if newly:
+            self.registrations += newly
+            self._match_cache.clear()  # longer chains may now match
+            self._event("register", blocks=newly, tokens=len(seqt))
+        if complete:
+            if len(self._reg_cache) > 256:
+                self._reg_cache.clear()
+            self._reg_cache[sh] = seqt
+
+    def release(self, blocks: List[int],
+                seq: Optional[List[int]] = None) -> None:
+        """Drop one reference per block. ``seq`` (the tokens actually
+        resident — prompt + generated, truncated to context_len)
+        registers the now-computed chunks first, so multi-turn
+        continuations and re-admissions hit them."""
+        if seq:
+            self.register(seq, blocks)
+        self._unref(blocks)
+
+    def _unref(self, blocks: List[int]) -> None:
+        ref, keys_of = self._ref, self._keys_of
+        lru, free = self._lru, self._free
+        for b in blocks:
+            r = ref.get(b, 0)
+            if r <= 0:
+                raise ValueError(f"double free of KV block {b}")
+            ref[b] = r - 1
+            if r == 1:
+                if keys_of.get(b):
+                    lru[b] = None           # parked, matchable, evictable
+                else:
+                    free.append(b)
+
+    # -- copy-on-write -----------------------------------------------------
+
+    def needs_cow(self, bid: int, offset: int) -> bool:
+        """Must a write at ``offset`` of ``bid`` go to a private copy?
+        Yes if the block has co-readers, or the write falls inside a
+        registered span (index entries are immutable content — a
+        later matcher must find exactly what was registered)."""
+        if self._ref.get(bid, 0) > 1:
+            return True
+        spans = [self._index[k][3] for k in self._keys_of.get(bid, ())
+                 if k in self._index]
+        return bool(spans) and offset < max(spans)
+
+    def cow(self, bid: int) -> Optional[int]:
+        """Split: allocate a private copy of ``bid`` (device block
+        copy), drop the caller's ref on the original. Returns the new
+        block id, or None if the pool can't grant one (caller preempts
+        and retries)."""
+        grant = self.alloc(1)
+        if grant is None:
+            return None
+        dst = grant[0]
+        self.k, self.v = _copy_block(
+            self.k, self.v, jnp.asarray(bid, jnp.int32),
+            jnp.asarray(dst, jnp.int32))
+        self.cow_splits += 1
+        self._event("cow", src=bid, dst=dst,
+                    refs=self._ref.get(bid, 0))
+        self._unref([bid])
+        return dst
+
+    # -- introspection -----------------------------------------------------
+
+    def hit_rate(self) -> float:
+        return self.hit_tokens / max(1, self.lookup_tokens)
+
+    def shared_blocks(self) -> int:
+        return sum(1 for r in self._ref.values() if r > 1)
+
+    def prefix_stats(self) -> dict:
+        return {
+            "hit_tokens": self.hit_tokens,
+            "lookup_tokens": self.lookup_tokens,
+            "hit_rate": self.hit_rate(),
+            "evictions": self.evictions,
+            "cow_splits": self.cow_splits,
+            "registrations": self.registrations,
+            "shared_blocks": self.shared_blocks(),
+            "cached_blocks": len(self._lru),
+        }

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.attention import causal_attention
+from ..ops.attention import NEG_INF, causal_attention
 from ..parallel.sharding import DEFAULT_RULES, logical_to_mesh_axes
 
 
@@ -382,6 +382,99 @@ def forward_decode(params, tokens, positions, k_pool, v_pool,
     x = _layernorm(x, params["ln_f"])
     logits = jnp.einsum("bm,vm->bv", x, params["wte"].astype(dt))
     return logits, k_pool, v_pool
+
+
+def _chunk_attention(q, k_tok, v_tok, k_ctx, v_ctx, ctx_len):
+    """Attention for one prefill chunk over [pool context ++ chunk].
+
+    q / k_tok / v_tok: [b, c, heads(kv), d] — this chunk's projections.
+    k_ctx / v_ctx: [b, S, kv_heads, d] — the sequence's pool slots
+    gathered from its block table (S = table_len * block_size; only the
+    first ctx_len hold real tokens). The key axis is the concatenation
+    [S pool slots ++ c chunk slots]; query i sits at absolute position
+    ctx_len + i, so the mask admits pool slots < ctx_len (all strictly
+    before any query) and chunk slots j <= i (causal within the chunk).
+    Padded chunk tails are keyed AFTER every real query index and thus
+    never attended. Same f32-softmax / NEG_INF discipline as
+    ops.attention.causal_attention.
+    """
+    b, c, hq, d = q.shape
+    S = k_ctx.shape[1]
+    k = jnp.concatenate([k_ctx.astype(q.dtype), k_tok], axis=1)
+    v = jnp.concatenate([v_ctx.astype(q.dtype), v_tok], axis=1)
+    hk = k.shape[2]
+    if hq != hk:
+        rep = hq // hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * (d ** -0.5)
+    qi = jnp.arange(c)[:, None]
+    kp = jnp.arange(S + c)[None, :]
+    mask = jnp.where(kp < S, kp < ctx_len, (kp - S) <= qi)
+    logits = jnp.where(mask[None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def forward_prefill_chunk(params, tokens, positions, k_pool, v_pool,
+                          block_table, ctx_len, cfg: GPTConfig,
+                          mesh: Optional[Mesh] = None,
+                          rules: Optional[dict] = None):
+    """One chunk of an incremental prefill.
+
+    Sarathi-style chunked admission and prefix-cache hits both land
+    here: run ``tokens`` [1, c] whose context — earlier prompt chunks,
+    possibly computed by ANOTHER request and shared through the prefix
+    pool — already sits in the paged pool under ``block_table``.
+
+    Args:
+      positions: [c] int32 absolute positions (ctx_len + arange(c),
+        clipped to max_seq - 1 on the padded tail).
+      block_table: [max_nb] int32, 0-padded like decode's tables.
+      ctx_len: scalar int32 — tokens already resident in the pool.
+
+    The pools are READ-ONLY here (no donation): the chunk's K/V comes
+    back like forward_prefill's and the caller writes it into the pool
+    afterwards — shared blocks must be COW-split before that write.
+
+    Returns (logits [1, c, vocab], k [L, 1, c, kv_heads, head_dim],
+    v like k).
+    """
+    rules = {**DEFAULT_RULES, **ACT_RULES, **(rules or {})}
+    dt = cfg.dtype
+    hkv, hd = cfg.kv_heads, cfg.head_dim
+    b, c = tokens.shape
+    wte = params["wte"].astype(dt)
+    x = wte[tokens] + params["wpe"].astype(dt)[positions]
+    x = _constrain(x, ("batch", "seq", "embed_act"), mesh, rules)
+
+    def scan_body(x, layer):
+        p, kp, vp = layer
+        h = _layernorm(x, p["ln1"])
+        q = jnp.einsum("bsm,mhd->bshd", h, p["wq"].astype(dt))
+        k_tok = jnp.einsum("bsm,mhd->bshd", h, p["wk"].astype(dt))
+        v_tok = jnp.einsum("bsm,mhd->bshd", h, p["wv"].astype(dt))
+        # This sequence's pool context: [hkv, max_nb, BS, d] gathered by
+        # table, flattened to slot order [1, S, hkv, d].
+        k_ctx = kp[:, block_table]
+        v_ctx = vp[:, block_table]
+        nb, bs = k_ctx.shape[1], k_ctx.shape[2]
+        k_ctx = k_ctx.transpose(1, 2, 0, 3).reshape(1, nb * bs, hkv, hd)
+        v_ctx = v_ctx.transpose(1, 2, 0, 3).reshape(1, nb * bs, hkv, hd)
+        o = _chunk_attention(q, k_tok, v_tok, k_ctx, v_ctx, ctx_len)
+        o = jnp.einsum("bshd,hdm->bsm", o, p["wo"].astype(dt))
+        x = x + o
+        h2 = _layernorm(x, p["ln2"])
+        ff = jax.nn.gelu(jnp.einsum("bsm,mf->bsf", h2, p["wi"].astype(dt)))
+        x = x + jnp.einsum("bsf,fm->bsm", ff, p["wm"].astype(dt))
+        return x, (k_tok, v_tok)
+
+    x, (k, v) = jax.lax.scan(scan_body, x,
+                             (params["blocks"], k_pool, v_pool))
+    x = _layernorm(x, params["ln_f"])
+    logits = jnp.einsum("bsm,vm->bsv", x, params["wte"].astype(dt))
+    return logits, k, v
 
 
 @jax.custom_vjp

@@ -367,13 +367,16 @@ def _print_top(rt):
     # Device-step performance plane: where did my step go, live.
     perf_rows = sorted((m, by_node) for m, by_node in latest.items()
                        if m.startswith(("llm_mfu:", "llm_host_gap_ms:",
+                                        "kv_cache_hit_rate:",
+                                        "kv_shared_blocks:",
                                         "train_mfu:",
                                         "train_host_gap_ms:")))
     if perf_rows:
         print("perf:")
         for metric, by_node in perf_rows:
             val = max(by_node.values())
-            if metric.startswith(("llm_mfu:", "train_mfu:")):
+            if metric.startswith(("llm_mfu:", "train_mfu:",
+                                  "kv_cache_hit_rate:")):
                 print(f"  {metric:<44} {val:10.2%}")
             else:
                 print(f"  {metric:<44} {val:10.2f}")

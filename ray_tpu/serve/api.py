@@ -31,8 +31,14 @@ def _get_controller(create: bool = True):
     if _controller is None:
         if ray_tpu.is_initialized():
             try:
-                _controller = ray_tpu.get_actor(CONTROLLER_NAME)
-            except Exception:  # lint: allow-swallow(controller not registered yet)
+                cand = ray_tpu.get_actor(CONTROLLER_NAME)
+                # The name can momentarily resolve to a controller a
+                # concurrent shutdown() just killed (unregistration is
+                # async) — validate before adopting, else every later
+                # serve call inherits a dead handle.
+                ray_tpu.get(cand.ping.remote(), timeout=10)
+                _controller = cand
+            except Exception:  # lint: allow-swallow(controller not registered yet, or dead and awaiting unregistration)
                 _controller = None
     if _controller is None and create:
         if not ray_tpu.is_initialized():
@@ -244,5 +250,16 @@ def shutdown():
             ray_tpu.kill(controller, no_restart=True)
         except Exception:  # lint: allow-swallow(best-effort shutdown)
             pass
+        # Name unregistration is async on the node service: wait for
+        # the directory entry to drop so an immediate serve.run() in
+        # this process creates a FRESH controller instead of racing
+        # into the dead one's name.
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            try:
+                ray_tpu.get_actor(CONTROLLER_NAME)
+            except Exception:  # lint: allow-swallow(name dropped — the goal)
+                break
+            time.sleep(0.05)
     _controller = None
     _clear_routers()

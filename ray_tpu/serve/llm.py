@@ -51,7 +51,10 @@ class _LLMServer:
 
     def __init__(self, cfg=None, params=None, *, seed: int = 0,
                  num_blocks: int = 64, block_size: int = 16,
-                 max_batch: int = 8, default_max_tokens: int = 32):
+                 max_batch: int = 8, default_max_tokens: int = 32,
+                 prefill_chunk_tokens: Optional[int] = 32,
+                 prefix_cache: bool = True,
+                 system_prompt=None):
         import jax
 
         from ..llm.engine import LLMEngine
@@ -64,9 +67,20 @@ class _LLMServer:
         # constructing us — tag the engine's gauges with it.
         name = slo.current_deployment() or "llm"
         self.default_max_tokens = int(default_max_tokens)
+        # Deployment-wide prefix hint: prepended to every prompt, so
+        # with the prefix cache on it is computed once and every later
+        # request's cached span covers it (the shared-system-prompt
+        # serving pattern).
+        if isinstance(system_prompt, str):
+            system_prompt = encode(system_prompt)
+        self.system_prompt = [int(t) for t in (system_prompt or ())]
+        # Serving defaults to chunked prefill (bounded per-step prefill
+        # keeps decode streams emitting every step) and prefix caching.
         self.engine = LLMEngine(params, cfg, num_blocks=num_blocks,
                                 block_size=block_size,
-                                max_batch=max_batch, name=name)
+                                max_batch=max_batch,
+                                prefill_chunk_tokens=prefill_chunk_tokens,
+                                prefix_cache=prefix_cache, name=name)
         self.engine.start()
 
     def __call__(self, request: Any):
@@ -81,6 +95,8 @@ class _LLMServer:
             prompt = encode(prompt)
         if not prompt:
             raise ValueError("request needs a non-empty 'prompt'")
+        if self.system_prompt:
+            prompt = self.system_prompt + list(prompt)
         # Register with the engine NOW: the request joins the in-flight
         # batch at the next step even though the generator body below
         # only runs when the stream is first pulled. The replica span's
@@ -119,6 +135,7 @@ class _LLMServer:
                    "finish_reason": req.finish_reason,
                    "num_tokens": len(req.output),
                    "preemptions": req.preemptions,
+                   "cached_tokens": req.cached_tokens,
                    "text": decode(req.output)}
 
         return gen()

@@ -199,16 +199,24 @@ def decode_step_cost(cfg, context_lens: Sequence[int], *,
     return StepCost(flops, hbm, n)
 
 
-def prefill_cost(cfg, n_tokens: int, *, kv_dtype_bytes: int = 2,
+def prefill_cost(cfg, n_tokens: int, *, ctx_tokens: int = 0,
+                 kv_dtype_bytes: int = 2,
                  param_bytes: int = 4) -> StepCost:
-    """Prefill of a T-token prompt (causal attention: position i
-    attends i+1 keys, so the quadratic term is T*(T+1)/2 contexts)."""
+    """Prefill of a T-token span whose first ``ctx_tokens`` of context
+    already sit in the KV pool (prefix-cache hit or an earlier chunk of
+    a chunked prefill — those spans are NOT priced here, so MFU stays
+    honest when cached work is skipped).
+
+    Causal attention: span position i attends ctx + i + 1 keys, so the
+    attention term is ctx*T + T*(T+1)/2 contexts. HBM adds one read of
+    the resident context's KV on top of the span's own write+read."""
     s = _shape(cfg)
     T = int(n_tokens)
+    ctx = int(ctx_tokens)
     flops = (2.0 * s["matmul_weights"] * T
-             + s["attn_per_ctx"] * T * (T + 1) / 2.0)
+             + s["attn_per_ctx"] * (ctx * T + T * (T + 1) / 2.0))
     kvb = s["kv_bytes_per_token"] * kv_dtype_bytes
-    hbm = s["num_params"] * param_bytes + 2.0 * T * kvb
+    hbm = s["num_params"] * param_bytes + (2.0 * T + ctx) * kvb
     return StepCost(flops, hbm, T)
 
 

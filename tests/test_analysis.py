@@ -550,6 +550,29 @@ def test_i407_catches_a_silent_batch_or_spill_site(tmp_path):
     assert all(f.severity == "P0" for f in rep.findings)
 
 
+def test_i408_catches_a_silent_prefix_pool_transition(tmp_path):
+    # Mirrors the real row: every prefix-pool state change (share,
+    # COW split, evict) must flow through _event or the hit-rate
+    # series diverge from what the allocator actually did.
+    tables = (("pool.py", "_event", ("admit", "cow", "_evict_one"),
+               "why"),)
+    rep = lint(tmp_path, {"pool.py": """\
+        class P:
+            def admit(self, seq, need):
+                self._event("share", tokens=8)
+                return [], 8
+
+            def cow(self, bid):
+                return bid + 1
+
+            def _evict_one(self):
+                self._event("evict", block=3)
+        """}, select="I408", config={"I408_tables": tables})
+    missing = sorted((f.path, f.symbol) for f in rep.findings)
+    assert missing == [("pool.py", "cow")]
+    assert all(f.severity == "P0" for f in rep.findings)
+
+
 # ---------------------------------------------------------------------------
 # Suppression surfaces
 # ---------------------------------------------------------------------------

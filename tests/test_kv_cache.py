@@ -70,6 +70,21 @@ def test_writes_to_disjoint_grants_do_not_interfere():
     np.testing.assert_allclose(np.asarray(k2b), np.asarray(k2), atol=1e-6)
 
 
+def test_double_free_raises_and_pool_stays_usable():
+    kv = PagedKVCache(CFG, num_blocks=8, block_size=4)
+    g = kv.alloc(2)
+    kv.free(g)
+    with pytest.raises(ValueError, match="double free"):
+        kv.free([g[0]])
+    with pytest.raises(ValueError, match="double free"):
+        kv.free(g)
+    # The failed frees did not corrupt the free list.
+    assert kv.num_free == kv.capacity
+    g2 = kv.alloc(kv.capacity)
+    assert g2 is not None
+    kv.free(g2)
+
+
 def test_write_prefill_rejects_overflow():
     kv = PagedKVCache(CFG, num_blocks=8, block_size=4)
     grant = kv.alloc(1)

@@ -168,6 +168,95 @@ def test_greedy_generation_is_reproducible():
 
 
 # ---------------------------------------------------------------------------
+# Prefix cache + chunked prefill (llm/kv_cache.py PrefixPool wiring)
+# ---------------------------------------------------------------------------
+PREFIX = [7] * 20 + [1, 2, 3]
+
+
+def test_prefix_cache_hit_is_token_identical_to_cold():
+    """A cache-hit request (roomy pool, warm prefix chain) must emit
+    EXACTLY the tokens a cold-cache run emits — the full-hit path holds
+    back the last position and recomputes its logits in decode, so the
+    sampled stream cannot drift."""
+    cold = LLMEngine(PARAMS, CFG, num_blocks=64, block_size=8,
+                     prefix_cache=False)
+    c = cold.add_request(list(PREFIX), max_tokens=6, seed=3,
+                         temperature=0.8)
+    _drain(cold)
+
+    eng = LLMEngine(PARAMS, CFG, num_blocks=64, block_size=8)
+    a = eng.add_request(list(PREFIX), max_tokens=6, seed=3,
+                        temperature=0.8)
+    _drain(eng)
+    b = eng.add_request(list(PREFIX), max_tokens=6, seed=3,
+                        temperature=0.8)
+    _drain(eng)
+    assert a.output == c.output            # cold fill through PrefixPool
+    assert b.output == c.output            # full hit, zero prefill
+    assert a.cached_tokens == 0
+    assert b.cached_tokens == len(PREFIX)
+    s = eng.stats()
+    assert s["kv_cache_hit_rate"] >= 0.5
+    assert s["prefix"]["cow_splits"] >= 1  # full-hit decode COWs the tail
+    assert eng.kv.num_free == eng.kv.capacity
+
+
+def test_divergent_tail_partial_hit_matches_cold_output():
+    tail_req = dict(prompt=PREFIX[:16] + [40, 41, 42], max_tokens=6,
+                    seed=9, temperature=0.7)
+    cold = LLMEngine(PARAMS, CFG, num_blocks=64, block_size=8,
+                     prefix_cache=False)
+    c = cold.add_request(**tail_req)
+    _drain(cold)
+
+    eng = LLMEngine(PARAMS, CFG, num_blocks=64, block_size=8)
+    eng.add_request(list(PREFIX), max_tokens=4)
+    _drain(eng)
+    h = eng.add_request(**tail_req)        # shares the 16-token prefix
+    _drain(eng)
+    assert h.cached_tokens == 16
+    assert h.output == c.output
+
+
+def test_chunked_prefill_interleaves_decode_every_step():
+    """With prefill_chunk_tokens set, a long prompt admits in chunks and
+    a live decode stream keeps emitting one token EVERY step while the
+    newcomer prefills — and the chunked output matches whole-prefill."""
+    long_prompt = list(range(1, 41))
+    ref = LLMEngine(PARAMS, CFG, num_blocks=64, block_size=8,
+                    prefix_cache=False)
+    r = ref.add_request(list(long_prompt), max_tokens=6)
+    _drain(ref)
+
+    eng = LLMEngine(PARAMS, CFG, num_blocks=64, block_size=8,
+                    prefill_chunk_tokens=8, prefix_cache=False)
+    s = eng.add_request([5, 6, 7], max_tokens=16, seed=1, temperature=0.6)
+    eng.step()                             # s prefilled, now decoding
+    h = eng.add_request(list(long_prompt), max_tokens=6)
+    deltas = []
+    for _ in range(100):
+        if h.finish_reason and s.finish_reason:
+            break
+        before = len(s.output)
+        eng.step()
+        if s.finish_reason is None or len(s.output) != before:
+            deltas.append(len(s.output) - before)
+    # 40 tokens / 8-token chunks = 5 prefill steps; s streamed through
+    # every one of them instead of stalling behind the prefill.
+    assert eng.stats()["prefill_chunks"] >= 5
+    assert all(d == 1 for d in deltas[:5])
+    assert h.finish_reason == "length"
+    assert h.output == r.output
+
+
+def test_kv_util_peak_samples_high_water_inside_step():
+    eng, hs = _run_once(64, REQS)
+    s = eng.stats()
+    assert s["kv_utilization"] == 0.0      # everything released/parked
+    assert 0.0 < s["kv_util_peak"] <= 1.0  # but the peak was observed
+
+
+# ---------------------------------------------------------------------------
 # Device-step performance plane (util/perfmodel.py accounting)
 # ---------------------------------------------------------------------------
 def test_step_breakdown_in_stats_spans_and_ring():

@@ -322,6 +322,46 @@ def test_locality_and_spill_bookkeeping_gate():
         f"per block > budget {budget * 1e6:.2f}us (calibration {cal:.2f})")
 
 
+def test_prefix_pool_bookkeeping_gate():
+    """The prefix-cache bookkeeping runs at EVERY admission, under the
+    engine lock: a full-hit admit (per-chunk chain hashing + index
+    verify + ref bumps + LRU pops) plus the matching release
+    (re-register walk + unref parks) must stay under 10us per admitted
+    request at calibration 1.0 (~2-4us observed solo for a 64-token
+    prompt). A regression — the index growing a per-lookup content
+    scan, or LRU parking degenerating to list removal — taxes every
+    admitted request, so it fails loudly here."""
+    pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from ray_tpu.llm.kv_cache import PrefixPool
+    from ray_tpu.models.gpt import GPTConfig
+
+    cal = _calibrate()
+    cfg = GPTConfig(vocab_size=64, max_seq=256, d_model=32, n_layer=2,
+                    n_head=4, dtype=jnp.float32)
+    pool = PrefixPool(cfg, num_blocks=32, block_size=16)
+    seq = list(range(64))                  # 4 full chunks
+    warm, _ = pool.admit(seq, len(seq) + 1)
+    pool.release(warm, seq=seq)            # chain registered + parked
+    n = 2000
+    cached = 0
+    per_pass = []
+    for _ in range(3):                     # min-of-3: GC/scheduler
+        t0 = time.perf_counter()           # spikes don't fail the gate
+        for _ in range(n):
+            table, cached = pool.admit(seq, len(seq) + 1)
+            pool.release(table, seq=seq)
+        per_pass.append((time.perf_counter() - t0) / n)
+    per_req = min(per_pass)
+    assert cached == len(seq), "gate must exercise the full-hit path"
+    budget = 10e-6 / cal
+    assert per_req < budget, (
+        f"prefix-pool bookkeeping regressed: {per_req * 1e6:.2f}us "
+        f"per admitted request > budget {budget * 1e6:.2f}us "
+        f"(calibration {cal:.2f})")
+
+
 def test_solo_cross_node_fetch_gate():
     cal = _calibrate()
     os.environ["RT_MB_FETCH_MB"] = "16"
