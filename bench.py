@@ -656,7 +656,8 @@ def run_serve_llm():
     import ray_tpu
     from ray_tpu.scripts.serve_bench import (run_serve_llm as _bench,
                                              run_serve_llm_mixed,
-                                             run_serve_llm_prefix)
+                                             run_serve_llm_prefix,
+                                             run_serve_llm_spec)
 
     duration = float(os.environ.get("RT_SERVE_BENCH_S", "6"))
     clients = int(os.environ.get("RT_SERVE_BENCH_CLIENTS", "6"))
@@ -671,6 +672,10 @@ def run_serve_llm():
         prefix_row["ts"] = ts
         mixed_row = run_serve_llm_mixed(duration_s=duration)
         mixed_row["ts"] = ts
+        # Speculative decoding A/B/C (off vs n-gram vs small-draft) on
+        # the decode-bound repetitive workload speculation targets.
+        spec_row = run_serve_llm_spec()
+        spec_row["ts"] = ts
     finally:
         ray_tpu.shutdown()
     out = os.environ.get("RT_SERVE_BENCH_OUT", "SERVE_BENCH.json")
@@ -681,6 +686,7 @@ def run_serve_llm():
     doc["llm"] = row
     doc["llm_prefix"] = prefix_row
     doc["llm_mixed"] = mixed_row
+    doc["llm_spec"] = spec_row
     with open(out, "w") as f:
         json.dump(doc, f, indent=2)
         f.write("\n")

@@ -304,6 +304,11 @@ class TelemetrySampler:
         "rtpu_llm_kv_hit_rate": ("kv_cache_hit_rate", "max"),
         "rtpu_llm_kv_shared_blocks": ("kv_shared_blocks", "sum"),
         "rtpu_llm_prefill_chunks": ("prefill_chunks", "sum"),
+        # Speculative-decode plane (llm/spec.py SpecDecoder): both are
+        # cumulative per-engine ratios, so the hottest source wins.
+        "rtpu_llm_spec_accept_rate": ("llm_spec_accept_rate", "max"),
+        "rtpu_llm_spec_tokens_per_step":
+            ("llm_spec_tokens_per_step", "max"),
         # Train-session equivalents (train/session.py wrap_step+report).
         "rtpu_train_step_ms": ("train_step_ms", "max"),
         "rtpu_train_device_ms": ("train_device_ms", "max"),

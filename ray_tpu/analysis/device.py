@@ -30,6 +30,7 @@ from .core import Checker, Context, Finding, Module, register
 HOT_MODULES = (
     "ray_tpu/llm/engine.py",
     "ray_tpu/llm/kv_cache.py",
+    "ray_tpu/llm/spec.py",        # proposers run on the decode hot path
     "ray_tpu/models/gpt.py",      # chunked-prefill / decode kernels
     "ray_tpu/train/session.py",
 )

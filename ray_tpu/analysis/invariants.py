@@ -245,6 +245,22 @@ PREFIX_POOL_SITE_TABLES = (
        "from what the allocator actually shared, split or evicted"),
 )
 
+#: Speculative-decode lifecycle sites that must land in the spec event
+#: ring: PROPOSE/VERIFY/ACCEPT/ROLLBACK are exactly the transitions the
+#: accept-rate story (SpecDecoder.stats(), llm_spec_accept_rate /
+#: llm_spec_tokens_per_step series) is built on — a silent one makes
+#: the speculation telemetry lie about what the verifier actually did.
+SPEC_SITE_TABLES = (
+    ("ray_tpu/llm/spec.py", "_event", (
+        "propose",   # "propose" (draft tokens submitted for a lane)
+        "verify",    # "verify" (lane entered the batched verify fwd)
+        "accept",    # "accept" (accepted prefix + emitted count)
+        "rollback",  # "rollback" (rejected slots freed via truncate)
+    ), "speculative-decode transition emits no event — accept_rate/"
+       "tokens_per_step and the llm_spec_* series silently diverge "
+       "from what the verify step actually accepted or rolled back"),
+)
+
 #: Dispatch-queue / pipeline-window mutation sites that must refresh
 #: the telemetry high-water gauges.
 GAUGE_SITE_TABLES = (
@@ -294,6 +310,7 @@ REF_SITE_TABLES = (
 PERF_SITE_TABLES = (
     ("ray_tpu/llm/engine.py", "_step_perf", (
         "LLMEngine._run_prefills", "LLMEngine._run_decode",
+        "LLMEngine._run_verify",
         "LLMEngine.step", "LLMEngine._publish_gauges",
     ), "device-dispatch site bypasses the step accounting — the "
        "MFU/step-breakdown series go stale or misattribute the step "
@@ -455,4 +472,13 @@ class SilentPrefixPoolTransition(_TableChecker):
     family = "invariants"
     severity = "P0"
     tables = PREFIX_POOL_SITE_TABLES
+    mode = "method_call"
+
+
+@register
+class SilentSpecTransition(_TableChecker):
+    id = "I409"
+    family = "invariants"
+    severity = "P0"
+    tables = SPEC_SITE_TABLES
     mode = "method_call"

@@ -211,7 +211,16 @@ async function refresh(){
         ph+='<div>KV hit '+spark(hit,240,34,'#093')+' '+
           (last(hit)*100).toFixed(1)+'%'+
           '  shared blocks '+spark(shared,240,34,'#909')+' '+
-          last(shared).toFixed(0)+'</div>';}}
+          last(shared).toFixed(0)+'</div>';}
+      // Speculative-decode line (LLM lane, engines built with
+      // speculative=...): proposal accept rate + tokens/verify-step.
+      const sacc=maxNodes(hs.series['llm_spec_accept_rate:'+id]||{});
+      const stps=maxNodes(hs.series['llm_spec_tokens_per_step:'+id]||{});
+      if(sacc.length||stps.length){
+        ph+='<div>spec accept '+spark(sacc,240,34,'#c36')+' '+
+          (last(sacc)*100).toFixed(1)+'%'+
+          '  tok/step '+spark(stps,240,34,'#666')+' '+
+          last(stps).toFixed(2)+'</div>';}}
     document.getElementById('perf').innerHTML=
       ph||'(no accounted engine/train steps yet)';
     document.getElementById('perfsum').textContent=ph?

@@ -67,6 +67,7 @@ class LLMProcessor:
                  max_batch: int = 8, seed: int = 0,
                  prefill_chunk_tokens: Optional[int] = None,
                  prefix_cache: bool = True,
+                 speculative=None,
                  system_prompt=None,
                  name: Optional[str] = None):
         sampling = dict(sampling or {})
@@ -90,6 +91,14 @@ class LLMProcessor:
         self.prefill_chunk_tokens = (None if prefill_chunk_tokens is None
                                      else int(prefill_chunk_tokens))
         self.prefix_cache = bool(prefix_cache)
+        # Speculative decoding (llm/spec.py; None | dict | SpecConfig)
+        # suits batch scoring well: outputs are bit-identical, so it is
+        # a pure tokens/s knob, and repetitive corpora keep the n-gram
+        # proposer's accept rate high. Validate eagerly — a bad knob
+        # should fail at pipeline build, not inside a worker actor.
+        from ..llm.spec import resolve_spec_config
+
+        self.speculative = resolve_spec_config(speculative)
         if isinstance(system_prompt, str):
             system_prompt = list(system_prompt.encode("utf-8"))
         self.system_prompt = [int(t) for t in (system_prompt or ())]
@@ -153,7 +162,9 @@ class _LLMWorker:
             params, cfg, num_blocks=proc.num_blocks,
             block_size=proc.block_size, max_batch=proc.max_batch,
             prefill_chunk_tokens=proc.prefill_chunk_tokens,
-            prefix_cache=proc.prefix_cache, name=proc.name)
+            prefix_cache=proc.prefix_cache,
+            speculative=getattr(proc, "speculative", None),
+            name=proc.name)
         self.engine.start()
         self.state = INIT
         self.events: list[tuple] = []

@@ -11,6 +11,9 @@ itself, built from the repo's own layers:
                             through block tables (interpret mode on CPU)
   * models/gpt.py        — forward_prefill / forward_decode modes
   * llm/engine.py        — Orca-style iteration-level scheduler
+  * llm/spec.py          — speculative decoding (n-gram / small-draft
+                            proposers verified in one paged-attention
+                            pass; output bit-identical either way)
   * serve/llm.py         — streaming deployment (TTFT/TPOT SLO phases,
                             tokens/s + KV-utilization telemetry)
 """
@@ -25,4 +28,11 @@ from .engine import (  # noqa: F401
     Request,
 )
 from .kv_cache import PagedKVCache, PrefixPool  # noqa: F401
-from .sampling import sample  # noqa: F401
+from .sampling import rejection_sample, sample, verify_tokens  # noqa: F401
+from .spec import (  # noqa: F401
+    DraftProposer,
+    NgramProposer,
+    Proposer,
+    SpecConfig,
+    SpecDecoder,
+)

@@ -40,6 +40,15 @@ Two admission-path optimizations (both on by default for serving):
     cursor carrying across steps, so running decode streams emit a
     token EVERY step instead of stalling behind a long prompt
     (Sarathi-style stall-free admission).
+
+And one decode-path optimization (opt-in, ``speculative=...``):
+SPECULATIVE DECODING (llm/spec.py) — a proposer guesses up to k next
+tokens per sequence and ONE verify forward scores k+1 positions per
+lane through the generalized paged-attention kernel; the accepted
+prefix plus one corrected/bonus token emit in a single step. Because
+sampling is keyed by (seed, position) alone, acceptance is an equality
+check against the replayed keyed draw — the output token stream is
+bit-identical to non-speculative decoding, preemption and all.
 """
 
 from __future__ import annotations
@@ -57,10 +66,11 @@ import jax
 import numpy as np
 
 from ..models.gpt import (GPTConfig, forward_decode, forward_prefill,
-                          forward_prefill_chunk)
+                          forward_prefill_chunk, forward_verify)
 from ..util import perfmodel, tracing
 from .kv_cache import PagedKVCache, PrefixPool
-from .sampling import sample
+from .sampling import sample, verify_tokens
+from .spec import make_spec
 
 # Request states (the event vocabulary).
 WAITING = "WAITING"
@@ -106,6 +116,28 @@ class Request:
             yield tok
 
 
+@functools.lru_cache(maxsize=32)
+def _jit_programs(cfg: GPTConfig, mesh, rules):
+    """Process-wide compiled-program cache. jax.jit's executable cache
+    is keyed by the wrapped callable's identity, so per-engine
+    ``jax.jit(partial(...))`` wrappers re-trace and re-compile the same
+    (cfg, shapes) program for every engine instance — per-block data
+    workers, serve redeploys, and tests all pay it. Engines with equal
+    (cfg, mesh, rules) share one set of wrappers instead; donation is
+    per-call, so two live engines sharing a program donate only their
+    own pools."""
+    return (
+        jax.jit(functools.partial(forward_decode, cfg=cfg, mesh=mesh,
+                                  rules=rules), donate_argnums=(3, 4)),
+        jax.jit(functools.partial(forward_prefill, cfg=cfg, mesh=mesh,
+                                  rules=rules)),
+        jax.jit(functools.partial(forward_prefill_chunk, cfg=cfg,
+                                  mesh=mesh, rules=rules)),
+        jax.jit(functools.partial(forward_verify, cfg=cfg, mesh=mesh,
+                                  rules=rules), donate_argnums=(3, 4)),
+    )
+
+
 class LLMEngine:
     """One model + one KV pool + one step scheduler.
 
@@ -117,6 +149,7 @@ class LLMEngine:
                  block_size: int = 16, max_batch: int = 8,
                  prefill_chunk_tokens: Optional[int] = None,
                  prefix_cache: bool = True,
+                 speculative=None,
                  mesh=None, rules=None, name: str = "llm"):
         self.cfg = cfg
         self.name = name
@@ -137,23 +170,25 @@ class LLMEngine:
                                      else int(prefill_chunk_tokens))
         self.params = params
         # Fixed decode shapes — one compile: batch padded to max_batch,
-        # tables padded to the worst-case blocks/sequence.
+        # tables padded to the worst-case blocks/sequence. Prefill
+        # recompiles per length bucket (lengths are padded to a block
+        # multiple, so at most max_seq/block_size variants). Programs
+        # come from the process-wide cache above when the key is
+        # hashable (unhashable mesh/rules fall back to per-instance).
         self.max_nb = self.kv.blocks_for_tokens(cfg.max_seq)
-        self._decode = jax.jit(
-            functools.partial(forward_decode, cfg=cfg, mesh=mesh,
-                              rules=rules),
-            donate_argnums=(3, 4))
-        # Prefill recompiles per length bucket (lengths are padded to a
-        # block multiple, so at most max_seq/block_size variants).
-        self._prefill = jax.jit(
-            functools.partial(forward_prefill, cfg=cfg, mesh=mesh,
-                              rules=rules))
-        # Incremental prefill over resident context (chunked admission
-        # and partial cache hits); pools are read-only inputs here, the
-        # chunk's K/V is written back via write_prefill afterwards.
-        self._prefill_chunk = jax.jit(
-            functools.partial(forward_prefill_chunk, cfg=cfg, mesh=mesh,
-                              rules=rules))
+        try:
+            progs = _jit_programs(cfg, mesh, rules)
+        except TypeError:
+            progs = _jit_programs.__wrapped__(cfg, mesh, rules)
+        self._decode, self._prefill, self._prefill_chunk, verify = progs
+        # Speculative decoding (llm/spec.py): when enabled, decode runs
+        # through ONE verify forward scoring k+1 positions per lane
+        # (fixed q shape, one compile) and the accepted prefix + one
+        # corrected/bonus token all land in a single step. None keeps
+        # the plain one-token decode path — zero cost when off.
+        self._spec = make_spec(speculative, target_params=params,
+                               target_cfg=cfg, mesh=mesh, rules=rules)
+        self._verify = verify if self._spec is not None else None
 
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -346,6 +381,14 @@ class LLMEngine:
         pos = len(req.prompt) + len(req.output)
         tok = sample(logits_row, temperature=req.temperature,
                      top_k=req.top_k, seed=req.seed, position=pos)
+        return self._emit_token(req, tok)
+
+    def _emit_token(self, req: Request, tok: int) -> bool:
+        """Append an already-decided token (sampled, or an accepted/
+        corrected speculative draw — identical by construction), push it
+        to the consumer, apply stop conditions. Returns True if the
+        request finished."""
+        tok = int(tok)
         req.output.append(tok)
         now = time.time()
         if req.first_token_t is None:
@@ -478,42 +521,46 @@ class LLMEngine:
         self._preempt(req)
         return False
 
-    def _ensure_decode_slot(self, req: Request) -> bool:
-        """Guarantee req's next token has a WRITABLE pool slot,
-        preempting LIFO victims if the pool is dry. With the prefix
-        pool the slot's block must also be private: a block with
-        co-readers, or one whose registered span covers the write
+    def _ensure_slots(self, req: Request, n: int = 1) -> bool:
+        """Guarantee req's next ``n`` tokens have WRITABLE pool slots
+        (n = 1 for plain decode; 1 + proposals for a speculative verify
+        row), preempting LIFO victims if the pool is dry. With the
+        prefix pool each touched block must also be private: a block
+        with co-readers, or one whose registered span covers a write
         offset (the shared partially-filled tail a diverging request
         hits), is COW-split first — the write never corrupts what other
         requests or the index can still read. Returns False if req
         itself was preempted (the last resort when it is the newest —
         and possibly only — sequence)."""
-        slot = req.context_len
-        bi = slot // self.kv.block_size
-        while True:
-            if bi >= len(req.block_table):
-                grant = self.kv.alloc(1)
-                if grant is None:
-                    if not self._preempt_for(req):
-                        return False
-                    continue
-                req.block_table.extend(grant)
-            if self._prefix:
-                bid = req.block_table[bi]
-                if self.kv.needs_cow(bid, slot % self.kv.block_size):
-                    nb = self.kv.cow(bid)
-                    if nb is None:
+        bs = self.kv.block_size
+        for j in range(n):
+            slot = req.context_len + j
+            bi = slot // bs
+            while True:
+                if bi >= len(req.block_table):
+                    grant = self.kv.alloc(1)
+                    if grant is None:
                         if not self._preempt_for(req):
                             return False
                         continue
-                    req.block_table[bi] = nb
-            return True
+                    req.block_table.extend(grant)
+                if self._prefix:
+                    bid = req.block_table[bi]
+                    if self.kv.needs_cow(bid, slot % bs):
+                        nb = self.kv.cow(bid)
+                        if nb is None:
+                            if not self._preempt_for(req):
+                                return False
+                            continue
+                        req.block_table[bi] = nb
+                break
+        return True
 
     def _run_decode(self):
         batch = [r for r in self._active if r.state == RUNNING]
         for req in list(batch):
             if req.state == RUNNING:
-                self._ensure_decode_slot(req)
+                self._ensure_slots(req, 1)
         # An ensure call may have preempted requests anywhere in the
         # batch (LIFO victims) — only still-RUNNING sequences decode.
         batch = [r for r in batch if r.state == RUNNING]
@@ -586,10 +633,149 @@ class LLMEngine:
                 tracing.emit("llm.decode_step", req.trace_ctx, t0, dur,
                              dict(breakdown, rid=req.rid))
 
+    def _run_verify(self):
+        """Speculative decode step: propose up to k tokens per lane,
+        write current + proposals into their pool slots, and score all
+        q = k+1 positions in ONE batched paged-attention forward
+        (models/gpt.py forward_verify). verify_tokens then accepts the
+        longest proposal prefix matching the target's keyed draws and
+        emits one corrected/bonus token — several output tokens per
+        step at exactly the non-speculative token stream (the sampler
+        is keyed by (seed, position) alone, so acceptance is an
+        equality check, not a new random process). Rejected slots are
+        rolled back with kv.truncate(); the fixed [max_batch, k+1]
+        shapes compile ONCE, lanes with fewer live rows padding onto
+        scratch block 0 exactly like padded decode lanes."""
+        batch = [r for r in self._active if r.state == RUNNING]
+        if not batch:
+            return
+        spec = self._spec
+        props: Dict[int, List[int]] = {}
+        for req in batch:
+            # Proposal budget: never past max_tokens (the final token
+            # is sampled, not proposed), never past the block span the
+            # admission check guaranteed, never past max_seq positions.
+            budget = min(
+                req.max_tokens - len(req.output) - 1,
+                len(req.prompt) + req.max_tokens - req.context_len - 1,
+                self.cfg.max_seq - req.context_len - 1)
+            props[req.rid] = spec.propose(
+                req.rid, req.prompt + req.output, budget)
+        for req in list(batch):
+            if req.state == RUNNING:
+                self._ensure_slots(req, 1 + len(props[req.rid]))
+        batch = [r for r in batch if r.state == RUNNING]
+        if not batch:
+            return
+        t0 = time.time()
+        B = self.max_batch
+        Q = spec.k + 1
+        bs = self.kv.block_size
+        tokens = np.zeros((B, Q), np.int32)
+        positions = np.zeros((B, Q), np.int32)
+        slot_blocks = np.zeros((B, Q), np.int32)
+        slot_offsets = np.zeros((B, Q), np.int32)
+        context_lens = np.ones((B,), np.int32)
+        q_lens = np.ones((B,), np.int32)
+        tables = np.zeros((B, self.max_nb), np.int32)
+        for i, req in enumerate(batch):
+            slot = req.context_len
+            p = props[req.rid]
+            n = 1 + len(p)
+            # Row 0 feeds the last sampled token (a FULL prefix-cache
+            # hit re-feeds its held-back last position — the verify
+            # fast start: its FIRST step already carries proposals);
+            # rows 1..n-1 feed the proposals. Rows n..Q-1 are padding:
+            # scratch block 0, positions clipped in range — their
+            # logits are garbage and never read (q_lens masks them in
+            # the kernel and the host loop stops at n).
+            tokens[i, 0] = (req.prompt[slot] if slot < len(req.prompt)
+                            else req.output[slot - len(req.prompt)])
+            tokens[i, 1:n] = p
+            positions[i] = np.minimum(slot + np.arange(Q, dtype=np.int32),
+                                      self.cfg.max_seq - 1)
+            for j in range(n):
+                slot_blocks[i, j] = req.block_table[(slot + j) // bs]
+                slot_offsets[i, j] = (slot + j) % bs
+            context_lens[i] = slot + n
+            q_lens[i] = n
+            tables[i, :len(req.block_table)] = req.block_table
+            spec.verify(req.rid, len(p))
+        spec.verify_steps += 1
+        t_disp = time.perf_counter()
+        logits, self.kv.k, self.kv.v = self._verify(
+            self.params, tokens, positions, self.kv.k, self.kv.v,
+            tables, context_lens, q_lens, slot_blocks, slot_offsets)
+        jax.block_until_ready(logits)
+        device_s = time.perf_counter() - t_disp
+        # Verify pricing is honest about speculation's bet: k+1 rows of
+        # FLOPs are burned regardless of how many tokens are accepted.
+        cost = perfmodel.verify_step_cost(
+            self.cfg, [int(context_lens[i]) for i in range(len(batch))],
+            [int(q_lens[i]) for i in range(len(batch))])
+        self._step_perf.add_device(device_s, cost)
+        rows = np.asarray(jax.device_get(logits), np.float32)
+        emitted_total = 0
+        for i, req in enumerate(batch):
+            p = props[req.rid]
+            n = 1 + len(p)
+            slot = req.context_len
+            start_pos = len(req.prompt) + len(req.output)
+            n_acc, emitted = verify_tokens(
+                rows[i, :n], p, temperature=req.temperature,
+                top_k=req.top_k, seed=req.seed, start_pos=start_pos)
+            spec.accept(req.rid, n_acc, len(p), len(emitted))
+            emitted_total += len(emitted)
+            for idx, tok in enumerate(emitted):
+                # Bookkeeping BEFORE emitting: an accepted token IS
+                # resident (its slot was written this step), the final
+                # corrected/bonus token is NOT (its draw replaced a
+                # rejected row / was never written) — so a mid-stream
+                # finish registers exactly the resident span.
+                if idx < n_acc:
+                    req.context_len = slot + 2 + idx
+                else:
+                    req.context_len = slot + 1 + n_acc
+                if self._emit_token(req, tok):
+                    break
+            n_rej = len(p) - n_acc
+            if n_rej:
+                # Rejected slots past the accept cursor: any whole
+                # blocks they spilled into go back to the pool (a
+                # finished lane already released everything).
+                freed = (self.kv.truncate(req.block_table,
+                                          req.context_len)
+                         if req.block_table else [])
+                spec.rollback(req.rid, n_rej, len(freed))
+        dur = time.time() - t0
+        kv_util = self.kv.utilization()
+        traced = [r for r in batch if r.trace_ctx is not None]
+        if traced:
+            rl = perfmodel.roofline(cost, device_s,
+                                    max(dur - device_s, 0.0),
+                                    hw=self._step_perf.hw)
+            breakdown = {
+                "step": self._steps + 1,
+                "prefill": self._last_prefill_count,
+                "decode": len(batch), "kv_util": kv_util,
+                "spec_proposed": int(sum(len(props[r.rid])
+                                         for r in batch)),
+                "spec_emitted": emitted_total,
+                "device_ms": round(device_s * 1e3, 3),
+                "host_ms": round(max(dur - device_s, 0.0) * 1e3, 3),
+                "mfu": round(rl["mfu"], 4),
+                "hbm_util": round(rl["hbm_util"], 4),
+                "verdict": rl["verdict"],
+            }
+            for req in traced:
+                tracing.emit("llm.decode_step", req.trace_ctx, t0, dur,
+                             dict(breakdown, rid=req.rid))
+
     def step(self) -> int:
         """One scheduler iteration: admit -> prefill -> decode one token
-        for every running sequence. Returns the number of in-flight
-        sequences after the step."""
+        for every running sequence (with speculation on, the decode is
+        a verify step that may emit several). Returns the number of
+        in-flight sequences after the step."""
         with self._lock:
             self._step_perf.begin()
             self._admit()
@@ -599,7 +785,10 @@ class LLMEngine:
             # block freed), which is why SERVE_BENCH read 0.0 for years.
             util_hw = self.kv.utilization()
             self._run_prefills()
-            self._run_decode()
+            if self._spec is not None:
+                self._run_verify()
+            else:
+                self._run_decode()
             self._kv_util_peak = max(self._kv_util_peak, util_hw,
                                      self.kv.utilization())
             self._steps += 1
@@ -642,6 +831,11 @@ class LLMEngine:
             out["kv_cache_hit_rate"] = ps["hit_rate"]
             out["kv_shared_blocks"] = ps["shared_blocks"]
             out["prefix"] = ps
+        if self._spec is not None:
+            ss = self._spec.stats()
+            out["spec_accept_rate"] = ss["accept_rate"]
+            out["spec_tokens_per_step"] = ss["tokens_per_step"]
+            out["spec"] = ss
         if self._step_perf.last is not None:
             out["last_step"] = dict(self._step_perf.last)
         return out
@@ -688,10 +882,17 @@ class LLMEngine:
                     Gauge("rtpu_llm_prefill_chunks",
                           "Cumulative prefill chunk dispatches",
                           tag_keys=keys),
+                    Gauge("rtpu_llm_spec_accept_rate",
+                          "Speculative-decode proposal acceptance rate "
+                          "[0,1]", tag_keys=keys),
+                    Gauge("rtpu_llm_spec_tokens_per_step",
+                          "Output tokens per verify step per lane "
+                          "(1.0 = plain decode, up to k+1)",
+                          tag_keys=keys),
                 )
             tags = {"deployment": self.name}
             (tps, util, bsz, step_ms, dev_ms, gap_ms, mfu,
-             hbm, hitr, shared, chunks) = self._gauges
+             hbm, hitr, shared, chunks, s_acc, s_tps) = self._gauges
             tps.set(self.tokens_per_s(), tags=tags)
             util.set(self.kv.utilization(), tags=tags)
             bsz.set(float(len(self._active)), tags=tags)
@@ -701,11 +902,17 @@ class LLMEngine:
                 shared.set(float(self.kv.shared_blocks())
                            if self._prefix else 0.0, tags=tags)
                 chunks.set(float(self._prefill_chunks), tags=tags)
+                s_acc.set(self._spec.accept_rate()
+                          if self._spec is not None else 0.0, tags=tags)
+                s_tps.set(self._spec.tokens_per_step()
+                          if self._spec is not None else 0.0, tags=tags)
             else:
                 # Idle decay, like the step-breakdown series below.
                 hitr.set(0.0, tags=tags)
                 shared.set(0.0, tags=tags)
                 chunks.set(0.0, tags=tags)
+                s_acc.set(0.0, tags=tags)
+                s_tps.set(0.0, tags=tags)
             perf = self._step_perf.last if self._active else None
             if perf is None:
                 # Idle (or no-work step): the breakdown series decay to

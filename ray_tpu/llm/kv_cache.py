@@ -113,6 +113,26 @@ class PagedKVCache:
             seen.add(b)
         self._free.extend(blocks)
 
+    def truncate(self, table: List[int], keep_tokens: int) -> List[int]:
+        """Trim ``table`` IN PLACE to the blocks covering
+        ``keep_tokens`` resident tokens, returning the surplus block
+        ids to the free list (speculative-decode rollback: rejected
+        proposal slots past the accept cursor spilled into blocks the
+        sequence no longer needs). Garbage K/V left inside the KEPT
+        tail block is invisible — attention masks by context length and
+        the next decode write overwrites slot by slot. On the prefix
+        pool the surplus goes through release(): refcounts drop by one,
+        so a shared or still-indexed block parks/unrefs instead of
+        being clobbered on the free list. Returns the freed block
+        ids."""
+        nb = self.blocks_for_tokens(keep_tokens)
+        if nb >= len(table):
+            return []
+        surplus = table[nb:]
+        del table[nb:]
+        self.free(surplus)
+        return surplus
+
     # -- writes ------------------------------------------------------------
 
     def write_prefill(self, k, v, block_ids: List[int]):

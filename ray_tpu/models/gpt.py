@@ -384,6 +384,76 @@ def forward_decode(params, tokens, positions, k_pool, v_pool,
     return logits, k_pool, v_pool
 
 
+def forward_verify(params, tokens, positions, k_pool, v_pool,
+                   block_tables, context_lens, q_lens, slot_blocks,
+                   slot_offsets, cfg: GPTConfig,
+                   mesh: Optional[Mesh] = None,
+                   rules: Optional[dict] = None):
+    """Speculative-verify step: score q = k+1 positions per sequence in
+    ONE batched paged-attention forward.
+
+    The decode step generalized to ``q`` query rows per lane: row 0 is
+    the lane's current (last sampled, not yet written) token and rows
+    1..q-1 its proposed continuation. Each layer projects all rows' K/V,
+    writes them into the paged pool at (slot_blocks, slot_offsets)
+    — write-then-attend, like decode — then attends with the q_len>1
+    kernel, causal within the speculative span. The engine samples the
+    q_lens[lane] leading logits rows to accept/reject proposals; the
+    pool writes of rejected rows are rolled back host-side
+    (kv_cache.truncate) — garbage beyond context_lens is never attended.
+
+    Args:
+      tokens / positions: [b, q] int32. Rows past q_lens[lane] are
+        padding: their slots point at the reserved scratch block 0 and
+        their logits are garbage the engine never reads.
+      context_lens: [b] int32 — resident tokens per lane INCLUDING its
+        q_lens real rows.
+      q_lens: [b] int32 — real rows per lane (1 = plain decode lane).
+      slot_blocks / slot_offsets: [b, q] int32 write sites per row.
+
+    Returns (logits [b, q, vocab], k_pool, v_pool) — donate the pools.
+    """
+    from ..ops.pallas.paged_decode import paged_verify_attention
+
+    rules = {**DEFAULT_RULES, **ACT_RULES, **(rules or {})}
+    dt = cfg.dtype
+    B, Q = tokens.shape
+    hkv, group = cfg.kv_heads, cfg.n_head // cfg.kv_heads
+    wte = params["wte"].astype(dt)
+    x = wte[tokens] + params["wpe"].astype(dt)[positions]   # [b, q, m]
+
+    def scan_body(x, layer):
+        p, kp, vp = layer
+        h = _layernorm(x, p["ln1"])
+        q = jnp.einsum("bqm,mhd->bqhd", h, p["wq"].astype(dt))
+        k_tok = jnp.einsum("bqm,mhd->bqhd", h, p["wk"].astype(dt))
+        v_tok = jnp.einsum("bqm,mhd->bqhd", h, p["wv"].astype(dt))
+        # Cache write for every row before attending (real rows land in
+        # their sequence slots; padding rows collide harmlessly on the
+        # scratch block).
+        kp = kp.at[:, slot_blocks, slot_offsets].set(
+            k_tok.astype(kp.dtype).transpose(2, 0, 1, 3))
+        vp = vp.at[:, slot_blocks, slot_offsets].set(
+            v_tok.astype(vp.dtype).transpose(2, 0, 1, 3))
+        o = paged_verify_attention(
+            q.reshape(B, Q, hkv, group, cfg.head_dim), kp, vp,
+            block_tables, context_lens, q_lens)
+        o = jnp.einsum("bqhd,hdm->bqm",
+                       o.reshape(B, Q, cfg.n_head, cfg.head_dim),
+                       p["wo"].astype(dt))
+        x = x + o
+        h2 = _layernorm(x, p["ln2"])
+        ff = jax.nn.gelu(jnp.einsum("bqm,mf->bqf", h2, p["wi"].astype(dt)))
+        x = x + jnp.einsum("bqf,fm->bqm", ff, p["wm"].astype(dt))
+        return x, (kp, vp)
+
+    x, (k_pool, v_pool) = jax.lax.scan(
+        scan_body, x, (params["blocks"], k_pool, v_pool))
+    x = _layernorm(x, params["ln_f"])
+    logits = jnp.einsum("bqm,vm->bqv", x, params["wte"].astype(dt))
+    return logits, k_pool, v_pool
+
+
 def _chunk_attention(q, k_tok, v_tok, k_ctx, v_ctx, ctx_len):
     """Attention for one prefill chunk over [pool context ++ chunk].
 

@@ -369,6 +369,8 @@ def _print_top(rt):
                        if m.startswith(("llm_mfu:", "llm_host_gap_ms:",
                                         "kv_cache_hit_rate:",
                                         "kv_shared_blocks:",
+                                        "llm_spec_accept_rate:",
+                                        "llm_spec_tokens_per_step:",
                                         "train_mfu:",
                                         "train_host_gap_ms:")))
     if perf_rows:
@@ -376,7 +378,8 @@ def _print_top(rt):
         for metric, by_node in perf_rows:
             val = max(by_node.values())
             if metric.startswith(("llm_mfu:", "train_mfu:",
-                                  "kv_cache_hit_rate:")):
+                                  "kv_cache_hit_rate:",
+                                  "llm_spec_accept_rate:")):
                 print(f"  {metric:<44} {val:10.2%}")
             else:
                 print(f"  {metric:<44} {val:10.2f}")

@@ -54,6 +54,7 @@ class _LLMServer:
                  max_batch: int = 8, default_max_tokens: int = 32,
                  prefill_chunk_tokens: Optional[int] = 32,
                  prefix_cache: bool = True,
+                 speculative=None,
                  system_prompt=None):
         import jax
 
@@ -76,11 +77,15 @@ class _LLMServer:
         self.system_prompt = [int(t) for t in (system_prompt or ())]
         # Serving defaults to chunked prefill (bounded per-step prefill
         # keeps decode streams emitting every step) and prefix caching.
+        # ``speculative`` (None | dict | SpecConfig — llm/spec.py) turns
+        # decode steps into k+1-position verify steps; output tokens are
+        # bit-identical either way, so it is purely a throughput knob.
         self.engine = LLMEngine(params, cfg, num_blocks=num_blocks,
                                 block_size=block_size,
                                 max_batch=max_batch,
                                 prefill_chunk_tokens=prefill_chunk_tokens,
-                                prefix_cache=prefix_cache, name=name)
+                                prefix_cache=prefix_cache,
+                                speculative=speculative, name=name)
         self.engine.start()
 
     def __call__(self, request: Any):

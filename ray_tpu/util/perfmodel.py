@@ -199,6 +199,35 @@ def decode_step_cost(cfg, context_lens: Sequence[int], *,
     return StepCost(flops, hbm, n)
 
 
+def verify_step_cost(cfg, context_lens: Sequence[int],
+                     q_lens: Sequence[int], *,
+                     kv_dtype_bytes: int = 2,
+                     param_bytes: int = 4) -> StepCost:
+    """One speculative verify step: each lane scores ``q_lens[i]`` rows
+    (current token + its proposals) against ``context_lens[i]`` resident
+    tokens (INCLUDING those rows). Priced honestly: every scored row
+    costs full matmul + attention FLOPs whether its proposal is later
+    accepted or rolled back — speculation buys steps, not FLOPs. Row j
+    of lane i attends ctx - q + 1 + j keys (causal within the span), so
+    the per-lane attention term is q*ctx - q*(q-1)/2 contexts. HBM: one
+    weight stream for the batch, one read of each lane's context KV
+    (the kernel's block gather serves all rows in a lane), one write
+    per scored row."""
+    s = _shape(cfg)
+    n_rows = float(sum(q_lens))
+    attn_ctx = 0.0
+    total_ctx = 0.0
+    for ctx, q in zip(context_lens, q_lens):
+        attn_ctx += q * ctx - q * (q - 1) / 2.0
+        total_ctx += ctx
+    flops = 2.0 * s["matmul_weights"] * n_rows + s["attn_per_ctx"] * attn_ctx
+    kvb = s["kv_bytes_per_token"] * kv_dtype_bytes
+    hbm = (s["num_params"] * param_bytes
+           + total_ctx * kvb                 # context KV read per lane
+           + n_rows * kvb)                   # KV write per scored row
+    return StepCost(flops, hbm, int(n_rows))
+
+
 def prefill_cost(cfg, n_tokens: int, *, ctx_tokens: int = 0,
                  kv_dtype_bytes: int = 2,
                  param_bytes: int = 4) -> StepCost:

@@ -10,6 +10,11 @@ import os
 # Force the CPU backend (the ambient env selects the real TPU via
 # JAX_PLATFORMS=axon; tests always run on the virtual 8-device CPU mesh).
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# NOTE: do NOT enable JAX's persistent compilation cache
+# (JAX_COMPILATION_CACHE_DIR) for this suite — on jaxlib 0.4.37 it
+# intermittently SIGABRTs the process when cache writes race the
+# trainer's checkpoint threads (reproduced in test_train).
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -31,6 +36,14 @@ import pytest  # noqa: E402
 # test_concurrency_net.py — not suite-wide, or the perf gates would
 # measure the debug instrumentation.)
 os.environ.setdefault("RT_LOOP_WATCHDOG_S", "5")
+
+# Runtime-env pip tests either install a LOCAL wheel (--no-index) or
+# assert a typed failure on a bogus requirement. Point pip at a dead
+# index by default so the failure tests fail fast (connection refused,
+# no retries) and the suite never waits on real network resolution.
+os.environ.setdefault("PIP_INDEX_URL", "http://127.0.0.1:1/simple")
+os.environ.setdefault("PIP_RETRIES", "0")
+os.environ.setdefault("PIP_DEFAULT_TIMEOUT", "1")
 
 
 def pytest_configure(config):

@@ -573,6 +573,33 @@ def test_i408_catches_a_silent_prefix_pool_transition(tmp_path):
     assert all(f.severity == "P0" for f in rep.findings)
 
 
+def test_i409_catches_a_silent_spec_transition(tmp_path):
+    # Mirrors the real row: every speculative-decode lifecycle
+    # transition (PROPOSE/VERIFY/ACCEPT/ROLLBACK) must flow through
+    # _event or accept_rate / the llm_spec_* series diverge from what
+    # the verify step actually did.
+    tables = (("spec.py", "_event",
+               ("propose", "verify", "accept", "rollback"), "why"),)
+    rep = lint(tmp_path, {"spec.py": """\
+        class S:
+            def propose(self, rid, toks, budget):
+                self._event("propose", rid=rid, n=2)
+                return toks[:2]
+
+            def verify(self, rid, n):
+                self._event("verify", rid=rid, n=n)
+
+            def accept(self, rid, n_acc, n_prop, n_emit):
+                self.accepted += n_acc
+
+            def rollback(self, rid, n_rej, freed):
+                self.rolled_back += n_rej
+        """}, select="I409", config={"I409_tables": tables})
+    missing = sorted((f.path, f.symbol) for f in rep.findings)
+    assert missing == [("spec.py", "accept"), ("spec.py", "rollback")]
+    assert all(f.severity == "P0" for f in rep.findings)
+
+
 # ---------------------------------------------------------------------------
 # Suppression surfaces
 # ---------------------------------------------------------------------------

@@ -70,7 +70,7 @@ def test_invariant_site_tables_still_bind():
     from ray_tpu.analysis import invariants as inv
     for tables in (inv.EVENT_SITE_TABLES, inv.GAUGE_SITE_TABLES,
                    inv.REF_SITE_TABLES, inv.PERF_SITE_TABLES,
-                   inv.FLIGHTREC_SITE_TABLES):
+                   inv.FLIGHTREC_SITE_TABLES, inv.SPEC_SITE_TABLES):
         for path, _needle, _entries, _why in tables:
             assert (REPO_ROOT / path).is_file(), path
 

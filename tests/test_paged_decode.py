@@ -21,6 +21,8 @@ from ray_tpu.ops.attention import causal_attention  # noqa: E402
 from ray_tpu.ops.pallas.paged_decode import (  # noqa: E402
     paged_decode_attention,
     paged_decode_attention_reference,
+    paged_verify_attention,
+    paged_verify_attention_reference,
 )
 
 ATOL_F32 = 2e-5
@@ -117,6 +119,89 @@ def test_matches_dense_causal_attention():
     np.testing.assert_allclose(np.asarray(out[0, :, 0]),
                                np.asarray(dense),
                                atol=ATOL_F32, rtol=0)
+
+
+def _verify_case(key, *, batch, q_len, hkv, group, d, num_blocks,
+                 block_size, max_nb, dtype):
+    """Verify-step layout: each lane's last q_lens[b] context slots ARE
+    its query rows (write-then-attend), lanes padded to q_len rows."""
+    base = _paged_case(key, batch=batch, hkv=hkv, group=group, d=d,
+                       num_blocks=num_blocks, block_size=block_size,
+                       max_nb=max_nb, dtype=dtype)
+    _, k_pool, v_pool, tables, lens = base
+    rng = np.random.default_rng(7)
+    q_lens = np.array([int(rng.integers(1, min(q_len, int(lens[b])) + 1))
+                       for b in range(batch)], np.int32)
+    q = jax.random.normal(jax.random.split(key, 5)[4],
+                          (batch, q_len, hkv, group, d), dtype)
+    return q, k_pool, v_pool, tables, lens, jnp.asarray(q_lens)
+
+
+def test_verify_matches_reference_qlen_gt1_f32():
+    q, k, v, tables, lens, q_lens = _verify_case(
+        jax.random.PRNGKey(5), batch=3, q_len=4, hkv=2, group=1, d=16,
+        num_blocks=24, block_size=8, max_nb=3, dtype=jnp.float32)
+    out = paged_verify_attention(q, k, v, tables, lens, q_lens,
+                                 interpret=True)
+    ref = paged_verify_attention_reference(q, k, v, tables, lens, q_lens)
+    # Padding rows (>= q_lens[b]) are defined garbage in BOTH paths
+    # (the clamped mask makes them attend the full context identically),
+    # so the whole tensor compares.
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=ATOL_F32, rtol=0)
+
+
+def test_verify_matches_reference_gqa_bf16():
+    q, k, v, tables, lens, q_lens = _verify_case(
+        jax.random.PRNGKey(6), batch=2, q_len=3, hkv=2, group=3, d=8,
+        num_blocks=16, block_size=4, max_nb=4, dtype=jnp.bfloat16)
+    out = paged_verify_attention(q, k, v, tables, lens, q_lens,
+                                 interpret=True)
+    ref = paged_verify_attention_reference(q, k, v, tables, lens, q_lens)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=ATOL_BF16, rtol=0)
+
+
+def test_verify_qlen1_equals_decode_kernel():
+    """A verify pass with one real row per lane IS the decode step —
+    the generalized mask must degenerate exactly."""
+    q, k, v, tables, lens = _paged_case(
+        jax.random.PRNGKey(7), batch=3, hkv=2, group=2, d=16,
+        num_blocks=24, block_size=8, max_nb=3, dtype=jnp.float32)
+    dec = paged_decode_attention(q, k, v, tables, lens, interpret=True)
+    ver = paged_verify_attention(q[:, None], k, v, tables, lens,
+                                 jnp.ones((3,), jnp.int32),
+                                 interpret=True)
+    np.testing.assert_allclose(np.asarray(ver[:, 0]), np.asarray(dec),
+                               atol=ATOL_F32, rtol=0)
+
+
+def test_verify_causal_within_speculative_span():
+    """Row j must not see rows j+1..: perturbing a LATER speculative
+    slot's K/V cannot change an earlier row's output."""
+    q, k, v, tables, lens, _ = _verify_case(
+        jax.random.PRNGKey(8), batch=1, q_len=3, hkv=1, group=1, d=8,
+        num_blocks=8, block_size=4, max_nb=2, dtype=jnp.float32)
+    q_lens = jnp.asarray([3], jnp.int32)
+    lens = jnp.maximum(lens, 3)            # room for 3 real rows
+    out1 = paged_verify_attention(q, k, v, tables, lens, q_lens,
+                                  interpret=True)
+    # Perturb the LAST real slot (position lens-1, row 2's write site).
+    ctx = int(lens[0])
+    bs = k.shape[2]
+    blk = int(tables[0, (ctx - 1) // bs])
+    k2 = k.at[:, blk, (ctx - 1) % bs].add(100.0)
+    v2 = v.at[:, blk, (ctx - 1) % bs].add(-50.0)
+    out2 = paged_verify_attention(q, k2, v2, tables, lens, q_lens,
+                                  interpret=True)
+    # Rows 0 and 1 see positions <= ctx-3 / ctx-2 only: unchanged.
+    np.testing.assert_allclose(np.asarray(out1[0, :2]),
+                               np.asarray(out2[0, :2]),
+                               atol=ATOL_F32, rtol=0)
+    # Row 2 attends its own slot: it must have moved.
+    assert not np.allclose(np.asarray(out1[0, 2]),
+                           np.asarray(out2[0, 2]), atol=1e-3)
 
 
 def test_scratch_block_garbage_is_masked():

@@ -362,6 +362,57 @@ def test_prefix_pool_bookkeeping_gate():
         f"(calibration {cal:.2f})")
 
 
+def test_spec_disabled_step_overhead_gate():
+    """Speculative decoding must be FREE when off: the engine builds no
+    proposer and no verify program (structural zero-overhead — step()
+    keeps the plain one-token decode path behind a single attribute
+    check), and the n-gram proposer itself — the per-lane, per-step
+    cost once speculation IS on — must stay under 50us per propose()
+    over a 256-token history at calibration 1.0 (~5-15us observed
+    solo). A regression — the guard growing work, or the suffix match
+    degenerating to a quadratic rescan per call — taxes every decode
+    step, so it fails loudly here."""
+    pytest.importorskip("jax")
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.llm.engine import LLMEngine
+    from ray_tpu.llm.spec import NgramProposer
+    from ray_tpu.models.gpt import GPTConfig, init
+
+    cal = _calibrate()
+    cfg = GPTConfig(vocab_size=64, max_seq=64, d_model=32, n_layer=1,
+                    n_head=2, dtype=jnp.float32)
+    eng = LLMEngine(init(jax.random.PRNGKey(0), cfg), cfg, num_blocks=4,
+                    block_size=16, max_batch=2, speculative=None)
+    # Structural: disabled means NO spec object and NO verify compile.
+    assert eng._spec is None and eng._verify is None
+    # The whole disabled-path residue inside step() is this guard.
+    n = 50000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        if eng._spec is not None:
+            raise AssertionError
+    per_guard = (time.perf_counter() - t0) / n
+    # Enabled-path proposer cost on a worst-ish-case history: long,
+    # periodic (every call walks the match loop and extends to k).
+    prop = NgramProposer()
+    hist = ([7, 8, 9, 7, 8] * 52)[:256]
+    prop.propose(hist, 4)  # warm
+    n = 2000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        prop.propose(hist, 4)
+    per_propose = (time.perf_counter() - t0) / n
+    budget = 50e-6 / cal
+    assert per_guard < budget, (
+        f"spec-off step guard regressed: {per_guard * 1e6:.2f}us "
+        f"per step > budget {budget * 1e6:.1f}us (calibration {cal:.2f})")
+    assert per_propose < budget, (
+        f"n-gram propose regressed: {per_propose * 1e6:.1f}us per call "
+        f"> budget {budget * 1e6:.1f}us (calibration {cal:.2f})")
+
+
 def test_solo_cross_node_fetch_gate():
     cal = _calibrate()
     os.environ["RT_MB_FETCH_MB"] = "16"
