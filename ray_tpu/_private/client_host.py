@@ -254,6 +254,14 @@ class SessionHost:
             return rt.get_trace(**(payload or {}))
         if method == "list_traces":
             return rt.list_traces(**(payload or {}))
+        if method == "declare_slo":
+            return rt.declare_slo(**(payload or {}))
+        if method == "list_alerts":
+            return rt.list_alerts(**(payload or {}))
+        if method == "list_incidents":
+            return rt.list_incidents(**(payload or {}))
+        if method == "get_incident":
+            return rt.get_incident(**(payload or {}))
         if method == "cluster_logs":
             return rt.cluster_logs(**(payload or {}))
         if method == "session_info":

@@ -336,6 +336,26 @@ class ClientRuntime:
                             "errors_only": errors_only, "limit": limit,
                             "timeout": timeout}, timeout=timeout + 30)
 
+    def declare_slo(self, spec: dict, timeout: float = 10.0) -> dict:
+        return self._call("declare_slo",
+                          {"spec": spec, "timeout": timeout},
+                          timeout=timeout + 30)
+
+    def list_alerts(self, timeout: float = 10.0):
+        return self._call("list_alerts", {"timeout": timeout},
+                          timeout=timeout + 30)
+
+    def list_incidents(self, state: str | None = None, limit: int = 50,
+                       timeout: float = 10.0):
+        return self._call(
+            "list_incidents", {"state": state, "limit": limit,
+                               "timeout": timeout}, timeout=timeout + 30)
+
+    def get_incident(self, incident_id: str, timeout: float = 10.0):
+        return self._call(
+            "get_incident", {"incident_id": incident_id,
+                             "timeout": timeout}, timeout=timeout + 30)
+
     def cluster_logs(self, tail_bytes: int = 16_384,
                      timeout: float = 15.0) -> dict:
         return self._call(

@@ -199,6 +199,14 @@ class HeadService:
             slow_fraction=self.cfg.trace_slow_fraction,
             window=self.cfg.trace_window,
             linger_s=self.cfg.trace_linger_s)
+        # SLO alerting + incident plane: declared objectives evaluated
+        # against the telemetry rings on every heartbeat beat; firing
+        # rules open incidents with evidence snapshotted from the
+        # trace/roofline/gang/ledger planes (PR 20).
+        from .alerting import AlertEngine
+
+        self.alerts = AlertEngine(self.telemetry, traces=self.traces,
+                                  kv=self.kv)
         self._replay()
         self.server = DuplexServer(
             (self.cfg.head_host, port), self._handle_rpc, self._on_disconnect)
@@ -393,6 +401,10 @@ class HeadService:
             return False  # node should re-register (head restarted / expired)
         if telemetry:
             self.telemetry.ingest(node_id.hex(), telemetry)
+            # Alert beat: feed the same samples into the rule windows,
+            # then run every rule's burn-rate state machine.
+            self.alerts.observe(telemetry)
+            self.alerts.evaluate()
         if trace:
             self.traces.ingest(trace)
         old = entry.available
@@ -1014,6 +1026,17 @@ class HeadService:
                                     p.get("min_ms", 0.0),
                                     p.get("errors_only", False),
                                     p.get("limit", 50))
+        if method == "declare_slo":
+            return self.alerts.declare((payload or {}).get("spec"))
+        if method == "list_alerts":
+            return self.alerts.list_alerts()
+        if method == "list_incidents":
+            p = payload or {}
+            return self.alerts.list_incidents(p.get("state"),
+                                              p.get("limit", 50))
+        if method == "get_incident":
+            return self.alerts.get_incident(
+                (payload or {}).get("incident_id"))
         if method == "pubsub_sub":
             return self.pubsub_sub(payload["channel"],
                                    NodeID(payload["node_id"]))
@@ -1180,6 +1203,18 @@ class LocalHeadClient:
                           errors_only=False, limit=50):
         return self.head.traces.list(deployment, min_ms, errors_only, limit)
 
+    async def declare_slo(self, spec):
+        return self.head.alerts.declare(spec)
+
+    async def list_alerts(self):
+        return self.head.alerts.list_alerts()
+
+    async def list_incidents(self, state=None, limit=50):
+        return self.head.alerts.list_incidents(state, limit)
+
+    async def get_incident(self, incident_id):
+        return self.head.alerts.get_incident(incident_id)
+
     async def create_pg(self, pg_id, bundles, strategy):
         pg = await self.head.create_placement_group(pg_id, bundles, strategy)
         return {"state": pg.state}
@@ -1316,6 +1351,23 @@ class RemoteHeadClient:
         return await self._read(
             "list_traces", {"deployment": deployment, "min_ms": min_ms,
                             "errors_only": errors_only, "limit": limit})
+
+    async def declare_slo(self, spec):
+        # A mutation: not retried (an ambiguous timeout must not
+        # double-register a replacement rule mid-redeclare).
+        return await self.conn.call("declare_slo", {"spec": spec},
+                                    timeout=self.MUTATE_TIMEOUT_S)
+
+    async def list_alerts(self):
+        return await self._read("list_alerts", None)
+
+    async def list_incidents(self, state=None, limit=50):
+        return await self._read("list_incidents",
+                                {"state": state, "limit": limit})
+
+    async def get_incident(self, incident_id):
+        return await self._read("get_incident",
+                                {"incident_id": incident_id})
 
     async def create_pg(self, pg_id, bundles, strategy):
         return await self.conn.call(

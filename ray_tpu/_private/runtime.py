@@ -544,6 +544,26 @@ class Runtime:
             self.node.head.list_traces(deployment, min_ms, errors_only,
                                        limit), timeout)
 
+    def declare_slo(self, spec: dict, timeout: float = 10.0) -> dict:
+        """Register (or replace) a head-evaluated SLO alert rule;
+        returns its ``list_alerts`` row."""
+        return self._run(self.node.head.declare_slo(spec), timeout)
+
+    def list_alerts(self, timeout: float = 10.0):
+        """Every declared alert rule with its live burn rates + state."""
+        return self._run(self.node.head.list_alerts(), timeout)
+
+    def list_incidents(self, state: str | None = None, limit: int = 50,
+                       timeout: float = 10.0):
+        """Incident rows, newest first (summaries — evidence via
+        ``get_incident``)."""
+        return self._run(self.node.head.list_incidents(state, limit),
+                         timeout)
+
+    def get_incident(self, incident_id: str, timeout: float = 10.0):
+        """One incident with its full evidence bundle + event log."""
+        return self._run(self.node.head.get_incident(incident_id), timeout)
+
     def head_client(self):
         return self.node.head
 

@@ -146,6 +146,69 @@ class TelemetryStore:
         return rows
 
 
+class GaugeIdleDecay:
+    """THE shared idle-decay clock for cumulative/instantaneous gauges
+    (the PR-10 gauge contract): a series whose producer goes quiet must
+    fall to 0 within ``decay_s`` instead of freezing at its last value
+    forever. Grown ad hoc three times (LLM engine gauges, collective
+    skew, spill counters) before being deduplicated here — and the
+    alert plane uses the same instance semantics so a decayed-to-zero
+    series can never hold a floor alert open.
+
+    Three idioms, one clock per key:
+
+      * ``active(key, signal)`` — signal-change tracking: True while
+        the observed signal keeps changing or changed within the
+        window (spill counters, alert-rule sample liveness);
+      * ``touch(key)`` / ``expired(key)`` — explicit activity marks
+        (the LLM engine touches per busy step; idle ticks ask
+        ``expired`` before zeroing);
+      * ``fresh(ts)`` — stateless timestamp freshness (collective
+        enter-ts gauges carry their own wall clock).
+    """
+
+    def __init__(self, decay_s: float = 10.0):
+        self.decay_s = float(decay_s)
+        self._last: Dict[str, list] = {}   # key -> [signal, last_change_t]
+
+    def active(self, key: str, signal, now: Optional[float] = None) -> bool:
+        now = time.time() if now is None else now
+        cell = self._last.get(key)
+        if cell is None or cell[0] != signal:
+            self._last[key] = [signal, now]
+            return True
+        return now - cell[1] <= self.decay_s
+
+    def touch(self, key: str, now: Optional[float] = None):
+        now = time.time() if now is None else now
+        cell = self._last.get(key)
+        if cell is None:
+            self._last[key] = [None, now]
+        else:
+            cell[1] = now
+
+    def expired(self, key: str, now: Optional[float] = None) -> bool:
+        now = time.time() if now is None else now
+        cell = self._last.get(key)
+        return cell is None or now - cell[1] > self.decay_s
+
+    def fresh(self, ts: float, now: Optional[float] = None) -> bool:
+        now = time.time() if now is None else now
+        return now - ts <= self.decay_s
+
+    def decay(self, key: str, signal, value: float,
+              now: Optional[float] = None) -> float:
+        """``value`` while the signal is live, 0.0 once it idles out."""
+        return value if self.active(key, signal, now) else 0.0
+
+    def rewind(self, key: str, seconds: float):
+        """Age a key's clock (tests fast-forward the window without
+        sleeping through it)."""
+        cell = self._last.get(key)
+        if cell is not None:
+            cell[1] -= seconds
+
+
 class TelemetrySampler:
     """Node-side delta engine: successive calls to ``sample()`` turn the
     node's cumulative counters into per-second rates plus instantaneous
@@ -165,10 +228,10 @@ class TelemetrySampler:
         self._prev_t: Optional[float] = None
         self._prev: Dict[str, float] = {}
         self._store_hw = 0.0
-        # Spill-plane idle decay state: last observed event count and
-        # when it last moved.
-        self._spill_prev_ev = 0.0
-        self._spill_last_t = 0.0
+        # Shared idle-decay clocks (GaugeIdleDecay): spill counters key
+        # on the event-count signal, collectives on their enter-ts.
+        self._spill_decay = GaugeIdleDecay(self.SPILL_DECAY_S)
+        self._coll_decay = GaugeIdleDecay(self.COLLECTIVE_DECAY_S)
 
     def _rate(self, name: str, cum: float, dt: float,
               out: Dict[str, float]):
@@ -261,11 +324,7 @@ class TelemetrySampler:
         if st is not None:
             now = time.time()
             ev = float(st.get("spilled", 0) + st.get("restored", 0))
-            if ev != self._spill_prev_ev:
-                self._spill_prev_ev = ev
-                self._spill_last_t = now
-            active = (ev > 0
-                      and now - self._spill_last_t <= self.SPILL_DECAY_S)
+            active = ev > 0 and self._spill_decay.active("spill", ev, now)
             m["store_spill_events"] = ev if active else 0.0
             m["store_spilled_bytes"] = (
                 float(st.get("spilled_bytes", 0)) if active else 0.0)
@@ -298,6 +357,10 @@ class TelemetrySampler:
         "rtpu_llm_host_gap_ms": ("llm_host_gap_ms", "max"),
         "rtpu_llm_mfu": ("llm_mfu", "max"),
         "rtpu_llm_hbm_util": ("llm_hbm_util", "max"),
+        # Coded roofline verdict (1=compute, 2=hbm, 3=host; 0=idle).
+        # "max" picks the worst-ranked verdict across replicas — the
+        # alert plane's evidence bundle reads the last N points.
+        "rtpu_llm_roofline_verdict": ("llm_roofline_verdict", "max"),
         # Prefix-cache plane (llm/kv_cache.py PrefixPool + chunked
         # admission): hit rate is a cumulative ratio (freshest wins);
         # shared blocks and chunk dispatches sum over replicas.
@@ -446,8 +509,8 @@ class TelemetrySampler:
         now = time.time()
         for g, by_src in coll.items():
             fresh = [d for d in by_src.values()
-                     if now - d.get("rtpu_collective_enter_ts", 0.0)
-                     <= self.COLLECTIVE_DECAY_S]
+                     if self._coll_decay.fresh(
+                         d.get("rtpu_collective_enter_ts", 0.0), now)]
             m[f"collective_latency_ms:{g}"] = max(
                 (d.get("rtpu_collective_latency_ms", 0.0) for d in fresh),
                 default=0.0)

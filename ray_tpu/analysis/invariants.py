@@ -26,6 +26,12 @@ I407  silent batch-inference / spill transition — every batch-inference
       transition means the operator trace or the cross-process spill
       ledger (``stats()`` counters, ``rtpu memory`` spill plane)
       quietly diverges from what actually happened.
+I410  silent alert/incident transition — every alert-engine incident
+      state change (open / resolve / refire) must append to the
+      incident's event log; a silent transition means the on-call's
+      timeline (``rtpu incident show``, the ``slo_breach`` ledger
+      emission) quietly diverges from what the burn-rate evaluator
+      actually decided.
 
 Adding a new invariant lint = appending a row to the right table (or a
 new table + ~10-line checker below). New site families go through this
@@ -243,6 +249,20 @@ PREFIX_POOL_SITE_TABLES = (
     ), "prefix-pool state change emits no event — prefix_stats() and "
        "the kv_cache_hit_rate/kv_shared_blocks series silently diverge "
        "from what the allocator actually shared, split or evicted"),
+)
+
+#: Alert-engine incident state changes that must append to the
+#: incident's event log: open/resolve/refire ARE the pager timeline —
+#: a silent one and `rtpu incident show` (plus the slo_breach ledger
+#: path those methods also drive) lies about when the rule fired.
+ALERT_SITE_TABLES = (
+    ("ray_tpu/_private/alerting.py", "_event", (
+        "_open_incident",     # "open" (evidence snapshotted)
+        "_resolve_incident",  # "resolve" (hysteresis hold satisfied)
+        "_refire",            # "refire" (reopened within dedup window)
+    ), "alert/incident state transition emits no event — the incident "
+       "timeline and the slo_breach/slo_resolved ledger trail silently "
+       "lose the transition the burn-rate evaluator made"),
 )
 
 #: Speculative-decode lifecycle sites that must land in the spec event
@@ -481,4 +501,13 @@ class SilentSpecTransition(_TableChecker):
     family = "invariants"
     severity = "P0"
     tables = SPEC_SITE_TABLES
+    mode = "method_call"
+
+
+@register
+class SilentAlertTransition(_TableChecker):
+    id = "I410"
+    family = "invariants"
+    severity = "P0"
+    tables = ALERT_SITE_TABLES
     mode = "method_call"

@@ -15,6 +15,7 @@ Endpoints:
   /api/jobs            job table (if a JobManager exists)
   /api/tasks           task summary by name/state
   /api/timeseries      head telemetry rings (?metric=&node_id=&resolution=)
+  /api/alerts          SLO alert rules + recent incidents
   /api/traces          retained request-trace summaries (tail-sampled)
   /api/trace/<id>      one trace's spans (the waterfall pane's source)
   /metrics             Prometheus text (same as util.serve_metrics)
@@ -40,6 +41,7 @@ _PAGE = """<!doctype html>
  .pill{padding:.1rem .5rem;border-radius:1rem;font-size:.75rem}
  .ALIVE,.RUNNING,.SUCCEEDED{background:#d6f5d6}.DEAD,.FAILED,.ERROR{background:#fdd}
  .PENDING,.STOPPED{background:#eee}
+ .firing,.open{background:#fdd}.ok,.resolved{background:#d6f5d6}
  #updated{color:#888;font-size:.8rem}
 </style></head><body>
 <h1>ray_tpu dashboard <span id="updated"></span></h1>
@@ -62,6 +64,8 @@ _PAGE = """<!doctype html>
 <h2>Jobs</h2><table id="jobs"></table>
 <h2>Object store</h2><table id="store"></table>
 <h2>Serve</h2><table id="serve"></table>
+<h2>Alerts &amp; incidents</h2><table id="alerts"></table>
+<table id="incidents" style="margin-top:.5rem"></table>
 <h2>Request traces</h2><table id="traces"></table>
 <div id="waterfall" style="font-family:monospace;font-size:.75rem;white-space:pre;background:#fff;padding:.6rem;box-shadow:0 1px 2px #0002;overflow:auto"></div>
 <h2>RPC (top methods)</h2><table id="rpc"></table>
@@ -281,6 +285,20 @@ async function refresh(){
         esc(d.deployment), pill(d.status),
         sv.proxies.map(p=>p.node_id.slice(0,8)+':'+p.port).join(' ')||'-'])).join('')
         : row(['-','-','-','-']));
+    const al = await (await fetch('api/alerts')).json();
+    document.getElementById('alerts').innerHTML =
+      row(['rule','metric','severity','state','fast burn','slow burn'],'th') +
+      (al.alerts.length ? al.alerts.map(x=>row([esc(x.name), esc(x.metric),
+        esc(x.severity), pill(x.state), x.fast_burn_rate,
+        x.slow_burn_rate])).join('')
+        : row(['-','-','-','-','-','-']));
+    document.getElementById('incidents').innerHTML =
+      row(['incident','rule','state','opened','refires','summary'],'th') +
+      (al.incidents.length ? al.incidents.map(x=>row([esc(x.id),
+        esc(x.rule), pill(x.state),
+        new Date(x.opened*1000).toLocaleTimeString(), x.refires||0,
+        esc(x.summary||'')])).join('')
+        : row(['-','-','-','-','-','-']));
     const tr = await (await fetch('api/traces')).json();
     document.getElementById('traces').innerHTML =
       row(['trace','deployment','ms','spans','reason','error'],'th') +
@@ -568,6 +586,19 @@ def _traces() -> dict:
         return {"traces": []}
 
 
+def _alerts() -> dict:
+    """Declared SLO alert rules + recent incidents (the alerting
+    pane's data source)."""
+    from ._private import context as context_mod
+
+    try:
+        rt = context_mod.require_context()
+        return {"alerts": rt.list_alerts(),
+                "incidents": rt.list_incidents(limit=20)}
+    except Exception:  # noqa: BLE001 - old head / alerts unavailable
+        return {"alerts": [], "incidents": []}
+
+
 def _trace_api(trace_id: str) -> dict:
     """One trace's spans, start-sorted, for the waterfall render."""
     from ._private import context as context_mod
@@ -622,6 +653,7 @@ def start_dashboard(port: int = 0, host: str = "127.0.0.1"):
         "/api/rpc": _rpc_stats,
         "/api/serve": _serve_status,
         "/api/traces": _traces,
+        "/api/alerts": _alerts,
         "/api/logs": _logs,
     }
 

@@ -72,6 +72,11 @@ from .kv_cache import PagedKVCache, PrefixPool
 from .sampling import sample, verify_tokens
 from .spec import make_spec
 
+# Roofline verdict -> coded gauge value for the telemetry plane
+# (0 = idle-decayed / no accounted step yet; _private/alerting.py's
+# VERDICT_CODES is the inverse map the evidence bundle uses).
+_VERDICT_CODE = {"compute": 1.0, "hbm": 2.0, "host": 3.0}
+
 # Request states (the event vocabulary).
 WAITING = "WAITING"
 PREFILL = "PREFILL"
@@ -207,6 +212,13 @@ class LLMEngine:
         self._thread: Optional[threading.Thread] = None
         self._stop = False
         self._gauges = None
+        # Shared idle-decay clock (the PR-10 gauge contract, one
+        # implementation for the whole repo): touched per busy publish;
+        # idle ticks keep the last busy values until the window lapses,
+        # then the series fall to zero instead of freezing.
+        from ray_tpu._private.telemetry import GaugeIdleDecay
+
+        self._idle_decay = GaugeIdleDecay()
         self._prefill_chunks = 0      # chunk dispatches (whole=1 chunk)
         self._kv_util_peak = 0.0      # high-water pool utilization
         # Device-step accounting: every step's dispatch->block_until_ready
@@ -889,14 +901,26 @@ class LLMEngine:
                           "Output tokens per verify step per lane "
                           "(1.0 = plain decode, up to k+1)",
                           tag_keys=keys),
+                    Gauge("rtpu_llm_roofline_verdict",
+                          "Coded roofline verdict of the last step "
+                          "(1=compute, 2=hbm, 3=host; 0=idle)",
+                          tag_keys=keys),
                 )
             tags = {"deployment": self.name}
             (tps, util, bsz, step_ms, dev_ms, gap_ms, mfu,
-             hbm, hitr, shared, chunks, s_acc, s_tps) = self._gauges
+             hbm, hitr, shared, chunks, s_acc, s_tps,
+             verd) = self._gauges
+            # Shared idle-decay clock: a busy publish touches it; idle
+            # ticks keep the last busy values until the window lapses,
+            # then every step-derived series reads zero.
+            busy = bool(self._active)
+            if busy:
+                self._idle_decay.touch("gauges")
+            live = busy or not self._idle_decay.expired("gauges")
             tps.set(self.tokens_per_s(), tags=tags)
             util.set(self.kv.utilization(), tags=tags)
             bsz.set(float(len(self._active)), tags=tags)
-            if self._active:
+            if live:
                 hitr.set(self.kv.hit_rate() if self._prefix else 0.0,
                          tags=tags)
                 shared.set(float(self.kv.shared_blocks())
@@ -907,16 +931,16 @@ class LLMEngine:
                 s_tps.set(self._spec.tokens_per_step()
                           if self._spec is not None else 0.0, tags=tags)
             else:
-                # Idle decay, like the step-breakdown series below.
                 hitr.set(0.0, tags=tags)
                 shared.set(0.0, tags=tags)
                 chunks.set(0.0, tags=tags)
                 s_acc.set(0.0, tags=tags)
                 s_tps.set(0.0, tags=tags)
-            perf = self._step_perf.last if self._active else None
+            perf = self._step_perf.last if live else None
             if perf is None:
-                # Idle (or no-work step): the breakdown series decay to
-                # zero with the engine, mirroring tokens_per_s.
+                # Idle past the decay window (or no accounted step
+                # yet): the breakdown series decay to zero with the
+                # engine, mirroring tokens_per_s.
                 perf = {"step_ms": 0.0, "device_ms": 0.0,
                         "host_gap_ms": 0.0, "mfu": 0.0, "hbm_util": 0.0}
             step_ms.set(perf["step_ms"], tags=tags)
@@ -924,6 +948,8 @@ class LLMEngine:
             gap_ms.set(perf["host_gap_ms"], tags=tags)
             mfu.set(perf["mfu"], tags=tags)
             hbm.set(perf["hbm_util"], tags=tags)
+            verd.set(_VERDICT_CODE.get(perf.get("verdict"), 0.0),
+                     tags=tags)
         except Exception:  # noqa: BLE001 - telemetry is best-effort
             pass
 

@@ -278,9 +278,27 @@ def _telemetry_latest(rt) -> dict:
     return out
 
 
+def _alerts_banner():
+    """One-line firing-alerts banner shared by status/top. Best-effort:
+    an old head without the alerts RPC prints nothing."""
+    try:
+        from ray_tpu.util import state
+
+        firing = [a for a in state.list_alerts()
+                  if a.get("state") == "firing"]
+    except Exception:  # noqa: BLE001 - old head / alerts unavailable
+        return
+    if firing:
+        names = ", ".join(f"{a['name']}[{a['severity']}]"
+                          for a in firing[:4])
+        more = f" +{len(firing) - 4} more" if len(firing) > 4 else ""
+        print(f"!! ALERTS FIRING: {names}{more}  (rtpu alerts)")
+
+
 def _print_status(rt):
     from ray_tpu.util import state
 
+    _alerts_banner()
     # Attached drivers (this CLI process included) aren't cluster capacity.
     nodes = state.list_nodes(filters=[("is_driver", "=", False)])
     latest = _telemetry_latest(rt)
@@ -340,6 +358,7 @@ _TOP_COLUMNS = (
 def _print_top(rt):
     from ray_tpu.util import state
 
+    _alerts_banner()
     nodes = state.list_nodes(filters=[("is_driver", "=", False)])
     latest = _telemetry_latest(rt)
     hdr = "node          " + "".join(f"{h:>11}" for h, _, _ in _TOP_COLUMNS)
@@ -975,6 +994,154 @@ def cmd_collectives(args):
               "at step granularity by wrap_step entries)")
 
 
+# Pinned machine-readable shape of `rtpu alerts --json`: scripts and
+# the schema test key on exactly these fields, so head-side additions
+# never silently change the contract.
+_ALERT_FIELDS = ("name", "metric", "target", "comparison", "severity",
+                 "state", "fast_burn_rate", "slow_burn_rate", "since",
+                 "source")
+_INCIDENT_FIELDS = ("id", "rule", "metric", "severity", "state",
+                    "opened", "resolved", "refires", "summary")
+
+
+def _alerts_payload(alerts: list, incidents: list) -> dict:
+    """Build the `rtpu alerts --json` document from head rows. Pure —
+    the pinned-schema test calls it with fabricated rows, no cluster."""
+    return {
+        "version": 1,
+        "alerts": [{k: a.get(k) for k in _ALERT_FIELDS}
+                   for a in alerts],
+        "incidents": [{k: i.get(k) for k in _INCIDENT_FIELDS}
+                      for i in incidents],
+    }
+
+
+def cmd_alerts(args):
+    """Declared SLO alert rules (with live burn rates) + recent
+    incidents."""
+    _attach(args)
+    from ray_tpu.util import state
+
+    alerts = state.list_alerts()
+    incidents = state.list_incidents(limit=args.limit)
+    if args.json:
+        print(json.dumps(_alerts_payload(alerts, incidents), indent=2,
+                         default=str))
+        return
+    if not alerts:
+        print("no SLO alert rules declared (state.declare_slo(...); "
+              "built-in rules register once their metric first appears)")
+    else:
+        print(f"  {'RULE':<26} {'METRIC':<30} {'SEV':<6} {'STATE':<8} "
+              f"{'FAST':>7} {'SLOW':>7}")
+        for a in alerts:
+            mark = "!!" if a["state"] == "firing" else "  "
+            print(f"{mark}{a['name'][:26]:<26} {a['metric'][:30]:<30} "
+                  f"{a['severity']:<6} {a['state']:<8} "
+                  f"{a['fast_burn_rate']:>7.2f} "
+                  f"{a['slow_burn_rate']:>7.2f}")
+    if incidents:
+        print("\nincidents (newest first):")
+        for inc in incidents:
+            ts = time.strftime("%H:%M:%S",
+                               time.localtime(inc["opened"]))
+            refires = (f" refires={inc['refires']}"
+                       if inc.get("refires") else "")
+            print(f"  {inc['id']}  {inc['state']:<9} {ts}  "
+                  f"{inc['rule']}{refires}")
+        print("  (rtpu incident show <id> for the evidence bundle)")
+
+
+def cmd_incident_show(args):
+    """Render one incident with its evidence bundle: metric window,
+    roofline verdicts, gang-doctor verdicts, job-ledger tail, the
+    transition timeline, and the exemplar trace's waterfall — the
+    on-call's first page."""
+    _attach(args)
+    from ray_tpu.util import state
+
+    inc = state.get_incident(args.id)
+    if inc is None:
+        print(f"incident {args.id} not found (the head keeps a bounded "
+              f"store of recent incidents; `rtpu alerts` lists them)")
+        return
+    if args.json:
+        print(json.dumps(inc, indent=2, default=str))
+        return
+    opened = time.strftime("%Y-%m-%d %H:%M:%S",
+                           time.localtime(inc["opened"]))
+    line = (f"incident {inc['id']}  [{inc['state']}]  "
+            f"rule={inc['rule']}  severity={inc['severity']}")
+    print(line)
+    tail = f"opened {opened}"
+    if inc.get("resolved"):
+        tail += "  resolved " + time.strftime(
+            "%H:%M:%S", time.localtime(inc["resolved"]))
+    if inc.get("refires"):
+        tail += f"  refires={inc['refires']}"
+    print(tail)
+    if inc.get("summary"):
+        print(inc["summary"])
+
+    ev = inc.get("evidence") or {}
+    print(f"\nmetric {ev.get('metric', inc.get('metric'))}: "
+          f"latest={ev.get('latest_value')}  "
+          f"burn fast={ev.get('fast_burn_rate')} "
+          f"slow={ev.get('slow_burn_rate')}")
+    for node, pts in sorted((ev.get("window") or {}).items()):
+        if pts:
+            vals = [p[1] for p in pts]
+            print(f"  window[{node[:12]}]: {len(pts)} pts "
+                  f"min={min(vals):g} max={max(vals):g} "
+                  f"last={vals[-1]:g}")
+
+    roof = ev.get("roofline")
+    if roof:
+        verdicts = roof.get("verdicts") or []
+        mfu = roof.get("mfu")
+        print(f"\nroofline (last {len(verdicts)} step(s)): "
+              f"{' '.join(verdicts) if verdicts else '-'}"
+              + (f"  mfu={mfu:.1%}" if isinstance(mfu, float) else ""))
+
+    for gv in ev.get("gang_verdicts") or []:
+        print(f"\ngang verdict [{gv.get('gang', '?')}]: "
+              f"{gv.get('summary', '')}")
+
+    ledger = ev.get("job_ledger") or []
+    if ledger:
+        print("\njob ledger tail:")
+        for e in ledger[-10:]:
+            print(f"  {e.get('ts', 0):.2f}  {e.get('kind', '?'):12s} "
+                  f"{e.get('job_id', '')}  {e.get('tenant', '')}")
+
+    events = inc.get("events") or []
+    if events:
+        print("\ntimeline:")
+        for e in events:
+            ts = time.strftime("%H:%M:%S",
+                               time.localtime(e.get("ts", 0)))
+            extra = {k: v for k, v in e.items()
+                     if k not in ("ts", "kind")}
+            print(f"  {ts}  {e.get('kind', '?'):8s} "
+                  f"{extra if extra else ''}")
+
+    ex = ev.get("exemplar")
+    if ex and ex.get("trace_id"):
+        print(f"\nexemplar trace {ex['trace_id']} "
+              f"({ex.get('duration_ms', 0):.1f}ms"
+              + (", error" if ex.get("error") else "") + "):")
+        try:
+            from ray_tpu.util import tracing
+
+            spans = state.get_trace(ex["trace_id"])
+            if spans:
+                sys.stdout.write(tracing.render_waterfall(spans))
+            else:
+                print("  (trace no longer retained)")
+        except Exception:  # noqa: BLE001 - waterfall render is best-effort
+            print("  (waterfall unavailable)")
+
+
 def cmd_lint(args):
     """Static analysis over the runtime's own source. Needs no cluster."""
     from pathlib import Path
@@ -1187,6 +1354,25 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--tail", type=int, default=20,
                     help="ring entries per process")
     sp.set_defaults(fn=cmd_collectives)
+
+    sp = sub.add_parser(
+        "alerts", help="SLO alert rules + recent incidents")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--json", action="store_true",
+                    help="machine-readable payload (pinned schema)")
+    sp.add_argument("--limit", type=int, default=20,
+                    help="incidents to list")
+    sp.set_defaults(fn=cmd_alerts)
+
+    ip = sub.add_parser("incident", help="incident inspection")
+    isub = ip.add_subparsers(dest="incident_cmd", required=True)
+    sp = isub.add_parser(
+        "show", help="one incident with its attached evidence "
+                     "(waterfall, roofline, gang verdicts, ledger)")
+    sp.add_argument("id")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_incident_show)
 
     sp = sub.add_parser("memory", help="object store usage summary")
     sp.add_argument("--address", default=None)

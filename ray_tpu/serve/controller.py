@@ -24,6 +24,7 @@ from typing import Any, Optional
 
 import cloudpickle
 
+from . import slo
 from .deployment import (Application, AutoscalingConfig, Deployment,
                          DeploymentHandle)
 from .replica import Replica
@@ -202,6 +203,17 @@ class ServeController:
         else:
             state.deployment = d
             state.target_replicas = target
+            # Redeploy: SLO cells/exemplars recorded against the
+            # previous version must not survive into the new one (a
+            # stale exemplar trace_id would point at code that no
+            # longer runs). Prune this process now; replicas/proxies
+            # prune via the collected RPCs (gathered OUTSIDE the lock,
+            # same as reconfigure).
+            slo.prune_deployment(d.name)
+            reconfigs.extend(r.prune_slo.remote(d.name)
+                             for r in state.replicas)
+            reconfigs.extend(p.prune_slo.remote(d.name)
+                             for p in self._proxies.values())
             if d.user_config is not None:
                 reconfigs.extend(r.reconfigure.remote(d.user_config)
                                  for r in state.replicas)
@@ -530,6 +542,11 @@ class ServeController:
                         ray_tpu.kill(r)
                     except Exception:  # lint: allow-swallow(best-effort shutdown)
                         pass
+            # Deleted deployments must not leave SLO exemplars behind
+            # in this (controller) process — in local mode it is the
+            # same interpreter the next deployment records into.
+            for name in self._deployments:
+                slo.prune_deployment(name)
             self._deployments.clear()
             self._apps.clear()
             self._routes.clear()

@@ -286,6 +286,15 @@ class HTTPProxy:
         self.routes = {p.rstrip("/") or "/": tuple(v)
                        for p, v in routes.items()}
 
+    def prune_slo(self, deployment: str):
+        """Controller broadcast on redeploy/teardown: proxies outlive
+        deployments, so their SLO cells/exemplars for a dead deployment
+        must be dropped explicitly."""
+        from . import slo
+
+        slo.prune_deployment(deployment)
+        return True
+
     def resolve(self, path: str) -> Optional[tuple]:
         path = path.split("?")[0].rstrip("/") or "/"
         best = None

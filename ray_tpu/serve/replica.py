@@ -70,6 +70,13 @@ class Replica:
             fn(user_config)
         return True
 
+    def prune_slo(self, deployment: str):
+        """Controller broadcast on redeploy: drop this process's SLO
+        cells/exemplars for the previous code version, so a stale
+        exemplar trace_id is never reported against the new one."""
+        slo.prune_deployment(deployment)
+        return True
+
     def handle_request(self, method: str, args: tuple, kwargs: dict,
                        multiplexed_model_id: str = "",
                        submit_ts: float = 0.0,

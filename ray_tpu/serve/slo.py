@@ -243,6 +243,21 @@ def latency_summary(merged: dict) -> dict:
     return out
 
 
+def prune_deployment(deployment: str):
+    """Drop this process's histogram cells AND exemplars for a deleted
+    or redeployed deployment. Without this the module-global
+    ``_exemplars`` keeps entries for dead deployments forever, and a
+    stale exemplar trace_id (from code that no longer runs) can be
+    reported as the root cause of a fresh p99 — the controller calls
+    this locally and broadcasts it to live replicas/proxies on
+    redeploy and teardown."""
+    with _lock:
+        for key in [k for k in _local if k[0] == deployment]:
+            del _local[key]
+        for key in [k for k in _exemplars if k[0] == deployment]:
+            del _exemplars[key]
+
+
 def _reset_for_tests():
     global _deployment, _proxy_inflight
     with _lock:

@@ -183,6 +183,22 @@ def prometheus_text() -> str:
                            f"{ex['ms']}")
     except Exception:  # noqa: BLE001 - export must not fail the page
         pass
+
+    # -- alerting plane: one series per declared SLO rule, 1.0 while
+    # firing. A scraper-side `rtpu_alert_firing == 1` expression mirrors
+    # the head's own burn-rate decision instead of recomputing it.
+    # Best-effort: an old head without the alerts RPC skips the section.
+    try:
+        rules = rt.list_alerts()
+        if rules:
+            emit_meta("rtpu_alert_firing", "gauge",
+                      "1 while the named SLO alert rule is firing")
+            for r in rules:
+                tags = {"rule": r["name"], "severity": r["severity"]}
+                val = 1.0 if r.get("state") == "firing" else 0.0
+                out.append(f"rtpu_alert_firing{_fmt_tags(tags)} {val}")
+    except Exception:  # noqa: BLE001 - export must not fail the page
+        pass
     return "\n".join(out) + "\n"
 
 

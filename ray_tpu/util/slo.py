@@ -1,0 +1,206 @@
+"""Declarative SLO objectives + multi-window multi-burn-rate math.
+
+This module is the PURE half of the alerting plane (PR 20): no
+telemetry, no threads, no clocks it did not receive — every function
+takes explicit timestamps so the burn-rate unit tests can hand-compute
+window numbers. The head-side half that wires these objects to the
+``TelemetryStore`` rings, opens incidents and attaches evidence lives
+in ``ray_tpu/_private/alerting.py``.
+
+The alerting policy is the Google-SRE multi-window multi-burn-rate
+recipe:
+
+  * every observed sample either violates the objective or it doesn't;
+  * the *burn rate* over a window is the violating fraction divided by
+    the objective's error budget (burn 1.0 = exactly spending the
+    budget; burn 14.4 = spending a 30-day budget in ~2 days);
+  * a rule FIRES only when the burn rate is high in BOTH a fast window
+    (pages quickly) and a slow window (confirms it is sustained) — one
+    slow request never pages, a sustained breach always does;
+  * a firing rule RESOLVES with hysteresis: both windows must sit
+    below ``resolve_burn`` continuously for ``resolve_hold_s`` before
+    the alert clears, so a flapping series cannot open a new incident
+    per oscillation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class SLOObjective:
+    """A declared service-level objective over one telemetry series.
+
+    ``comparison`` gives the GOOD direction: ``"<="`` for latency-style
+    ceilings (a sample above ``target`` violates), ``">="`` for
+    floor-style objectives like MFU or accept-rate (a sample below
+    ``target`` violates). ``budget`` is the tolerated violating
+    fraction (0.01 = 99% of samples must be good).
+    """
+
+    name: str
+    metric: str
+    target: float
+    comparison: str = "<="          # "<=" ceiling | ">=" floor
+    budget: float = 0.01
+    severity: str = "page"          # "page" | "ticket"
+    description: str = ""
+
+    def __post_init__(self):
+        if self.comparison not in ("<=", ">="):
+            raise ValueError(
+                f"comparison must be '<=' or '>=', got {self.comparison!r}")
+        if not 0.0 < self.budget <= 1.0:
+            raise ValueError(f"budget must be in (0, 1], got {self.budget}")
+
+    def violated(self, value: float) -> bool:
+        if self.comparison == "<=":
+            return value > self.target
+        return value < self.target
+
+
+@dataclass
+class BurnRatePolicy:
+    """Window shapes + thresholds for one rule. Defaults follow the
+    SRE-workbook 2%/5% budget-spend pairing, scaled to this repo's
+    second-resolution rings rather than 30-day months."""
+
+    fast_window_s: float = 60.0
+    slow_window_s: float = 300.0
+    fast_burn: float = 14.4
+    slow_burn: float = 6.0
+    resolve_burn: float = 1.0
+    resolve_hold_s: float = 60.0
+    # A fire needs at least this many samples in the slow window —
+    # the "one slow request never pages" guard when a series is young.
+    min_points: int = 4
+
+
+@dataclass
+class MultiWindowBurnRate:
+    """The per-rule state machine: ``ok`` <-> ``firing``.
+
+    ``add()`` feeds a sample into both windows; ``evaluate(now)``
+    returns the transition that just happened — ``"fire"``,
+    ``"resolve"`` or ``None`` — and updates ``state``.
+
+    Every sample enters both windows and the fast window is a suffix
+    of the slow one, so both share ONE parallel (ts, violating) buffer
+    with a head cursor per window. On the head's per-beat hot path a
+    sample costs two list appends and two amortized cursor advances —
+    each sample is passed exactly once per cursor, and a compaction
+    drops the dead prefix once the slow cursor runs far enough ahead,
+    keeping memory bounded even if ``evaluate`` is never called.
+    """
+
+    objective: SLOObjective
+    policy: BurnRatePolicy = field(default_factory=BurnRatePolicy)
+
+    _COMPACT_AT = 512   # dead head entries tolerated before compaction
+
+    def __post_init__(self):
+        obj, pol = self.objective, self.policy
+        self._ceil = obj.comparison == "<="
+        self._target = obj.target
+        self._fast_s = pol.fast_window_s
+        self._slow_s = pol.slow_window_s
+        self._ts: List[float] = []
+        self._viol: List[bool] = []
+        self._f0 = 0             # first index inside the fast window
+        self._s0 = 0             # first index inside the slow window
+        self.fast_bad = 0
+        self.slow_bad = 0
+        self.state = "ok"
+        self._below_since: Optional[float] = None
+        self.fast_burn_rate = 0.0
+        self.slow_burn_rate = 0.0
+
+    @property
+    def fast_total(self) -> int:
+        return len(self._ts) - self._f0
+
+    @property
+    def slow_total(self) -> int:
+        return len(self._ts) - self._s0
+
+    def add(self, ts: float, value: float):
+        violating = value > self._target if self._ceil \
+            else value < self._target
+        tsl = self._ts
+        vl = self._viol
+        tsl.append(ts)
+        vl.append(violating)
+        if violating:
+            self.fast_bad += 1
+            self.slow_bad += 1
+        # The just-appended sample sits inside both of its own windows,
+        # so neither cursor can run off the end here.
+        f0 = self._f0
+        horizon = ts - self._fast_s
+        while tsl[f0] < horizon:
+            if vl[f0]:
+                self.fast_bad -= 1
+            f0 += 1
+        s0 = self._s0
+        horizon = ts - self._slow_s
+        while tsl[s0] < horizon:
+            if vl[s0]:
+                self.slow_bad -= 1
+            s0 += 1
+        if s0 >= self._COMPACT_AT:
+            del tsl[:s0]
+            del vl[:s0]
+            f0 -= s0
+            s0 = 0
+        self._f0 = f0
+        self._s0 = s0
+
+    def _expire(self, now: float):
+        tsl, vl = self._ts, self._viol
+        n = len(tsl)
+        f0 = self._f0
+        horizon = now - self._fast_s
+        while f0 < n and tsl[f0] < horizon:
+            if vl[f0]:
+                self.fast_bad -= 1
+            f0 += 1
+        self._f0 = f0
+        s0 = self._s0
+        horizon = now - self._slow_s
+        while s0 < n and tsl[s0] < horizon:
+            if vl[s0]:
+                self.slow_bad -= 1
+            s0 += 1
+        self._s0 = s0
+
+    def evaluate(self, now: float) -> Optional[str]:
+        self._expire(now)
+        budget = self.objective.budget
+        ft = len(self._ts) - self._f0
+        st = len(self._ts) - self._s0
+        self.fast_burn_rate = (self.fast_bad / ft) / budget if ft else 0.0
+        self.slow_burn_rate = (self.slow_bad / st) / budget if st else 0.0
+        pol = self.policy
+        if self.state == "ok":
+            if (st >= pol.min_points
+                    and self.fast_burn_rate >= pol.fast_burn
+                    and self.slow_burn_rate >= pol.slow_burn):
+                self.state = "firing"
+                self._below_since = None
+                return "fire"
+            return None
+        # firing: hysteresis — BOTH windows must hold below resolve_burn
+        # for resolve_hold_s continuously before the alert clears.
+        if (self.fast_burn_rate < pol.resolve_burn
+                and self.slow_burn_rate < pol.resolve_burn):
+            if self._below_since is None:
+                self._below_since = now
+            if now - self._below_since >= pol.resolve_hold_s:
+                self.state = "ok"
+                self._below_since = None
+                return "resolve"
+        else:
+            self._below_since = None
+        return None

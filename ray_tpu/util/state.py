@@ -303,6 +303,43 @@ def list_traces(deployment: Optional[str] = None, min_ms: float = 0.0,
                                                errors_only, limit)
 
 
+def declare_slo(spec: dict) -> dict:
+    """Register (or replace, by ``name``) a head-evaluated SLO alert
+    rule. ``spec`` keys: ``name``, ``metric`` (a head timeseries name,
+    e.g. ``serve_p95_ms:llm:ttft``), ``target``, ``comparison``
+    (``"<="`` ceiling / ``">="`` floor), ``budget`` (tolerated
+    violating fraction), ``severity`` (``page``/``ticket``),
+    ``description``, plus burn-rate policy overrides
+    (``fast_window_s``, ``slow_window_s``, ``fast_burn``,
+    ``slow_burn``, ``resolve_burn``, ``resolve_hold_s``,
+    ``min_points``). Returns the rule's ``list_alerts`` row."""
+    return _runtime("declare_slo").declare_slo(spec)
+
+
+def list_alerts() -> list:
+    """Every declared alert rule (user + auto-registered builtins) with
+    live state: ``{"name", "metric", "target", "comparison",
+    "severity", "state" (ok|firing), "fast_burn_rate",
+    "slow_burn_rate", "since", "source"}``."""
+    return _runtime("list_alerts").list_alerts()
+
+
+def list_incidents(state: Optional[str] = None, limit: int = 50) -> list:
+    """Incident rows, newest first: ``{"id", "rule", "metric",
+    "severity", "state" (open|resolved), "opened", "resolved",
+    "refires", "summary"}``. Evidence bundles via ``get_incident``."""
+    return _runtime("list_incidents").list_incidents(state, limit)
+
+
+def get_incident(incident_id: str) -> Optional[dict]:
+    """One incident with its evidence bundle (exemplar trace_id,
+    roofline verdicts, gang-doctor verdicts, job-ledger tail, the
+    breached metric's timeseries window) and its own transition event
+    log. None for an unknown id (or one evicted from the bounded
+    store)."""
+    return _runtime("get_incident").get_incident(incident_id)
+
+
 def timeline(filename: Optional[str] = None) -> Any:
     """Dump task execution as a chrome-tracing JSON (load in
     chrome://tracing or Perfetto). Returns the event list, and writes it

@@ -466,6 +466,42 @@ def test_i402_missing_file_is_a_finding(tmp_path):
     assert "missing" in rep.findings[0].message
 
 
+def test_i410_catches_a_silent_alert_transition(tmp_path):
+    # Same driver as I402, aimed at the alert engine: an incident
+    # open/resolve/refire that never appends to the incident's event
+    # log is exactly the silent-pager-timeline bug class.
+    tables = (("eng.py", "_event",
+               ("_open_incident", "_resolve_incident", "_refire"),
+               "why"),)
+    rep = lint(tmp_path, {"eng.py": """\
+        class Engine:
+            def _open_incident(self, st, now):
+                self._event(st, "open", now)
+
+            def _resolve_incident(self, st, now):
+                st.state = "resolved"
+
+            def _refire(self, st, inc, now):
+                self._event(inc, "refire", now)
+        """}, select="I410", config={"I410_tables": tables})
+    assert [f.symbol for f in rep.findings] == ["_resolve_incident"]
+    assert all(f.severity == "P0" for f in rep.findings)
+
+
+def test_i410_real_table_names_live_sites():
+    # The shipped table must point at methods that actually exist in
+    # ray_tpu/_private/alerting.py — run the checker against the real
+    # repo subtree and require zero findings.
+    from pathlib import Path
+
+    import ray_tpu as _pkg
+
+    root = Path(_pkg.__file__).resolve().parent.parent
+    rep = run_lint(root, paths=["ray_tpu/_private/alerting.py"],
+                   select="I410", use_baseline=False)
+    assert not rep.findings, [f.message for f in rep.findings]
+
+
 def test_i403_catches_a_gaugeless_queue_mutation(tmp_path):
     tables = (("svc.py", "_gauge_queues", ("enq", "deq"), "why"),)
     rep = lint(tmp_path, {"svc.py": """\

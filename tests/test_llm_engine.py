@@ -329,6 +329,11 @@ def test_idle_engine_decays_perf_gauges_to_zero():
         deadline = time.monotonic() + 10
         rows = {}
         while time.monotonic() < deadline:
+            # The shared GaugeIdleDecay helper holds the last busy
+            # values for decay_s before zeroing; age its clock instead
+            # of sleeping through the window (any still-busy publish
+            # re-touches it, so rewind per poll).
+            eng._idle_decay.rewind("gauges", eng._idle_decay.decay_s + 1)
             rows = perf_rows()
             if rows and all(v == 0.0 for v in rows.values()):
                 break

@@ -421,3 +421,36 @@ def test_solo_cross_node_fetch_gate():
     assert row["per_s"] > floor, (
         f"cross-node fetch regression: {row['per_s']:.1f} MB/s < "
         f"scaled floor {floor:.1f} (calibration {cal:.2f})")
+
+
+def test_alert_rule_evaluation_gate():
+    """The head's per-beat alert pass (observe one node's sampler beat
+    + run every rule's burn-rate state machine) rides the heartbeat
+    path — at 50 declared rules all receiving samples it must stay
+    under 100us per beat, scaled like every other floor."""
+    from ray_tpu._private.alerting import AlertEngine
+    from ray_tpu._private.telemetry import TelemetryStore
+
+    cal = _calibrate()
+    eng = AlertEngine(TelemetryStore())
+    for i in range(50):
+        eng.declare({"name": f"gate-rule-{i}",
+                     "metric": f"alert_gate_m{i}",
+                     "target": 10.0, "comparison": "<=",
+                     "budget": 0.01})
+    metrics = {f"alert_gate_m{i}": 1.0 for i in range(50)}
+    # Warm one beat: window deques allocate, builtin probing settles.
+    eng.observe([{"ts": time.time(), "metrics": metrics}])
+    eng.evaluate()
+    n = 500
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ts = time.time()
+        eng.observe([{"ts": ts, "metrics": metrics}])
+        eng.evaluate()
+    per_beat = (time.perf_counter() - t0) / n
+    budget = 100e-6 / cal
+    assert per_beat < budget, (
+        f"alert evaluation hot path regressed: {per_beat * 1e6:.1f}us "
+        f"per beat at 50 rules > budget {budget * 1e6:.1f}us "
+        f"(calibration {cal:.2f})")

@@ -488,7 +488,7 @@ def test_spill_series_sampled_with_idle_decay(rt):
     assert m["store_restored_bytes"] == 1024.0
 
     # No new events for longer than the decay window -> back to 0.
-    sampler._spill_last_t -= sampler.SPILL_DECAY_S + 1
+    sampler._spill_decay.rewind("spill", sampler.SPILL_DECAY_S + 1)
     m = sampler.sample()["metrics"]
     assert m["store_spill_events"] == 0.0
     assert m["store_spilled_bytes"] == 0.0
